@@ -1,0 +1,16 @@
+"""Force an 8-device virtual CPU mesh for all tests.
+
+Multi-chip sharding is validated on virtual CPU devices
+(xla_force_host_platform_device_count) since the dev box has one real chip.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

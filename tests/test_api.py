@@ -1,0 +1,155 @@
+"""API-layer tests: quantities, durations, naming parity, conditions, hashing,
+YAML loading of reference-format manifests."""
+
+import pathlib
+
+import pytest
+
+from grove_tpu.api import names
+from grove_tpu.api.hashing import compute_pcs_generation_hash, compute_pod_template_hash
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.meta import Condition, parse_quantity, set_condition
+from grove_tpu.api.topology import (
+    ClusterTopology,
+    broader_than,
+    narrower_than,
+)
+from grove_tpu.api.types import parse_duration
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+class TestQuantity:
+    def test_plain(self):
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity(3) == 3.0
+
+    def test_milli(self):
+        assert parse_quantity("10m") == pytest.approx(0.01)
+
+    def test_binary(self):
+        assert parse_quantity("4Gi") == 4 * 2**30
+        assert parse_quantity("150Mi") == 150 * 2**20
+
+    def test_decimal(self):
+        assert parse_quantity("1k") == 1000.0
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1Xi")
+
+
+class TestDuration:
+    def test_hours(self):
+        assert parse_duration("4h") == 4 * 3600
+
+    def test_combo(self):
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration("10s") == 10
+        assert parse_duration("500ms") == 0.5
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_duration("4hours")
+
+
+class TestNamegen:
+    """Naming parity with reference operator/api/common/namegen.go."""
+
+    def test_children(self):
+        assert names.podclique_name("simple1", 0, "pca") == "simple1-0-pca"
+        assert names.pcsg_name("simple1", 0, "sga") == "simple1-0-sga"
+        assert names.podclique_name("simple1-0-sga", 1, "pcb") == "simple1-0-sga-1-pcb"
+        assert names.headless_service_name("simple1", 2) == "simple1-2"
+        assert (
+            names.headless_service_address("simple1", 0, "default")
+            == "simple1-0.default.svc.cluster.local"
+        )
+        assert names.pod_role_name("simple1") == "grove.io:pcs:simple1"
+        assert (
+            names.initc_sa_token_secret_name("simple1")
+            == "simple1-initc-sa-token-secret"
+        )
+
+    def test_base_vs_scaled_podgang_split(self):
+        """namegen.go:100-118: PCSG replicas < minAvailable go to the base
+        gang; others get 0-based scaled gangs."""
+        fqn = names.pcsg_name("simple1", 0, "sga")
+        got = [
+            names.podgang_name_for_pcsg_replica("simple1", 0, fqn, r, 2)
+            for r in range(4)
+        ]
+        assert got == ["simple1-0", "simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"]
+
+    def test_extract_sg_name(self):
+        assert (
+            names.extract_sg_name_from_pcsg_fqn("simple1-0-sga", "simple1", 0) == "sga"
+        )
+
+
+class TestConditions:
+    def test_transition_time_only_on_status_change(self):
+        conds = []
+        set_condition(conds, Condition("Ready", "False", "init"), now=1.0)
+        assert conds[0].last_transition_time == 1.0
+        set_condition(conds, Condition("Ready", "False", "other"), now=2.0)
+        assert conds[0].last_transition_time == 1.0  # status unchanged
+        assert conds[0].reason == "other"
+        set_condition(conds, Condition("Ready", "True", "up"), now=3.0)
+        assert conds[0].last_transition_time == 3.0
+
+
+class TestTopology:
+    def test_order(self):
+        assert broader_than("zone", "slice")
+        assert narrower_than("ici-block", "slice")
+        assert broader_than("slice", "host")
+
+    def test_translate(self):
+        topo = ClusterTopology()
+        assert topo.translate_pack_domain("slice") == "cloud.google.com/gke-tpu-slice"
+        assert topo.translate_pack_domain(None) is None
+        with pytest.raises(KeyError):
+            topo.translate_pack_domain("rack")  # not in the TPU default levels
+        assert topo.narrowest_key() == "kubernetes.io/hostname"
+
+
+class TestYamlLoad:
+    def test_simple1(self):
+        pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        assert pcs.metadata.name == "simple1"
+        assert pcs.spec.replicas == 1
+        tmpl = pcs.spec.template
+        assert [c.name for c in tmpl.cliques] == ["pca", "pcb", "pcc", "pcd"]
+        assert tmpl.cliques[0].spec.auto_scaling_config.max_replicas == 5
+        assert tmpl.cliques[0].spec.pod_spec.containers[0].requests["cpu"] == (
+            pytest.approx(0.01)
+        )
+        assert len(tmpl.pod_clique_scaling_group_configs) == 1
+        sg = tmpl.pod_clique_scaling_group_configs[0]
+        assert sg.name == "sga" and sg.clique_names == ["pcb", "pcc"]
+        assert [c.name for c in tmpl.standalone_clique_templates()] == ["pca", "pcd"]
+
+
+class TestHashing:
+    def test_generation_hash_stable_and_sensitive(self):
+        pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        h1 = compute_pcs_generation_hash(pcs)
+        h2 = compute_pcs_generation_hash(
+            load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        )
+        assert h1 == h2
+        pcs.spec.template.cliques[0].spec.pod_spec.containers[0].image = "other:img"
+        assert compute_pcs_generation_hash(pcs) != h1
+        # replica-count change does NOT change the template hash (scaling is
+        # not a rolling update)
+        pcs2 = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        pcs2.spec.replicas = 3
+        assert compute_pcs_generation_hash(pcs2) == h1
+
+    def test_pod_template_hash(self):
+        pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        h = compute_pod_template_hash(pcs.spec.template.cliques[0])
+        assert len(h) == 16
