@@ -1,0 +1,311 @@
+"""Admission tests — rule tables modeled on the reference webhook test suites
+(pcs/defaulting/podcliqueset_test.go, pcs/validation/podcliqueset_test.go)."""
+
+import copy
+import pathlib
+
+import pytest
+
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import (
+    PodCliqueDependencyGraph,
+    validate_cluster_topology,
+    validate_podcliqueset,
+    validate_podcliqueset_update,
+)
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.topology import ClusterTopology, TopologyLevel
+from grove_tpu.api.types import (
+    STARTUP_ANY_ORDER,
+    STARTUP_EXPLICIT,
+    STARTUP_IN_ORDER,
+    AutoScalingConfig,
+    TopologyConstraint,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def make_pcs(**overrides):
+    pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+    for k, v in overrides.items():
+        setattr(pcs, k, v)
+    return pcs
+
+
+def defaulted_pcs():
+    return default_podcliqueset(make_pcs())
+
+
+class TestDefaulting:
+    def test_defaults_applied(self):
+        pcs = defaulted_pcs()
+        tmpl = pcs.spec.template
+        assert tmpl.startup_type == STARTUP_ANY_ORDER
+        assert tmpl.termination_delay == 4 * 3600
+        assert tmpl.headless_service_config.publish_not_ready_addresses is True
+        for clique in tmpl.cliques:
+            assert clique.spec.min_available == clique.spec.replicas
+            assert clique.spec.pod_spec.restart_policy == "Always"
+            assert (
+                clique.spec.pod_spec.extra["terminationGracePeriodSeconds"] == 30
+            )
+        # pca has autoscaling: minReplicas defaults to replicas (3)
+        assert tmpl.cliques[0].spec.auto_scaling_config.min_replicas == 3
+        sg = tmpl.pod_clique_scaling_group_configs[0]
+        assert sg.replicas == 1 and sg.min_available == 1
+        assert sg.scale_config.min_replicas == 1
+
+    def test_existing_values_kept(self):
+        pcs = make_pcs()
+        pcs.spec.template.termination_delay = 60.0
+        pcs.spec.template.cliques[1].spec.min_available = 1
+        default_podcliqueset(pcs)
+        assert pcs.spec.template.termination_delay == 60.0
+        assert pcs.spec.template.cliques[1].spec.min_available == 1
+
+
+class TestValidationCreate:
+    def test_valid(self):
+        res = validate_podcliqueset(defaulted_pcs())
+        assert res.ok, res.errors
+
+    def test_duplicate_clique_names(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[1].name = "pca"
+        res = validate_podcliqueset(pcs)
+        assert any("unique" in e for e in res.errors)
+
+    def test_minavailable_exceeds_replicas(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[0].spec.min_available = 10
+        res = validate_podcliqueset(pcs)
+        assert any("minAvailable must not be greater than replicas" in e for e in res.errors)
+
+    def test_sg_member_with_own_autoscaler_rejected(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[1].spec.auto_scaling_config = AutoScalingConfig(
+            max_replicas=4, min_replicas=2
+        )
+        res = validate_podcliqueset(pcs)
+        assert any("part of" in e and "scaling group" in e for e in res.errors)
+
+    def test_overlapping_scaling_groups(self):
+        pcs = make_pcs()
+        cfg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+        other = copy.deepcopy(cfg)
+        other.name = "sgb"
+        other.clique_names = ["pcc", "pcd"]
+        pcs.spec.template.pod_clique_scaling_group_configs.append(other)
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert any("overlap" in e for e in res.errors)
+
+    def test_unknown_sg_clique(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].clique_names = ["nope"]
+        res = validate_podcliqueset(pcs)
+        assert any("unidentified" in e for e in res.errors)
+
+    def test_scaleconfig_minreplicas_below_minavailable(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[0].spec.auto_scaling_config.min_replicas = 1
+        pcs.spec.template.cliques[0].spec.min_available = 2
+        res = validate_podcliqueset(pcs)
+        assert any("greater than or equal to minAvailable" in e for e in res.errors)
+
+    def test_termination_delay_positive(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.termination_delay = 0
+        res = validate_podcliqueset(pcs)
+        assert any("terminationDelay" in e for e in res.errors)
+
+    def test_bad_startup_type(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.startup_type = "Bogus"
+        res = validate_podcliqueset(pcs)
+        assert any("cliqueStartupType" in e for e in res.errors)
+
+    def test_cycle_detection(self):
+        pcs = make_pcs()
+        tmpl = pcs.spec.template
+        tmpl.startup_type = STARTUP_EXPLICIT
+        tmpl.cliques[0].spec.starts_after = ["pcd"]
+        tmpl.cliques[3].spec.starts_after = ["pca"]
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert any("circular" in e for e in res.errors)
+
+    def test_self_dependency(self):
+        pcs = make_pcs()
+        tmpl = pcs.spec.template
+        tmpl.startup_type = STARTUP_EXPLICIT
+        tmpl.cliques[0].spec.starts_after = ["pca"]
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert any("refer to itself" in e for e in res.errors)
+
+    def test_unknown_dependency(self):
+        pcs = make_pcs()
+        tmpl = pcs.spec.template
+        tmpl.startup_type = STARTUP_EXPLICIT
+        tmpl.cliques[0].spec.starts_after = ["ghost"]
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert any("unknown cliques" in e for e in res.errors)
+
+    def test_inorder_ignores_starts_after(self):
+        """podcliqueset.go:143-145 — DAG validation is Explicit-only; InOrder
+        derives the chain from declaration order."""
+        pcs = make_pcs()
+        tmpl = pcs.spec.template
+        tmpl.startup_type = STARTUP_IN_ORDER
+        tmpl.cliques[0].spec.starts_after = ["ghost"]
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert res.ok, res.errors
+
+    def test_sg_member_constraint_checked_against_group(self):
+        pcs = defaulted_pcs()
+        sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+        sg.topology_constraint = TopologyConstraint(pack_domain="ici-block")
+        # member pcb demands broader 'slice' than its group's 'ici-block'
+        pcs.spec.template.cliques[1].topology_constraint = TopologyConstraint(
+            pack_domain="slice"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("stricter" in e for e in res.errors)
+
+    def test_valid_dag_accepted(self):
+        pcs = make_pcs()
+        tmpl = pcs.spec.template
+        tmpl.startup_type = STARTUP_EXPLICIT
+        tmpl.cliques[1].spec.starts_after = ["pca"]
+        tmpl.cliques[2].spec.starts_after = ["pca", "pcb"]
+        default_podcliqueset(pcs)
+        res = validate_podcliqueset(pcs)
+        assert res.ok, res.errors
+
+    def test_name_budget(self):
+        pcs = defaulted_pcs()
+        pcs.metadata.name = "x" * 60
+        res = validate_podcliqueset(pcs)
+        assert any("exceeds" in e for e in res.errors)
+
+    def test_topology_constraint_validation(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(pack_domain="slice")
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert res.ok, res.errors
+        # child broader than parent → rejected
+        pcs.spec.template.cliques[0].topology_constraint = TopologyConstraint(
+            pack_domain="zone"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("stricter" in e for e in res.errors)
+
+    def test_forbidden_podspec_fields(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[0].spec.pod_spec.extra["nodeName"] = "n1"
+        res = validate_podcliqueset(pcs)
+        assert any("nodeName" in e for e in res.errors)
+
+
+class TestValidationUpdate:
+    def test_allowed_update(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.replicas = 3
+        new.spec.template.cliques[0].spec.pod_spec.containers[0].image = "new:img"
+        res = validate_podcliqueset_update(new, old)
+        assert res.ok, res.errors
+
+    def test_startup_type_immutable(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.template.startup_type = STARTUP_IN_ORDER
+        res = validate_podcliqueset_update(new, old)
+        assert any("cliqueStartupType" in e for e in res.errors)
+
+    def test_clique_composition_immutable(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.template.cliques[0].name = "renamed"
+        res = validate_podcliqueset_update(new, old)
+        assert any("composition" in e for e in res.errors)
+
+    def test_min_available_immutable(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.template.cliques[0].spec.min_available = 1
+        res = validate_podcliqueset_update(new, old)
+        assert any("minAvailable" in e for e in res.errors)
+
+    def test_clique_order_immutable_when_inorder(self):
+        old = defaulted_pcs()
+        old.spec.template.startup_type = STARTUP_IN_ORDER
+        new = copy.deepcopy(old)
+        new.spec.template.cliques = [
+            new.spec.template.cliques[1],
+            new.spec.template.cliques[0],
+        ] + new.spec.template.cliques[2:]
+        res = validate_podcliqueset_update(new, old)
+        assert any("order cannot be changed" in e for e in res.errors)
+
+    def test_sg_clique_names_immutable(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.template.pod_clique_scaling_group_configs[0].clique_names = ["pcb"]
+        res = validate_podcliqueset_update(new, old)
+        assert any("cliqueNames" in e for e in res.errors)
+
+
+class TestTarjan:
+    def test_finds_cycle(self):
+        g = PodCliqueDependencyGraph()
+        g.add_dependencies("a", ["b"])
+        g.add_dependencies("b", ["c"])
+        g.add_dependencies("c", ["a"])
+        g.add_dependencies("d", ["a"])
+        assert g.strongly_connected_cliques() == [["a", "b", "c"]]
+
+    def test_dag_clean(self):
+        g = PodCliqueDependencyGraph()
+        g.add_dependencies("a", [])
+        g.add_dependencies("b", ["a"])
+        g.add_dependencies("c", ["a", "b"])
+        assert g.strongly_connected_cliques() == []
+
+    def test_self_loop(self):
+        g = PodCliqueDependencyGraph()
+        g.add_dependencies("a", ["a"])
+        assert g.strongly_connected_cliques() == [["a"]]
+
+
+class TestClusterTopologyValidation:
+    def test_default_valid(self):
+        assert validate_cluster_topology(ClusterTopology()).ok
+
+    def test_bad_order(self):
+        topo = ClusterTopology()
+        topo.spec.levels = [
+            TopologyLevel("host", "kubernetes.io/hostname"),
+            TopologyLevel("zone", "topology.kubernetes.io/zone"),
+        ]
+        res = validate_cluster_topology(topo)
+        assert any("broadest to narrowest" in e for e in res.errors)
+
+    def test_duplicate_domain(self):
+        topo = ClusterTopology()
+        topo.spec.levels = [
+            TopologyLevel("zone", "a"),
+            TopologyLevel("zone", "b"),
+        ]
+        res = validate_cluster_topology(topo)
+        assert any("duplicate domain" in e for e in res.errors)
+
+    def test_unknown_domain(self):
+        topo = ClusterTopology()
+        topo.spec.levels = [TopologyLevel("floor", "x")]
+        res = validate_cluster_topology(topo)
+        assert any("unknown domain" in e for e in res.errors)
