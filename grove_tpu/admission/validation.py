@@ -117,11 +117,13 @@ class PodCliqueDependencyGraph:
 
 
 def validate_podcliqueset(
-    pcs: PodCliqueSet, topology: Optional[ClusterTopology] = None
+    pcs: PodCliqueSet,
+    topology: Optional[ClusterTopology] = None,
+    is_update: bool = False,
 ) -> ValidationResult:
     res = ValidationResult()
     _validate_object_meta(pcs, res)
-    _validate_spec(pcs, res, topology)
+    _validate_spec(pcs, res, topology, is_update)
     return res
 
 
@@ -179,7 +181,10 @@ def _worst_case_pod_name_len(pcs: PodCliqueSet) -> Tuple[int, str]:
 
 
 def _validate_spec(
-    pcs: PodCliqueSet, res: ValidationResult, topology: Optional[ClusterTopology]
+    pcs: PodCliqueSet,
+    res: ValidationResult,
+    topology: Optional[ClusterTopology],
+    is_update: bool = False,
 ) -> None:
     spec = pcs.spec
     tmpl = spec.template
@@ -285,7 +290,7 @@ def _validate_spec(
                     f"{path}.spec.autoScalingConfig.maxReplicas",
                     "must be greater than or equal to replicas",
                 )
-        _validate_pod_spec(cs.pod_spec, f"{path}.spec.podSpec", res)
+        _validate_pod_spec(cs.pod_spec, f"{path}.spec.podSpec", res, is_update)
         if clique.topology_constraint is not None:
             _validate_topology_constraint(
                 clique.topology_constraint,
@@ -394,16 +399,20 @@ def _validate_scale_config(sc, min_available: int, path: str, res: ValidationRes
         )
 
 
-def _validate_pod_spec(pod_spec, path: str, res: ValidationResult) -> None:
+def _validate_pod_spec(
+    pod_spec, path: str, res: ValidationResult, is_update: bool = False
+) -> None:
     if not pod_spec.containers:
         res.error(f"{path}.containers", "at least one container is required")
     if pod_spec.restart_policy and pod_spec.restart_policy != "Always":
         res.warn(f"{path}.restartPolicy will be ignored, it will be set to Always")
-    # forbidden fields the operator owns (validatePodSpec, create path)
-    if pod_spec.extra.get("topologySpreadConstraints"):
-        res.error(f"{path}.topologySpreadConstraints", "must not be set")
-    if pod_spec.extra.get("nodeName"):
-        res.error(f"{path}.nodeName", "must not be set")
+    # forbidden fields the operator owns (validatePodSpec — create path only,
+    # matching the reference's operation==Create gate)
+    if not is_update:
+        if pod_spec.extra.get("topologySpreadConstraints"):
+            res.error(f"{path}.topologySpreadConstraints", "must not be set")
+        if pod_spec.extra.get("nodeName"):
+            res.error(f"{path}.nodeName", "must not be set")
 
 
 def _validate_topology_constraint(
@@ -424,8 +433,13 @@ def _validate_topology_constraint(
             f"domain {tc.pack_domain!r} is not a level of the cluster topology",
         )
     # Child constraints must be equal to or stricter than the parent's
-    # (podcliqueset.go:232-234 docs on PCSG TopologyConstraint).
-    if parent_tc is not None and parent_tc.pack_domain is not None:
+    # (podcliqueset.go:232-234 docs on PCSG TopologyConstraint). A parent with
+    # an unknown domain is reported at its own path; skip the comparison.
+    if (
+        parent_tc is not None
+        and parent_tc.pack_domain is not None
+        and parent_tc.pack_domain in TOPOLOGY_DOMAIN_ORDER
+    ):
         if broader_than(tc.pack_domain, parent_tc.pack_domain):
             res.error(
                 f"{path}.packDomain",
@@ -449,10 +463,15 @@ def _unique(items: List[str], path: str, msg: str, res: ValidationResult) -> Non
 
 
 def validate_podcliqueset_update(
-    new: PodCliqueSet, old: PodCliqueSet
+    new: PodCliqueSet,
+    old: PodCliqueSet,
+    topology: Optional[ClusterTopology] = None,
 ) -> ValidationResult:
-    """validatePodGangTemplateSpecUpdate (podcliqueset.go:443-530)."""
-    res = ValidationResult()
+    """Full update validation: the create-path rules on the new object plus
+    immutability checks — matching the reference webhook handler, which runs
+    validate() then validateUpdate() on every update (admission handler.go).
+    """
+    res = validate_podcliqueset(new, topology, is_update=True)
     nt, ot = new.spec.template, old.spec.template
 
     if nt.startup_type != ot.startup_type:

@@ -1,0 +1,215 @@
+"""In-memory object store — the fake apiserver.
+
+Stands in for kube-apiserver + etcd + informer caches (the reference's entire
+"communication backend", SURVEY §5). Supports the exact semantics the
+controllers rely on:
+
+- resourceVersion bump per write; generation bump on spec updates only
+- watch events (Added/Modified/Deleted) fanned out to subscribers
+- finalizer-aware deletion (deletion_timestamp first, removal when finalizers
+  drain — mirrors apiserver behavior the reference's ensureFinalizer flows use)
+- label-selector list
+- optional *cache lag*: reads can be served from a stale snapshot that only
+  advances when `sync_cache()` is called, reproducing the informer-staleness
+  race the reference's expectations store exists to absorb
+  (expect/expectations.go:33-50). Tests run the controllers in lagged mode so
+  those races can't hide.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from grove_tpu.api.meta import deep_copy, next_uid
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
+
+ADDED = "Added"
+MODIFIED = "Modified"
+DELETED = "Deleted"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    kind: str
+    obj: object  # deep copy at emit time
+
+
+def obj_key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def matches_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = obj.metadata.labels or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Store:
+    def __init__(self, clock: Optional[Clock] = None, cache_lag: bool = False) -> None:
+        self.clock = clock or Clock()
+        self.cache_lag = cache_lag
+        self._committed: Dict[str, Dict[str, object]] = {}
+        self._cache: Dict[str, Dict[str, object]] = {}
+        self._rv = 0
+        self._watchers: List[Callable[[WatchEvent], None]] = []
+
+    # -- watch ----------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
+        self._watchers.append(fn)
+
+    def _emit(self, type_: str, obj) -> None:
+        ev = WatchEvent(type=type_, kind=obj.kind, obj=deep_copy(obj))
+        for w in self._watchers:
+            w(ev)
+
+    # -- cache ----------------------------------------------------------
+
+    def sync_cache(self) -> None:
+        """Advance the whole read cache to the committed state."""
+        for kind in self._committed:
+            self.sync_cache_kind(kind)
+
+    def sync_cache_kind(self, kind: str) -> None:
+        """Advance one kind's cache — models that kind's informer receiving
+        its watch events (each informer syncs independently; cross-kind
+        staleness is exactly the race expectations absorb)."""
+        self._cache[kind] = {
+            k: deep_copy(v) for k, v in self._committed.get(kind, {}).items()
+        }
+
+    def _read_view(self, cached: bool) -> Dict[str, Dict[str, object]]:
+        if cached and self.cache_lag:
+            return self._cache
+        return self._committed
+
+    # -- CRUD -----------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind_objs = self._committed.setdefault(obj.kind, {})
+        key = obj_key(obj)
+        if key in kind_objs:
+            raise GroveError(
+                ERR_CONFLICT, f"{obj.kind} {key} already exists", "create"
+            )
+        stored = deep_copy(obj)
+        self._rv += 1
+        stored.metadata.uid = stored.metadata.uid or next_uid()
+        stored.metadata.resource_version = self._rv
+        stored.metadata.generation = 1
+        stored.metadata.creation_timestamp = self.clock.now()
+        kind_objs[key] = stored
+        self._emit(ADDED, stored)
+        return deep_copy(stored)
+
+    def get(self, kind: str, namespace: str, name: str, cached: bool = False):
+        obj = self._read_view(cached).get(kind, {}).get(f"{namespace}/{name}")
+        return deep_copy(obj) if obj is not None else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        cached: bool = False,
+    ) -> List[object]:
+        out = []
+        for obj in self._read_view(cached).get(kind, {}).values():
+            if namespace is not None and obj.metadata.namespace != namespace:
+                continue
+            if matches_labels(obj, label_selector):
+                out.append(deep_copy(obj))
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def _require(self, obj):
+        kind_objs = self._committed.get(obj.kind, {})
+        key = obj_key(obj)
+        if key not in kind_objs:
+            raise GroveError(
+                ERR_NOT_FOUND, f"{obj.kind} {key} not found", "update"
+            )
+        return kind_objs, key
+
+    def update(self, obj, bump_generation: bool = True) -> object:
+        """Spec write: bumps resourceVersion and (by default) generation.
+
+        Enforces optimistic concurrency like the apiserver: writing from a
+        stale read (resource_version behind committed) raises ERR_CONFLICT,
+        so controllers that clobber concurrent writes fail in the sim too.
+        """
+        kind_objs, key = self._require(obj)
+        current = kind_objs[key]
+        if (
+            obj.metadata.resource_version
+            and obj.metadata.resource_version != current.metadata.resource_version
+        ):
+            raise GroveError(
+                ERR_CONFLICT,
+                f"{obj.kind} {key}: resourceVersion "
+                f"{obj.metadata.resource_version} != "
+                f"{current.metadata.resource_version}",
+                "update",
+            )
+        stored = deep_copy(obj)
+        self._rv += 1
+        stored.metadata.resource_version = self._rv
+        stored.metadata.uid = current.metadata.uid
+        stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        stored.metadata.generation = current.metadata.generation + (
+            1 if bump_generation else 0
+        )
+        kind_objs[key] = stored
+        self._emit(MODIFIED, stored)
+        return deep_copy(stored)
+
+    def update_status(self, obj) -> object:
+        """Status write: no generation bump (status subresource semantics)."""
+        return self.update(obj, bump_generation=False)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        kind_objs = self._committed.get(kind, {})
+        key = f"{namespace}/{name}"
+        obj = kind_objs.get(key)
+        if obj is None:
+            raise GroveError(ERR_NOT_FOUND, f"{kind} {key} not found", "delete")
+        if obj.metadata.finalizers:
+            if obj.metadata.deletion_timestamp is None:
+                obj.metadata.deletion_timestamp = self.clock.now()
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                self._emit(MODIFIED, obj)
+            return
+        del kind_objs[key]
+        self._emit(DELETED, obj)
+
+    def remove_finalizer(self, kind: str, namespace: str, name: str, finalizer: str) -> None:
+        kind_objs = self._committed.get(kind, {})
+        key = f"{namespace}/{name}"
+        obj = kind_objs.get(key)
+        if obj is None:
+            return
+        if finalizer in obj.metadata.finalizers:
+            obj.metadata.finalizers.remove(finalizer)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._emit(MODIFIED, obj)
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            del kind_objs[key]
+            self._emit(DELETED, obj)
+
+    def delete_collection(
+        self,
+        kind: str,
+        namespace: str,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> int:
+        """DeleteAllOf equivalent (used by gang termination)."""
+        victims = self.list(kind, namespace, label_selector)
+        for v in victims:
+            self.delete(kind, namespace, v.metadata.name)
+        return len(victims)

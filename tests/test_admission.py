@@ -211,6 +211,30 @@ class TestValidationCreate:
         assert any("nodeName" in e for e in res.errors)
 
 
+class TestValidationMore:
+    def test_bogus_parent_domain_no_crash(self):
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(pack_domain="bogus")
+        pcs.spec.template.cliques[0].topology_constraint = TopologyConstraint(
+            pack_domain="slice"
+        )
+        res = validate_podcliqueset(pcs)
+        assert any("unknown topology domain" in e for e in res.errors)
+
+    def test_update_reruns_create_rules(self):
+        old = defaulted_pcs()
+        new = copy.deepcopy(old)
+        new.spec.template.cliques[0].spec.replicas = -3
+        new.spec.template.cliques[0].spec.min_available = -3
+        res = validate_podcliqueset_update(new, old)
+        assert any("must be greater than 0" in e for e in res.errors)
+        # but create-only forbidden fields are not re-enforced on update
+        new2 = copy.deepcopy(old)
+        new2.spec.template.cliques[0].spec.pod_spec.extra["nodeName"] = "n"
+        res2 = validate_podcliqueset_update(new2, old)
+        assert res2.ok, res2.errors
+
+
 class TestValidationUpdate:
     def test_allowed_update(self):
         old = defaulted_pcs()

@@ -1,0 +1,363 @@
+"""Runtime tests: store semantics, workqueue, expectations, indexer, engine."""
+
+import pytest
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import PodClique
+from grove_tpu.runtime.clock import VirtualClock
+from grove_tpu.runtime.engine import Controller, Engine
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.expectations import ExpectationsStore
+from grove_tpu.runtime.flow import (
+    continue_reconcile,
+    do_not_requeue,
+    reconcile_after,
+    reconcile_with_errors,
+    run_steps,
+)
+from grove_tpu.runtime.indexer import allocate_indices, parse_index
+from grove_tpu.runtime.store import ADDED, DELETED, MODIFIED, Store
+from grove_tpu.runtime.workqueue import WorkQueue
+
+
+def mk(name, ns="default", labels=None):
+    return PodClique(metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}))
+
+
+class TestStore:
+    def test_crud_and_versions(self):
+        s = Store(VirtualClock())
+        created = s.create(mk("a"))
+        assert created.metadata.uid and created.metadata.generation == 1
+        got = s.get("PodClique", "default", "a")
+        got.spec.replicas = 5
+        updated = s.update(got)
+        assert updated.metadata.generation == 2
+        assert updated.metadata.resource_version > created.metadata.resource_version
+        # status write: no generation bump
+        updated.status.ready_replicas = 1
+        st = s.update_status(updated)
+        assert st.metadata.generation == 2
+
+    def test_create_conflict(self):
+        s = Store(VirtualClock())
+        s.create(mk("a"))
+        with pytest.raises(GroveError):
+            s.create(mk("a"))
+
+    def test_deep_copy_isolation(self):
+        s = Store(VirtualClock())
+        obj = mk("a")
+        s.create(obj)
+        obj.spec.replicas = 99  # caller's copy must not leak in
+        assert s.get("PodClique", "default", "a").spec.replicas != 99
+
+    def test_label_selector(self):
+        s = Store(VirtualClock())
+        s.create(mk("a", labels={"grove.io/podgang": "g1"}))
+        s.create(mk("b", labels={"grove.io/podgang": "g2"}))
+        got = s.list("PodClique", "default", {"grove.io/podgang": "g1"})
+        assert [o.metadata.name for o in got] == ["a"]
+
+    def test_finalizer_deletion_flow(self):
+        s = Store(VirtualClock())
+        obj = mk("a")
+        obj.metadata.finalizers = ["grove.io/operator"]
+        s.create(obj)
+        s.delete("PodClique", "default", "a")
+        pending = s.get("PodClique", "default", "a")
+        assert pending is not None and pending.metadata.deletion_timestamp is not None
+        s.remove_finalizer("PodClique", "default", "a", "grove.io/operator")
+        assert s.get("PodClique", "default", "a") is None
+
+    def test_watch_events(self):
+        s = Store(VirtualClock())
+        events = []
+        s.subscribe(events.append)
+        s.create(mk("a"))
+        obj = s.get("PodClique", "default", "a")
+        s.update(obj)
+        s.delete("PodClique", "default", "a")
+        assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+
+    def test_cache_lag(self):
+        s = Store(VirtualClock(), cache_lag=True)
+        s.create(mk("a"))
+        assert s.list("PodClique", cached=True) == []  # cache not synced yet
+        s.sync_cache()
+        assert len(s.list("PodClique", cached=True)) == 1
+        assert len(s.list("PodClique", cached=False)) == 1  # direct read sees it
+
+
+class TestWorkQueue:
+    def test_dedup(self):
+        q = WorkQueue()
+        key = ("PodClique", "default", "a")
+        q.add(key)
+        q.add(key)
+        assert q.pop(0.0) == key
+        assert q.pop(0.0) is None
+
+    def test_delayed(self):
+        q = WorkQueue()
+        key = ("PodClique", "default", "a")
+        q.add_after(key, 10.0, now=0.0)
+        assert q.pop(5.0) is None
+        assert q.pop(10.0) == key
+
+    def test_backoff_grows(self):
+        q = WorkQueue()
+        key = ("PodClique", "default", "a")
+        q.add_rate_limited(key, now=0.0)
+        t1 = q.next_delayed_at()
+        q.pop(t1)
+        q.add_rate_limited(key, now=0.0)
+        t2 = q.next_delayed_at()
+        assert t2 > t1
+        q.forget(key)
+        q.pop(t2)
+        q.add_rate_limited(key, now=0.0)
+        assert q.next_delayed_at() == t1  # reset after forget
+
+
+class TestExpectations:
+    def test_fold_and_self_heal(self):
+        e = ExpectationsStore("pod")
+        e.expect_creations("k", ["u1", "u2"])
+        e.expect_deletions("k", ["u3"])
+        creates, deletes = e.pending("k", observed_uids=["u1", "u3", "u4"])
+        assert creates == {"u2"}  # u1 appeared -> healed
+        assert deletes == {"u3"}  # still visible -> still pending
+        creates, deletes = e.pending("k", observed_uids=["u1", "u2", "u4"])
+        assert creates == set() and deletes == set()
+
+
+class TestIndexer:
+    def test_parse(self):
+        assert parse_index("pcs-0-pca", "pcs-0-pca-3") == 3
+        assert parse_index("pcs-0-pca", "pcs-0-pcb-3") == -1
+
+    def test_hole_filling(self):
+        got = allocate_indices("c", ["c-0", "c-2", "c-5"], 3)
+        assert got == [1, 3, 4]
+
+    def test_duplicate_errors(self):
+        with pytest.raises(GroveError):
+            allocate_indices("c", ["c-1", "c-1"], 1)
+
+
+class TestFlow:
+    def test_run_steps_short_circuit(self):
+        calls = []
+
+        def step_a():
+            calls.append("a")
+            return continue_reconcile()
+
+        def step_b():
+            calls.append("b")
+            return reconcile_after(5.0, "wait")
+
+        def step_c():
+            calls.append("c")
+            return do_not_requeue()
+
+        result = run_steps([step_a, step_b, step_c])
+        assert calls == ["a", "b"]
+        assert result.result == "requeue_after" and result.requeue_after == 5.0
+
+    def test_errors(self):
+        r = reconcile_with_errors("boom", GroveError("ERR_X", "x"))
+        assert r.has_errors() and r.short_circuits()
+
+
+class TestEngine:
+    @staticmethod
+    def _replica_controller(store, expectations):
+        """Toy replica controller reading children through the lagged cache,
+        folding expectations into the diff (expectations.go:33-50 pattern)."""
+        from grove_tpu.api.pod import Pod
+
+        def reconcile(key):
+            kind, ns, name = key
+            parent = store.get("PodClique", ns, name)
+            if parent is None:
+                return do_not_requeue()
+            sel = {"parent": name}
+            children = store.list("Pod", ns, sel, cached=True)
+            observed = [c.metadata.uid for c in children]
+            if expectations is not None:
+                pending_creates, _ = expectations.pending(f"{ns}/{name}", observed)
+            else:
+                pending_creates = set()
+            existing = len(children) + len(pending_creates)
+            for i in range(parent.spec.replicas - existing):
+                child = Pod(
+                    metadata=ObjectMeta(
+                        name=f"{name}-child-{parent.metadata.generation}-{existing + i}",
+                        namespace=ns,
+                        labels=sel,
+                    )
+                )
+                created = store.create(child)
+                if expectations is not None:
+                    expectations.expect_creations(
+                        f"{ns}/{name}", [created.metadata.uid]
+                    )
+            return continue_reconcile()
+
+        return reconcile
+
+    def _run_race(self, with_expectations: bool) -> int:
+        """Pod informer falls behind: reconcile #2 (triggered by a parent
+        update) runs with a Pod cache that predates reconcile #1's creates."""
+        clock = VirtualClock()
+        store = Store(clock, cache_lag=True)
+        engine = Engine(store, clock)
+        expectations = ExpectationsStore("toy") if with_expectations else None
+        engine.register(
+            Controller(
+                name="toy",
+                kind="PodClique",
+                reconcile=self._replica_controller(store, expectations),
+            )
+        )
+        engine.hold_events("Pod")  # pod informer lags
+        parent = mk("p")
+        parent.spec.replicas = 3
+        store.create(parent)
+        engine.drain()  # reconcile #1 creates 3 pods; their events are held
+        fresh = store.get("PodClique", "default", "p")
+        store.update(fresh)  # unrelated parent touch -> reconcile #2
+        engine.drain()
+        engine.release_events("Pod")
+        engine.drain()
+        return len(store.list("Pod", "default", {"parent": "p"}))
+
+    def test_expectations_prevent_overcreation_race(self):
+        assert self._run_race(with_expectations=True) == 3
+
+    def test_race_is_real_without_expectations(self):
+        """Control: with expectations disabled the stale cache over-creates —
+        proving the race the store/engine claim to reproduce exists."""
+        assert self._run_race(with_expectations=False) > 3
+
+    def test_requeue_after_fires_on_advance(self):
+        clock = VirtualClock()
+        store = Store(clock)
+        engine = Engine(store, clock)
+        seen = []
+
+        def reconcile(key):
+            seen.append(clock.now())
+            if len(seen) == 1:
+                return reconcile_after(30.0)
+            return do_not_requeue()
+
+        engine.register(Controller(name="t", kind="PodClique", reconcile=reconcile))
+        store.create(mk("a"))
+        engine.drain()
+        assert len(seen) == 1
+        engine.advance_and_drain(30.0)
+        assert len(seen) == 2 and seen[1] == 30.0
+
+    def test_panic_requeues(self):
+        clock = VirtualClock()
+        store = Store(clock)
+        engine = Engine(store, clock)
+        attempts = []
+
+        def reconcile(key):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("boom")
+            return do_not_requeue()
+
+        engine.register(Controller(name="t", kind="PodClique", reconcile=reconcile))
+        store.create(mk("a"))
+        engine.run_until_idle()
+        assert len(attempts) == 3
+
+    def test_watch_mapping(self):
+        clock = VirtualClock()
+        store = Store(clock)
+        engine = Engine(store, clock)
+        reconciled = []
+
+        def reconcile(key):
+            reconciled.append(key)
+            return do_not_requeue()
+
+        def map_pod_to_parent(ev):
+            parent = ev.obj.metadata.labels.get("parent")
+            return [(ev.obj.metadata.namespace, parent)] if parent else []
+
+        engine.register(
+            Controller(
+                name="t",
+                kind="PodClique",
+                reconcile=reconcile,
+                watches=[("Pod", map_pod_to_parent)],
+            )
+        )
+        from grove_tpu.api.pod import Pod
+
+        store.create(
+            Pod(metadata=ObjectMeta(name="x", labels={"parent": "p"}))
+        )
+        engine.drain()
+        assert ("PodClique", "default", "p") in reconciled
+
+    def test_events_emitted_during_reconcile_are_delivered(self):
+        """Regression: events produced *inside* a reconcile must reach watch
+        mappings (the backlog is drained in place, not rebound)."""
+        clock = VirtualClock()
+        store = Store(clock)
+        engine = Engine(store, clock)
+        calls = []
+
+        def reconcile(key):
+            calls.append(key)
+            from grove_tpu.api.pod import Pod
+
+            if store.get("Pod", "default", "child") is None:
+                store.create(
+                    Pod(
+                        metadata=ObjectMeta(
+                            name="child", labels={"parent": key[2]}
+                        )
+                    )
+                )
+            return do_not_requeue()
+
+        engine.register(
+            Controller(
+                name="t",
+                kind="PodClique",
+                reconcile=reconcile,
+                watches=[
+                    (
+                        "Pod",
+                        lambda ev: [
+                            (
+                                ev.obj.metadata.namespace,
+                                ev.obj.metadata.labels.get("parent"),
+                            )
+                        ],
+                    )
+                ],
+            )
+        )
+        store.create(mk("p"))
+        engine.drain()
+        # reconcile #1 creates the pod; its ADDED event maps back -> #2
+        assert len(calls) == 2
+
+    def test_stale_write_conflicts(self):
+        s = Store(VirtualClock())
+        s.create(mk("a"))
+        stale = s.get("PodClique", "default", "a")
+        fresh = s.get("PodClique", "default", "a")
+        s.update(fresh)
+        with pytest.raises(GroveError):
+            s.update(stale)
