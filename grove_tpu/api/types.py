@@ -573,6 +573,22 @@ class PodGang:
 
 
 # ---------------------------------------------------------------------------
+# Generic child resources (Service / HPA / RBAC / Secret)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GenericObject:
+    """Lightweight stand-in for child kinds the operator materializes but the
+    sim doesn't interpret deeply (headless Service, HPA, ServiceAccount, Role,
+    RoleBinding, SA-token Secret)."""
+
+    kind: str
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
 

@@ -41,6 +41,18 @@ def obj_key(obj) -> str:
     return f"{obj.metadata.namespace}/{obj.metadata.name}"
 
 
+def _semantically_equal(a, b) -> bool:
+    """Deep equality ignoring resourceVersion/generation bookkeeping.
+    Swap-compare-restore: no extra deep copies on the hottest write path."""
+    saved = (a.metadata.resource_version, a.metadata.generation)
+    a.metadata.resource_version = b.metadata.resource_version
+    a.metadata.generation = b.metadata.generation
+    try:
+        return a == b
+    finally:
+        a.metadata.resource_version, a.metadata.generation = saved
+
+
 def matches_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
     if not selector:
         return True
@@ -156,10 +168,15 @@ class Store:
                 "update",
             )
         stored = deep_copy(obj)
-        self._rv += 1
-        stored.metadata.resource_version = self._rv
         stored.metadata.uid = current.metadata.uid
         stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        if _semantically_equal(stored, current):
+            # No-op write: no version bump, no event. Plays the role of the
+            # reference's change predicates (GenerationChanged etc.) in
+            # preventing self-triggering reconcile livelock.
+            return deep_copy(current)
+        self._rv += 1
+        stored.metadata.resource_version = self._rv
         stored.metadata.generation = current.metadata.generation + (
             1 if bump_generation else 0
         )

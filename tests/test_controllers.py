@@ -1,0 +1,337 @@
+"""Controller end-to-end tests on the sim harness.
+
+Scenario coverage modeled on the reference's unit tables + e2e gang scenarios
+(SURVEY §4): materialization tree, base/scaled gang split, gated admission
+handshake, hierarchical ungating, startup ordering, breach → gang
+termination, scale in/out.
+"""
+
+import pathlib
+
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.pod import is_ready, is_schedule_gated
+from grove_tpu.api.types import (
+    COND_MIN_AVAILABLE_BREACHED,
+    STARTUP_EXPLICIT,
+)
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def simple1():
+    return load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+
+
+@pytest.fixture
+def harness():
+    return SimHarness(num_nodes=32)
+
+
+class TestSimple1EndToEnd:
+    def test_resource_tree(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+
+        pclqs = {p.metadata.name for p in harness.store.list("PodClique")}
+        assert pclqs == {
+            "simple1-0-pca",
+            "simple1-0-pcd",
+            "simple1-0-sga-0-pcb",
+            "simple1-0-sga-0-pcc",
+        }
+        pcsgs = [g.metadata.name for g in harness.store.list("PodCliqueScalingGroup")]
+        assert pcsgs == ["simple1-0-sga"]
+        gangs = [g.metadata.name for g in harness.store.list("PodGang")]
+        assert gangs == ["simple1-0"]  # replicas=1 == minAvailable → base only
+
+        pods = harness.store.list("Pod")
+        assert len(pods) == 3 + 2 + 2 + 2
+        assert all(is_ready(p) for p in pods), harness.tree()
+        assert all(not is_schedule_gated(p) for p in pods)
+
+        # infra children
+        assert harness.store.get("Service", "default", "simple1-0") is not None
+        hpas = {h.metadata.name for h in harness.store.list("HorizontalPodAutoscaler")}
+        assert hpas == {"simple1-0-pca", "simple1-0-sga"}
+        assert harness.store.get("ServiceAccount", "default", "simple1") is not None
+
+    def test_podgroups_shape(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        groups = {g.name: g for g in gang.spec.pod_groups}
+        assert set(groups) == {
+            "simple1-0-pca",
+            "simple1-0-pcd",
+            "simple1-0-sga-0-pcb",
+            "simple1-0-sga-0-pcc",
+        }
+        assert groups["simple1-0-pca"].min_replicas == 3  # defaulted to replicas
+        assert len(groups["simple1-0-pca"].pod_references) == 3
+        names = [r.name for r in groups["simple1-0-pca"].pod_references]
+        assert names == sorted(names)
+
+    def test_pod_identity(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        pod = harness.store.get("Pod", "default", "simple1-0-pca-0")
+        assert pod.spec.hostname == "simple1-0-pca-0"
+        assert pod.spec.subdomain == "simple1-0"
+        env = {e["name"]: e.get("value") for e in pod.spec.containers[0].env}
+        assert env["GROVE_PCS_NAME"] == "simple1"
+        assert env["GROVE_PCS_INDEX"] == "0"
+        assert env["GROVE_PCLQ_NAME"] == "simple1-0-pca"
+        assert env["GROVE_HEADLESS_SERVICE"] == "simple1-0.default.svc.cluster.local"
+        assert env["GROVE_PCLQ_POD_INDEX"] == "0"
+        assert pod.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
+
+    def test_pcs_status(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        assert pcs.status.available_replicas == 1
+        assert pcs.status.current_generation_hash
+        assert [g.name for g in pcs.status.pod_gang_statuses] == ["simple1-0"]
+
+
+class TestScaledGangs:
+    def test_scale_out_creates_scaled_gangs(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        # HPA-style scale: PCSG replicas 1 -> 3 (minAvailable=1)
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg.spec.replicas = 3
+        harness.store.update(pcsg)
+        harness.converge()
+
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        assert gangs == {"simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"}
+        scaled = harness.store.get("PodGang", "default", "simple1-0-sga-0")
+        assert (
+            scaled.metadata.labels[namegen.LABEL_BASE_PODGANG] == "simple1-0"
+        )
+        # scaled PCLQs carry the base-podgang label; base replicas don't
+        base_pclq = harness.store.get("PodClique", "default", "simple1-0-sga-0-pcb")
+        scaled_pclq = harness.store.get("PodClique", "default", "simple1-0-sga-1-pcb")
+        assert namegen.LABEL_BASE_PODGANG not in base_pclq.metadata.labels
+        assert (
+            scaled_pclq.metadata.labels[namegen.LABEL_BASE_PODGANG] == "simple1-0"
+        )
+        # everything eventually ready
+        pods = harness.store.list("Pod")
+        assert len(pods) == 9 + 2 * (2 + 2)
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+    def test_scale_in_removes_highest_replicas(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg.spec.replicas = 3
+        harness.store.update(pcsg)
+        harness.converge()
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg.spec.replicas = 1
+        harness.store.update(pcsg)
+        harness.converge()
+        pclqs = {p.metadata.name for p in harness.store.list("PodClique")}
+        assert "simple1-0-sga-2-pcb" not in pclqs
+        assert "simple1-0-sga-1-pcb" not in pclqs
+        assert "simple1-0-sga-0-pcb" in pclqs
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        assert gangs == {"simple1-0"}
+
+    def test_scaled_pods_wait_for_base_gang(self):
+        """Hierarchical admission: scaled pods stay gated until the base gang
+        is scheduled (syncflow.go:303-387)."""
+        harness = SimHarness(num_nodes=2)  # capacity for base, not for all
+        # base needs 9 pods * 10m cpu; nodes have 8 cpu — capacity is ample,
+        # so instead gate by cordoning: cordon all nodes first
+        for n in harness.cluster.nodes:
+            n.cordoned = True
+        pcs = simple1()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        base_pods = [
+            p
+            for p in pods
+            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
+        ]
+        scaled_pods = [
+            p
+            for p in pods
+            if p.metadata.labels[namegen.LABEL_PODGANG] != "simple1-0"
+        ]
+        # base pods are ungated (ready to schedule); scaled pods remain gated
+        # because the base gang isn't scheduled yet
+        assert base_pods and all(not is_schedule_gated(p) for p in base_pods)
+        assert scaled_pods and all(is_schedule_gated(p) for p in scaled_pods)
+
+        for n in harness.cluster.nodes:
+            n.cordoned = False
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+
+class TestStartupOrdering:
+    def test_explicit_dag_order(self):
+        harness = SimHarness(num_nodes=32)
+        pcs = simple1()
+        pcs.spec.template.startup_type = STARTUP_EXPLICIT
+        # pcd starts after pca
+        pcs.spec.template.cliques[3].spec.starts_after = ["pca"]
+        harness.apply(pcs)
+
+        # converge in fine steps, recording first-ready times
+        first_ready = {}
+        for _ in range(30):
+            harness.engine.drain()
+            harness.cluster.schedule_pending()
+            harness.cluster.kubelet_tick()
+            harness.engine.drain()
+            for pod in harness.store.list("Pod"):
+                if is_ready(pod) and pod.metadata.name not in first_ready:
+                    first_ready[pod.metadata.name] = harness.clock.now()
+            harness.advance(1.0)
+
+        pca_times = [t for n, t in first_ready.items() if "-pca-" in n]
+        pcd_times = [t for n, t in first_ready.items() if "-pcd-" in n]
+        assert pca_times and pcd_times
+        assert max(pca_times) < min(pcd_times), first_ready
+
+    def test_waiter_annotation_plumbing(self):
+        harness = SimHarness()
+        pcs = simple1()
+        pcs.spec.template.startup_type = STARTUP_EXPLICIT
+        pcs.spec.template.cliques[3].spec.starts_after = ["pca"]
+        harness.apply(pcs)
+        harness.converge()
+        pod = harness.store.get("Pod", "default", "simple1-0-pcd-0")
+        cfg = pod.spec.extra["groveInitWaiter"]
+        assert cfg["podcliques"] == [
+            {"pclq": "simple1-0-pca", "min_available": 3}
+        ]
+        assert cfg["podgang"] == "simple1-0"
+
+
+class TestGangTermination:
+    def test_breach_terminates_replica_after_delay(self, harness):
+        pcs = simple1()
+        pcs.spec.template.termination_delay = 600.0  # 10 min for the test
+        harness.apply(pcs)
+        harness.converge()
+
+        # crash pcd below minAvailable (2 replicas, minAvailable=2)
+        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
+        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        harness.engine.drain()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
+        assert cond is not None and cond.is_true()
+        uid_before = pclq.metadata.uid
+
+        # before the delay: nothing terminated
+        harness.advance(300.0)
+        harness.engine.drain()
+        assert (
+            harness.store.get("PodClique", "default", "simple1-0-pcd").metadata.uid
+            == uid_before
+        )
+
+        # past the delay: whole replica's PCLQs deleted and recreated
+        harness.advance(301.0)
+        harness.converge()
+        pclq_after = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        assert pclq_after is not None and pclq_after.metadata.uid != uid_before
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+
+    def test_never_scheduled_is_not_breached(self, harness):
+        """reconcilestatus.go:192-201: unscheduled gangs must not be
+        terminated."""
+        for n in harness.cluster.nodes:
+            n.cordoned = True
+        harness.apply(simple1())
+        harness.converge()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
+        assert cond is not None and not cond.is_true()
+        assert cond.reason == "InsufficientScheduledPods"
+
+
+class TestAvailability:
+    def test_never_scheduled_not_available(self):
+        harness = SimHarness()
+        for n in harness.cluster.nodes:
+            n.cordoned = True
+        harness.apply(simple1())
+        harness.converge()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        assert pcs.status.available_replicas == 0
+        for n in harness.cluster.nodes:
+            n.cordoned = False
+        harness.converge()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        assert pcs.status.available_replicas == 1
+
+    def test_recreated_pod_schedules_on_tight_node(self):
+        """Regression: stale scheduler bindings must not phantom-reserve
+        capacity for deleted-and-recreated pods with stable names."""
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.1}
+        pcs = simple1()
+        pcs.spec.template.termination_delay = 60.0
+        harness.apply(pcs)
+        harness.converge()
+        assert all(is_ready(p) for p in harness.store.list("Pod"))
+        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
+        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        harness.engine.drain()
+        harness.advance(61.0)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+
+class TestMultiNodeDisaggregated:
+    def test_reference_sample(self):
+        harness = SimHarness(num_nodes=32)
+        pcs = load_podcliqueset_file(
+            str(REPO / "samples" / "multinode-disaggregated.yaml")
+        )
+        harness.apply(pcs)
+        harness.converge()
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        # prefill: replicas=2, minAvailable=1 -> base + 1 scaled gang
+        assert gangs == {
+            "multinode-disaggregated-0",
+            "multinode-disaggregated-0-prefill-0",
+        }
+        pods = harness.store.list("Pod")
+        # prefill (1+4)*2 + decode (1+2)*1 = 13
+        assert len(pods) == 13
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+
+class TestDeletion:
+    def test_cascading_delete(self, harness):
+        harness.apply(simple1())
+        harness.converge()
+        harness.delete("simple1")
+        harness.converge()
+        for kind in (
+            "PodCliqueSet",
+            "PodClique",
+            "PodCliqueScalingGroup",
+            "PodGang",
+            "Pod",
+            "Service",
+            "HorizontalPodAutoscaler",
+        ):
+            assert harness.store.list(kind) == [], kind

@@ -76,7 +76,10 @@ class TestStore:
         s.subscribe(events.append)
         s.create(mk("a"))
         obj = s.get("PodClique", "default", "a")
+        obj.spec.replicas = 7
         s.update(obj)
+        # no-op write: no event (predicate-equivalent suppression)
+        s.update(s.get("PodClique", "default", "a"))
         s.delete("PodClique", "default", "a")
         assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
 
@@ -228,7 +231,8 @@ class TestEngine:
         store.create(parent)
         engine.drain()  # reconcile #1 creates 3 pods; their events are held
         fresh = store.get("PodClique", "default", "p")
-        store.update(fresh)  # unrelated parent touch -> reconcile #2
+        fresh.metadata.annotations["touch"] = "1"
+        store.update(fresh)  # unrelated parent change -> reconcile #2
         engine.drain()
         engine.release_events("Pod")
         engine.drain()
@@ -358,6 +362,8 @@ class TestEngine:
         s.create(mk("a"))
         stale = s.get("PodClique", "default", "a")
         fresh = s.get("PodClique", "default", "a")
+        fresh.spec.replicas = 5
         s.update(fresh)
+        stale.spec.replicas = 9
         with pytest.raises(GroveError):
             s.update(stale)
