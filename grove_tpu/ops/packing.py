@@ -1,0 +1,250 @@
+"""Batched all-or-nothing gang packing kernel (JAX/XLA, TPU-first).
+
+The hot path of the framework: places G pending gangs onto N nodes with
+hierarchical topology packing, replacing the external KAI scheduler of the
+reference architecture (SURVEY §2, BASELINE.json north star).
+
+Design for the MXU/VPU + XLA compilation model:
+- ONE `lax.scan` over gangs (sequential commit is inherent to all-or-nothing
+  packing: each admission consumes capacity) — everything inside a step is
+  wide vector math over the node axis, which XLA fuses and vectorizes.
+- static shapes everywhere: problems are padded into size buckets so each
+  bucket compiles once and is cached.
+- topology choice is computed for ALL levels with `segment_sum` over
+  pre-sorted, contiguously-numbered domains, then the narrowest feasible
+  allowed level is selected branch-free.
+
+Semantics (mirroring the PodGang contract, scheduler podgang.go:50-114):
+- a gang is ADMITTED iff every group places >= min_count pods (MinReplicas
+  floor); extra pods up to `count` are placed best-effort with the gang.
+- `req_level` (TopologyPackConstraint.Required): the gang must fit inside ONE
+  domain at that level or narrower; no cluster-wide fallback.
+- `pref_level` (…Preferred): narrower levels are tried first; falls back to
+  broader levels, then cluster-wide scatter when no single domain fits.
+- PlacementScore: level-weighted co-location — for each level, the fraction
+  of the gang's pods inside its dominant domain, weighted toward narrow
+  levels; 1.0 = everything on one node-domain at the narrowest level.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_INT_CAP = 1 << 20  # cap on pods-per-node fit counts (avoid inf→int wrap)
+
+
+class GangInputs(NamedTuple):
+    demand: jnp.ndarray  # [P, R]
+    count: jnp.ndarray  # [P]
+    min_count: jnp.ndarray  # [P]
+    req_level: jnp.ndarray  # scalar
+    pref_level: jnp.ndarray  # scalar
+
+
+def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
+    """k[n] = how many pods of this group fit on node n given free capacity."""
+    safe = jnp.where(demand_p > 0, demand_p, 1.0)
+    ratio = jnp.floor(free / safe[None, :])
+    ratio = jnp.where(demand_p[None, :] > 0, ratio, jnp.inf)
+    k = jnp.min(ratio, axis=1)
+    return jnp.clip(k, 0, _INT_CAP).astype(jnp.int32)
+
+
+def _fill(free, mask, demand, count):
+    """Sequentially fill each group inside `mask` (nodes are topology-sorted,
+    so the exclusive-cumsum take packs into contiguous domains first).
+    Returns (alloc [P,N], placed [P], free_after)."""
+
+    def group_step(free_c, inputs):
+        demand_p, count_p = inputs
+        k = _pods_fit_per_node(free_c, demand_p)
+        # cap at the group's own count: bounds the int32 cumsum below at
+        # count*N (a zero-demand group would otherwise contribute _INT_CAP
+        # per node and wrap the prefix sum negative)
+        k = jnp.minimum(jnp.where(mask, k, 0), count_p)
+        cum = jnp.cumsum(k) - k  # exclusive prefix
+        take = jnp.clip(count_p - cum, 0, k)
+        free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
+        return free_c, (take, take.sum())
+
+    free_after, (alloc, placed) = jax.lax.scan(group_step, free, (demand, count))
+    return alloc, placed, free_after
+
+
+def _level_weights(num_levels: int) -> jnp.ndarray:
+    w = jnp.arange(1, num_levels + 1, dtype=jnp.float32)
+    return w / w.sum()
+
+
+@partial(jax.jit, static_argnames=("with_alloc",))
+def solve_packing(
+    capacity: jnp.ndarray,  # [N, R] float32
+    topo: jnp.ndarray,  # [N, L] int32, dense ids per level
+    demand: jnp.ndarray,  # [G, P, R] float32
+    count: jnp.ndarray,  # [G, P] int32
+    min_count: jnp.ndarray,  # [G, P] int32
+    req_level: jnp.ndarray,  # [G] int32 (-1 none)
+    pref_level: jnp.ndarray,  # [G] int32 (-1 → narrowest)
+    with_alloc: bool = True,
+):
+    n_nodes, n_levels = topo.shape
+    nseg = n_nodes  # dense per-level domain ids are < N
+    weights = _level_weights(n_levels)
+
+    def gang_step(free, gang: GangInputs):
+        active = gang.count > 0
+        any_active = jnp.any(active)
+        k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
+        # aggregate resource demand of the admission floor (joint check)
+        min_demand = jnp.sum(
+            gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
+        )  # [R]
+
+        all_nodes = jnp.ones((n_nodes,), dtype=bool)
+        no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+
+        # Per-level candidate domain: per-group fit counts AND joint resource
+        # feasibility (both optimistic w.r.t. fragmentation — the actual fill
+        # below is the ground truth). Best-fit tie-break by smallest spare.
+        def level_candidate(l):
+            seg = topo[:, l]
+            K = jax.vmap(
+                lambda kp: jax.ops.segment_sum(kp, seg, num_segments=nseg)
+            )(k_all)  # [P, nseg]
+            free_agg = jax.vmap(
+                lambda col: jax.ops.segment_sum(col, seg, num_segments=nseg),
+                in_axes=1,
+                out_axes=1,
+            )(free)  # [nseg, R]
+            feas = jnp.all(
+                jnp.where(active[:, None], K >= gang.min_count[:, None], True),
+                axis=0,
+            )
+            feas &= jnp.all(free_agg >= min_demand[None, :], axis=1)
+            feas &= any_active  # a fully-padded gang selects nothing
+            spare = jnp.sum(
+                jnp.where(active[:, None], K - gang.count[:, None], 0), axis=0
+            )
+            best = jnp.argmin(jnp.where(feas, spare, jnp.inf).astype(jnp.float32))
+            return jnp.any(feas), best
+
+        # Try the actual fill at every level (narrow masks included) plus a
+        # cluster-wide candidate; choose the narrowest allowed level whose
+        # fill truly meets the admission floor. L is small and static, so
+        # this unrolls into L+1 fused fills.
+        lv = jnp.arange(n_levels)
+        min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
+
+        cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
+        for l in range(n_levels):
+            ok_l, best_l = level_candidate(l)
+            mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
+            alloc_l, placed_l, free_l = _fill(free, mask_l, gang.demand, gang.count)
+            fill_ok = (
+                ok_l
+                & (lv[l] >= min_allowed)
+                & jnp.all(jnp.where(active, placed_l >= gang.min_count, True))
+            )
+            cand_alloc.append(alloc_l)
+            cand_placed.append(placed_l)
+            cand_free.append(free_l)
+            cand_ok.append(fill_ok)
+        # cluster-wide fallback (only when no required pack level)
+        alloc_c, placed_c, free_c = _fill(free, all_nodes, gang.demand, gang.count)
+        cluster_ok = (
+            (gang.req_level < 0)
+            & any_active
+            & jnp.all(jnp.where(active, placed_c >= gang.min_count, True))
+        )
+        cand_alloc.append(alloc_c)
+        cand_placed.append(placed_c)
+        cand_free.append(free_c)
+        cand_ok.append(cluster_ok)
+
+        oks = jnp.stack(cand_ok)  # [L+1]
+        # Preference order (TopologyPackConstraint.Preferred): try the
+        # preferred level first, then levels closest to it (narrower wins
+        # ties), cluster-wide last. pref_level=-1 → narrowest level first.
+        pref_eff = jnp.where(
+            gang.pref_level >= 0, gang.pref_level, n_levels - 1
+        )
+        level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
+        pref_rank = jnp.concatenate(
+            [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
+        )  # cluster rank 0
+        chosen = jnp.argmax(jnp.where(oks, pref_rank + 1, 0))
+        ok_min = jnp.any(oks)
+
+        one_hot = jax.nn.one_hot(chosen, n_levels + 1, dtype=free.dtype)
+        alloc = sum(
+            one_hot[i] * cand_alloc[i].astype(free.dtype)
+            for i in range(n_levels + 1)
+        ).astype(jnp.int32)
+        placed = sum(
+            one_hot[i] * cand_placed[i].astype(free.dtype)
+            for i in range(n_levels + 1)
+        ).astype(jnp.int32)
+        free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
+
+        # best-effort extras: pods beyond the packed domain scatter
+        # cluster-wide (no required constraint only)
+        chose_packed_level = ok_min & (chosen < n_levels)
+        spill = (gang.req_level < 0) & chose_packed_level
+        remaining = jnp.where(spill, gang.count - placed, 0)
+        alloc2, placed2, free_after2 = _fill(
+            free_after, all_nodes, gang.demand, remaining
+        )
+        alloc = jnp.where(spill, alloc + alloc2, alloc)
+        placed_total = jnp.where(spill, placed + placed2, placed)
+        free_final = jnp.where(spill, free_after2, free_after)
+
+        # all-or-nothing: revert capacity if not admitted
+        free_new = jnp.where(ok_min, free_final, free)
+        alloc = jnp.where(ok_min, alloc, 0)
+        placed_total = jnp.where(ok_min, placed_total, 0)
+        any_level = ok_min & (chosen < n_levels)
+        chosen_l = jnp.where(any_level, chosen, -1)
+
+        # placement score: level-weighted dominant-domain co-location
+        pods_per_node = alloc.sum(axis=0)
+        total = jnp.maximum(placed_total.sum(), 1)
+
+        def level_coloc(l):
+            agg = jax.ops.segment_sum(pods_per_node, topo[:, l], num_segments=nseg)
+            return jnp.max(agg).astype(jnp.float32) / total.astype(jnp.float32)
+
+        score = sum(
+            weights[l] * level_coloc(l) for l in range(n_levels)
+        )
+        score = jnp.clip(jnp.where(ok_min, score, 0.0), 0.0, 1.0)
+
+        ys = (ok_min, placed_total, score, chosen_l)
+        if with_alloc:
+            ys = ys + (alloc,)
+        return free_new, ys
+
+    inputs = GangInputs(
+        demand=demand,
+        count=count,
+        min_count=min_count,
+        req_level=req_level,
+        pref_level=pref_level,
+    )
+    free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
+    if with_alloc:
+        admitted, placed, score, chosen_level, alloc = ys
+    else:
+        admitted, placed, score, chosen_level = ys
+        alloc = None
+    return {
+        "admitted": admitted,
+        "placed": placed,
+        "score": score,
+        "chosen_level": chosen_level,
+        "alloc": alloc,
+        "free_after": free_after,
+    }

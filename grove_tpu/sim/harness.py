@@ -39,6 +39,16 @@ class SimHarness:
         )
         register_controllers(self.engine, self.ctx)
         self.cluster = SimCluster(store=self.store, nodes=make_nodes(num_nodes))
+        # TPU-solver-backed gang scheduler (the KAI-replacement); set to None
+        # to fall back to the cluster's naive first-fit binder.
+        from grove_tpu.solver.scheduler import GangScheduler
+
+        self.scheduler = GangScheduler(self.store, self.cluster, self.topology)
+
+    def schedule(self) -> int:
+        if self.scheduler is not None:
+            return self.scheduler.schedule_pending()
+        return self.cluster.schedule_pending()
 
     # -- user actions ----------------------------------------------------
 
@@ -67,7 +77,7 @@ class SimHarness:
         ticks = 0
         for _ in range(max_ticks):
             work = self.engine.drain()
-            bound = self.cluster.schedule_pending()
+            bound = self.schedule()
             started = self.cluster.kubelet_tick()
             work += self.engine.drain()
             ticks += 1

@@ -1,0 +1,58 @@
+"""JAX kernel wrapper: PackingProblem → PackingResult (device execution).
+
+Compilation is AOT-cached per shape signature so `solve_seconds` measures
+steady-state device execution only; compile time is recorded separately in
+the `gang_solve_compile_seconds` metric (one entry per new size bucket).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.ops.packing import solve_packing
+from grove_tpu.solver.types import PackingProblem, PackingResult
+
+_compiled_cache: Dict[Tuple, object] = {}
+
+
+def _get_compiled(args, with_alloc: bool):
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (with_alloc,)
+    compiled = _compiled_cache.get(sig)
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = solve_packing.lower(*args, with_alloc=with_alloc).compile()
+        METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
+        _compiled_cache[sig] = compiled
+    return compiled
+
+
+def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
+    args = (
+        jnp.asarray(problem.capacity),
+        jnp.asarray(problem.topo),
+        jnp.asarray(problem.demand),
+        jnp.asarray(problem.count),
+        jnp.asarray(problem.min_count),
+        jnp.asarray(problem.req_level),
+        jnp.asarray(problem.pref_level),
+    )
+    compiled = _get_compiled(args, with_alloc)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    admitted = np.asarray(out["admitted"])  # device sync
+    elapsed = time.perf_counter() - t0
+    return PackingResult(
+        admitted=admitted,
+        placed=np.asarray(out["placed"]),
+        score=np.asarray(out["score"]),
+        chosen_level=np.asarray(out["chosen_level"]),
+        alloc=None if out["alloc"] is None else np.asarray(out["alloc"]),
+        free_after=np.asarray(out["free_after"]),
+        solve_seconds=elapsed,
+    )

@@ -1,0 +1,153 @@
+"""Reference oracle: plain-NumPy greedy packer with identical semantics.
+
+The quality gate of BASELINE.md: the TPU kernel must stay within 0.5% of this
+oracle's placement quality. Written for clarity, not speed — loops over
+gangs, groups, and domains exactly as the kernel's math does, so small cases
+can be compared assignment-by-assignment and large cases score-by-score.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from grove_tpu.solver.types import PackingProblem, PackingResult
+
+
+def _pods_fit(free: np.ndarray, demand_p: np.ndarray) -> np.ndarray:
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.floor(free / np.where(demand_p > 0, demand_p, 1.0))
+    ratio = np.where(demand_p > 0, ratio, np.inf)
+    k = ratio.min(axis=1)
+    return np.clip(k, 0, 1 << 20).astype(np.int64)
+
+
+def _fill(free, mask, demand, count):
+    P, _ = demand.shape
+    N = free.shape[0]
+    alloc = np.zeros((P, N), dtype=np.int64)
+    placed = np.zeros((P,), dtype=np.int64)
+    free = free.copy()
+    for p in range(P):
+        k = _pods_fit(free, demand[p])
+        k[~mask] = 0
+        k = np.minimum(k, count[p])
+        cum = np.cumsum(k) - k
+        take = np.clip(count[p] - cum, 0, k)
+        alloc[p] = take
+        placed[p] = take.sum()
+        free -= take[:, None] * demand[p][None, :]
+    return alloc, placed, free
+
+
+def _level_weights(L: int) -> np.ndarray:
+    w = np.arange(1, L + 1, dtype=np.float64)
+    return w / w.sum()
+
+
+def solve_oracle(problem: PackingProblem) -> PackingResult:
+    cap = problem.capacity.astype(np.float64).copy()
+    topo = problem.topo
+    N, L = topo.shape
+    G, P, R = problem.demand.shape
+    weights = _level_weights(L)
+
+    admitted = np.zeros((G,), dtype=bool)
+    placed_out = np.zeros((G, P), dtype=np.int32)
+    score_out = np.zeros((G,), dtype=np.float32)
+    chosen_out = np.full((G,), -1, dtype=np.int32)
+    alloc_out = np.zeros((G, P, N), dtype=np.int32)
+
+    for g in range(G):
+        demand = problem.demand[g].astype(np.float64)
+        count = problem.count[g].astype(np.int64)
+        min_count = problem.min_count[g].astype(np.int64)
+        active = count > 0
+        if not active.any():
+            continue
+        req = int(problem.req_level[g])
+
+        # per-level candidate domain (joint-aware aggregate feasibility,
+        # best-fit tie-break), attempted narrowest-first; the fill is the
+        # ground truth — first level whose fill meets the floor wins.
+        k_all = np.stack([_pods_fit(cap, demand[p]) for p in range(P)])
+        min_demand = (min_count[:, None] * demand).sum(axis=0)  # [R]
+        min_allowed = req if req >= 0 else 0
+        pref = int(problem.pref_level[g])
+        pref_eff = pref if pref >= 0 else L - 1
+        # same preference order as the kernel: closest to preferred level,
+        # narrower wins ties, required floor respected
+        level_order = sorted(
+            range(min_allowed, L),
+            key=lambda l: (abs(l - pref_eff), l <= pref_eff),
+        )
+        chosen_level = None
+        alloc = placed = free_after = None
+        for l in level_order:
+            seg = topo[:, l]
+            nseg = seg.max() + 1
+            K = np.stack(
+                [np.bincount(seg, weights=k_all[p], minlength=nseg) for p in range(P)]
+            )
+            free_agg = np.stack(
+                [
+                    np.bincount(seg, weights=cap[:, r], minlength=nseg)
+                    for r in range(R)
+                ],
+                axis=1,
+            )  # [nseg, R]
+            feas = np.all(free_agg >= min_demand[None, :], axis=1)
+            spare = np.zeros((nseg,))
+            for p in range(P):
+                if active[p]:
+                    feas &= K[p] >= min_count[p]
+                    spare += K[p] - count[p]
+            if not feas.any():
+                continue
+            spare[~feas] = np.inf
+            mask = seg == int(np.argmin(spare))
+            a, pl, fa = _fill(cap, mask, demand, count)
+            if all(pl[p] >= min_count[p] for p in range(P) if active[p]):
+                chosen_level, alloc, placed, free_after = l, a, pl, fa
+                break
+
+        if chosen_level is None:
+            if req >= 0:
+                continue  # required pack unsatisfiable → unplaced
+            mask = np.ones((N,), dtype=bool)  # cluster-wide fallback
+            alloc, placed, free_after = _fill(cap, mask, demand, count)
+            if not all(placed[p] >= min_count[p] for p in range(P) if active[p]):
+                continue  # all-or-nothing: no capacity consumed
+        elif req < 0:
+            # best-effort extras spill cluster-wide
+            alloc2, placed2, free_after = _fill(
+                free_after, np.ones((N,), dtype=bool), demand, count - placed
+            )
+            alloc += alloc2
+            placed += placed2
+
+        cap = free_after
+        admitted[g] = True
+        placed_out[g] = placed
+        alloc_out[g] = alloc
+        chosen_out[g] = -1 if chosen_level is None else chosen_level
+
+        pods_per_node = alloc.sum(axis=0)
+        total = max(int(placed.sum()), 1)
+        score = 0.0
+        for l in range(L):
+            agg = np.bincount(
+                topo[:, l], weights=pods_per_node, minlength=topo[:, l].max() + 1
+            )
+            score += weights[l] * (agg.max() / total)
+        score_out[g] = min(score, 1.0)
+
+    return PackingResult(
+        admitted=admitted,
+        placed=placed_out,
+        score=score_out,
+        chosen_level=chosen_out,
+        alloc=alloc_out,
+        free_after=cap.astype(np.float32),
+    )

@@ -1,0 +1,236 @@
+"""Solver-backed gang scheduler: the KAI-replacement binding loop.
+
+Occupies the boundary the reference delegates to the external KAI scheduler
+(SURVEY §2 'scheduler contract'): consumes PodGangs + ungated pods, encodes
+pending work as dense tensors, runs the TPU packing kernel, binds pods to
+nodes, and writes PodGang status (phase, Scheduled condition, PlacementScore
+— scheduler podgang.go:139-176).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.pod import is_scheduled, is_terminating
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.api.types import (
+    COND_PODGANG_SCHEDULED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_STARTING,
+)
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.store import Store
+from grove_tpu.sim.cluster import SimCluster
+from grove_tpu.solver.encode import build_problem
+from grove_tpu.solver.kernel import solve
+
+
+class GangScheduler:
+    """All-or-nothing, topology-aware binder over a SimCluster."""
+
+    def __init__(
+        self,
+        store: Store,
+        cluster: SimCluster,
+        topology: Optional[ClusterTopology] = None,
+        priority_map: Optional[Dict[str, int]] = None,
+    ) -> None:
+        self.store = store
+        self.cluster = cluster
+        self.topology = topology or ClusterTopology()
+        # priorityClassName -> numeric priority (higher schedules first)
+        self.priority_map = priority_map or {}
+
+    # -- main loop -------------------------------------------------------
+
+    def schedule_pending(self, namespace: str = "default") -> int:
+        self.cluster._gc_bindings()
+        self.update_gang_phases(namespace)
+        pending = self._pending_pods(namespace)
+        if not pending:
+            return 0
+        gang_specs, gang_pods, loose_pods = self._encode_pending(namespace, pending)
+
+        bound = 0
+        if gang_specs:
+            free = {
+                node.name: self.cluster.node_free(node)
+                for node in self.cluster.nodes
+                if not node.cordoned
+            }
+            nodes = [n for n in self.cluster.nodes if not n.cordoned]
+            if nodes:
+                problem = build_problem(
+                    nodes, gang_specs, self.topology, free_capacity=free
+                )
+                result = solve(problem)
+                METRICS.observe("gang_solve_seconds", result.solve_seconds)
+                assignments = result.assignments(problem)
+                for gi, spec in enumerate(gang_specs):
+                    gang_name = spec["name"]
+                    if not result.admitted[gi]:
+                        continue
+                    for pclq_fqn, node_names in assignments[gang_name].items():
+                        pods = gang_pods[gang_name].get(pclq_fqn, [])
+                        for pod, node_name in zip(pods, node_names):
+                            self.cluster.bind(pod, node_name)
+                            bound += 1
+                    # A recovery delta-solve (floors reduced by pods already
+                    # placed) only covers the missing pods; its score says
+                    # nothing about the whole gang — keep the original.
+                    partial = any(g["partial"] for g in spec["groups"])
+                    self._mark_scheduled(
+                        namespace,
+                        gang_name,
+                        None if partial else float(result.score[gi]),
+                    )
+
+        # pods not in any gang (shouldn't happen for grove pods): first-fit
+        for pod in loose_pods:
+            for node in self.cluster.nodes:
+                if not node.cordoned and self.cluster.fits(node, pod):
+                    self.cluster.bind(pod, node.name)
+                    bound += 1
+                    break
+        return bound
+
+    # -- helpers ---------------------------------------------------------
+
+    def _pending_pods(self, namespace: str) -> List:
+        return [
+            p
+            for p in self.store.list("Pod", namespace)
+            if not p.spec.scheduling_gates
+            and not is_scheduled(p)
+            and not is_terminating(p)
+        ]
+
+    def _encode_pending(self, namespace: str, pending: List):
+        by_gang: Dict[str, List] = defaultdict(list)
+        loose = []
+        for pod in pending:
+            gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
+            if gang_name:
+                by_gang[gang_name].append(pod)
+            else:
+                loose.append(pod)
+
+        gang_specs: List[dict] = []
+        gang_pods: Dict[str, Dict[str, List]] = {}
+        for gang_name, pods in sorted(by_gang.items()):
+            gang_cr = self.store.get("PodGang", namespace, gang_name)
+            if gang_cr is None:
+                loose.extend(pods)
+                continue
+            groups_cr = {g.name: g for g in gang_cr.spec.pod_groups}
+            by_pclq: Dict[str, List] = defaultdict(list)
+            for pod in pods:
+                by_pclq[pod.metadata.labels.get(namegen.LABEL_PODCLIQUE, "")].append(
+                    pod
+                )
+            groups = []
+            for pclq_fqn, members in sorted(by_pclq.items()):
+                members.sort(key=lambda p: p.metadata.name)
+                group_cr = groups_cr.get(pclq_fqn)
+                min_replicas = group_cr.min_replicas if group_cr else len(members)
+                already = self._scheduled_count(namespace, pclq_fqn)
+                groups.append(
+                    {
+                        "name": pclq_fqn,
+                        "demand": members[0].spec.total_requests(),
+                        "count": len(members),
+                        # floor reduced by already-scheduled pods (recovery)
+                        "min_count": max(0, min_replicas - already),
+                        "partial": already > 0,
+                    }
+                )
+            required_key = preferred_key = None
+            tc = gang_cr.spec.topology_constraint
+            if tc is not None and tc.pack_constraint is not None:
+                required_key = tc.pack_constraint.required
+                preferred_key = tc.pack_constraint.preferred
+            gang_specs.append(
+                {
+                    "name": gang_name,
+                    "groups": groups,
+                    "required_key": required_key,
+                    "preferred_key": preferred_key,
+                    "priority": self.priority_map.get(
+                        gang_cr.spec.priority_class_name, 0
+                    ),
+                }
+            )
+            gang_pods[gang_name] = dict(by_pclq)
+
+        # higher priority commits first (kernel admits in input order)
+        order = sorted(
+            range(len(gang_specs)),
+            key=lambda i: (-gang_specs[i]["priority"], gang_specs[i]["name"]),
+        )
+        gang_specs = [gang_specs[i] for i in order]
+        return gang_specs, gang_pods, loose
+
+    def _scheduled_count(self, namespace: str, pclq_fqn: str) -> int:
+        return sum(
+            1
+            for p in self.store.list(
+                "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
+            )
+            if is_scheduled(p) and not is_terminating(p)
+        )
+
+    def _mark_scheduled(
+        self, namespace: str, gang_name: str, score: Optional[float]
+    ) -> None:
+        gang = self.store.get("PodGang", namespace, gang_name)
+        if gang is None:
+            return
+        if gang.status.phase == PHASE_PENDING:
+            gang.status.phase = PHASE_STARTING
+        if score is not None:
+            gang.status.placement_score = score
+        set_condition(
+            gang.status.conditions,
+            Condition(
+                type=COND_PODGANG_SCHEDULED,
+                status="True",
+                reason="AllPodGroupsPlaced",
+                message=f"placement score {gang.status.placement_score}",
+            ),
+            self.store.clock.now(),
+        )
+        self.store.update_status(gang)
+
+    def update_gang_phases(self, namespace: str = "default") -> None:
+        """Advance Starting → Running (+ Ready condition) once every pod of
+        the gang is Ready (scheduler podgang.go:139-151 phase semantics)."""
+        from grove_tpu.api.pod import is_ready
+
+        for gang in self.store.list("PodGang", namespace):
+            if gang.status.phase != PHASE_STARTING:
+                continue
+            all_ready = True
+            total = 0
+            for group in gang.spec.pod_groups:
+                for ref in group.pod_references:
+                    total += 1
+                    pod = self.store.get("Pod", ref.namespace, ref.name)
+                    if pod is None or not is_ready(pod):
+                        all_ready = False
+            if total and all_ready:
+                gang.status.phase = PHASE_RUNNING
+                set_condition(
+                    gang.status.conditions,
+                    Condition(
+                        type="Ready",
+                        status="True",
+                        reason="AllPodGroupsReady",
+                        message="all constituent pods are ready",
+                    ),
+                    self.store.clock.now(),
+                )
+                self.store.update_status(gang)

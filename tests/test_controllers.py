@@ -193,7 +193,7 @@ class TestStartupOrdering:
         first_ready = {}
         for _ in range(30):
             harness.engine.drain()
-            harness.cluster.schedule_pending()
+            harness.schedule()
             harness.cluster.kubelet_tick()
             harness.engine.drain()
             for pod in harness.store.list("Pod"):

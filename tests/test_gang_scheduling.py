@@ -1,0 +1,135 @@
+"""Gang-scheduling e2e scenarios on the solver-backed sim — modeled on the
+reference's GS1-GS12 k3d suite (e2e/tests/gang_scheduling_test.go), using
+capacity pressure instead of cordons where noted."""
+
+import pathlib
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.pod import is_ready, is_scheduled
+from grove_tpu.api.types import TopologyConstraint
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def simple1():
+    return load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+
+
+class TestAllOrNothing:
+    def test_insufficient_capacity_blocks_whole_gang(self):
+        """GS1 analogue: gang needs 9 pods x 10m cpu; give the cluster less.
+        NOTHING may be scheduled (no partial gangs)."""
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.05}  # fits 5 pods only
+        harness.apply(simple1())
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert len(pods) == 9
+        assert all(not is_scheduled(p) for p in pods), harness.tree()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang.status.placement_score is None
+
+    def test_capacity_added_admits_gang(self):
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.05}
+        harness.apply(simple1())
+        harness.converge()
+        harness.cluster.nodes[0].capacity = {"cpu": 1.0}
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang.status.placement_score is not None
+        assert 0.0 < gang.status.placement_score <= 1.0
+
+    def test_scaled_gang_admitted_independently(self):
+        """GS partial-capacity analogue: base gang fits, scaled gang doesn't —
+        base must run, scaled must stay fully pending."""
+        harness = SimHarness(num_nodes=1)
+        # base = 9 pods x 10m = 0.09; scaled adds 4 pods x 10m
+        harness.cluster.nodes[0].capacity = {"cpu": 0.1}
+        pcs = simple1()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 2
+        harness.apply(pcs)
+        harness.converge()
+        base_pods = [
+            p
+            for p in harness.store.list("Pod")
+            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
+        ]
+        scaled_pods = [
+            p
+            for p in harness.store.list("Pod")
+            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0-sga-0"
+        ]
+        assert base_pods and all(is_ready(p) for p in base_pods), harness.tree()
+        assert scaled_pods and all(not is_scheduled(p) for p in scaled_pods)
+
+
+class TestTopologyPacking:
+    def test_pack_domain_respected_end_to_end(self):
+        """A PCS with packDomain: ici-block must land inside one block."""
+        harness = SimHarness(num_nodes=16)  # 4 hosts/block
+        pcs = simple1()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        node_by_name = {n.name: n for n in harness.cluster.nodes}
+        blocks = {
+            node_by_name[p.status.node_name].labels[
+                "cloud.google.com/gke-tpu-ici-block"
+            ]
+            for p in pods
+        }
+        assert len(blocks) == 1, blocks
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        tc = gang.spec.topology_constraint.pack_constraint
+        assert tc.required == "cloud.google.com/gke-tpu-ici-block"
+
+    def test_unpackable_required_domain_blocks_gang(self):
+        """Required pack into one block that can't hold the gang → pending."""
+        harness = SimHarness(num_nodes=16)
+        for n in harness.cluster.nodes:
+            n.capacity = {"cpu": 0.02}  # 2 pods/node → 8 pods per block
+        pcs = load_podcliqueset_file(
+            str(REPO / "samples" / "multinode-disaggregated.yaml")
+        )
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.containers[0].requests = {"cpu": 0.01}
+        # base gang = pleader 1 + pworker 6 + dleader 1 + dworker 2 = 10 pods
+        pcs.spec.template.cliques[1].spec.replicas = 6
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 1
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        harness.apply(pcs)
+        harness.converge()
+        # base gang = 10 pods > one block's 8-pod capacity → nothing runs
+        pods = harness.store.list("Pod")
+        assert pods and all(not is_scheduled(p) for p in pods), harness.tree()
+        # relaxing to slice (4 blocks) admits it
+        pcs2 = harness.store.get("PodCliqueSet", "default", pcs.metadata.name)
+        pcs2.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="slice"
+        )
+        harness.store.update(pcs2)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+
+class TestPlacementScore:
+    def test_score_reported_on_gang_status(self):
+        harness = SimHarness(num_nodes=16)
+        harness.apply(simple1())
+        harness.converge()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        assert pcs.status.pod_gang_statuses
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang.status.placement_score is not None
