@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Driver benchmark: the BASELINE.json stress sim.
+
+Places 10k synthetic PodGangs onto a simulated 5k-node / 40k-TPU cluster with
+the device-resident wave solver and reports ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+value  = p99 solve latency (seconds) over repeated full solves
+vs_baseline = target_p99 / measured_p99 (target 1.0s from BASELINE.json;
+              >1 means faster than target)
+
+Also reports placement-quality versus the exact sequential-greedy oracle
+semantics (quality_vs_exact; the BASELINE gate allows >= 0.995).
+
+Usage: python bench.py [--small] [--runs N]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
+    from grove_tpu.api.topology import ClusterTopology
+    from grove_tpu.sim.cluster import make_nodes
+    from grove_tpu.solver.encode import build_problem
+
+    rng = np.random.default_rng(seed)
+    nodes = make_nodes(
+        n_nodes,
+        capacity={"cpu": 128.0, "tpu": 8.0},
+        hosts_per_ici_block=8,
+        blocks_per_slice=8,
+    )
+    gangs = []
+    for i in range(n_gangs):
+        # headline mix: mostly small gangs (the cluster can hold them all),
+        # a tail of multi-group disaggregated-style gangs with pack hints
+        if i % 8 == 0:
+            n_groups = int(rng.integers(2, 4))
+            groups = [
+                {
+                    "name": f"g{i}-{p}",
+                    "demand": {
+                        "tpu": float(rng.integers(1, 3)),
+                        "cpu": float(rng.integers(1, 9)),
+                    },
+                    "count": int(rng.integers(1, 5)),
+                    "min_count": None,
+                }
+                for p in range(n_groups)
+            ]
+            required = "cloud.google.com/gke-tpu-slice"
+        else:
+            groups = [
+                {
+                    "name": f"g{i}-0",
+                    "demand": {"tpu": 1.0, "cpu": 2.0},
+                    "count": int(rng.integers(2, 5)),
+                    "min_count": None,
+                }
+            ]
+            required = None
+        for g in groups:
+            g["min_count"] = g["count"]
+        gangs.append(
+            {
+                "name": f"g{i}",
+                "groups": groups,
+                "required_key": required,
+                "preferred_key": None,
+                "priority": 0,
+            }
+        )
+    return build_problem(nodes, gangs, ClusterTopology())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--small", action="store_true", help="reduced size smoke run")
+    parser.add_argument("--runs", type=int, default=7)
+    args = parser.parse_args()
+
+    from grove_tpu.solver.kernel import solve, solve_waves_stats
+
+    n_nodes, n_gangs = (512, 1024) if args.small else (5120, 10240)
+    target_p99 = 1.0  # BASELINE.json: 10k gangs onto 5k nodes in <1s p99
+
+    problem = build_stress_problem(n_nodes, n_gangs)
+    # warm (compile excluded from the measured runs)
+    result = solve_waves_stats(problem)
+
+    times = []
+    for _ in range(args.runs):
+        result = solve_waves_stats(problem)
+        times.append(result.solve_seconds)
+    times.sort()
+    p99 = times[min(len(times) - 1, int(np.ceil(0.99 * len(times))) - 1)]
+
+    # quality vs the exact sequential-greedy kernel (oracle semantics)
+    exact = solve(problem, with_alloc=False)
+    wave_quality = float(result.score.sum())
+    exact_quality = float(exact.score.sum())
+    quality = wave_quality / exact_quality if exact_quality else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "p99 placement latency, 10k gangs x 5k nodes/40k TPUs",
+                "value": round(p99, 4),
+                "unit": "seconds",
+                "vs_baseline": round(target_p99 / p99, 2),
+                "gangs_per_sec": round(n_gangs / p99),
+                "admitted": int(result.admitted.sum()),
+                "pods_placed": int(result.placed.sum()),
+                "quality_vs_exact": round(quality, 4),
+                "median_s": round(times[len(times) // 2], 4),
+            }
+        )
+    )
+    if quality < 0.995:
+        print(
+            f"WARNING: quality_vs_exact {quality:.4f} below the 0.995 gate",
+            file=sys.stderr,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,29 +1,35 @@
-"""Batched all-or-nothing gang packing kernel (JAX/XLA, TPU-first).
+"""Batched all-or-nothing gang packing kernels (JAX/XLA, TPU-first).
 
 The hot path of the framework: places G pending gangs onto N nodes with
 hierarchical topology packing, replacing the external KAI scheduler of the
 reference architecture (SURVEY §2, BASELINE.json north star).
 
-Design for the MXU/VPU + XLA compilation model:
-- ONE `lax.scan` over gangs (sequential commit is inherent to all-or-nothing
-  packing: each admission consumes capacity) — everything inside a step is
-  wide vector math over the node axis, which XLA fuses and vectorizes.
-- static shapes everywhere: problems are padded into size buckets so each
-  bucket compiles once and is cached.
-- topology choice is computed for ALL levels with `segment_sum` over
-  pre-sorted, contiguously-numbered domains, then the narrowest feasible
-  allowed level is selected branch-free.
+Two kernels share one per-gang placement routine (`gang_select_and_fill`):
+
+- `solve_packing` — EXACT sequential greedy: one `lax.scan` over gangs,
+  matching the NumPy oracle decision-for-decision. The parity baseline.
+- `solve_wave_chunk` — the SCALE path: a chunk of gangs is decided in
+  parallel (vmap) against the same capacity snapshot, then committed by a
+  cheap sequential capacity-check scan; conflicting gangs retry in the next
+  wave (host loop in grove_tpu.solver.kernel). Wave convergence trades exact
+  greedy order within a chunk for massive parallelism; quality is gated
+  against the oracle (≤0.5% regression, BASELINE.md).
+
+Design for the MXU/VPU + XLA compilation model: static shapes (bucketed
+padding), wide vector math over the node axis, `segment_sum` over pre-sorted
+contiguously-numbered topology domains, branch-free level selection, L+1
+unrolled fused fills.
 
 Semantics (mirroring the PodGang contract, scheduler podgang.go:50-114):
 - a gang is ADMITTED iff every group places >= min_count pods (MinReplicas
   floor); extra pods up to `count` are placed best-effort with the gang.
 - `req_level` (TopologyPackConstraint.Required): the gang must fit inside ONE
   domain at that level or narrower; no cluster-wide fallback.
-- `pref_level` (…Preferred): narrower levels are tried first; falls back to
-  broader levels, then cluster-wide scatter when no single domain fits.
+- `pref_level` (…Preferred): that level is tried first, then levels closest
+  to it (narrower wins ties), then cluster-wide scatter. -1 → narrowest.
 - PlacementScore: level-weighted co-location — for each level, the fraction
   of the gang's pods inside its dominant domain, weighted toward narrow
-  levels; 1.0 = everything on one node-domain at the narrowest level.
+  levels; 1.0 = everything inside one narrowest-level domain.
 """
 
 from __future__ import annotations
@@ -80,10 +86,189 @@ def _level_weights(num_levels: int) -> jnp.ndarray:
     return w / w.sum()
 
 
+def _aggregate_tables(free: jnp.ndarray, gang: GangInputs):
+    """Shared prelude of both per-gang selectors: capped per-node fit counts,
+    prefix-sum tables for boundary gathers, float-cumsum tolerance, and the
+    admission floor's joint resource demand."""
+    active = gang.count > 0
+    k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
+    # cap per-node fits at the group count: preserves every >=min/>=count
+    # comparison (sum-of-mins bound) while keeping int32 prefix sums exact
+    k_all = jnp.minimum(k_all, gang.count[:, None])
+    min_demand = jnp.sum(
+        gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
+    )  # [R]
+    zero_col = jnp.zeros((k_all.shape[0], 1), dtype=k_all.dtype)
+    cs_k = jnp.concatenate([zero_col, jnp.cumsum(k_all, axis=1)], axis=1)
+    cs_free = jnp.concatenate(
+        [jnp.zeros((1, free.shape[1]), dtype=free.dtype), jnp.cumsum(free, axis=0)],
+        axis=0,
+    )
+    # float32 prefix sums of byte-scale capacity accumulate rounding error;
+    # slack the joint check so it can only false-KEEP (the fill is exact)
+    free_tol = 1e-5 * cs_free[-1]
+    return active, cs_k, cs_free, free_tol, min_demand
+
+
+def _coloc_score(
+    alloc, placed_total, seg_starts, seg_ends, weights, ok
+):
+    """Level-weighted dominant-domain co-location score (shared)."""
+    n_levels = seg_starts.shape[0]
+    pods_per_node = alloc.sum(axis=0)
+    total = jnp.maximum(placed_total.sum(), 1)
+    cs_pods = jnp.concatenate(
+        [jnp.zeros((1,), dtype=pods_per_node.dtype), jnp.cumsum(pods_per_node)]
+    )
+    score = sum(
+        weights[l]
+        * (
+            jnp.max(cs_pods[seg_ends[l]] - cs_pods[seg_starts[l]]).astype(
+                jnp.float32
+            )
+            / total.astype(jnp.float32)
+        )
+        for l in range(n_levels)
+    )
+    return jnp.clip(jnp.where(ok, score, 0.0), 0.0, 1.0)
+
+
+def gang_select_and_fill(
+    free: jnp.ndarray,
+    topo: jnp.ndarray,
+    seg_starts: jnp.ndarray,  # [L, D] contiguous-domain boundaries
+    seg_ends: jnp.ndarray,  # [L, D]
+    gang: GangInputs,
+):
+    """One gang's placement decision against `free`.
+
+    Shared by the exact sequential kernel (inside lax.scan) and the wave
+    kernel (vmapped across a chunk against one capacity snapshot).
+    Returns (free_new, alloc [P,N], placed [P], ok_min, chosen_l, score).
+
+    Topology-sorted nodes make every domain a contiguous slab, so all
+    per-domain aggregates are prefix-sum boundary gathers — no scatters
+    (TPU scatters serialize; gathers vectorize).
+    """
+    n_nodes, n_levels = topo.shape
+    weights = _level_weights(n_levels)
+
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(free, gang)
+    any_active = jnp.any(active)
+    all_nodes = jnp.ones((n_nodes,), dtype=bool)
+    no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+
+    # Per-level candidate domain: per-group fit counts AND joint resource
+    # feasibility (both optimistic w.r.t. fragmentation — the actual fill
+    # below is the ground truth). Best-fit tie-break by smallest spare.
+    def level_candidate(l):
+        starts = seg_starts[l]
+        ends = seg_ends[l]
+        K = cs_k[:, ends] - cs_k[:, starts]  # [P, D] gather
+        free_agg = cs_free[ends] - cs_free[starts]  # [D, R] gather
+        feas = jnp.all(
+            jnp.where(active[:, None], K >= gang.min_count[:, None], True),
+            axis=0,
+        )
+        feas &= jnp.all(
+            free_agg >= (min_demand - free_tol)[None, :], axis=1
+        )
+        feas &= ends > starts  # padded empty domains never selected
+        feas &= any_active  # a fully-padded gang selects nothing
+        # Best-fit: primary key is leftover fit-count (K is capped at the
+        # gang's count, so full-fit domains tie at spare=0 — break the tie
+        # toward the domain with the least total free capacity, preserving
+        # large domains for large gangs)
+        spare = jnp.sum(
+            jnp.where(active[:, None], K - gang.count[:, None], 0), axis=0
+        )
+        free_total = jnp.sum(free_agg, axis=1)
+        tie = free_total / (jnp.max(free_total) + 1.0)
+        key = spare.astype(jnp.float32) + tie.astype(jnp.float32)
+        best = jnp.argmin(jnp.where(feas, key, jnp.inf))
+        return jnp.any(feas), best
+
+    # Try the actual fill at every level (narrow masks included) plus a
+    # cluster-wide candidate; choose by preference among levels whose fill
+    # truly meets the admission floor. L is small and static → L+1 fused
+    # unrolled fills.
+    lv = jnp.arange(n_levels)
+    min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
+
+    cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
+    for l in range(n_levels):
+        ok_l, best_l = level_candidate(l)
+        mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
+        alloc_l, placed_l, free_l = _fill(free, mask_l, gang.demand, gang.count)
+        fill_ok = (
+            ok_l
+            & (lv[l] >= min_allowed)
+            & jnp.all(jnp.where(active, placed_l >= gang.min_count, True))
+        )
+        cand_alloc.append(alloc_l)
+        cand_placed.append(placed_l)
+        cand_free.append(free_l)
+        cand_ok.append(fill_ok)
+    # cluster-wide fallback (only when no required pack level)
+    alloc_c, placed_c, free_c = _fill(free, all_nodes, gang.demand, gang.count)
+    cluster_ok = (
+        (gang.req_level < 0)
+        & any_active
+        & jnp.all(jnp.where(active, placed_c >= gang.min_count, True))
+    )
+    cand_alloc.append(alloc_c)
+    cand_placed.append(placed_c)
+    cand_free.append(free_c)
+    cand_ok.append(cluster_ok)
+
+    oks = jnp.stack(cand_ok)  # [L+1]
+    # Preference order (TopologyPackConstraint.Preferred): preferred level
+    # first, then closest levels (narrower wins ties), cluster-wide last.
+    pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
+    pref_rank = jnp.concatenate(
+        [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
+    )  # cluster rank 0
+    chosen = jnp.argmax(jnp.where(oks, pref_rank + 1, 0))
+    ok_min = jnp.any(oks)
+
+    one_hot = jax.nn.one_hot(chosen, n_levels + 1, dtype=free.dtype)
+    alloc = sum(
+        one_hot[i] * cand_alloc[i].astype(free.dtype) for i in range(n_levels + 1)
+    ).astype(jnp.int32)
+    placed = sum(
+        one_hot[i] * cand_placed[i].astype(free.dtype) for i in range(n_levels + 1)
+    ).astype(jnp.int32)
+    free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
+
+    # best-effort extras: pods beyond the packed domain scatter cluster-wide
+    # (no required constraint only)
+    chose_packed_level = ok_min & (chosen < n_levels)
+    spill = (gang.req_level < 0) & chose_packed_level
+    remaining = jnp.where(spill, gang.count - placed, 0)
+    alloc2, placed2, free_after2 = _fill(free_after, all_nodes, gang.demand, remaining)
+    alloc = jnp.where(spill, alloc + alloc2, alloc)
+    placed_total = jnp.where(spill, placed + placed2, placed)
+    free_final = jnp.where(spill, free_after2, free_after)
+
+    # all-or-nothing: revert capacity if not admitted
+    free_new = jnp.where(ok_min, free_final, free)
+    alloc = jnp.where(ok_min, alloc, 0)
+    placed_total = jnp.where(ok_min, placed_total, 0)
+    any_level = ok_min & (chosen < n_levels)
+    chosen_l = jnp.where(any_level, chosen, -1)
+
+    score = _coloc_score(alloc, placed_total, seg_starts, seg_ends, weights, ok_min)
+
+    return free_new, alloc, placed_total, ok_min, chosen_l, score
+
+
 @partial(jax.jit, static_argnames=("with_alloc",))
 def solve_packing(
     capacity: jnp.ndarray,  # [N, R] float32
     topo: jnp.ndarray,  # [N, L] int32, dense ids per level
+    seg_starts: jnp.ndarray,  # [L, D] contiguous-domain boundaries
+    seg_ends: jnp.ndarray,  # [L, D]
     demand: jnp.ndarray,  # [G, P, R] float32
     count: jnp.ndarray,  # [G, P] int32
     min_count: jnp.ndarray,  # [G, P] int32
@@ -91,138 +276,13 @@ def solve_packing(
     pref_level: jnp.ndarray,  # [G] int32 (-1 → narrowest)
     with_alloc: bool = True,
 ):
-    n_nodes, n_levels = topo.shape
-    nseg = n_nodes  # dense per-level domain ids are < N
-    weights = _level_weights(n_levels)
+    """Exact sequential greedy (oracle-parity kernel)."""
 
     def gang_step(free, gang: GangInputs):
-        active = gang.count > 0
-        any_active = jnp.any(active)
-        k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
-        # aggregate resource demand of the admission floor (joint check)
-        min_demand = jnp.sum(
-            gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
-        )  # [R]
-
-        all_nodes = jnp.ones((n_nodes,), dtype=bool)
-        no_nodes = jnp.zeros((n_nodes,), dtype=bool)
-
-        # Per-level candidate domain: per-group fit counts AND joint resource
-        # feasibility (both optimistic w.r.t. fragmentation — the actual fill
-        # below is the ground truth). Best-fit tie-break by smallest spare.
-        def level_candidate(l):
-            seg = topo[:, l]
-            K = jax.vmap(
-                lambda kp: jax.ops.segment_sum(kp, seg, num_segments=nseg)
-            )(k_all)  # [P, nseg]
-            free_agg = jax.vmap(
-                lambda col: jax.ops.segment_sum(col, seg, num_segments=nseg),
-                in_axes=1,
-                out_axes=1,
-            )(free)  # [nseg, R]
-            feas = jnp.all(
-                jnp.where(active[:, None], K >= gang.min_count[:, None], True),
-                axis=0,
-            )
-            feas &= jnp.all(free_agg >= min_demand[None, :], axis=1)
-            feas &= any_active  # a fully-padded gang selects nothing
-            spare = jnp.sum(
-                jnp.where(active[:, None], K - gang.count[:, None], 0), axis=0
-            )
-            best = jnp.argmin(jnp.where(feas, spare, jnp.inf).astype(jnp.float32))
-            return jnp.any(feas), best
-
-        # Try the actual fill at every level (narrow masks included) plus a
-        # cluster-wide candidate; choose the narrowest allowed level whose
-        # fill truly meets the admission floor. L is small and static, so
-        # this unrolls into L+1 fused fills.
-        lv = jnp.arange(n_levels)
-        min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
-
-        cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
-        for l in range(n_levels):
-            ok_l, best_l = level_candidate(l)
-            mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
-            alloc_l, placed_l, free_l = _fill(free, mask_l, gang.demand, gang.count)
-            fill_ok = (
-                ok_l
-                & (lv[l] >= min_allowed)
-                & jnp.all(jnp.where(active, placed_l >= gang.min_count, True))
-            )
-            cand_alloc.append(alloc_l)
-            cand_placed.append(placed_l)
-            cand_free.append(free_l)
-            cand_ok.append(fill_ok)
-        # cluster-wide fallback (only when no required pack level)
-        alloc_c, placed_c, free_c = _fill(free, all_nodes, gang.demand, gang.count)
-        cluster_ok = (
-            (gang.req_level < 0)
-            & any_active
-            & jnp.all(jnp.where(active, placed_c >= gang.min_count, True))
+        free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
+            free, topo, seg_starts, seg_ends, gang
         )
-        cand_alloc.append(alloc_c)
-        cand_placed.append(placed_c)
-        cand_free.append(free_c)
-        cand_ok.append(cluster_ok)
-
-        oks = jnp.stack(cand_ok)  # [L+1]
-        # Preference order (TopologyPackConstraint.Preferred): try the
-        # preferred level first, then levels closest to it (narrower wins
-        # ties), cluster-wide last. pref_level=-1 → narrowest level first.
-        pref_eff = jnp.where(
-            gang.pref_level >= 0, gang.pref_level, n_levels - 1
-        )
-        level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
-        pref_rank = jnp.concatenate(
-            [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
-        )  # cluster rank 0
-        chosen = jnp.argmax(jnp.where(oks, pref_rank + 1, 0))
-        ok_min = jnp.any(oks)
-
-        one_hot = jax.nn.one_hot(chosen, n_levels + 1, dtype=free.dtype)
-        alloc = sum(
-            one_hot[i] * cand_alloc[i].astype(free.dtype)
-            for i in range(n_levels + 1)
-        ).astype(jnp.int32)
-        placed = sum(
-            one_hot[i] * cand_placed[i].astype(free.dtype)
-            for i in range(n_levels + 1)
-        ).astype(jnp.int32)
-        free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
-
-        # best-effort extras: pods beyond the packed domain scatter
-        # cluster-wide (no required constraint only)
-        chose_packed_level = ok_min & (chosen < n_levels)
-        spill = (gang.req_level < 0) & chose_packed_level
-        remaining = jnp.where(spill, gang.count - placed, 0)
-        alloc2, placed2, free_after2 = _fill(
-            free_after, all_nodes, gang.demand, remaining
-        )
-        alloc = jnp.where(spill, alloc + alloc2, alloc)
-        placed_total = jnp.where(spill, placed + placed2, placed)
-        free_final = jnp.where(spill, free_after2, free_after)
-
-        # all-or-nothing: revert capacity if not admitted
-        free_new = jnp.where(ok_min, free_final, free)
-        alloc = jnp.where(ok_min, alloc, 0)
-        placed_total = jnp.where(ok_min, placed_total, 0)
-        any_level = ok_min & (chosen < n_levels)
-        chosen_l = jnp.where(any_level, chosen, -1)
-
-        # placement score: level-weighted dominant-domain co-location
-        pods_per_node = alloc.sum(axis=0)
-        total = jnp.maximum(placed_total.sum(), 1)
-
-        def level_coloc(l):
-            agg = jax.ops.segment_sum(pods_per_node, topo[:, l], num_segments=nseg)
-            return jnp.max(agg).astype(jnp.float32) / total.astype(jnp.float32)
-
-        score = sum(
-            weights[l] * level_coloc(l) for l in range(n_levels)
-        )
-        score = jnp.clip(jnp.where(ok_min, score, 0.0), 0.0, 1.0)
-
-        ys = (ok_min, placed_total, score, chosen_l)
+        ys = (ok_min, placed, score, chosen_l)
         if with_alloc:
             ys = ys + (alloc,)
         return free_new, ys
@@ -247,4 +307,374 @@ def solve_packing(
         "chosen_level": chosen_level,
         "alloc": alloc,
         "free_after": free_after,
+    }
+
+
+@jax.jit
+def solve_wave_chunk(
+    free: jnp.ndarray,  # [N, R]
+    topo: jnp.ndarray,  # [N, L]
+    seg_starts: jnp.ndarray,  # [L, D]
+    seg_ends: jnp.ndarray,  # [L, D]
+    demand: jnp.ndarray,  # [C, P, R] — one CHUNK of gangs
+    count: jnp.ndarray,  # [C, P] (zeroed for already-settled gangs)
+    min_count: jnp.ndarray,  # [C, P]
+    req_level: jnp.ndarray,  # [C]
+    pref_level: jnp.ndarray,  # [C]
+):
+    """One wave over one chunk: decide all C gangs in parallel against the
+    same capacity snapshot, then commit sequentially with a cheap per-node
+    validity re-check. Returns per-gang results + updated free.
+
+    `retry[i]` marks gangs whose parallel decision met the floor but clashed
+    with an earlier commit in this chunk — the host requeues them for the
+    next wave (their next decision sees the updated capacity).
+    """
+    inputs = GangInputs(
+        demand=demand,
+        count=count,
+        min_count=min_count,
+        req_level=req_level,
+        pref_level=pref_level,
+    )
+    # Phase A: parallel decisions (vmap over the chunk). free_new is ignored;
+    # commitment happens in phase B.
+    _, alloc, placed, ok_min, chosen_l, score = jax.vmap(
+        gang_select_and_fill, in_axes=(None, None, None, None, 0)
+    )(free, topo, seg_starts, seg_ends, inputs)
+
+    # Phase B: sequential commit. usage[g] = alloc[g]^T demand[g] per node.
+    def commit_step(free_c, xs):
+        alloc_g, demand_g, ok_g = xs
+        usage = jnp.einsum(
+            "pn,pr->nr", alloc_g.astype(free_c.dtype), demand_g
+        )
+        fits = ok_g & jnp.all(usage <= free_c + 1e-6)
+        free_c = jnp.where(fits, free_c - usage, free_c)
+        return free_c, fits
+
+    free_after, committed = jax.lax.scan(
+        commit_step, free, (alloc, demand, ok_min)
+    )
+    retry = ok_min & ~committed
+    return {
+        "admitted": committed,
+        "retry": retry,
+        "placed": jnp.where(committed[:, None], placed, 0),
+        "score": jnp.where(committed, score, 0.0),
+        "chosen_level": jnp.where(committed, chosen_l, -1),
+        "alloc": jnp.where(committed[:, None, None], alloc, 0),
+        "free_after": free_after,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Device-resident multi-wave solver (the bench/stats path)
+# ---------------------------------------------------------------------------
+
+
+def gang_select_single(
+    free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed
+):
+    """Single-fill variant of gang_select_and_fill for the wave solver.
+
+    Candidate levels are ranked by aggregate feasibility (cheap prefix-sum
+    gathers); ONE fill is attempted at the best allowed level (or
+    cluster-wide when none). A fill that misses the floor is signalled to the
+    caller, which lowers `narrow_cap` (the narrowest level this gang may try)
+    and retries next wave — amortizing the L+1 fills of the exact kernel
+    across waves instead of paying them per gang.
+
+    Returns (alloc, placed, ok, chosen, score, had_candidate).
+    chosen: level index, n_levels for cluster-wide, -1 when nothing allowed.
+    """
+    n_nodes, n_levels = topo.shape
+    weights = _level_weights(n_levels)
+
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(free, gang)
+    any_active = jnp.any(active)
+
+    oks, bests = [], []
+    for l in range(n_levels):
+        starts, ends = seg_starts[l], seg_ends[l]
+        K = cs_k[:, ends] - cs_k[:, starts]
+        free_agg = cs_free[ends] - cs_free[starts]
+        feas = jnp.all(
+            jnp.where(active[:, None], K >= gang.min_count[:, None], True), axis=0
+        )
+        feas &= jnp.all(free_agg >= (min_demand - free_tol)[None, :], axis=1)
+        feas &= ends > starts
+        feas &= any_active
+        # STRIDED choice: gangs deciding in parallel against the same
+        # capacity snapshot must not all pick the same best-fit domain (the
+        # whole chunk would collide at commit). Each gang takes the
+        # (seed mod n)-th domain among the candidates — perfect spread, and
+        # co-location score is unaffected by WHICH single domain is chosen.
+        # Prefer domains that hold the FULL count (extras stay in-domain
+        # instead of spilling cluster-wide, which would dilute the score).
+        feas_full = feas & jnp.all(
+            jnp.where(active[:, None], K >= gang.count[:, None], True), axis=0
+        )
+        pool = jnp.where(jnp.any(feas_full), feas_full, feas)
+        # CAPACITY-WEIGHTED pick: spread gangs across candidate domains in
+        # proportion to how many copies of this gang each domain can host —
+        # commits per wave then approach the capacity-limited maximum.
+        w = jnp.where(pool, jnp.sum(K, axis=0), 0).astype(jnp.float32)
+        cum_w = jnp.cumsum(w)
+        total_w = cum_w[-1]
+        h = (
+            jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32)
+            / (1 << 16)
+        )
+        u = h * total_w
+        best = jnp.argmax(cum_w > u)
+        # degenerate fallback (all weights zero): first pool domain
+        best = jnp.where(total_w > 0, best, jnp.argmax(pool))
+        oks.append(jnp.any(feas))
+        bests.append(best)
+    oks = jnp.stack(oks)
+    bests = jnp.stack(bests)
+
+    lv = jnp.arange(n_levels)
+    min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
+    allowed = oks & (lv >= min_allowed) & (lv <= narrow_cap)
+    pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
+    has_level = jnp.any(allowed)
+    chosen_level = jnp.argmax(jnp.where(allowed, level_rank + 1, 0))
+    use_cluster = (~has_level) & (gang.req_level < 0) & any_active
+    had_candidate = has_level | use_cluster
+
+    all_nodes = jnp.ones((n_nodes,), dtype=bool)
+    no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+    packed_mask = topo[:, chosen_level] == bests[chosen_level]
+    mask = jnp.where(
+        has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
+    )
+
+    alloc, placed, free_after = _fill(free, mask, gang.demand, gang.count)
+    level_fill_ok = (
+        had_candidate
+        & any_active
+        & jnp.all(jnp.where(active, placed >= gang.min_count, True))
+    )
+
+    # when the level fill fails, the retry cap jumps straight to the next
+    # BROADER level whose aggregates looked feasible (skip hopeless levels)
+    lower_feasible = jnp.where(allowed & (lv < chosen_level), lv, -1)
+    fallback_cap = jnp.max(lower_feasible)
+
+    # Second fill doubles as both paths:
+    # - level fill met the floor → best-effort extras spill cluster-wide
+    # - level fill missed the floor AND no broader feasible level remains
+    #   (and no required pack) → cluster-wide scatter as a last resort;
+    #   otherwise the gang retries at the fallback level next wave, keeping
+    #   it packed instead of eagerly scattering
+    cluster_rescue = (
+        has_level
+        & ~level_fill_ok
+        & (gang.req_level < 0)
+        & (fallback_cap < 0)
+        & any_active
+    )
+    spill = level_fill_ok & has_level & (gang.req_level < 0)
+    base_free = jnp.where(cluster_rescue, free, free_after)
+    remaining = jnp.where(
+        cluster_rescue, gang.count, jnp.where(spill, gang.count - placed, 0)
+    )
+    alloc2, placed2, _ = _fill(base_free, all_nodes, gang.demand, remaining)
+    rescue_ok = cluster_rescue & jnp.all(
+        jnp.where(active, placed2 >= gang.min_count, True)
+    )
+    alloc = jnp.where(
+        rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)
+    )
+    placed = jnp.where(
+        rescue_ok, placed2, jnp.where(spill, placed + placed2, placed)
+    )
+    fill_ok = level_fill_ok | rescue_ok
+    chosen_level = jnp.where(rescue_ok, n_levels, chosen_level)
+    has_level = has_level & ~rescue_ok
+    use_cluster = use_cluster | rescue_ok
+
+    alloc = jnp.where(fill_ok, alloc, 0)
+    placed = jnp.where(fill_ok, placed, 0)
+
+    score = _coloc_score(alloc, placed, seg_starts, seg_ends, weights, fill_ok)
+
+    chosen = jnp.where(
+        has_level, chosen_level, jnp.where(use_cluster, n_levels, -1)
+    )
+    return alloc, placed, fill_ok, chosen, score, had_candidate, fallback_cap
+
+
+@partial(jax.jit, static_argnames=("n_chunks", "max_waves", "commit_iters"))
+def solve_waves_device(
+    capacity,  # [N, R]
+    topo,  # [N, L]
+    seg_starts,  # [L, D]
+    seg_ends,  # [L, D]
+    demand,  # [G, P, R], G divisible by n_chunks
+    count,  # [G, P]
+    min_count,  # [G, P]
+    req_level,  # [G]
+    pref_level,  # [G]
+    n_chunks: int = 20,
+    max_waves: int = 8,
+    commit_iters: int = 2,
+):
+    """Whole multi-wave wave-parallel solve in ONE device program — zero
+    host↔device round trips until the final results (critical when the chip
+    sits behind a high-latency link, and cheap dispatch regardless).
+
+    Per wave, per chunk: decide all C gangs in parallel against the chunk's
+    capacity snapshot (gang_select_single), then commit with an iterative
+    vectorized prefix-acceptance (no per-gang scan): accept the set of gangs
+    whose cumulative usage fits, re-checking `commit_iters` times as rejected
+    gangs' usage is removed, with a final masking pass that guarantees the
+    accepted set is jointly feasible. Conflicting or fill-failed gangs retry
+    in the next wave (fill failures lower the gang's narrow_cap so it retries
+    at a coarser level).
+    """
+    g_total, p_max, _ = demand.shape
+    n_nodes, n_levels = topo.shape
+    c = g_total // n_chunks
+
+    def reshape_chunks(a):
+        return a.reshape((n_chunks, c) + a.shape[1:])
+
+    state0 = {
+        "free": capacity,
+        "pending": jnp.ones((g_total,), dtype=bool),
+        "narrow_cap": jnp.full((g_total,), n_levels - 1, dtype=jnp.int32),
+        "admitted": jnp.zeros((g_total,), dtype=bool),
+        "placed": jnp.zeros((g_total, p_max), dtype=jnp.int32),
+        "score": jnp.zeros((g_total,), dtype=jnp.float32),
+        "chosen": jnp.full((g_total,), -1, dtype=jnp.int32),
+        "rescue": jnp.zeros((g_total,), dtype=bool),
+        "wave": jnp.asarray(0, dtype=jnp.int32),
+        "progress": jnp.asarray(True),
+    }
+
+    def chunk_step(free, xs):
+        # settled chunks skip the whole decision+commit (lax.cond executes
+        # one branch): waves after the first mostly touch a few chunks
+        dem, cnt, mn, rq, pf, pend, ncap, seeds = xs
+        c_gangs = dem.shape[0]
+
+        def passthrough(free):
+            return free, (
+                jnp.zeros((c_gangs,), dtype=bool),
+                jnp.zeros((c_gangs, dem.shape[1]), dtype=jnp.int32),
+                jnp.zeros((c_gangs,), dtype=jnp.float32),
+                jnp.full((c_gangs,), -1, dtype=jnp.int32),
+                jnp.zeros((c_gangs,), dtype=bool),
+                ncap,
+                jnp.zeros((c_gangs,), dtype=bool),
+            )
+
+        return jax.lax.cond(
+            jnp.any(pend), lambda f: _active_chunk_step(f, xs), passthrough, free
+        )
+
+    def _active_chunk_step(free, xs):
+        dem, cnt, mn, rq, pf, pend, ncap, seeds = xs
+        cnt = cnt * pend[:, None]
+        inputs = GangInputs(dem, cnt, mn, rq, pf)
+        alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
+            gang_select_single, in_axes=(None, None, None, None, 0, 0, 0)
+        )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
+
+        usage = jnp.einsum(
+            "cpn,cpr->cnr", alloc.astype(free.dtype), dem
+        )  # [C, N, R]
+        accept = ok
+        for _ in range(commit_iters):
+            cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+            fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+            accept = ok & fits
+        # final guarantee: with this accept set, every accepted prefix fits
+        cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+        fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+        accept &= fits
+        free = free - jnp.sum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+
+        # retry bookkeeping: a failed fill jumps the cap straight to the next
+        # broader aggregate-feasible level; cluster fallback was already
+        # attempted in-wave, so a -1 cap means the gang is done for good
+        fill_failed = pend & had_cand & ~ok
+        new_cap = jnp.where(fill_failed, fallback_cap, ncap)
+        min_allowed = jnp.where(rq >= 0, rq, 0)
+        retry = pend & (
+            (ok & ~accept) | (fill_failed & (new_cap >= min_allowed))
+        )
+        return free, (
+            accept & pend,
+            placed,
+            score,
+            chosen,
+            retry,
+            new_cap,
+            fill_failed,
+        )
+
+    def wave_body(state):
+        # NOTE: pending gangs are deliberately NOT compacted into fewer
+        # chunks — spreading stragglers across chunks lets later chunks see
+        # earlier commits' capacity updates within the same wave, which
+        # converges faster than concentrating the contention (measured).
+        seeds_c = reshape_chunks(
+            jnp.arange(g_total, dtype=jnp.int32) + state["wave"] * jnp.int32(7919)
+        )
+        free, ys = jax.lax.scan(
+            chunk_step,
+            state["free"],
+            (
+                reshape_chunks(demand),
+                reshape_chunks(count),
+                reshape_chunks(min_count),
+                reshape_chunks(req_level),
+                reshape_chunks(pref_level),
+                reshape_chunks(state["pending"]),
+                reshape_chunks(state["narrow_cap"]),
+                seeds_c,
+            ),
+        )
+        accept, placed, score, chosen, retry, new_cap, fill_failed = (
+            y.reshape((g_total,) + y.shape[2:]) for y in ys
+        )
+        return {
+            "free": free,
+            "pending": retry,
+            "narrow_cap": new_cap,
+            "admitted": state["admitted"] | accept,
+            "placed": jnp.where(accept[:, None], placed, state["placed"]),
+            "score": jnp.where(accept, score, state["score"]),
+            "chosen": jnp.where(accept, chosen, state["chosen"]),
+            # gangs whose heuristic single fill ever missed the floor are
+            # exact-tail candidates (the seed-picked domain may simply have
+            # been the wrong one)
+            "rescue": state["rescue"] | fill_failed,
+            "wave": state["wave"] + 1,
+            "progress": jnp.any(accept) | jnp.any(retry),
+        }
+
+    def cond(state):
+        return (
+            (state["wave"] < max_waves)
+            & state["progress"]
+            & jnp.any(state["pending"] | (state["wave"] == 0))
+        )
+
+    final = jax.lax.while_loop(cond, wave_body, state0)
+    chosen = final["chosen"]
+    return {
+        "admitted": final["admitted"],
+        "placed": final["placed"],
+        "score": final["score"],
+        "chosen_level": jnp.where(chosen >= n_levels, -1, chosen),
+        "free_after": final["free"],
+        "waves": final["wave"],
+        "pending": final["pending"]
+        | (final["rescue"] & ~final["admitted"]),
     }

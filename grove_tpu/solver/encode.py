@@ -49,7 +49,12 @@ def encode_nodes(
     n = len(ordered)
     capacity = np.zeros((n, len(resource_names)), dtype=np.float32)
     topo = np.zeros((n, len(level_keys)), dtype=np.int32)
-    id_maps: List[Dict[str, int]] = [{} for _ in level_keys]
+    # Domain identity is the PATH PREFIX (labels of levels 0..l), not the
+    # bare label: a rack name reused under two zones is two domains (matches
+    # k8s label reality), and path-keyed ids over path-sorted nodes are
+    # monotone — every domain is one contiguous slab whose slab index equals
+    # its dense id (the kernel's boundary-gather aggregation relies on this).
+    id_maps: List[Dict[tuple, int]] = [{} for _ in level_keys]
     for i, node in enumerate(ordered):
         caps = (
             free_capacity.get(node.name, node.capacity)
@@ -58,11 +63,42 @@ def encode_nodes(
         )
         for r, rname in enumerate(resource_names):
             capacity[i, r] = caps.get(rname, 0.0)
-        for l, key in enumerate(level_keys):
-            value = node.labels.get(key, "")
-            topo[i, l] = id_maps[l].setdefault(value, len(id_maps[l]))
+        path = topo_path(node)
+        for l in range(len(level_keys)):
+            prefix = path[: l + 1]
+            topo[i, l] = id_maps[l].setdefault(prefix, len(id_maps[l]))
     node_names = [node.name for node in ordered]
     return capacity, topo, node_names, resource_names, level_keys
+
+
+def domain_boundaries(topo: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-level contiguous-domain [start, end) node ranges (topology-sorted
+    nodes ⇒ each domain is a slab). Padded with empty ranges to the max
+    domain count across levels."""
+    n, levels = topo.shape
+    d_max = 1
+    per_level = []
+    for l in range(levels):
+        col = topo[:, l]
+        # boundaries where the id changes
+        changes = np.flatnonzero(np.diff(col)) + 1
+        starts = np.concatenate([[0], changes]).astype(np.int32)
+        ends = np.concatenate([changes, [n]]).astype(np.int32)
+        # slab index must equal dense domain id (path-keyed encoding
+        # guarantees it; the kernel masks nodes with topo == slab index)
+        if not np.array_equal(col[starts], np.arange(len(starts))):
+            raise ValueError(
+                f"level {l}: domain ids are not contiguous slab indices — "
+                "nodes must be encoded with path-keyed topology ids"
+            )
+        per_level.append((starts, ends))
+        d_max = max(d_max, len(starts))
+    seg_starts = np.zeros((levels, d_max), dtype=np.int32)
+    seg_ends = np.zeros((levels, d_max), dtype=np.int32)
+    for l, (starts, ends) in enumerate(per_level):
+        seg_starts[l, : len(starts)] = starts
+        seg_ends[l, : len(ends)] = ends
+    return seg_starts, seg_ends
 
 
 def level_index_for_key(
@@ -194,10 +230,13 @@ def build_problem(
     ) = encode_gangs(gang_specs, resource_names, level_keys, pad_gangs, pad_groups)
 
     capacity, demand = _quantize_resources(capacity, demand)
+    seg_starts, seg_ends = domain_boundaries(topo)
 
     return PackingProblem(
         capacity=capacity,
         topo=topo,
+        seg_starts=seg_starts,
+        seg_ends=seg_ends,
         demand=demand,
         count=count,
         min_count=min_count,

@@ -69,9 +69,25 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         req = int(problem.req_level[g])
 
         # per-level candidate domain (joint-aware aggregate feasibility,
-        # best-fit tie-break), attempted narrowest-first; the fill is the
+        # best-fit tie-break), attempted in preference order; the fill is the
         # ground truth — first level whose fill meets the floor wins.
-        k_all = np.stack([_pods_fit(cap, demand[p]) for p in range(P)])
+        # Aggregates mirror the kernel: per-node fits capped at the group
+        # count, contiguous-domain boundary gathers on prefix sums, float32
+        # capacity prefix sums with the same tolerance slack.
+        k_all = np.stack(
+            [np.minimum(_pods_fit(cap, demand[p]), count[p]) for p in range(P)]
+        )
+        cs_k = np.concatenate(
+            [np.zeros((P, 1), dtype=np.int64), np.cumsum(k_all, axis=1)], axis=1
+        )
+        cs_free = np.concatenate(
+            [
+                np.zeros((1, R), dtype=np.float32),
+                np.cumsum(cap.astype(np.float32), axis=0, dtype=np.float32),
+            ],
+            axis=0,
+        )
+        free_tol = 1e-5 * cs_free[-1]
         min_demand = (min_count[:, None] * demand).sum(axis=0)  # [R]
         min_allowed = req if req >= 0 else 0
         pref = int(problem.pref_level[g])
@@ -85,28 +101,26 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         chosen_level = None
         alloc = placed = free_after = None
         for l in level_order:
-            seg = topo[:, l]
-            nseg = seg.max() + 1
-            K = np.stack(
-                [np.bincount(seg, weights=k_all[p], minlength=nseg) for p in range(P)]
-            )
-            free_agg = np.stack(
-                [
-                    np.bincount(seg, weights=cap[:, r], minlength=nseg)
-                    for r in range(R)
-                ],
-                axis=1,
-            )  # [nseg, R]
-            feas = np.all(free_agg >= min_demand[None, :], axis=1)
-            spare = np.zeros((nseg,))
+            starts = problem.seg_starts[l]
+            ends = problem.seg_ends[l]
+            K = cs_k[:, ends] - cs_k[:, starts]  # [P, D]
+            free_agg = cs_free[ends] - cs_free[starts]  # [D, R]
+            feas = np.all(free_agg >= (min_demand - free_tol)[None, :], axis=1)
+            feas &= ends > starts
+            spare = np.zeros((len(starts),))
             for p in range(P):
                 if active[p]:
                     feas &= K[p] >= min_count[p]
                     spare += K[p] - count[p]
             if not feas.any():
                 continue
-            spare[~feas] = np.inf
-            mask = seg == int(np.argmin(spare))
+            # mirror the kernel's best-fit key: spare, tie-broken toward the
+            # least total free capacity (float32 arithmetic for parity)
+            free_total = free_agg.sum(axis=1)
+            tie = (free_total / (free_total.max() + 1.0)).astype(np.float32)
+            key = spare.astype(np.float32) + tie
+            key[~feas] = np.inf
+            mask = topo[:, l] == int(np.argmin(key))
             a, pl, fa = _fill(cap, mask, demand, count)
             if all(pl[p] >= min_count[p] for p in range(P) if active[p]):
                 chosen_level, alloc, placed, free_after = l, a, pl, fa

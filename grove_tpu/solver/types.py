@@ -35,6 +35,13 @@ class PackingProblem:
     pref_level: np.ndarray  # [G] int32
     priority: np.ndarray  # [G] int32
 
+    # Contiguous-domain boundaries (nodes are topology-sorted): domain d of
+    # level l spans node indices [seg_starts[l,d], seg_ends[l,d]). Padded
+    # entries are empty ranges. Lets the kernel compute per-domain aggregates
+    # as prefix-sum gathers instead of TPU-hostile scatter segment-sums.
+    seg_starts: np.ndarray = None  # [L, D] int32
+    seg_ends: np.ndarray = None  # [L, D] int32
+
     # bookkeeping (host side, not shipped to device)
     node_names: List[str] = field(default_factory=list)
     gang_names: List[str] = field(default_factory=list)

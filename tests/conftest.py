@@ -2,15 +2,22 @@
 
 Multi-chip sharding is validated on virtual CPU devices
 (xla_force_host_platform_device_count) since the dev box has one real chip.
-Must run before jax is imported anywhere.
+
+Note: this image's sitecustomize registers the axon TPU plugin and pins
+JAX_PLATFORMS before conftest runs, so the env var alone is not enough — the
+platform is re-pinned via jax.config after import.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

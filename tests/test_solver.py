@@ -260,6 +260,102 @@ class TestOracleEquivalence:
         assert stats.alloc is None
 
 
+class TestWaveSolver:
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_waves_validity_and_quality(self, seed):
+        """Wave placements must be VALID (capacity, floors, required packs)
+        and within 0.5% quality of the exact greedy."""
+        from grove_tpu.solver.kernel import solve_waves
+
+        rng = np.random.default_rng(seed)
+        nodes = make_nodes(32, capacity={"cpu": 16.0}, hosts_per_ici_block=4)
+        gangs = []
+        for i in range(40):
+            groups = [
+                group(
+                    f"g{i}-{p}",
+                    cpu=float(rng.integers(1, 5)),
+                    count=int(rng.integers(1, 5)),
+                )
+                for p in range(int(rng.integers(1, 3)))
+            ]
+            req = BLOCK_KEY if rng.random() < 0.3 else None
+            gangs.append(gang(f"g{i}", groups, required_key=req))
+        problem = build_problem(nodes, gangs, TOPO)
+        waves = solve_waves(problem, chunk_size=8)
+        exact = solve(problem)
+
+        # validity: total usage within capacity
+        usage = np.einsum("gpn,gpr->nr", waves.alloc, problem.demand)
+        assert (usage <= problem.capacity + 1e-5).all()
+        # floors met for admitted gangs; required level respected
+        for g_i in range(len(gangs)):
+            if waves.admitted[g_i]:
+                assert (waves.placed[g_i] >= problem.min_count[g_i]).all()
+                if problem.req_level[g_i] >= 0:
+                    assert waves.chosen_level[g_i] >= problem.req_level[g_i]
+                    used = np.nonzero(waves.alloc[g_i].sum(axis=0))[0]
+                    doms = {
+                        problem.topo[n, problem.req_level[g_i]] for n in used
+                    }
+                    assert len(doms) <= 1
+        # quality gate: admitted pods + mean score within 0.5% of exact greedy
+        pods_w = waves.placed.sum()
+        pods_e = exact.placed.sum()
+        assert pods_w >= 0.995 * pods_e, (pods_w, pods_e)
+        if pods_w and pods_e:
+            q_w = waves.score.sum()
+            q_e = exact.score.sum()
+            assert q_w >= 0.98 * q_e, (q_w, q_e)
+
+    def test_waves_match_exact_when_no_contention(self):
+        from grove_tpu.solver.kernel import solve_waves
+
+        nodes = make_nodes(16, capacity={"cpu": 100.0})
+        gangs = [
+            gang(f"g{i}", [group(f"g{i}-a", cpu=1.0, count=2)]) for i in range(10)
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        waves = solve_waves(problem, chunk_size=4)
+        exact = solve(problem)
+        assert list(waves.admitted) == list(exact.admitted)
+        np.testing.assert_array_equal(waves.placed, exact.placed)
+
+
+class TestMultiChip:
+    def test_sharded_batch_solve_on_mesh(self):
+        """Scenario-dp × node-tp sharded solve over the 8-device CPU mesh."""
+        import jax
+
+        from grove_tpu.parallel.sharded import (
+            batch_solve_sharded,
+            make_example_batch,
+            make_solver_mesh,
+        )
+
+        assert len(jax.devices()) >= 8, jax.devices()
+        mesh = make_solver_mesh(8)
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "dp": 4,
+            "tp": 2,
+        }
+        batch = make_example_batch(n_scenarios=8, n_nodes=16)
+        with mesh:
+            out = batch_solve_sharded(mesh, *batch)
+        assert out["admitted"].shape[0] == 8
+        assert out["admitted"].any()
+        # sharded result matches the single-device solve per scenario
+        from grove_tpu.ops.packing import solve_packing
+
+        ref = solve_packing(
+            *[__import__("jax").numpy.asarray(b[0]) for b in batch],
+            with_alloc=False,
+        )
+        np.testing.assert_array_equal(
+            out["admitted"][0], np.asarray(ref["admitted"])
+        )
+
+
 class TestEncoder:
     def test_topology_sorted_contiguous(self):
         nodes = make_nodes(8, hosts_per_ici_block=2)
