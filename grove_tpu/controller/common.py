@@ -53,20 +53,39 @@ class Component(Protocol):
 
 
 def create_or_adopt(ctx: OperatorContext, desired) -> None:
-    """Create the child if missing; otherwise adopt label/annotation drift
-    (spec is owned by the child's own controller / HPA). Shared by the PCS
-    podclique component and the PCSG reconciler."""
+    """Create the child if missing; otherwise adopt label/annotation drift.
+
+    Spec is NOT adopted (it is owned by the child's own controller / HPA),
+    and neither is the pod-template-hash label: the hash only moves together
+    with a spec push during a rolling update (the replica-by-replica
+    orchestrator does both atomically) — otherwise pods would be replaced
+    against the old spec.
+    """
     ns = desired.metadata.namespace
     current = ctx.store.get(desired.kind, ns, desired.metadata.name)
     if current is None:
         ctx.store.create(desired)
         return
-    if current.metadata.deletion_timestamp is None and (
-        current.metadata.labels != desired.metadata.labels
-        or current.metadata.annotations != desired.metadata.annotations
+    if current.metadata.deletion_timestamp is not None:
+        return
+    from grove_tpu.controller.podclique.status import UPDATE_IN_PROGRESS_ANNOTATION
+
+    want_labels = dict(desired.metadata.labels)
+    cur_hash = current.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+    if cur_hash is not None:
+        want_labels[namegen.LABEL_POD_TEMPLATE_HASH] = cur_hash
+    want_annotations = dict(desired.metadata.annotations)
+    # the update-in-progress marker is owned by the rolling updater too
+    if UPDATE_IN_PROGRESS_ANNOTATION in current.metadata.annotations:
+        want_annotations[UPDATE_IN_PROGRESS_ANNOTATION] = (
+            current.metadata.annotations[UPDATE_IN_PROGRESS_ANNOTATION]
+        )
+    if (
+        current.metadata.labels != want_labels
+        or current.metadata.annotations != want_annotations
     ):
-        current.metadata.labels = dict(desired.metadata.labels)
-        current.metadata.annotations = dict(desired.metadata.annotations)
+        current.metadata.labels = want_labels
+        current.metadata.annotations = want_annotations
         ctx.store.update(current, bump_generation=False)
 
 

@@ -63,7 +63,54 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique) -> int:
     elif diff > 0:
         _delete_excess_pods(ctx, pclq, diff, cached_pods, pending_deletes)
 
+    _process_pending_updates(ctx, pclq, cached_pods, pending_deletes)
+
     return _remove_scheduling_gates(ctx, pclq)
+
+
+def _process_pending_updates(
+    ctx: OperatorContext, pclq: PodClique, pods, pending_deletes
+) -> None:
+    """Pod-by-pod rolling replacement (components/pod/rollingupdate.go:55-244):
+    pods whose template hash doesn't match the PCLQ's are replaced — all
+    not-ready stale pods at once, then ready pods ONE at a time, each only
+    after the previous replacement is Ready again."""
+    current_hash = pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+    if not current_hash:
+        return
+    ns = pclq.metadata.namespace
+    key = f"{ns}/{pclq.metadata.name}"
+    # refresh delete expectations: scale-in may have recorded deletions in
+    # this same sync pass (stale snapshot would allow a double replacement)
+    _, pending_deletes = ctx.pod_expectations.pending(
+        key, [p.metadata.uid for p in pods]
+    )
+    live = [p for p in pods if p.metadata.uid not in pending_deletes]
+    stale = [
+        p
+        for p in live
+        if p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != current_hash
+    ]
+    if not stale:
+        return
+
+    not_ready_stale = [p for p in stale if not is_ready(p)]
+    if not_ready_stale:
+        # pending/unhealthy stale pods carry no availability — replace at once
+        for pod in not_ready_stale:
+            ctx.pod_expectations.expect_deletions(key, [pod.metadata.uid])
+            ctx.store.delete("Pod", ns, pod.metadata.name)
+            ctx.record_event("Pod", "PodUpdateDeleteSuccessful", pod.metadata.name)
+        return
+
+    # every pod is ready; only proceed when no replacement is still missing
+    # (one in-flight replacement at a time)
+    if len(live) < pclq.spec.replicas or not all(is_ready(p) for p in live):
+        return
+    victim = sorted(stale, key=deletion_order)[0]
+    ctx.pod_expectations.expect_deletions(key, [victim.metadata.uid])
+    ctx.store.delete("Pod", ns, victim.metadata.name)
+    ctx.record_event("Pod", "PodUpdateDeleteSuccessful", victim.metadata.name)
 
 
 def _create_pods(

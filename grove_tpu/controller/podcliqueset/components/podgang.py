@@ -291,6 +291,13 @@ def _create_or_update_podgang(
                     )
                 break
 
+    # During a rolling update, hint the scheduler to reuse this gang's prior
+    # reservation for replaced pods (scheduler podgang.go:67-73)
+    reuse_ref = None
+    progress = pcs.status.rolling_update_progress
+    if progress is not None and progress.update_ended_at is None:
+        reuse_ref = NamespacedName(namespace=ns, name=gang.fqn)
+
     spec = PodGangSpec(
         pod_groups=pod_groups,
         topology_constraint=translate_topology_constraint(
@@ -298,6 +305,7 @@ def _create_or_update_podgang(
         ),
         topology_constraint_group_configs=group_configs,
         priority_class_name=tmpl.priority_class_name,
+        reuse_reservation_ref=reuse_ref,
     )
 
     current = ctx.store.get("PodGang", ns, gang.fqn)

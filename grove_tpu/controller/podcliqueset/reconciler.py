@@ -32,6 +32,7 @@ from grove_tpu.controller.podcliqueset.components import (
     podclique,
     podgang,
     replica as replica_component,
+    rollingupdate,
     scalinggroup,
 )
 from grove_tpu.runtime.errors import GroveError
@@ -108,6 +109,7 @@ class PodCliqueSetReconciler:
         infra.sync_headless_services(self.ctx, pcs)
         infra.sync_hpas(self.ctx, pcs)
         breach_wait = replica_component.sync(self.ctx, pcs)
+        update_wait = rollingupdate.sync(self.ctx, pcs)
         podclique.sync(self.ctx, pcs)
         scalinggroup.sync(self.ctx, pcs)
         podgang.sync(self.ctx, pcs)
@@ -119,8 +121,9 @@ class PodCliqueSetReconciler:
             fresh.status.observed_generation = fresh.metadata.generation
             self.ctx.store.update_status(fresh)
 
-        if breach_wait is not None:
-            return reconcile_after(breach_wait, "gang-termination breach wait")
+        waits = [w for w in (breach_wait, update_wait) if w is not None]
+        if waits:
+            return reconcile_after(min(waits), "breach/rolling-update wait")
         return continue_reconcile()
 
     def _process_generation_hash(self, pcs: PodCliqueSet) -> PodCliqueSet:

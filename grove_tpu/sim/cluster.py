@@ -43,6 +43,9 @@ class SimCluster:
     nodes: List[Node] = field(default_factory=list)
     # (namespace, pod name) -> node name
     bindings: Dict[tuple, str] = field(default_factory=dict)
+    # sticky history surviving deletion: reservation-reuse hints rebind
+    # recreated pods (stable names) to their previous node when it still fits
+    last_node: Dict[tuple, str] = field(default_factory=dict)
     start_delay: float = 0.0  # container start latency (virtual seconds)
 
     def _gc_bindings(self) -> None:
@@ -101,7 +104,9 @@ class SimCluster:
         fresh = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
         if fresh is None:
             return
-        self.bindings[(fresh.metadata.namespace, fresh.metadata.name)] = node_name
+        key = (fresh.metadata.namespace, fresh.metadata.name)
+        self.bindings[key] = node_name
+        self.last_node[key] = node_name
         fresh.status.node_name = node_name
         set_condition(
             fresh.status.conditions,

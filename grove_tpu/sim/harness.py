@@ -10,7 +10,11 @@ import io
 from typing import List, Optional
 
 from grove_tpu.admission.defaulting import default_podcliqueset
-from grove_tpu.admission.validation import validate_or_raise
+from grove_tpu.admission.validation import (
+    ValidationError,
+    validate_or_raise,
+    validate_podcliqueset_update,
+)
 from grove_tpu.api import names as namegen
 from grove_tpu.api.load import load_podcliquesets
 from grove_tpu.api.topology import ClusterTopology
@@ -54,12 +58,15 @@ class SimHarness:
 
     def apply(self, pcs: PodCliqueSet) -> PodCliqueSet:
         default_podcliqueset(pcs)
-        validate_or_raise(pcs, self.topology)
         existing = self.store.get(
             "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
         )
         if existing is None:
+            validate_or_raise(pcs, self.topology)
             return self.store.create(pcs)
+        res = validate_podcliqueset_update(pcs, existing, self.topology)
+        if not res.ok:
+            raise ValidationError(res)
         existing.spec = pcs.spec
         return self.store.update(existing)
 

@@ -53,6 +53,11 @@ class GangScheduler:
         pending = self._pending_pods(namespace)
         if not pending:
             return 0
+        sticky_bound, pending = self._bind_with_reused_reservations(
+            namespace, pending
+        )
+        if not pending:
+            return sticky_bound
         gang_specs, gang_pods, loose_pods = self._encode_pending(namespace, pending)
 
         bound = 0
@@ -96,7 +101,76 @@ class GangScheduler:
                     self.cluster.bind(pod, node.name)
                     bound += 1
                     break
-        return bound
+        return bound + sticky_bound
+
+    def _bind_with_reused_reservations(self, namespace: str, pending: List):
+        """Honor PodGang.reuseReservationRef: a recreated pod of an
+        already-scheduled gang whose gang carries the reuse hint goes back to
+        its previous node when that node still fits it (scheduler-side
+        handling of scheduler podgang.go:67-73)."""
+        from grove_tpu.api.meta import get_condition
+
+        remaining = []
+        bound = 0
+        nodes_by_name = {n.name: n for n in self.cluster.nodes}
+        gang_cache: Dict[str, object] = {}
+        for pod in pending:
+            gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
+            if gang_name and gang_name not in gang_cache:
+                gang_cache[gang_name] = self.store.get(
+                    "PodGang", namespace, gang_name
+                )
+            gang = gang_cache.get(gang_name) if gang_name else None
+            prev = self.cluster.last_node.get((namespace, pod.metadata.name))
+            cond = (
+                get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+                if gang is not None
+                else None
+            )
+            if (
+                gang is not None
+                and gang.spec.reuse_reservation_ref is not None
+                and cond is not None
+                and cond.is_true()
+                and prev in nodes_by_name
+                and not nodes_by_name[prev].cordoned
+                and self.cluster.fits(nodes_by_name[prev], pod)
+                and self._reuse_respects_pack_constraint(
+                    namespace, gang, nodes_by_name, nodes_by_name[prev]
+                )
+            ):
+                self.cluster.bind(pod, prev)
+                bound += 1
+            else:
+                remaining.append(pod)
+        return bound, remaining
+
+    def _reuse_respects_pack_constraint(
+        self, namespace: str, gang, nodes_by_name, candidate_node
+    ) -> bool:
+        """A reused reservation must not break the gang's required pack: the
+        candidate node has to share the required-level domain with the gang's
+        currently-bound pods (no sticky bind when none are bound — the full
+        solver decides instead)."""
+        tc = gang.spec.topology_constraint
+        required = (
+            tc.pack_constraint.required
+            if tc is not None and tc.pack_constraint is not None
+            else None
+        )
+        if required is None:
+            return True
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                bound_node_name = self.cluster.bindings.get(
+                    (namespace, ref.name)
+                )
+                node = nodes_by_name.get(bound_node_name)
+                if node is not None:
+                    return node.labels.get(required) == candidate_node.labels.get(
+                        required
+                    )
+        return False
 
     # -- helpers ---------------------------------------------------------
 

@@ -1,0 +1,148 @@
+"""Rolling-update e2e: hash-triggered, replica-by-replica, pod-by-pod."""
+
+import pathlib
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.pod import is_ready
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def simple1():
+    return load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+
+
+def converge_update(harness, max_rounds=120):
+    """Drive the update loop: reconcile → schedule → kubelet, advancing the
+    2s update requeues."""
+    for _ in range(max_rounds):
+        harness.engine.drain()
+        harness.schedule()
+        harness.cluster.kubelet_tick()
+        harness.engine.drain()
+        pcs = harness.store.list("PodCliqueSet")[0]
+        progress = pcs.status.rolling_update_progress
+        if progress is not None and progress.update_ended_at is not None:
+            return True
+        harness.advance(2.0)
+    return False
+
+
+class TestRollingUpdate:
+    def test_image_change_replaces_all_pods(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        old_uids = {p.metadata.name: p.metadata.uid for p in harness.store.list("Pod")}
+
+        updated = simple1()
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+        assert converge_update(harness), harness.tree()
+        harness.converge()
+
+        pods = harness.store.list("Pod")
+        assert len(pods) == 9
+        assert all(is_ready(p) for p in pods), harness.tree()
+        # every pod rebuilt from the new template
+        for p in pods:
+            assert p.metadata.uid != old_uids.get(p.metadata.name)
+            img = None
+            for c in p.spec.containers:
+                img = c.image
+            assert img == "busybox:new"
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        progress = pcs.status.rolling_update_progress
+        assert progress.update_ended_at is not None
+        assert "simple1-0-sga" in progress.updated_pod_clique_scaling_groups
+        assert "simple1-0-pca" in progress.updated_pod_cliques
+
+    def test_one_replica_at_a_time(self):
+        harness = SimHarness(num_nodes=32)
+        pcs = simple1()
+        pcs.spec.replicas = 2
+        harness.apply(pcs)
+        harness.converge()
+
+        updated = simple1()
+        updated.spec.replicas = 2
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+        assert converge_update(harness, max_rounds=240), harness.tree()
+
+        # event order proves sequencing: replica N completed before N+1 started
+        events = [e for e in harness.ctx.events if "RollingUpdateReplica" in e]
+        started = [e for e in events if "Started" in e]
+        completed = [e for e in events if "Completed" in e]
+        assert len(started) == 2 and len(completed) == 2
+        first_complete = events.index(completed[0])
+        second_start = events.index(started[1])
+        assert first_complete < second_start, events
+
+    def test_availability_kept_during_update(self):
+        """At no point may a clique drop below minAvailable ready pods
+        (beyond the single in-flight replacement)."""
+        harness = SimHarness(num_nodes=32)
+        pcs = simple1()
+        # pca: 3 replicas, minAvailable defaults to 3 → set 2 to allow churn
+        pcs.spec.template.cliques[0].spec.min_available = 2
+        harness.apply(pcs)
+        harness.converge()
+
+        updated = simple1()
+        updated.spec.template.cliques[0].spec.min_available = 2
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+
+        min_ready_seen = 99
+        for _ in range(120):
+            harness.engine.drain()
+            harness.schedule()
+            harness.cluster.kubelet_tick()
+            harness.engine.drain()
+            ready = sum(
+                1
+                for p in harness.store.list(
+                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+                )
+                if is_ready(p)
+            )
+            min_ready_seen = min(min_ready_seen, ready)
+            pcs_now = harness.store.get("PodCliqueSet", "default", "simple1")
+            if (
+                pcs_now.status.rolling_update_progress is not None
+                and pcs_now.status.rolling_update_progress.update_ended_at
+                is not None
+            ):
+                break
+            harness.advance(2.0)
+        assert min_ready_seen >= 2, min_ready_seen
+
+    def test_reuse_reservation_hint_set_and_honored(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        node_before = {
+            p.metadata.name: p.status.node_name for p in harness.store.list("Pod")
+        }
+
+        updated = simple1()
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+        # mid-update the gang should carry the reuse hint
+        harness.engine.drain()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang.spec.reuse_reservation_ref is not None
+        assert converge_update(harness), harness.tree()
+        harness.converge()
+        # replacements landed on their previous nodes (capacity unchanged)
+        node_after = {
+            p.metadata.name: p.status.node_name for p in harness.store.list("Pod")
+        }
+        assert node_after == node_before
