@@ -1,0 +1,158 @@
+"""grove-tpu CLI: apply manifests to the simulated control plane, inspect the
+resource tree, validate manifests, and run the benchmark.
+
+    python -m grove_tpu.cli apply samples/simple1.yaml
+    python -m grove_tpu.cli validate samples/*.yaml
+    python -m grove_tpu.cli tree samples/simple1.yaml --scale sga=3
+    python -m grove_tpu.cli bench --small
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+
+def _cmd_validate(args) -> int:
+    from grove_tpu.admission.defaulting import default_podcliqueset
+    from grove_tpu.admission.validation import validate_podcliqueset
+    from grove_tpu.api.load import load_podcliquesets
+    from grove_tpu.api.topology import ClusterTopology
+
+    failed = 0
+    for path in args.manifests:
+        with open(path) as f:
+            try:
+                sets = load_podcliquesets(f.read())
+            except Exception as exc:
+                print(f"{path}: LOAD ERROR: {exc}")
+                failed += 1
+                continue
+        for pcs in sets:
+            default_podcliqueset(pcs)
+            res = validate_podcliqueset(pcs, ClusterTopology())
+            if res.ok:
+                print(f"{path}: {pcs.metadata.name}: OK")
+                for w in res.warnings:
+                    print(f"  warning: {w}")
+            else:
+                failed += 1
+                print(f"{path}: {pcs.metadata.name}: INVALID")
+                for e in res.errors:
+                    print(f"  {e}")
+    return 1 if failed else 0
+
+
+def _cmd_apply(args) -> int:
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            applied = harness.apply_yaml(f.read())
+        print(f"applied {', '.join(p.metadata.name for p in applied)}")
+    ticks = harness.converge()
+    print(f"converged in {ticks} virtual ticks (t={harness.clock.now():.0f}s)\n")
+    print(harness.tree(), end="")
+    return 0
+
+
+def _cmd_tree(args) -> int:
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            harness.apply_yaml(f.read())
+    harness.converge()
+    for spec in args.scale or []:
+        name, sep, replicas_str = spec.partition("=")
+        if not sep or not replicas_str.isdigit():
+            print(
+                f"--scale expects GROUP=REPLICAS (a non-negative integer),"
+                f" got {spec!r}",
+                file=sys.stderr,
+            )
+            return 2
+        replicas = int(replicas_str)
+        matched = [
+            g
+            for g in harness.store.list("PodCliqueScalingGroup")
+            if g.metadata.name.endswith(f"-{name}") or g.metadata.name == name
+        ]
+        if not matched:
+            print(f"no scaling group matches {name!r}", file=sys.stderr)
+            return 1
+        for pcsg in matched:
+            if replicas < pcsg.spec.min_available:
+                print(
+                    f"{pcsg.metadata.name}: replicas {replicas} below"
+                    f" minAvailable {pcsg.spec.min_available}",
+                    file=sys.stderr,
+                )
+                return 1
+            pcsg.spec.replicas = replicas
+            harness.store.update(pcsg)
+    harness.converge()
+    print(harness.tree(), end="")
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import subprocess
+
+    cmd = [sys.executable, "bench.py"]
+    if args.small:
+        cmd.append("--small")
+    return subprocess.call(cmd)
+
+
+def _cmd_config_check(args) -> int:
+    from grove_tpu.config.operator import load_operator_configuration_file
+
+    try:
+        cfg = load_operator_configuration_file(args.config)
+    except Exception as exc:
+        print(f"INVALID: {exc}")
+        return 1
+    print(
+        f"OK: logLevel={cfg.log_level} solver.chunkSize={cfg.solver.chunk_size}"
+        f" authorizer.enabled={cfg.authorizer.enabled}"
+    )
+    return 0
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="grove-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("validate", help="admission-check manifests")
+    p.add_argument("manifests", nargs="+")
+    p.set_defaults(fn=_cmd_validate)
+
+    p = sub.add_parser("apply", help="apply to the simulated control plane")
+    p.add_argument("manifests", nargs="+")
+    p.add_argument("--nodes", type=int, default=32)
+    p.set_defaults(fn=_cmd_apply)
+
+    p = sub.add_parser("tree", help="apply + optional scale + dump tree")
+    p.add_argument("manifests", nargs="+")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--scale", action="append", metavar="GROUP=REPLICAS")
+    p.set_defaults(fn=_cmd_tree)
+
+    p = sub.add_parser("bench", help="run the stress benchmark")
+    p.add_argument("--small", action="store_true")
+    p.set_defaults(fn=_cmd_bench)
+
+    p = sub.add_parser("config-check", help="validate an operator config file")
+    p.add_argument("config")
+    p.set_defaults(fn=_cmd_config_check)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

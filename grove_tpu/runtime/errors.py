@@ -26,6 +26,7 @@ ERR_SYNC_PODS = "ERR_SYNC_PODS"
 ERR_VALIDATION = "ERR_VALIDATION"
 ERR_CONFLICT = "ERR_CONFLICT"
 ERR_NOT_FOUND = "ERR_NOT_FOUND"
+ERR_FORBIDDEN = "ERR_FORBIDDEN"
 
 
 class GroveError(Exception):

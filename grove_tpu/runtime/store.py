@@ -18,12 +18,18 @@ controllers rely on:
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from grove_tpu.api.meta import deep_copy, next_uid
 from grove_tpu.runtime.clock import Clock
-from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
+from grove_tpu.runtime.errors import (
+    ERR_CONFLICT,
+    ERR_FORBIDDEN,
+    ERR_NOT_FOUND,
+    GroveError,
+)
 
 ADDED = "Added"
 MODIFIED = "Modified"
@@ -68,6 +74,31 @@ class Store:
         self._cache: Dict[str, Dict[str, object]] = {}
         self._rv = 0
         self._watchers: List[Callable[[WatchEvent], None]] = []
+        # optional admission guard (grove_tpu.admission.authorization):
+        # writes are checked against the current actor; in-process
+        # controllers act as the operator identity
+        self.guard = None
+        self.actor: Optional[str] = None
+
+    @contextmanager
+    def as_user(self, username: str):
+        """Attribute subsequent writes to `username` (authorization guard)."""
+        previous = self.actor
+        self.actor = username
+        try:
+            yield self
+        finally:
+            self.actor = previous
+
+    def _authorize(self, operation: str, obj) -> None:
+        if self.guard is None:
+            return
+        from grove_tpu.admission.authorization import OPERATOR_USERNAME
+
+        actor = self.actor or OPERATOR_USERNAME
+        decision = self.guard.check(actor, operation, obj)
+        if not decision.allowed:
+            raise GroveError(ERR_FORBIDDEN, decision.reason, operation)
 
     # -- watch ----------------------------------------------------------
 
@@ -102,6 +133,7 @@ class Store:
     # -- CRUD -----------------------------------------------------------
 
     def create(self, obj) -> object:
+        self._authorize("create", obj)
         kind_objs = self._committed.setdefault(obj.kind, {})
         key = obj_key(obj)
         if key in kind_objs:
@@ -156,6 +188,7 @@ class Store:
         """
         kind_objs, key = self._require(obj)
         current = kind_objs[key]
+        self._authorize("update", current)
         if (
             obj.metadata.resource_version
             and obj.metadata.resource_version != current.metadata.resource_version
@@ -194,6 +227,7 @@ class Store:
         obj = kind_objs.get(key)
         if obj is None:
             raise GroveError(ERR_NOT_FOUND, f"{kind} {key} not found", "delete")
+        self._authorize("delete", obj)
         if obj.metadata.finalizers:
             if obj.metadata.deletion_timestamp is None:
                 obj.metadata.deletion_timestamp = self.clock.now()

@@ -33,9 +33,20 @@ class SimHarness:
         num_nodes: int = 16,
         cache_lag: bool = True,
         topology: Optional[ClusterTopology] = None,
+        config=None,  # Optional[OperatorConfiguration]
     ) -> None:
+        from grove_tpu.config.operator import OperatorConfiguration
+
+        self.config = config or OperatorConfiguration()
         self.clock = VirtualClock()
         self.store = Store(self.clock, cache_lag=cache_lag)
+        if self.config.authorizer.enabled:
+            from grove_tpu.admission.authorization import AuthorizationGuard
+
+            self.store.guard = AuthorizationGuard(
+                enabled=True,
+                exempt_users=self.config.authorizer.exempt_service_accounts,
+            )
         self.engine = Engine(self.store, self.clock)
         self.topology = topology or ClusterTopology()
         self.ctx = OperatorContext(
@@ -47,7 +58,22 @@ class SimHarness:
         # to fall back to the cluster's naive first-fit binder.
         from grove_tpu.solver.scheduler import GangScheduler
 
-        self.scheduler = GangScheduler(self.store, self.cluster, self.topology)
+        self.scheduler = GangScheduler(
+            self.store,
+            self.cluster,
+            self.topology,
+            priority_map=self.config.solver.priority_classes,
+        )
+        # HPA controller equivalent (multi-level autoscaling)
+        from grove_tpu.autoscale.hpa import (
+            HorizontalAutoscaler,
+            StaticMetricsProvider,
+        )
+
+        self.metrics_provider = StaticMetricsProvider()
+        self.autoscaler = HorizontalAutoscaler(
+            self.store, self.metrics_provider, scale_down_stabilization=60.0
+        )
 
     def schedule(self) -> int:
         if self.scheduler is not None:
@@ -84,15 +110,25 @@ class SimHarness:
         ticks = 0
         for _ in range(max_ticks):
             work = self.engine.drain()
+            work += self.autoscaler.tick()
             bound = self.schedule()
             started = self.cluster.kubelet_tick()
             work += self.engine.drain()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
-                # idle now — but short-horizon requeues (gate retries) may be
-                # pending; jump to the next wakeup rather than stopping early
-                wake = self.engine.next_wakeup()
-                if wake is not None and wake - self.clock.now() <= 60.0:
+                # idle now — but short-horizon requeues (gate retries) or a
+                # held HPA scale-down may be pending; jump to the earliest
+                # wakeup rather than stopping early
+                wakes = [
+                    w
+                    for w in (
+                        self.engine.next_wakeup(),
+                        self.autoscaler.next_deadline(),
+                    )
+                    if w is not None
+                ]
+                wake = min(wakes) if wakes else None
+                if wake is not None and wake - self.clock.now() <= 120.0:
                     self.clock.advance(max(wake - self.clock.now(), 0.0))
                     continue
                 break

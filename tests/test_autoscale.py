@@ -1,0 +1,109 @@
+"""Multi-level autoscaling e2e: HPAs drive PodClique and scaling-group
+replicas; PCSG scale-out materializes scaled gangs."""
+
+import pathlib
+
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.pod import is_ready
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def simple1():
+    return load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+
+
+class TestHPA:
+    def test_clique_scale_up(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        # pca: 3 replicas, target 80% CPU; observe 160% → desired 6 → cap 5
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        harness.converge()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        assert pclq.spec.replicas == 5  # maxReplicas cap
+        pods = harness.store.list(
+            "Pod", "default", {"grove.io/podclique": "simple1-0-pca"}
+        )
+        assert len(pods) == 5 and all(is_ready(p) for p in pods)
+        # the base gang's PodGroup follows the scaled clique
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        group = next(g for g in gang.spec.pod_groups if g.name == "simple1-0-pca")
+        assert len(group.pod_references) == 5
+
+    def test_scaling_group_scale_up_creates_scaled_gangs(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.metrics_provider.set(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga", 250.0
+        )
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        # sustained high utilization walks the group to maxReplicas (6)
+        assert pcsg.spec.replicas == 6
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        # minAvailable=1 → base + 5 scaled gangs (0-based)
+        assert {f"simple1-0-sga-{i}" for i in range(5)} <= gangs
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+
+    def test_scale_down_waits_for_stabilization(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        harness.converge()
+        assert (
+            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            == 5
+        )
+        # load drops; within the 60s stabilization window nothing shrinks
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 40.0)
+        harness.autoscaler.tick()
+        assert (
+            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            == 5
+        )
+        harness.advance(61.0)
+        harness.converge()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        assert pclq.spec.replicas == 3  # ceil(5*40/80)=3, floor minReplicas=3
+        pods = harness.store.list(
+            "Pod", "default", {"grove.io/podclique": "simple1-0-pca"}
+        )
+        assert len(pods) == 3
+
+    def test_scale_down_respects_min_replicas_floor(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 1.0)
+        harness.advance(61.0)
+        harness.converge()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        # minReplicas defaulted to template replicas (3)
+        assert pclq.spec.replicas == 3
+
+    def test_pcsg_scale_down_removes_scaled_gangs(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.metrics_provider.set(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga", 250.0
+        )
+        harness.converge()
+        assert "simple1-0-sga-1" in {
+            g.metadata.name for g in harness.store.list("PodGang")
+        }
+        harness.metrics_provider.set(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga", 10.0
+        )
+        harness.autoscaler.tick()  # records the scale-down candidate
+        harness.advance(61.0)  # stabilization window elapses
+        harness.converge()
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        assert gangs == {"simple1-0"}, harness.tree()

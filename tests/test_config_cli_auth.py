@@ -1,0 +1,248 @@
+"""Config system, authorization guard, CLI, and remaining-sample tests."""
+
+import pathlib
+
+import pytest
+
+from grove_tpu.admission.authorization import (
+    OPERATOR_USERNAME,
+    AuthorizationGuard,
+)
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.pod import is_ready
+from grove_tpu.config.operator import (
+    load_operator_configuration,
+    validate_operator_configuration,
+)
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+class TestOperatorConfig:
+    def test_defaults(self):
+        cfg = load_operator_configuration("")
+        assert cfg.log_level == "info"
+        assert cfg.controllers.pod_clique.concurrent_syncs == 1
+        assert cfg.solver.chunk_size == 128
+
+    def test_full_file(self):
+        cfg = load_operator_configuration(
+            """
+logLevel: debug
+logFormat: text
+leaderElection: {enabled: true, leaseDuration: 15, renewDeadline: 10, retryPeriod: 2}
+controllers:
+  podCliqueSet: {concurrentSyncs: 4}
+authorizer:
+  enabled: true
+  exemptServiceAccounts: ["system:serviceaccount:ops:admin"]
+clusterTopology: {enabled: true, name: tpu-v5e}
+solver: {chunkSize: 256, maxWaves: 8, priorityClasses: {critical: 100}}
+"""
+        )
+        assert cfg.controllers.pod_clique_set.concurrent_syncs == 4
+        assert cfg.authorizer.enabled
+        assert cfg.cluster_topology.name == "tpu-v5e"
+        assert cfg.solver.priority_classes == {"critical": 100}
+
+    def test_invalid_rejected(self):
+        with pytest.raises(ValueError, match="logLevel"):
+            load_operator_configuration("logLevel: verbose")
+        with pytest.raises(ValueError, match="concurrentSyncs"):
+            load_operator_configuration(
+                "controllers: {podClique: {concurrentSyncs: 0}}"
+            )
+        with pytest.raises(ValueError, match="leaseDuration"):
+            load_operator_configuration(
+                "leaderElection: {enabled: true, leaseDuration: 5,"
+                " renewDeadline: 10}"
+            )
+
+
+class TestAuthorizationGuard:
+    def _managed_pod(self, harness):
+        return harness.store.get("Pod", "default", "simple1-0-pca-0")
+
+    def test_blocks_users_allows_operator(self):
+        harness = SimHarness()
+        harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        harness.converge()
+        guard = AuthorizationGuard(enabled=True, exempt_users=["admin-sa"])
+        pod = self._managed_pod(harness)
+        denied = guard.check("dev-user", "delete", pod)
+        assert not denied.allowed and "managed by the grove operator" in denied.reason
+        assert guard.check(OPERATOR_USERNAME, "delete", pod).allowed
+        assert guard.check("admin-sa", "delete", pod).allowed
+        # the parent PCS itself is never guarded
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        assert guard.check("dev-user", "update", pcs).allowed
+
+    def test_disabled_allows_all(self):
+        harness = SimHarness()
+        harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        harness.converge()
+        guard = AuthorizationGuard(enabled=False)
+        assert guard.check("dev-user", "delete", self._managed_pod(harness)).allowed
+
+    def test_unmanaged_objects_unguarded(self):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.pod import Pod
+
+        guard = AuthorizationGuard(enabled=True)
+        assert guard.check(
+            "dev-user", "delete", Pod(metadata=ObjectMeta(name="own-pod"))
+        ).allowed
+
+
+class TestAuthorizationWiring:
+    def test_guard_enforced_through_store(self):
+        """authorizer config → store guard: user writes to managed children
+        are rejected; the in-process controllers (operator actor) proceed."""
+        from grove_tpu.config.operator import load_operator_configuration
+        from grove_tpu.runtime.errors import GroveError
+
+        cfg = load_operator_configuration("authorizer: {enabled: true}")
+        harness = SimHarness(config=cfg)
+        harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        harness.converge()  # controllers created everything despite the guard
+        assert all(is_ready(p) for p in harness.store.list("Pod"))
+        with harness.store.as_user("dev-user"):
+            with pytest.raises(GroveError, match="managed by the grove operator"):
+                harness.store.delete("Pod", "default", "simple1-0-pca-0")
+            # the user's own PCS stays editable
+            pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+            pcs.spec.replicas = 1
+            harness.store.update(pcs)
+
+    def test_hpa_works_in_other_namespaces(self):
+        harness = SimHarness(num_nodes=32)
+        pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+        pcs.metadata.namespace = "prod"
+        harness.apply(pcs)
+        harness.converge()
+        harness.metrics_provider.set("PodClique", "prod", "simple1-0-pca", 160.0)
+        harness.converge()
+        assert (
+            harness.store.get("PodClique", "prod", "simple1-0-pca").spec.replicas
+            == 5
+        )
+
+    def test_converge_drives_pending_scale_down(self):
+        """converge() alone must fire held scale-downs (stabilization
+        deadline is part of the wakeup horizon)."""
+        harness = SimHarness(num_nodes=32)
+        harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        harness.converge()
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        harness.converge()
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 40.0)
+        harness.converge(max_ticks=200)
+        assert (
+            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            == 3
+        )
+
+
+class TestCLI:
+    def test_scale_argument_errors(self, capsys):
+        from grove_tpu.cli import main
+
+        rc = main(
+            ["tree", str(REPO / "samples" / "simple1.yaml"), "--scale", "sga"]
+        )
+        assert rc == 2
+        assert "GROUP=REPLICAS" in capsys.readouterr().err
+
+    def test_validate(self, capsys):
+        from grove_tpu.cli import main
+
+        rc = main(["validate", str(REPO / "samples" / "simple1.yaml")])
+        out = capsys.readouterr().out
+        assert rc == 0 and "OK" in out
+
+    def test_validate_rejects_bad(self, tmp_path, capsys):
+        from grove_tpu.cli import main
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(
+            """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata: {name: bad}
+spec:
+  template:
+    cliques:
+      - name: a
+        spec: {roleName: r, replicas: 2, minAvailable: 5,
+               podSpec: {containers: [{name: c, image: i}]}}
+"""
+        )
+        rc = main(["validate", str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "INVALID" in out
+
+    def test_apply_tree(self, capsys):
+        from grove_tpu.cli import main
+
+        rc = main(["apply", str(REPO / "samples" / "simple1.yaml")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pcs/simple1" in out and "pg/simple1-0" in out
+
+    def test_config_check(self, tmp_path, capsys):
+        from grove_tpu.cli import main
+
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text("logLevel: info\nsolver: {chunkSize: 64}\n")
+        rc = main(["config-check", str(cfg)])
+        assert rc == 0 and "OK" in capsys.readouterr().out
+
+
+class TestRemainingSamples:
+    def test_agentic_pipeline_ordering(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(
+            load_podcliqueset_file(str(REPO / "samples" / "agentic-pipeline.yaml"))
+        )
+        first_ready = {}
+        for _ in range(40):
+            harness.engine.drain()
+            harness.schedule()
+            harness.cluster.kubelet_tick()
+            harness.engine.drain()
+            for pod in harness.store.list("Pod"):
+                if is_ready(pod) and pod.metadata.name not in first_ready:
+                    first_ready[pod.metadata.name] = harness.clock.now()
+            harness.advance(1.0)
+        pods = harness.store.list("Pod")
+        assert len(pods) == 2 + 2 + 3 + 2
+        assert all(is_ready(p) for p in pods), harness.tree()
+
+        def t(prefix):
+            return [v for k, v in first_ready.items() if prefix in k]
+
+        # vectorstore before model; model+tools before router
+        assert max(t("-vectorstore-")) < min(t("-model-"))
+        assert max(t("-model-")) < min(t("-router-"))
+        assert max(t("-tools-")) < min(t("-router-"))
+
+    def test_single_node_disaggregated(self):
+        harness = SimHarness(num_nodes=8)
+        harness.apply(
+            load_podcliqueset_file(
+                str(REPO / "samples" / "single-node-disaggregated.yaml")
+            )
+        )
+        harness.converge()
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+        # scale the serving group via its HPA
+        harness.metrics_provider.set(
+            "PodCliqueScalingGroup", "default", "singlenode-disagg-0-serving", 200.0
+        )
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "singlenode-disagg-0-serving"
+        )
+        assert pcsg.spec.replicas == 4
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
