@@ -39,9 +39,40 @@ class OperatorContext:
         default_factory=lambda: ExpectationsStore("pod")
     )
     events: List[str] = field(default_factory=list)
+    _event_seq: int = 0
+    max_events: int = 1000  # ring buffer (k8s Events have a TTL; we cap)
 
     def record_event(self, kind: str, reason: str, message: str) -> None:
+        """k8s-Event equivalent: kept as a readable log AND materialized as an
+        Event object in the store (the reference emits corev1 Events on every
+        important transition — SURVEY §5). Capped as a ring buffer so long
+        sims don't accumulate unbounded Event objects."""
         self.events.append(f"{kind} {reason}: {message}")
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import GenericObject
+
+        self._event_seq += 1
+        try:
+            self.store.create(
+                GenericObject(
+                    kind="Event",
+                    metadata=ObjectMeta(name=f"evt-{self._event_seq}"),
+                    spec={
+                        "involvedKind": kind,
+                        "reason": reason,
+                        "message": message,
+                        "timestamp": self.clock.now(),
+                    },
+                )
+            )
+        except Exception:
+            pass  # events are best-effort (conflict on replayed names etc.)
+        expired = self._event_seq - self.max_events
+        if expired > 0:
+            try:
+                self.store.delete("Event", "default", f"evt-{expired}")
+            except Exception:
+                pass
 
 
 class Component(Protocol):

@@ -116,15 +116,35 @@ def _process_pending_updates(
 def _create_pods(
     ctx: OperatorContext, pclq: PodClique, count: int, existing: List[Pod]
 ) -> None:
+    from grove_tpu.runtime.errors import GroveError
+    from grove_tpu.utils.concurrent import Task, run_concurrently_with_slow_start
+
     ns = pclq.metadata.namespace
     active_names = [p.metadata.name for p in existing]
     indices = indexer.allocate_indices(pclq.metadata.name, active_names, count)
     key = f"{ns}/{pclq.metadata.name}"
-    for idx in indices:
-        pod = build_pod(ctx, pclq, idx)
-        created = ctx.store.create(pod)
-        ctx.pod_expectations.expect_creations(key, [created.metadata.uid])
-        ctx.record_event("Pod", "PodCreateSuccessful", created.metadata.name)
+
+    def make_create(idx: int):
+        def create() -> None:
+            pod = build_pod(ctx, pclq, idx)
+            created = ctx.store.create(pod)
+            ctx.pod_expectations.expect_creations(key, [created.metadata.uid])
+            ctx.record_event("Pod", "PodCreateSuccessful", created.metadata.name)
+
+        return create
+
+    # slow-start batches (1,2,4,…) — a failing apiserver is detected after a
+    # handful of creates, not a burst (reference utils/concurrent.go:69-90)
+    result = run_concurrently_with_slow_start(
+        [
+            Task(name=namegen.pod_name(pclq.metadata.name, idx), fn=make_create(idx))
+            for idx in indices
+        ]
+    )
+    if result.has_errors:
+        raise GroveError(
+            "ERR_SYNC_PODS", result.summary(), f"create-pods {pclq.metadata.name}"
+        )
 
 
 def build_pod(ctx: OperatorContext, pclq: PodClique, pod_index: int) -> Pod:

@@ -13,11 +13,13 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.meta import Condition, get_condition, set_condition
 from grove_tpu.api.pod import is_scheduled, is_terminating
 from grove_tpu.api.topology import ClusterTopology
 from grove_tpu.api.types import (
+    COND_PODGANG_DISRUPTION_TARGET,
     COND_PODGANG_SCHEDULED,
+    COND_PODGANG_UNHEALTHY,
     PHASE_PENDING,
     PHASE_RUNNING,
     PHASE_STARTING,
@@ -50,6 +52,7 @@ class GangScheduler:
     def schedule_pending(self, namespace: str = "default") -> int:
         self.cluster._gc_bindings()
         self.update_gang_phases(namespace)
+        self.update_gang_health(namespace)
         pending = self._pending_pods(namespace)
         if not pending:
             return 0
@@ -74,10 +77,13 @@ class GangScheduler:
                 )
                 result = solve(problem)
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
+                preempted = self._maybe_preempt(namespace, gang_specs, result)
                 assignments = result.assignments(problem)
                 for gi, spec in enumerate(gang_specs):
                     gang_name = spec["name"]
-                    if not result.admitted[gi]:
+                    if not result.admitted[gi] or gang_name in preempted:
+                        # a victim's stale admission from this solve must not
+                        # overwrite its Preempted status (its pods are gone)
                         continue
                     for pclq_fqn, node_names in assignments[gang_name].items():
                         pods = gang_pods[gang_name].get(pclq_fqn, [])
@@ -277,7 +283,171 @@ class GangScheduler:
             ),
             self.store.clock.now(),
         )
+        # a successfully (re)scheduled gang is no longer a disruption target
+        if (
+            dt := get_condition(
+                gang.status.conditions, COND_PODGANG_DISRUPTION_TARGET
+            )
+        ) is not None and dt.is_true():
+            set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=COND_PODGANG_DISRUPTION_TARGET,
+                    status="False",
+                    reason="Rescheduled",
+                ),
+                self.store.clock.now(),
+            )
         self.store.update_status(gang)
+
+    # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----
+
+    def _maybe_preempt(self, namespace: str, gang_specs, result) -> set:
+        """A higher-priority pending gang that the solver could not admit may
+        evict strictly-lower-priority scheduled gangs: victims get the
+        DisruptionTarget condition (scheduler podgang.go:157-165) and their
+        pods are deleted; the controllers recreate them gated and the gang
+        re-queues, while the preemptor is admitted in the next round against
+        the freed capacity. Returns the victim gang names.
+
+        Thrash guards: only BOUND victim pods count as freeable capacity, and
+        the eviction only proceeds when a TRIAL SOLVE of the preemptor
+        against the hypothetically-freed cluster admits it (a topologically
+        infeasible preemptor — e.g. a required pack no single domain can ever
+        satisfy — must never cost victims their placement)."""
+        rejected = [
+            spec
+            for i, spec in enumerate(gang_specs)
+            if not result.admitted[i] and spec["priority"] > 0
+        ]
+        if not rejected:
+            return set()
+        preemptor = max(rejected, key=lambda s: s["priority"])
+
+        victims = []
+        for gang in self.store.list("PodGang", namespace):
+            cond = get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+            if cond is None or not cond.is_true():
+                continue
+            victim_priority = self.priority_map.get(
+                gang.spec.priority_class_name, 0
+            )
+            if victim_priority < preemptor["priority"]:
+                victims.append((victim_priority, gang))
+        if not victims:
+            return set()
+        victims.sort(key=lambda v: v[0])
+
+        demand_total: Dict[str, float] = {}
+        for group in preemptor["groups"]:
+            for r, q in group["demand"].items():
+                demand_total[r] = demand_total.get(r, 0.0) + q * group["min_count"]
+
+        # freed capacity per NODE, counting only pods actually bound
+        freed: Dict[str, float] = {}
+        freed_per_node: Dict[str, Dict[str, float]] = {}
+        chosen = []
+        for _, gang in victims:
+            chosen.append(gang)
+            for group in gang.spec.pod_groups:
+                for ref in group.pod_references:
+                    node_name = self.cluster.bindings.get(
+                        (ref.namespace, ref.name)
+                    )
+                    if node_name is None:
+                        continue
+                    pod = self.store.get("Pod", ref.namespace, ref.name)
+                    if pod is None:
+                        continue
+                    per_node = freed_per_node.setdefault(node_name, {})
+                    for r, q in pod.spec.total_requests().items():
+                        freed[r] = freed.get(r, 0.0) + q
+                        per_node[r] = per_node.get(r, 0.0) + q
+            if all(freed.get(r, 0.0) >= q for r, q in demand_total.items()):
+                break
+        else:
+            return set()  # evicting everything lower still wouldn't fit
+
+        # trial solve: preemptor alone against free + hypothetically freed
+        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        trial_free = {}
+        for node in nodes:
+            caps = dict(self.cluster.node_free(node))
+            for r, q in freed_per_node.get(node.name, {}).items():
+                caps[r] = caps.get(r, 0.0) + q
+            trial_free[node.name] = caps
+        trial_problem = build_problem(
+            nodes, [preemptor], self.topology, free_capacity=trial_free
+        )
+        trial = solve(trial_problem, with_alloc=False)
+        if not trial.admitted[0]:
+            return set()  # eviction would not make the preemptor placeable
+
+        for gang in chosen:
+            now = self.store.clock.now()
+            set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=COND_PODGANG_DISRUPTION_TARGET,
+                    status="True",
+                    reason="PreemptedByHigherPriority",
+                    message=f"preempted by {preemptor['name']}",
+                ),
+                now,
+            )
+            set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=COND_PODGANG_SCHEDULED,
+                    status="False",
+                    reason="Preempted",
+                    message=f"preempted by {preemptor['name']}",
+                ),
+                now,
+            )
+            gang.status.phase = PHASE_PENDING
+            gang.status.placement_score = None
+            self.store.update_status(gang)
+            # victim pods recreate gated via their PCLQs
+            for group in gang.spec.pod_groups:
+                for ref in group.pod_references:
+                    if self.store.get("Pod", ref.namespace, ref.name) is not None:
+                        self.store.delete("Pod", ref.namespace, ref.name)
+            METRICS.inc("gang_preemptions_total")
+        return {g.metadata.name for g in chosen}
+
+    def update_gang_health(self, namespace: str = "default") -> None:
+        """Unhealthy condition: any constituent PCLQ currently breaching
+        MinAvailable marks the gang a gang-termination candidate
+        (scheduler podgang.go:157-161)."""
+        from grove_tpu.api.types import COND_MIN_AVAILABLE_BREACHED
+
+        for gang in self.store.list("PodGang", namespace):
+            breached = False
+            for group in gang.spec.pod_groups:
+                pclq = self.store.get("PodClique", namespace, group.name)
+                if pclq is None:
+                    continue
+                cond = get_condition(
+                    pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED
+                )
+                if cond is not None and cond.is_true():
+                    breached = True
+                    break
+            set_condition(
+                gang.status.conditions,
+                Condition(
+                    type=COND_PODGANG_UNHEALTHY,
+                    status="True" if breached else "False",
+                    reason=(
+                        "ConstituentBreachedMinAvailable"
+                        if breached
+                        else "AllConstituentsHealthy"
+                    ),
+                ),
+                self.store.clock.now(),
+            )
+            self.store.update_status(gang)
 
     def update_gang_phases(self, namespace: str = "default") -> None:
         """Advance Starting → Running (+ Ready condition) once every pod of

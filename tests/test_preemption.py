@@ -1,0 +1,215 @@
+"""Priority preemption + gang health conditions + support-infra units."""
+
+import pathlib
+
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.pod import is_ready, is_scheduled
+from grove_tpu.config.operator import load_operator_configuration
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def small_pcs(name, cpu, priority_class="", replicas=4):
+    from grove_tpu.api.load import load_podcliquesets
+
+    text = f"""
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata: {{name: {name}}}
+spec:
+  replicas: 1
+  template:
+    priorityClassName: "{priority_class}"
+    cliques:
+      - name: main
+        spec:
+          roleName: {name}-main
+          replicas: {replicas}
+          podSpec:
+            containers:
+              - name: c
+                image: busybox:stable
+                resources: {{requests: {{cpu: "{cpu}"}}}}
+"""
+    return load_podcliquesets(text)[0]
+
+
+class TestPreemption:
+    def _harness(self):
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        h = SimHarness(num_nodes=2, config=cfg)
+        for n in h.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        return h
+
+    def test_high_priority_preempts_low(self):
+        h = self._harness()
+        h.apply(small_pcs("low", cpu=4, priority_class="batch"))
+        h.converge()
+        assert all(is_ready(p) for p in h.store.list("Pod"))  # fills cluster
+
+        h.apply(small_pcs("high", cpu=4, priority_class="critical"))
+        h.converge()
+
+        high_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "high"})
+        assert high_pods and all(is_ready(p) for p in high_pods), h.tree()
+        # victim carries the disruption record
+        low_gang = h.store.get("PodGang", "default", "low-0")
+        dt = get_condition(low_gang.status.conditions, "DisruptionTarget")
+        assert dt is not None and dt.is_true()
+        assert dt.reason == "PreemptedByHigherPriority"
+        # low's recreated pods exist but cannot all be scheduled now
+        low_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "low"})
+        assert low_pods and not all(is_scheduled(p) for p in low_pods)
+
+    def test_equal_priority_never_preempts(self):
+        h = self._harness()
+        h.apply(small_pcs("first", cpu=4, priority_class="batch"))
+        h.converge()
+        h.apply(small_pcs("second", cpu=4, priority_class="batch"))
+        h.converge()
+        first_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "first"})
+        assert all(is_ready(p) for p in first_pods)
+        gang = h.store.get("PodGang", "default", "first-0")
+        dt = get_condition(gang.status.conditions, "DisruptionTarget")
+        assert dt is None or not dt.is_true()
+
+    def test_no_thrash_when_eviction_would_not_help(self):
+        h = self._harness()
+        h.apply(small_pcs("low", cpu=4, priority_class="batch"))
+        h.converge()
+        # high demands more than the whole cluster even when empty
+        h.apply(small_pcs("huge", cpu=8, priority_class="critical", replicas=4))
+        h.converge()
+        low_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "low"})
+        assert all(is_ready(p) for p in low_pods), h.tree()  # untouched
+
+
+class TestPreemptionGuards:
+    def test_topologically_infeasible_preemptor_never_evicts(self):
+        """Trial-solve guard: a required pack no single domain can satisfy
+        must not cost victims their placement (cross-pass thrash)."""
+        from grove_tpu.api.types import TopologyConstraint
+
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        # 2 nodes in DIFFERENT ici-blocks (1 host per block)
+        h = SimHarness(num_nodes=2, config=cfg)
+        from grove_tpu.sim.cluster import make_nodes
+
+        h.cluster.nodes = make_nodes(2, capacity={"cpu": 8.0}, hosts_per_ici_block=1)
+        h.apply(small_pcs("low", cpu=4, priority_class="batch"))
+        h.converge()
+        assert all(is_ready(p) for p in h.store.list("Pod"))
+
+        # high needs 16 cpu inside ONE block (max 8) → never placeable
+        high = small_pcs("high", cpu=4, priority_class="critical")
+        high.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        h.apply(high)
+        h.converge()
+        low_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "low"})
+        assert all(is_ready(p) for p in low_pods), h.tree()
+        gang = h.store.get("PodGang", "default", "low-0")
+        dt = get_condition(gang.status.conditions, "DisruptionTarget")
+        assert dt is None or not dt.is_true()
+
+    def test_disruption_target_cleared_on_reschedule(self):
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        h = SimHarness(num_nodes=2, config=cfg)
+        for n in h.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        h.apply(small_pcs("low", cpu=4, priority_class="batch"))
+        h.converge()
+        h.apply(small_pcs("high", cpu=4, priority_class="critical"))
+        h.converge()
+        gang = h.store.get("PodGang", "default", "low-0")
+        assert get_condition(gang.status.conditions, "DisruptionTarget").is_true()
+        # the preemptor departs; low reschedules and sheds the condition
+        h.delete("high")
+        h.converge()
+        low_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "low"})
+        assert low_pods and all(is_ready(p) for p in low_pods), h.tree()
+        gang = h.store.get("PodGang", "default", "low-0")
+        dt = get_condition(gang.status.conditions, "DisruptionTarget")
+        assert dt is not None and not dt.is_true()
+        assert dt.reason == "Rescheduled"
+
+
+class TestGangHealth:
+    def test_unhealthy_condition_follows_breach(self):
+        h = SimHarness(num_nodes=16)
+        h.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        h.converge()
+        gang = h.store.get("PodGang", "default", "simple1-0")
+        cond = get_condition(gang.status.conditions, "Unhealthy")
+        assert cond is not None and not cond.is_true()
+        h.cluster.fail_pod("default", "simple1-0-pcd-0")
+        h.cluster.fail_pod("default", "simple1-0-pcd-1")
+        h.engine.drain()
+        h.schedule()  # health refresh
+        gang = h.store.get("PodGang", "default", "simple1-0")
+        cond = get_condition(gang.status.conditions, "Unhealthy")
+        assert cond is not None and cond.is_true()
+
+
+class TestSupportInfra:
+    def test_slow_start_aborts_on_total_failure(self):
+        from grove_tpu.utils.concurrent import (
+            Task,
+            run_concurrently_with_slow_start,
+        )
+
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("down")
+
+        result = run_concurrently_with_slow_start(
+            [Task(name=f"t{i}", fn=boom) for i in range(100)]
+        )
+        assert result.has_errors
+        assert len(calls) == 1  # first batch of 1 failed → abort
+        assert len(result.failed) == 100
+
+    def test_slow_start_batches_grow(self):
+        from grove_tpu.utils.concurrent import (
+            Task,
+            run_concurrently_with_slow_start,
+        )
+
+        done = []
+        result = run_concurrently_with_slow_start(
+            [Task(name=f"t{i}", fn=lambda i=i: done.append(i)) for i in range(10)]
+        )
+        assert not result.has_errors and len(done) == 10
+
+    def test_structured_logging(self, capsys):
+        from grove_tpu.observability.logging import configure_logging, get_logger
+
+        configure_logging(level="info", fmt="json")
+        log = get_logger("test").with_values(controller="pcs")
+        log.info("reconciled", name="simple1")
+        err = capsys.readouterr().err
+        assert '"controller": "pcs"' in err and '"name": "simple1"' in err
+
+    def test_events_materialized(self):
+        h = SimHarness(num_nodes=16)
+        h.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        h.converge()
+        events = h.store.list("Event")
+        assert events
+        reasons = {e.spec["reason"] for e in events}
+        assert "PodCliqueCreateSuccessful" in reasons
