@@ -99,6 +99,30 @@ def _cmd_tree(args) -> int:
     return 0
 
 
+def _cmd_get(args) -> int:
+    import yaml
+
+    from grove_tpu.api.serialize import export_object
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            harness.apply_yaml(f.read())
+    harness.converge()
+    objs = harness.store.list(args.kind)
+    if not objs:
+        print(f"no {args.kind} objects", file=sys.stderr)
+        return 1
+    print(
+        yaml.safe_dump_all(
+            [export_object(o) for o in objs], sort_keys=False
+        ),
+        end="",
+    )
+    return 0
+
+
 def _cmd_bench(args) -> int:
     import subprocess
 
@@ -141,6 +165,14 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--nodes", type=int, default=32)
     p.add_argument("--scale", action="append", metavar="GROUP=REPLICAS")
     p.set_defaults(fn=_cmd_tree)
+
+    p = sub.add_parser(
+        "get", help="apply manifests, then export live objects as YAML"
+    )
+    p.add_argument("manifests", nargs="+")
+    p.add_argument("--kind", default="PodGang")
+    p.add_argument("--nodes", type=int, default=32)
+    p.set_defaults(fn=_cmd_get)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")

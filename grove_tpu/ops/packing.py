@@ -60,6 +60,25 @@ def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(k, 0, _INT_CAP).astype(jnp.int32)
 
 
+def _fill_floors_first(free, mask, demand, count, min_count):
+    """Two-phase fill: place every group's admission FLOOR first, then the
+    best-effort extras — a full-count greedy would let an early group's
+    extras starve a later group's floor (guaranteed gang scheduling is for
+    MinReplicas; extras must never defeat it).
+
+    Floors are clamped to the available count and extras to >= 0: a recovery
+    delta-solve can momentarily have fewer pending pods than the remaining
+    floor (count < min_count), and a negative extras count would corrupt the
+    fill (negative allocations inflate free capacity). The clamped floor can
+    never satisfy `placed_min >= min_count`, so such gangs correctly wait.
+    Returns (alloc [P,N], placed [P], placed_min [P], free_after)."""
+    floors = jnp.minimum(min_count, count)
+    extras = jnp.maximum(count - min_count, 0)
+    alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
+    alloc_ext, placed_ext, free2 = _fill(free1, mask, demand, extras)
+    return alloc_min + alloc_ext, placed_min + placed_ext, placed_min, free2
+
+
 def _fill(free, mask, demand, count):
     """Sequentially fill each group inside `mask` (nodes are topology-sorted,
     so the exclusive-cumsum take packs into contiguous domains first).
@@ -199,22 +218,26 @@ def gang_select_and_fill(
     for l in range(n_levels):
         ok_l, best_l = level_candidate(l)
         mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
-        alloc_l, placed_l, free_l = _fill(free, mask_l, gang.demand, gang.count)
+        alloc_l, placed_l, placed_min_l, free_l = _fill_floors_first(
+            free, mask_l, gang.demand, gang.count, gang.min_count
+        )
         fill_ok = (
             ok_l
             & (lv[l] >= min_allowed)
-            & jnp.all(jnp.where(active, placed_l >= gang.min_count, True))
+            & jnp.all(jnp.where(active, placed_min_l >= gang.min_count, True))
         )
         cand_alloc.append(alloc_l)
         cand_placed.append(placed_l)
         cand_free.append(free_l)
         cand_ok.append(fill_ok)
     # cluster-wide fallback (only when no required pack level)
-    alloc_c, placed_c, free_c = _fill(free, all_nodes, gang.demand, gang.count)
+    alloc_c, placed_c, placed_min_c, free_c = _fill_floors_first(
+        free, all_nodes, gang.demand, gang.count, gang.min_count
+    )
     cluster_ok = (
         (gang.req_level < 0)
         & any_active
-        & jnp.all(jnp.where(active, placed_c >= gang.min_count, True))
+        & jnp.all(jnp.where(active, placed_min_c >= gang.min_count, True))
     )
     cand_alloc.append(alloc_c)
     cand_placed.append(placed_c)
@@ -452,11 +475,13 @@ def gang_select_single(
         has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
     )
 
-    alloc, placed, free_after = _fill(free, mask, gang.demand, gang.count)
+    alloc, placed, placed_min, free_after = _fill_floors_first(
+        free, mask, gang.demand, gang.count, gang.min_count
+    )
     level_fill_ok = (
         had_candidate
         & any_active
-        & jnp.all(jnp.where(active, placed >= gang.min_count, True))
+        & jnp.all(jnp.where(active, placed_min >= gang.min_count, True))
     )
 
     # when the level fill fails, the retry cap jumps straight to the next
@@ -482,9 +507,12 @@ def gang_select_single(
     remaining = jnp.where(
         cluster_rescue, gang.count, jnp.where(spill, gang.count - placed, 0)
     )
-    alloc2, placed2, _ = _fill(base_free, all_nodes, gang.demand, remaining)
+    rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
+    alloc2, placed2, placed2_min, _ = _fill_floors_first(
+        base_free, all_nodes, gang.demand, remaining, rescue_min
+    )
     rescue_ok = cluster_rescue & jnp.all(
-        jnp.where(active, placed2 >= gang.min_count, True)
+        jnp.where(active, placed2_min >= gang.min_count, True)
     )
     alloc = jnp.where(
         rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)

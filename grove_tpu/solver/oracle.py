@@ -41,6 +41,16 @@ def _fill(free, mask, demand, count):
     return alloc, placed, free
 
 
+def _fill_floors_first(free, mask, demand, count, min_count):
+    """Mirror of the kernel's two-phase fill: floors first (clamped to the
+    available count), then non-negative extras."""
+    floors = np.minimum(min_count, count)
+    extras = np.maximum(count - min_count, 0)
+    alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
+    alloc_ext, placed_ext, free2 = _fill(free1, mask, demand, extras)
+    return alloc_min + alloc_ext, placed_min + placed_ext, placed_min, free2
+
+
 def _level_weights(L: int) -> np.ndarray:
     w = np.arange(1, L + 1, dtype=np.float64)
     return w / w.sum()
@@ -121,8 +131,8 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
             key = spare.astype(np.float32) + tie
             key[~feas] = np.inf
             mask = topo[:, l] == int(np.argmin(key))
-            a, pl, fa = _fill(cap, mask, demand, count)
-            if all(pl[p] >= min_count[p] for p in range(P) if active[p]):
+            a, pl, pl_min, fa = _fill_floors_first(cap, mask, demand, count, min_count)
+            if all(pl_min[p] >= min_count[p] for p in range(P) if active[p]):
                 chosen_level, alloc, placed, free_after = l, a, pl, fa
                 break
 
@@ -130,8 +140,10 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
             if req >= 0:
                 continue  # required pack unsatisfiable → unplaced
             mask = np.ones((N,), dtype=bool)  # cluster-wide fallback
-            alloc, placed, free_after = _fill(cap, mask, demand, count)
-            if not all(placed[p] >= min_count[p] for p in range(P) if active[p]):
+            alloc, placed, pl_min, free_after = _fill_floors_first(
+                cap, mask, demand, count, min_count
+            )
+            if not all(pl_min[p] >= min_count[p] for p in range(P) if active[p]):
                 continue  # all-or-nothing: no capacity consumed
         elif req < 0:
             # best-effort extras spill cluster-wide

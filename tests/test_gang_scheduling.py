@@ -124,6 +124,92 @@ class TestTopologyPacking:
         assert all(is_ready(p) for p in pods), harness.tree()
 
 
+class TestMinReplicasSemantics:
+    def test_gang_admitted_at_floor_extra_pods_pending(self):
+        """PodGroup.MinReplicas floor: a gang whose clique has
+        minAvailable < replicas is admitted once the floor fits; extra pods
+        are best-effort."""
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.05}  # 5 pods of 10m
+        pcs = simple1()
+        # pca: 3 replicas but floor of 1; others floor = replicas (7 pods)
+        pcs.spec.template.cliques[0].spec.min_available = 1
+        # shrink others so floor total fits: pcb/pcc/pcd 1 replica each
+        for clique in pcs.spec.template.cliques[1:]:
+            clique.spec.replicas = 1
+            clique.spec.min_available = 1
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        scheduled = [p for p in pods if is_scheduled(p)]
+        # 3 (pcb+pcc+pcd) + at least 1 pca, at most 5 total (capacity)
+        assert len(scheduled) == 5, harness.tree()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang.status.placement_score is not None  # admitted at the floor
+        pca_pending = [
+            p
+            for p in pods
+            if "pca" in p.metadata.name and not is_scheduled(p)
+        ]
+        assert len(pca_pending) == 1  # best-effort extra waits for capacity
+
+
+class TestMultiReplicaSets:
+    def test_each_replica_gets_own_base_gang(self):
+        harness = SimHarness(num_nodes=2)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.09}
+        harness.cluster.nodes[1].capacity = {"cpu": 0.09}
+        pcs = simple1()
+        pcs.spec.replicas = 2
+        harness.apply(pcs)
+        harness.converge()
+        gangs = {g.metadata.name for g in harness.store.list("PodGang")}
+        assert gangs == {"simple1-0", "simple1-1"}
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+
+    def test_partial_capacity_admits_one_replica_atomically(self):
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.09}  # one replica's worth
+        pcs = simple1()
+        pcs.spec.replicas = 2
+        harness.apply(pcs)
+        harness.converge()
+        scheduled_gangs = {
+            p.metadata.labels[namegen.LABEL_PODGANG]
+            for p in harness.store.list("Pod")
+            if is_scheduled(p)
+        }
+        pending_gangs = {
+            p.metadata.labels[namegen.LABEL_PODGANG]
+            for p in harness.store.list("Pod")
+            if not is_scheduled(p)
+        }
+        # exactly one replica fully placed, the other fully pending
+        assert len(scheduled_gangs) == 1 and len(pending_gangs) == 1
+        assert scheduled_gangs.isdisjoint(pending_gangs), harness.tree()
+
+    def test_deleting_one_set_releases_capacity_for_another(self):
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.09}
+        harness.apply(simple1())
+        harness.converge()
+        assert all(is_ready(p) for p in harness.store.list("Pod"))
+        other = simple1()
+        other.metadata.name = "waiting"
+        harness.apply(other)
+        harness.converge()
+        waiting_pods = harness.store.list(
+            "Pod", "default", {namegen.LABEL_PART_OF: "waiting"}
+        )
+        assert waiting_pods and all(not is_scheduled(p) for p in waiting_pods)
+        harness.delete("simple1")
+        harness.converge()
+        waiting_pods = harness.store.list(
+            "Pod", "default", {namegen.LABEL_PART_OF: "waiting"}
+        )
+        assert all(is_ready(p) for p in waiting_pods), harness.tree()
+
+
 class TestPlacementScore:
     def test_score_reported_on_gang_status(self):
         harness = SimHarness(num_nodes=16)
