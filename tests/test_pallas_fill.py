@@ -1,0 +1,40 @@
+"""Pallas fill experiment: interpret-mode correctness vs the XLA fill, and
+the multi-host local cluster smoke test."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+
+class TestPallasFill:
+    def test_matches_xla_fill_interpret(self):
+        from grove_tpu.ops.packing import _fill
+        from grove_tpu.ops.pallas_fill import pallas_fill_batch
+
+        rng = np.random.default_rng(0)
+        n, r, p, g = 256, 3, 4, 8
+        free = jnp.asarray(rng.integers(0, 32, (n, r)).astype(np.float32))
+        demand = jnp.asarray(rng.integers(1, 4, (g, p, r)).astype(np.float32))
+        count = jnp.asarray(rng.integers(0, 6, (g, p)).astype(np.int32))
+        masks = jnp.asarray((rng.random((g, n)) < 0.5).astype(np.float32))[
+            :, None, :
+        ]
+        alloc, placed = pallas_fill_batch(
+            free.T, masks, demand, count[..., None], interpret=True
+        )
+        for gi in range(g):
+            ref_alloc, ref_placed, _ = _fill(
+                free, masks[gi, 0].astype(bool), demand[gi], count[gi]
+            )
+            np.testing.assert_array_equal(np.asarray(ref_alloc), np.asarray(alloc[gi]))
+            np.testing.assert_array_equal(
+                np.asarray(ref_placed), np.asarray(placed[gi, :, 0])
+            )
+
+
+@pytest.mark.slow
+class TestMultiHost:
+    def test_local_two_process_cluster(self):
+        from grove_tpu.parallel.multihost import spawn_local_cluster
+
+        assert spawn_local_cluster(num_processes=2, port=12871)
