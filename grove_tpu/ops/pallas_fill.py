@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_INT_CAP = 1 << 20
+from grove_tpu.ops.packing import _INT_CAP  # one cap for both kernels
 
 
 def _fill_kernel(free_ref, mask_ref, demand_ref, count_ref, alloc_ref, placed_ref):
@@ -51,15 +51,18 @@ def _fill_kernel(free_ref, mask_ref, demand_ref, count_ref, alloc_ref, placed_re
             d = demand_ref[0, p, r]
             ratio = jnp.floor(free[r, :] / jnp.where(d > 0, d, 1.0))
             k = jnp.where(d > 0, jnp.minimum(k, ratio), k)
-        k = jnp.minimum(
-            jnp.where(mask > 0, k, 0.0), count_p.astype(jnp.float32)
+        # integer prefix math exactly as ops.packing._fill (float32 cumsum
+        # would lose integer exactness past 2^24 at large count*N)
+        k_i = jnp.minimum(
+            jnp.where(mask > 0, k, 0.0).astype(jnp.int32), count_p
         )
-        cum = jnp.cumsum(k) - k  # exclusive prefix along lanes
-        take = jnp.clip(count_p.astype(jnp.float32) - cum, 0.0, k)
+        cum = jnp.cumsum(k_i) - k_i  # exclusive prefix along lanes
+        take = jnp.clip(count_p - cum, 0, k_i)
+        take_f = take.astype(jnp.float32)
         for r in range(r_dim):
-            free = free.at[r, :].set(free[r, :] - take * demand_ref[0, p, r])
-        alloc_ref[0, p, :] = take.astype(jnp.int32)
-        placed_ref[0, p, 0] = jnp.sum(take).astype(jnp.int32)
+            free = free.at[r, :].set(free[r, :] - take_f * demand_ref[0, p, r])
+        alloc_ref[0, p, :] = take
+        placed_ref[0, p, 0] = jnp.sum(take)
 
 
 @partial(jax.jit, static_argnames=("interpret",))

@@ -58,8 +58,6 @@ def global_solver_mesh():
 
 _WORKER_SNIPPET = """
 import os
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 from grove_tpu.parallel import multihost
 multihost.initialize(
@@ -86,32 +84,43 @@ def spawn_local_cluster(num_processes: int = 2, port: int = 12765) -> bool:
     import subprocess
     import sys
 
+    from grove_tpu.utils.platform import cpu_subprocess_env
+
     repo = pathlib.Path(__file__).resolve().parents[2]
     procs = []
-    for pid in range(num_processes):
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update(
-            COORD=f"127.0.0.1:{port}",
-            NPROC=str(num_processes),
-            PID_IDX=str(pid),
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="",  # exactly one device per process (no virtual fanout)
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", _WORKER_SNIPPET],
-                env=env,
-                cwd=repo,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
+    try:
+        for pid in range(num_processes):
+            env = cpu_subprocess_env(n_devices=None)  # one device per process
+            env.update(
+                COORD=f"127.0.0.1:{port}",
+                NPROC=str(num_processes),
+                PID_IDX=str(pid),
             )
-        )
-    ok = True
-    for proc in procs:
-        out, _ = proc.communicate(timeout=120)
-        if proc.returncode != 0 or "MULTIHOST_OK" not in out:
-            ok = False
-            print(out)
-    return ok
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", _WORKER_SNIPPET],
+                    env=env,
+                    cwd=repo,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        ok = True
+        for proc in procs:
+            try:
+                out, _ = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                ok = False
+                continue
+            if proc.returncode != 0 or "MULTIHOST_OK" not in out:
+                ok = False
+                print(out)
+        return ok
+    finally:
+        # never leak workers (a hung peer would hold the coordinator port
+        # and wedge every subsequent run)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()

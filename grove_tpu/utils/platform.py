@@ -1,0 +1,22 @@
+"""Subprocess environment for forced-CPU JAX children.
+
+Single home for the sitecustomize workaround (this image's axon TPU plugin
+pins the platform before user code runs — see tests/conftest.py): child
+processes that must run on host CPU devices get a sanitized env from here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def cpu_subprocess_env(n_devices: Optional[int] = None) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon sitecustomize pin
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is None:
+        env["XLA_FLAGS"] = ""  # exactly one device
+    else:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env
