@@ -79,6 +79,17 @@ class Store:
         # controllers act as the operator identity
         self.guard = None
         self.actor: Optional[str] = None
+        # fault injection (reference test/utils/client.go): map of
+        # "create"|"update"|"delete" -> callable(obj) -> Optional[Exception];
+        # a returned exception is raised before the write commits
+        self.error_injectors: Dict[str, Callable] = {}
+
+    def _inject(self, operation: str, obj) -> None:
+        injector = self.error_injectors.get(operation)
+        if injector is not None:
+            err = injector(obj)
+            if err is not None:
+                raise err
 
     @contextmanager
     def as_user(self, username: str):
@@ -134,6 +145,7 @@ class Store:
 
     def create(self, obj) -> object:
         self._authorize("create", obj)
+        self._inject("create", obj)
         kind_objs = self._committed.setdefault(obj.kind, {})
         key = obj_key(obj)
         if key in kind_objs:
@@ -189,6 +201,7 @@ class Store:
         kind_objs, key = self._require(obj)
         current = kind_objs[key]
         self._authorize("update", current)
+        self._inject("update", obj)  # injectors see the state being written
         if (
             obj.metadata.resource_version
             and obj.metadata.resource_version != current.metadata.resource_version
@@ -228,6 +241,7 @@ class Store:
         if obj is None:
             raise GroveError(ERR_NOT_FOUND, f"{kind} {key} not found", "delete")
         self._authorize("delete", obj)
+        self._inject("delete", obj)
         if obj.metadata.finalizers:
             if obj.metadata.deletion_timestamp is None:
                 obj.metadata.deletion_timestamp = self.clock.now()
@@ -244,6 +258,9 @@ class Store:
         obj = kind_objs.get(key)
         if obj is None:
             return
+        # finalizer drain is an update-class write: same guard + fault hooks
+        self._authorize("update", obj)
+        self._inject("update", obj)
         if finalizer in obj.metadata.finalizers:
             obj.metadata.finalizers.remove(finalizer)
             self._rv += 1

@@ -1,0 +1,81 @@
+"""Fault-injection resilience: controllers must absorb transient apiserver
+errors via requeue/backoff and converge once the fault clears (the tier the
+reference covers with its error-injecting fake client + -race runs)."""
+
+import pathlib
+
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.pod import is_ready
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def simple1():
+    return load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+
+
+class TestFaultInjection:
+    def test_transient_pod_create_failures_recover(self):
+        """Every pod create fails N times, then succeeds: slow-start aborts
+        the burst, the reconciler requeues with backoff, and the system still
+        converges to the full resource tree with no duplicates."""
+        harness = SimHarness(num_nodes=32)
+        failures = {"budget": 7}
+
+        def flaky_create(obj):
+            if obj.kind == "Pod" and failures["budget"] > 0:
+                failures["budget"] -= 1
+                return GroveError("ERR_CREATE_RESOURCE", "injected outage", "create")
+            return None
+
+        harness.store.error_injectors["create"] = flaky_create
+        harness.apply(simple1())
+        harness.converge(max_ticks=120)
+        pods = harness.store.list("Pod")
+        assert len(pods) == 9, harness.tree()
+        assert all(is_ready(p) for p in pods)
+        assert failures["budget"] == 0  # the outage really happened
+
+    def test_persistent_failure_surfaces_without_livelock(self):
+        harness = SimHarness(num_nodes=32)
+        harness.store.error_injectors["create"] = lambda obj: (
+            GroveError("ERR_CREATE_RESOURCE", "down", "create")
+            if obj.kind == "Pod"
+            else None
+        )
+        from grove_tpu.observability.metrics import METRICS
+
+        errors_before = METRICS.counters.get("reconcile_errors_total/podclique", 0)
+        harness.apply(simple1())
+        harness.converge(max_ticks=30)  # must terminate, not spin
+        assert harness.store.list("Pod") == []
+        # reconcile errors were counted (observability surface) — compare
+        # against the snapshot: METRICS is a process-global singleton
+        assert (
+            METRICS.counters.get("reconcile_errors_total/podclique", 0)
+            > errors_before
+        )
+        # clearing the fault heals the system — the key sits in capped
+        # exponential backoff (workqueue MAX_BACKOFF=1000s), so jump past it
+        harness.store.error_injectors.clear()
+        harness.advance(1001.0)
+        harness.converge()
+        assert len(harness.store.list("Pod")) == 9
+
+    def test_transient_status_update_failures_recover(self):
+        harness = SimHarness(num_nodes=32)
+        failures = {"budget": 5}
+
+        def flaky_update(obj):
+            if obj.kind == "PodClique" and failures["budget"] > 0:
+                failures["budget"] -= 1
+                return GroveError("ERR_UPDATE_RESOURCE", "injected conflict", "update")
+            return None
+
+        harness.store.error_injectors["update"] = flaky_update
+        harness.apply(simple1())
+        harness.converge(max_ticks=120)
+        assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+        assert failures["budget"] == 0

@@ -1,0 +1,146 @@
+"""Direct unit tests for the PodGang compute semantics — the subtlest parity
+logic (reference syncflow_test.go tables, SURVEY §7 'semantics parity')."""
+
+import pathlib
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.controller.podcliqueset.components.podgang import (
+    compute_expected_podgangs,
+)
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def setup_harness(mutate=None):
+    harness = SimHarness(num_nodes=32)
+    pcs = load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+    if mutate:
+        mutate(pcs)
+    harness.apply(pcs)
+    return harness
+
+
+class TestComputeExpectedPodGangs:
+    def test_base_contains_standalone_and_min_available_sg_replicas(self):
+        def mutate(pcs):
+            sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+            sg.replicas = 5
+            sg.min_available = 3
+
+        harness = setup_harness(mutate)
+        harness.engine.drain()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        gangs = compute_expected_podgangs(harness.ctx, pcs)
+        by_name = {g.fqn: g for g in gangs}
+        # worked example from syncflow.go:227-229: minAvailable=3 → replicas
+        # 0,1,2 fold into the base; 3,4 become scaled gangs 0,1
+        assert set(by_name) == {"simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"}
+        base = by_name["simple1-0"]
+        base_pclqs = {p.fqn for p in base.pclqs}
+        assert base_pclqs == {
+            "simple1-0-pca",
+            "simple1-0-pcd",
+            "simple1-0-sga-0-pcb",
+            "simple1-0-sga-0-pcc",
+            "simple1-0-sga-1-pcb",
+            "simple1-0-sga-1-pcc",
+            "simple1-0-sga-2-pcb",
+            "simple1-0-sga-2-pcc",
+        }
+        scaled = by_name["simple1-0-sga-0"]
+        assert {p.fqn for p in scaled.pclqs} == {
+            "simple1-0-sga-3-pcb",
+            "simple1-0-sga-3-pcc",
+        }
+        assert scaled.base_fqn == "simple1-0"
+
+    def test_live_pcsg_replicas_override_template(self):
+        """determinePodCliqueReplicas / live PCSG override (HPA mutations)."""
+        harness = setup_harness()
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        pcsg.spec.replicas = 4
+        harness.store.update(pcsg)
+        harness.engine.drain()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        gangs = compute_expected_podgangs(harness.ctx, pcs)
+        names = {g.fqn for g in gangs}
+        assert names == {
+            "simple1-0",
+            "simple1-0-sga-0",
+            "simple1-0-sga-1",
+            "simple1-0-sga-2",
+        }
+
+    def test_autoscaled_clique_uses_live_replicas(self):
+        harness = setup_harness()
+        harness.converge()
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq.spec.replicas = 5  # HPA scaled the autoscaled clique
+        harness.store.update(pclq)
+        harness.engine.drain()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        gangs = compute_expected_podgangs(harness.ctx, pcs)
+        base = next(g for g in gangs if g.fqn == "simple1-0")
+        pca = next(p for p in base.pclqs if p.fqn == "simple1-0-pca")
+        assert pca.replicas == 5
+        # non-autoscaled cliques always follow the template
+        pcd = next(p for p in base.pclqs if p.fqn == "simple1-0-pcd")
+        assert pcd.replicas == 2
+
+    def test_gang_creation_deferred_until_pods_labeled(self):
+        """syncflow.go:394-461: a gang pending creation is skipped while any
+        constituent pod is missing or unlabeled."""
+        harness = setup_harness()
+        # single drain round: PCLQs exist, pods may not all exist yet
+        harness.engine.drain()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        if gang is not None:
+            # if it exists, every referenced pod must exist and carry the label
+            for group in gang.spec.pod_groups:
+                for ref in group.pod_references:
+                    pod = harness.store.get("Pod", ref.namespace, ref.name)
+                    assert pod is not None
+                    assert (
+                        pod.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
+                    )
+        harness.converge()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        assert gang is not None
+        assert sum(len(g.pod_references) for g in gang.spec.pod_groups) == 9
+
+    def test_pod_groups_sorted_and_min_replicas(self):
+        harness = setup_harness()
+        harness.converge()
+        gang = harness.store.get("PodGang", "default", "simple1-0")
+        for group in gang.spec.pod_groups:
+            names = [r.name for r in group.pod_references]
+            assert names == sorted(names)
+        by_name = {g.name: g for g in gang.spec.pod_groups}
+        assert by_name["simple1-0-pca"].min_replicas == 3
+        assert by_name["simple1-0-sga-0-pcb"].min_replicas == 2
+
+    def test_excess_gangs_deleted_on_scale_in(self):
+        harness = setup_harness()
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        pcsg.spec.replicas = 3
+        harness.store.update(pcsg)
+        harness.converge()
+        assert (
+            harness.store.get("PodGang", "default", "simple1-0-sga-1") is not None
+        )
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        pcsg.spec.replicas = 1
+        harness.store.update(pcsg)
+        harness.converge()
+        assert harness.store.get("PodGang", "default", "simple1-0-sga-0") is None
+        assert harness.store.get("PodGang", "default", "simple1-0-sga-1") is None
