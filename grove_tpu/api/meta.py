@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import copy
 import itertools
+import pickle
 import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -169,4 +170,10 @@ class NamespacedName:
 
 
 def deep_copy(obj):
-    return copy.deepcopy(obj)
+    """Deep-copy an API object. pickle round-trip is several times faster
+    than copy.deepcopy for plain dataclass trees (the store copies on every
+    read/write, so this is the control plane's hottest function)."""
+    try:
+        return pickle.loads(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    except Exception:
+        return copy.deepcopy(obj)

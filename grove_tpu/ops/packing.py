@@ -333,67 +333,108 @@ def solve_packing(
     }
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("commit_iters",))
 def solve_wave_chunk(
     free: jnp.ndarray,  # [N, R]
     topo: jnp.ndarray,  # [N, L]
     seg_starts: jnp.ndarray,  # [L, D]
     seg_ends: jnp.ndarray,  # [L, D]
     demand: jnp.ndarray,  # [C, P, R] — one CHUNK of gangs
-    count: jnp.ndarray,  # [C, P] (zeroed for already-settled gangs)
+    count: jnp.ndarray,  # [C, P]
     min_count: jnp.ndarray,  # [C, P]
     req_level: jnp.ndarray,  # [C]
     pref_level: jnp.ndarray,  # [C]
+    pending: jnp.ndarray,  # [C] bool
+    narrow_cap: jnp.ndarray,  # [C] int32
+    seeds: jnp.ndarray,  # [C] int32
+    commit_iters: int = 2,
 ):
-    """One wave over one chunk: decide all C gangs in parallel against the
-    same capacity snapshot, then commit sequentially with a cheap per-node
-    validity re-check. Returns per-gang results + updated free.
-
-    `retry[i]` marks gangs whose parallel decision met the floor but clashed
-    with an earlier commit in this chunk — the host requeues them for the
-    next wave (their next decision sees the updated capacity).
-    """
-    inputs = GangInputs(
-        demand=demand,
-        count=count,
-        min_count=min_count,
-        req_level=req_level,
-        pref_level=pref_level,
-    )
-    # Phase A: parallel decisions (vmap over the chunk). free_new is ignored;
-    # commitment happens in phase B.
-    _, alloc, placed, ok_min, chosen_l, score = jax.vmap(
-        gang_select_and_fill, in_axes=(None, None, None, None, 0)
-    )(free, topo, seg_starts, seg_ends, inputs)
-
-    # Phase B: sequential commit. usage[g] = alloc[g]^T demand[g] per node.
-    def commit_step(free_c, xs):
-        alloc_g, demand_g, ok_g = xs
-        usage = jnp.einsum(
-            "pn,pr->nr", alloc_g.astype(free_c.dtype), demand_g
+    """One wave over one chunk, with per-pod allocations materialized (the
+    binding path). Same core as the device-resident stats solver."""
+    free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
+        wave_chunk_core(
+            free,
+            topo,
+            seg_starts,
+            seg_ends,
+            demand,
+            count,
+            min_count,
+            req_level,
+            pref_level,
+            pending,
+            narrow_cap,
+            seeds,
+            commit_iters,
         )
-        fits = ok_g & jnp.all(usage <= free_c + 1e-6)
-        free_c = jnp.where(fits, free_c - usage, free_c)
-        return free_c, fits
-
-    free_after, committed = jax.lax.scan(
-        commit_step, free, (alloc, demand, ok_min)
     )
-    retry = ok_min & ~committed
+    n_levels = topo.shape[1]
     return {
-        "admitted": committed,
+        "admitted": accept,
         "retry": retry,
-        "placed": jnp.where(committed[:, None], placed, 0),
-        "score": jnp.where(committed, score, 0.0),
-        "chosen_level": jnp.where(committed, chosen_l, -1),
-        "alloc": jnp.where(committed[:, None, None], alloc, 0),
+        "new_cap": new_cap,
+        "placed": jnp.where(accept[:, None], placed, 0),
+        "score": jnp.where(accept, score, 0.0),
+        "chosen_level": jnp.where(
+            accept, jnp.where(chosen >= n_levels, -1, chosen), -1
+        ),
+        "alloc": jnp.where(accept[:, None, None], alloc, 0),
         "free_after": free_after,
     }
 
 
 # ---------------------------------------------------------------------------
-# Device-resident multi-wave solver (the bench/stats path)
+# Wave-solver core (shared by the chunked binding path and the
+# device-resident stats loop)
 # ---------------------------------------------------------------------------
+
+
+def wave_chunk_core(
+    free, topo, seg_starts, seg_ends,
+    dem, cnt, mn, rq, pf, pend, ncap, seeds, commit_iters,
+):
+    """Decide one chunk of gangs in parallel (gang_select_single vmapped over
+    the chunk against one capacity snapshot), commit via iterative vectorized
+    prefix-acceptance with a final joint-feasibility guarantee, and produce
+    the retry/narrow-cap bookkeeping for the next wave.
+    Returns (free, accept, placed, score, chosen, retry, new_cap,
+    fill_failed, alloc)."""
+    cnt = cnt * pend[:, None]
+    inputs = GangInputs(dem, cnt, mn, rq, pf)
+    alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
+        gang_select_single, in_axes=(None, None, None, None, 0, 0, 0)
+    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
+
+    usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
+    accept = ok
+    for _ in range(commit_iters):
+        cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+        fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+        accept = ok & fits
+    # final guarantee: with this accept set, every accepted prefix fits
+    cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+    fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+    accept &= fits
+    free = free - jnp.sum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+
+    # retry bookkeeping: a failed fill jumps the cap straight to the next
+    # broader aggregate-feasible level; cluster fallback was already
+    # attempted in-wave, so a -1 cap means the gang is done for good
+    fill_failed = pend & had_cand & ~ok
+    new_cap = jnp.where(fill_failed, fallback_cap, ncap)
+    min_allowed = jnp.where(rq >= 0, rq, 0)
+    retry = pend & ((ok & ~accept) | (fill_failed & (new_cap >= min_allowed)))
+    return (
+        free,
+        accept & pend,
+        placed,
+        score,
+        chosen,
+        retry,
+        new_cap,
+        fill_failed,
+        alloc,
+    )
 
 
 def gang_select_single(
@@ -607,44 +648,13 @@ def solve_waves_device(
 
     def _active_chunk_step(free, xs):
         dem, cnt, mn, rq, pf, pend, ncap, seeds = xs
-        cnt = cnt * pend[:, None]
-        inputs = GangInputs(dem, cnt, mn, rq, pf)
-        alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
-            gang_select_single, in_axes=(None, None, None, None, 0, 0, 0)
-        )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
-
-        usage = jnp.einsum(
-            "cpn,cpr->cnr", alloc.astype(free.dtype), dem
-        )  # [C, N, R]
-        accept = ok
-        for _ in range(commit_iters):
-            cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
-            fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
-            accept = ok & fits
-        # final guarantee: with this accept set, every accepted prefix fits
-        cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
-        fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
-        accept &= fits
-        free = free - jnp.sum(jnp.where(accept[:, None, None], usage, 0), axis=0)
-
-        # retry bookkeeping: a failed fill jumps the cap straight to the next
-        # broader aggregate-feasible level; cluster fallback was already
-        # attempted in-wave, so a -1 cap means the gang is done for good
-        fill_failed = pend & had_cand & ~ok
-        new_cap = jnp.where(fill_failed, fallback_cap, ncap)
-        min_allowed = jnp.where(rq >= 0, rq, 0)
-        retry = pend & (
-            (ok & ~accept) | (fill_failed & (new_cap >= min_allowed))
+        free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
+            wave_chunk_core(
+                free, topo, seg_starts, seg_ends,
+                dem, cnt, mn, rq, pf, pend, ncap, seeds, commit_iters,
+            )
         )
-        return free, (
-            accept & pend,
-            placed,
-            score,
-            chosen,
-            retry,
-            new_cap,
-            fill_failed,
-        )
+        return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)
 
     def wave_body(state):
         # NOTE: pending gangs are deliberately NOT compacted into fewer

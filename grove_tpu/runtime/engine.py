@@ -67,14 +67,16 @@ class Engine:
     def _route_events(self) -> None:
         # Drain in place: reconciles emit new events while we iterate.
         remaining: List[WatchEvent] = []
-        delivered_kinds = set()
         events = list(self._event_backlog)
         self._event_backlog.clear()
         for ev in events:
             if ev.kind in self.held_kinds:
                 remaining.append(ev)
                 continue
-            delivered_kinds.add(ev.kind)
+            # a kind's cache advances exactly when its events are delivered
+            # (incremental informer application); held kinds stay stale
+            if self.store.cache_lag:
+                self.store.apply_event_to_cache(ev)
             for ctrl in self.controllers:
                 if ev.kind == ctrl.kind:
                     ctrl.queue.add(
@@ -85,11 +87,6 @@ class Engine:
                         for ns, name in map_fn(ev):
                             ctrl.queue.add((ctrl.kind, ns, name))
         self._event_backlog.extend(remaining)
-        # A kind's cache advances exactly when its events are delivered
-        # (informer semantics); held kinds stay stale.
-        if self.store.cache_lag:
-            for kind in delivered_kinds:
-                self.store.sync_cache_kind(kind)
 
     # -- run loop --------------------------------------------------------
 

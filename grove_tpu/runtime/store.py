@@ -35,6 +35,16 @@ ADDED = "Added"
 MODIFIED = "Modified"
 DELETED = "Deleted"
 
+# Label keys with inverted indices (the controllers' hot selectors). A
+# selector containing any of these resolves to the candidate set instead of
+# scanning the whole kind — the control plane's lists go O(matched).
+INDEXED_LABELS = (
+    "grove.io/podclique",
+    "grove.io/podgang",
+    "grove.io/podcliquescalinggroup",
+    "app.kubernetes.io/part-of",
+)
+
 
 @dataclass
 class WatchEvent:
@@ -45,6 +55,24 @@ class WatchEvent:
 
 def obj_key(obj) -> str:
     return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _index_insert(index: Dict[tuple, set], obj) -> None:
+    key = obj_key(obj)
+    for lk in INDEXED_LABELS:
+        lv = obj.metadata.labels.get(lk)
+        if lv is not None:
+            index.setdefault((lk, lv), set()).add(key)
+
+
+def _index_delete(index: Dict[tuple, set], obj) -> None:
+    key = obj_key(obj)
+    for lk in INDEXED_LABELS:
+        lv = obj.metadata.labels.get(lk)
+        if lv is not None:
+            entries = index.get((lk, lv))
+            if entries is not None:
+                entries.discard(key)
 
 
 def _semantically_equal(a, b) -> bool:
@@ -72,6 +100,9 @@ class Store:
         self.cache_lag = cache_lag
         self._committed: Dict[str, Dict[str, object]] = {}
         self._cache: Dict[str, Dict[str, object]] = {}
+        # kind -> (label_key, label_value) -> set of object keys
+        self._index: Dict[str, Dict[tuple, set]] = {}
+        self._cache_index: Dict[str, Dict[tuple, set]] = {}
         self._rv = 0
         self._watchers: List[Callable[[WatchEvent], None]] = []
         # optional admission guard (grove_tpu.admission.authorization):
@@ -135,6 +166,58 @@ class Store:
         self._cache[kind] = {
             k: deep_copy(v) for k, v in self._committed.get(kind, {}).items()
         }
+        index: Dict[tuple, set] = {}
+        for obj in self._cache[kind].values():
+            _index_insert(index, obj)
+        self._cache_index[kind] = index
+
+    def apply_event_to_cache(self, ev: "WatchEvent") -> None:
+        """Incrementally apply one delivered watch event to the read cache —
+        O(1) informer semantics (sync_cache_kind re-copies a whole kind and
+        is kept for explicit full resyncs)."""
+        kind_cache = self._cache.setdefault(ev.kind, {})
+        kind_index = self._cache_index.setdefault(ev.kind, {})
+        key = obj_key(ev.obj)
+        old = kind_cache.get(key)
+        if old is not None:
+            _index_delete(kind_index, old)
+        if ev.type == DELETED:
+            kind_cache.pop(key, None)
+            return
+        # copy on insert: the event payload is shared by every subscriber, so
+        # a mutating watcher must not be able to corrupt the informer cache
+        stored = deep_copy(ev.obj)
+        kind_cache[key] = stored
+        _index_insert(kind_index, stored)
+
+    # -- label index ------------------------------------------------------
+
+    def _index_add(self, obj) -> None:
+        _index_insert(self._index.setdefault(obj.kind, {}), obj)
+
+    def _index_remove(self, obj) -> None:
+        _index_delete(self._index.get(obj.kind, {}), obj)
+
+    def _candidates(
+        self,
+        kind: str,
+        selector: Optional[Dict[str, str]],
+        cached: bool,
+        view: Dict[str, object],
+    ):
+        """Smallest indexed candidate set for the selector, else all keys."""
+        if selector:
+            index = (self._cache_index if cached else self._index).get(kind)
+            if index is not None:
+                best = None
+                for lk in INDEXED_LABELS:
+                    if lk in selector:
+                        entries = index.get((lk, selector[lk]), set())
+                        if best is None or len(entries) < len(best):
+                            best = entries
+                if best is not None:
+                    return [view[k] for k in best if k in view]
+        return view.values()
 
     def _read_view(self, cached: bool) -> Dict[str, Dict[str, object]]:
         if cached and self.cache_lag:
@@ -159,6 +242,7 @@ class Store:
         stored.metadata.generation = 1
         stored.metadata.creation_timestamp = self.clock.now()
         kind_objs[key] = stored
+        self._index_add(stored)
         self._emit(ADDED, stored)
         return deep_copy(stored)
 
@@ -173,8 +257,10 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
         cached: bool = False,
     ) -> List[object]:
+        use_cache = cached and self.cache_lag
+        view = self._read_view(cached).get(kind, {})
         out = []
-        for obj in self._read_view(cached).get(kind, {}).values():
+        for obj in self._candidates(kind, label_selector, use_cache, view):
             if namespace is not None and obj.metadata.namespace != namespace:
                 continue
             if matches_labels(obj, label_selector):
@@ -226,7 +312,9 @@ class Store:
         stored.metadata.generation = current.metadata.generation + (
             1 if bump_generation else 0
         )
+        self._index_remove(current)
         kind_objs[key] = stored
+        self._index_add(stored)
         self._emit(MODIFIED, stored)
         return deep_copy(stored)
 
@@ -250,6 +338,7 @@ class Store:
                 self._emit(MODIFIED, obj)
             return
         del kind_objs[key]
+        self._index_remove(obj)
         self._emit(DELETED, obj)
 
     def remove_finalizer(self, kind: str, namespace: str, name: str, finalizer: str) -> None:
@@ -268,6 +357,7 @@ class Store:
             self._emit(MODIFIED, obj)
         if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
             del kind_objs[key]
+            self._index_remove(obj)
             self._emit(DELETED, obj)
 
     def delete_collection(

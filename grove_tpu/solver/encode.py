@@ -24,6 +24,14 @@ def _next_pow2(x: int) -> int:
     return n
 
 
+# Minimum padded sizes: every distinct (G, P) shape compiles its own
+# executable, so small problems share a handful of buckets instead of
+# compiling one per pending-gang count (compiles dominate wall time when the
+# chip sits behind a remote link).
+MIN_GANG_BUCKET = 32
+MIN_GROUP_BUCKET = 4
+
+
 def encode_nodes(
     nodes: Sequence,
     topology: ClusterTopology,
@@ -130,8 +138,8 @@ def encode_gangs(
     min_count}], required_key, preferred_key, priority}] → padded tensors."""
     g = len(gang_specs)
     p = max((len(s["groups"]) for s in gang_specs), default=1)
-    gp = pad_gangs or _next_pow2(max(g, 1))
-    pp = pad_groups or _next_pow2(max(p, 1))
+    gp = pad_gangs or _next_pow2(max(g, MIN_GANG_BUCKET))
+    pp = pad_groups or _next_pow2(max(p, MIN_GROUP_BUCKET))
     r = len(resource_names)
 
     demand = np.zeros((gp, pp, r), dtype=np.float32)

@@ -66,22 +66,23 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
 
 def solve_waves(
     problem: PackingProblem,
-    chunk_size: int = 512,
-    max_waves: int = 8,
+    chunk_size: int = 32,
+    max_waves: int = 16,
     with_alloc: bool = True,
 ) -> PackingResult:
-    """The scale path: wave-parallel solve (ops.packing.solve_wave_chunk).
+    """Wave-parallel solve WITH per-pod allocations (the binding path).
 
-    Gangs are processed in priority order in chunks; each chunk's decisions
-    are made in parallel against one capacity snapshot and committed with a
-    sequential validity check; clashing gangs retry next wave against the
-    updated capacity. Converges in a handful of waves; placement quality is
-    gated against the oracle (≤0.5% regression) rather than being
-    decision-identical to it.
+    Same algorithm as the device-resident stats solver (single-fill parallel
+    decisions, strided domain spread, prefix-acceptance commit, narrow-cap
+    retry walk), driven chunk-by-chunk from the host so allocations stream
+    out per chunk. Gangs still pending when the wave budget ends simply stay
+    pending — in the control loop they are re-solved on the next scheduling
+    round (no exact tail here; that kernel's compile cost is only paid on
+    the stats/bench path where alloc isn't materialized).
     """
     g = problem.num_gangs
-    chunk_size = min(chunk_size, g) or 1
-    n_chunks = (g + chunk_size - 1) // chunk_size
+    chunk_size = min(chunk_size, max(g, 1))
+    n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
     g_pad = n_chunks * chunk_size
 
     def pad(a, value=0):
@@ -100,8 +101,10 @@ def solve_waves(
     topo = jnp.asarray(problem.topo)
     seg_starts = jnp.asarray(problem.seg_starts)
     seg_ends = jnp.asarray(problem.seg_ends)
+    n_levels = problem.num_levels
     pending = np.ones((g_pad,), dtype=bool)
     pending[g:] = False
+    narrow_cap = np.full((g_pad,), n_levels - 1, dtype=np.int32)
 
     admitted = np.zeros((g_pad,), dtype=bool)
     placed = np.zeros_like(count)
@@ -113,13 +116,25 @@ def solve_waves(
         else None
     )
 
+    # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
+    # change between waves; re-uploading per wave would pay the remote-link
+    # latency this path exists to avoid)
+    chunk_const = [
+        tuple(
+            jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
+            for a in (demand, count, min_count, req_level, pref_level)
+        )
+        for c in range(n_chunks)
+    ]
+
     t0 = time.perf_counter()
     waves_used = 0
-    for _wave in range(max_waves):
+    for wave in range(max_waves):
         if not pending.any():
             break
         progress = False
         waves_used += 1
+        seeds = np.arange(g_pad, dtype=np.int32) + np.int32(wave * 7919)
         for c in range(n_chunks):
             sl = slice(c * chunk_size, (c + 1) * chunk_size)
             mask = pending[sl]
@@ -130,11 +145,10 @@ def solve_waves(
                 topo,
                 seg_starts,
                 seg_ends,
-                jnp.asarray(demand[sl]),
-                jnp.asarray(count[sl] * mask[:, None]),
-                jnp.asarray(min_count[sl]),
-                jnp.asarray(req_level[sl]),
-                jnp.asarray(pref_level[sl]),
+                *chunk_const[c],
+                jnp.asarray(mask),
+                jnp.asarray(narrow_cap[sl]),
+                jnp.asarray(seeds[sl]),
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -145,12 +159,16 @@ def solve_waves(
             chosen_level[sl] = np.where(
                 committed, out["chosen_level"], chosen_level[sl]
             )
+            narrow_cap[sl] = np.asarray(out["new_cap"])
             if with_alloc:
                 alloc[sl] = np.where(
                     committed[:, None, None], np.asarray(out["alloc"]), alloc[sl]
                 )
             pending[sl] = mask & retry
-            progress |= committed.any()
+            # retry counts as progress: the narrow-cap fallback walk admits
+            # gangs in LATER waves even when this one committed nothing
+            # (device-loop parity)
+            progress |= committed.any() or retry.any()
         if not progress:
             break
     elapsed = time.perf_counter() - t0
@@ -223,8 +241,9 @@ def solve_waves_stats(
     n_pending = int(pending.sum())
     if n_pending:
         idx = np.flatnonzero(pending)
-        # pad the tail to a pow2 bucket so repeat solves reuse one executable
-        t_pad = 1
+        # pad the tail to a pow2 bucket (min 32) so repeat solves reuse one
+        # executable across varying tail sizes
+        t_pad = 32
         while t_pad < n_pending:
             t_pad *= 2
 

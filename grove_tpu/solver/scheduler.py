@@ -28,7 +28,7 @@ from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
 from grove_tpu.solver.encode import build_problem
-from grove_tpu.solver.kernel import solve
+from grove_tpu.solver.kernel import solve, solve_waves
 
 
 class GangScheduler:
@@ -75,7 +75,10 @@ class GangScheduler:
                 problem = build_problem(
                     nodes, gang_specs, self.topology, free_capacity=free
                 )
-                result = solve(problem)
+                # wave solver with allocations: cheap-to-compile vmapped
+                # decisions (the exact scan kernel stays on the parity/bench
+                # paths; unadmitted gangs retry on the next control round)
+                result = solve_waves(problem)
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
                 preempted = self._maybe_preempt(namespace, gang_specs, result)
                 assignments = result.assignments(problem)
@@ -324,6 +327,22 @@ class GangScheduler:
             return set()
         preemptor = max(rejected, key=lambda s: s["priority"])
 
+        # The wave solver is heuristic: "not admitted" can be a seed/budget
+        # artifact, not infeasibility. If the gang fits the CURRENT free
+        # capacity on its own, it will simply be placed next round — never
+        # evict for it.
+        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        if not nodes:
+            return set()
+        current_free = {
+            node.name: self.cluster.node_free(node) for node in nodes
+        }
+        solo = build_problem(
+            nodes, [preemptor], self.topology, free_capacity=current_free
+        )
+        if solve_waves(solo, with_alloc=False).admitted[0]:
+            return set()
+
         victims = []
         for gang in self.store.list("PodGang", namespace):
             cond = get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
@@ -369,7 +388,6 @@ class GangScheduler:
             return set()  # evicting everything lower still wouldn't fit
 
         # trial solve: preemptor alone against free + hypothetically freed
-        nodes = [n for n in self.cluster.nodes if not n.cordoned]
         trial_free = {}
         for node in nodes:
             caps = dict(self.cluster.node_free(node))
@@ -379,7 +397,7 @@ class GangScheduler:
         trial_problem = build_problem(
             nodes, [preemptor], self.topology, free_capacity=trial_free
         )
-        trial = solve(trial_problem, with_alloc=False)
+        trial = solve_waves(trial_problem, with_alloc=False)
         if not trial.admitted[0]:
             return set()  # eviction would not make the preemptor placeable
 
