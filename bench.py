@@ -79,11 +79,56 @@ def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
     return build_problem(nodes, gangs, ClusterTopology())
 
 
+def _probe_device_health(timeout_s: float = 120.0) -> bool:
+    """Run a trivial jit in a subprocess: a wedged accelerator link would
+    otherwise hang the whole benchmark with no output."""
+    import pathlib
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)));"
+                "jax.block_until_ready(x); print('OK', jax.default_backend())",
+            ],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+            cwd=pathlib.Path(__file__).resolve().parent,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0 and "OK" in proc.stdout
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--small", action="store_true", help="reduced size smoke run")
     parser.add_argument("--runs", type=int, default=7)
+    parser.add_argument("--skip-health-probe", action="store_true")
     args = parser.parse_args()
+
+    backend_note = "default"
+    if not args.skip_health_probe and not _probe_device_health():
+        # accelerator link wedged — fall back to host CPU so the benchmark
+        # still produces its artifact (marked in the output)
+        import os
+
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        backend_note = "cpu-fallback (accelerator probe failed)"
+        print(
+            "WARNING: accelerator health probe failed; benchmarking on CPU",
+            file=sys.stderr,
+        )
+
+    import jax
 
     from grove_tpu.solver.kernel import solve, solve_waves_stats
 
@@ -119,6 +164,7 @@ def main() -> None:
                 "pods_placed": int(result.placed.sum()),
                 "quality_vs_exact": round(quality, 4),
                 "median_s": round(times[len(times) // 2], 4),
+                "backend": f"{jax.default_backend()} ({backend_note})",
             }
         )
     )

@@ -92,6 +92,55 @@ class TestStore:
         assert len(s.list("PodClique", cached=False)) == 1  # direct read sees it
 
 
+class TestLabelIndex:
+    def test_label_change_moves_index(self):
+        s = Store(VirtualClock())
+        s.create(mk("a", labels={"grove.io/podgang": "g1"}))
+        obj = s.get("PodClique", "default", "a")
+        obj.metadata.labels["grove.io/podgang"] = "g2"
+        s.update(obj)
+        assert s.list("PodClique", "default", {"grove.io/podgang": "g1"}) == []
+        assert len(s.list("PodClique", "default", {"grove.io/podgang": "g2"})) == 1
+
+    def test_index_cleared_on_delete(self):
+        s = Store(VirtualClock())
+        s.create(mk("a", labels={"grove.io/podgang": "g1"}))
+        s.delete("PodClique", "default", "a")
+        assert s.list("PodClique", "default", {"grove.io/podgang": "g1"}) == []
+
+    def test_unindexed_selector_still_scans(self):
+        s = Store(VirtualClock())
+        s.create(mk("a", labels={"custom/key": "v", "grove.io/podgang": "g"}))
+        s.create(mk("b", labels={"custom/key": "w"}))
+        got = s.list("PodClique", "default", {"custom/key": "v"})
+        assert [o.metadata.name for o in got] == ["a"]
+        # combined indexed + unindexed selector intersects correctly
+        got = s.list(
+            "PodClique", "default", {"grove.io/podgang": "g", "custom/key": "v"}
+        )
+        assert [o.metadata.name for o in got] == ["a"]
+
+    def test_cached_index_respects_informer_lag(self):
+        clock = VirtualClock()
+        s = Store(clock, cache_lag=True)
+        engine = Engine(s, clock)
+        engine.hold_events("PodClique")
+        s.create(mk("a", labels={"grove.io/podgang": "g1"}))
+        engine.drain()
+        # event held: cached view (and its index) must not see the object
+        assert s.list("PodClique", "default", {"grove.io/podgang": "g1"}, cached=True) == []
+        engine.release_events("PodClique")
+        engine.drain()
+        assert (
+            len(
+                s.list(
+                    "PodClique", "default", {"grove.io/podgang": "g1"}, cached=True
+                )
+            )
+            == 1
+        )
+
+
 class TestWorkQueue:
     def test_dedup(self):
         q = WorkQueue()
