@@ -84,24 +84,35 @@ def _probe_device_health(timeout_s: float = 120.0) -> bool:
     otherwise hang the whole benchmark with no output."""
     import pathlib
     import subprocess
+    import tempfile
 
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, jax.numpy as jnp;"
-                "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)));"
-                "jax.block_until_ready(x); print('OK', jax.default_backend())",
-            ],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
-            cwd=pathlib.Path(__file__).resolve().parent,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return proc.returncode == 0 and "OK" in proc.stdout
+    # Detached child writing to a temp file; on timeout we kill and ABANDON
+    # it (a child wedged in uninterruptible device sleep ignores SIGKILL, and
+    # waiting on it would hang the very benchmark the probe protects).
+    out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp;"
+            "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)));"
+            "jax.block_until_ready(x); print('OK', jax.default_backend())",
+        ],
+        stdout=out,
+        stderr=subprocess.STDOUT,
+        cwd=pathlib.Path(__file__).resolve().parent,
+        start_new_session=True,
+    )
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    else:
+        proc.kill()
+        return False  # abandoned — do not block on a D-state child
+    out.seek(0)
+    return proc.returncode == 0 and "OK" in out.read()
 
 
 def main() -> None:
@@ -121,6 +132,10 @@ def main() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
+        # the env var alone is NOT sufficient on this image: sitecustomize
+        # registers the accelerator plugin at interpreter start and pins the
+        # platform, so it must be re-pinned via config after import
+        # (same workaround as tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
         backend_note = "cpu-fallback (accelerator probe failed)"
         print(

@@ -83,6 +83,33 @@ class Component(Protocol):
     def delete(self, ctx: OperatorContext, owner) -> None: ...
 
 
+def record_last_error(
+    ctx: OperatorContext, kind: str, namespace: str, name: str, err
+) -> None:
+    """Persist a typed error on the object's status (errors.go:88-103
+    LastErrors). Skips the write when the same code+description is already
+    recorded — a timestamp-only rewrite would emit a self-watch event and
+    defeat the workqueue's backoff with an immediate re-reconcile."""
+    fresh = ctx.store.get(kind, namespace, name)
+    if fresh is None:
+        return
+    entry = {
+        "code": err.code,
+        "description": str(err),
+        "observedAt": ctx.clock.now(),
+    }
+    existing = fresh.status.last_errors
+    if existing and all(
+        existing[0].get(k) == entry[k] for k in ("code", "description")
+    ):
+        return
+    fresh.status.last_errors = [entry]
+    try:
+        ctx.store.update_status(fresh)
+    except Exception:
+        pass  # a failing status write must not mask the original error
+
+
 def create_or_adopt(ctx: OperatorContext, desired) -> None:
     """Create the child if missing; otherwise adopt label/annotation drift.
 

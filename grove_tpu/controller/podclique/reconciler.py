@@ -8,7 +8,11 @@ with the pod component as its single ordered component
 from __future__ import annotations
 
 from grove_tpu.api import names as namegen
-from grove_tpu.controller.common import FINALIZER, OperatorContext
+from grove_tpu.controller.common import (
+    FINALIZER,
+    OperatorContext,
+    record_last_error,
+)
 from grove_tpu.controller.podclique import pods as pod_component
 from grove_tpu.controller.podclique.status import reconcile_status
 from grove_tpu.runtime.errors import GroveError
@@ -44,8 +48,10 @@ class PodCliqueReconciler:
             if fresh is not None and fresh.metadata.deletion_timestamp is None:
                 reconcile_status(self.ctx, fresh)
                 fresh.status.observed_generation = fresh.metadata.generation
+                fresh.status.last_errors = []  # cleared on a clean reconcile
                 self.ctx.store.update_status(fresh)
         except GroveError as err:
+            record_last_error(self.ctx, "PodClique", ns, name, err)
             return reconcile_with_errors(f"podclique {ns}/{name}", err)
         if skipped_gated:
             # pods still gated (not in PodGang yet / base gang unscheduled):

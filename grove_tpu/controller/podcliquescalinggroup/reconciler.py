@@ -31,6 +31,7 @@ from grove_tpu.controller.common import (
     FINALIZER,
     OperatorContext,
     create_or_adopt,
+    record_last_error,
     resolve_starts_after,
 )
 from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
@@ -68,6 +69,7 @@ class PodCliqueScalingGroupReconciler:
             requeue_in = self._sync_podcliques(pcsg, pcs)
             self._reconcile_status(pcsg, pcs)
         except GroveError as err:
+            record_last_error(self.ctx, "PodCliqueScalingGroup", ns, name, err)
             return reconcile_with_errors(f"pcsg {ns}/{name}", err)
         if requeue_in is not None:
             return reconcile_after(requeue_in, "scaled-replica breach wait")

@@ -26,7 +26,11 @@ from grove_tpu.api.types import (
     PodCliqueSet,
     PodGangStatusSummary,
 )
-from grove_tpu.controller.common import FINALIZER, OperatorContext
+from grove_tpu.controller.common import (
+    FINALIZER,
+    OperatorContext,
+    record_last_error,
+)
 from grove_tpu.controller.podcliqueset.components import (
     infra,
     podclique,
@@ -73,6 +77,7 @@ class PodCliqueSetReconciler:
             result = self._reconcile_spec(pcs)
             self._reconcile_status(ns, name)
         except GroveError as err:
+            record_last_error(self.ctx, "PodCliqueSet", ns, name, err)
             return reconcile_with_errors(f"pcs {ns}/{name}", err)
         return result
 
@@ -170,6 +175,7 @@ class PodCliqueSetReconciler:
         ]
         pcs.status.available_replicas = self._count_available_replicas(pcs)
         pcs.status.selector = f"{namegen.LABEL_PART_OF}={name}"
+        pcs.status.last_errors = []  # cleared on a clean reconcile
         self.ctx.store.update_status(pcs)
 
     def _count_available_replicas(self, pcs: PodCliqueSet) -> int:

@@ -57,12 +57,19 @@ class TestFaultInjection:
             METRICS.counters.get("reconcile_errors_total/podclique", 0)
             > errors_before
         )
+        # the typed error is persisted on status (LastErrors parity)
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        assert pclq.status.last_errors
+        assert pclq.status.last_errors[0]["code"] == "ERR_SYNC_PODS"
         # clearing the fault heals the system — the key sits in capped
         # exponential backoff (workqueue MAX_BACKOFF=1000s), so jump past it
         harness.store.error_injectors.clear()
         harness.advance(1001.0)
         harness.converge()
         assert len(harness.store.list("Pod")) == 9
+        # errors clear once reconciles succeed again
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        assert pclq.status.last_errors == []
 
     def test_transient_status_update_failures_recover(self):
         harness = SimHarness(num_nodes=32)
