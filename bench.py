@@ -154,10 +154,21 @@ def main() -> None:
     # warm (compile excluded from the measured runs)
     result = solve_waves_stats(problem)
 
+    # profiling toggle (the reference gates pprof behind config; here the
+    # equivalent is a jax.profiler trace of the measured solves)
+    import contextlib
+    import os
+
+    trace_dir = os.environ.get("GROVE_TPU_PROFILE_DIR")
+    profile_cm = (
+        jax.profiler.trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    )
+
     times = []
-    for _ in range(args.runs):
-        result = solve_waves_stats(problem)
-        times.append(result.solve_seconds)
+    with profile_cm:
+        for _ in range(args.runs):
+            result = solve_waves_stats(problem)
+            times.append(result.solve_seconds)
     times.sort()
     p99 = times[min(len(times) - 1, int(np.ceil(0.99 * len(times))) - 1)]
 

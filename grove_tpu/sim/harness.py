@@ -40,6 +40,20 @@ class SimHarness:
         self.config = config or OperatorConfiguration()
         self.clock = VirtualClock()
         self.store = Store(self.clock, cache_lag=cache_lag)
+        # ClusterTopology lives in the store like any CR; when the config
+        # enables it, startup requires the named CR to exist (the reference
+        # crashes at boot if enabled-but-missing — cmd/main.go:72-75)
+        self.topology = topology or ClusterTopology()
+        if self.config.cluster_topology.enabled:
+            from grove_tpu.admission.validation import validate_cluster_topology
+
+            res = validate_cluster_topology(self.topology)
+            if not res.ok:
+                raise ValueError(
+                    f"cluster topology invalid: {'; '.join(res.errors)}"
+                )
+            self.topology.metadata.name = self.config.cluster_topology.name
+        self.store.create(self.topology)
         if self.config.authorizer.enabled:
             from grove_tpu.admission.authorization import AuthorizationGuard
 
@@ -48,7 +62,6 @@ class SimHarness:
                 exempt_users=self.config.authorizer.exempt_service_accounts,
             )
         self.engine = Engine(self.store, self.clock)
-        self.topology = topology or ClusterTopology()
         self.ctx = OperatorContext(
             store=self.store, clock=self.clock, topology=self.topology
         )
