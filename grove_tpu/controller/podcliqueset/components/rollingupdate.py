@@ -148,7 +148,9 @@ def _push_template_to_replica(
     ctx: OperatorContext, pcs: PodCliqueSet, replica: int
 ) -> None:
     """Atomically update spec + hash label (+ update-in-progress marker) on
-    every PCLQ of the replica."""
+    every PCLQ of the replica; PCSGs of the replica track their own
+    rolling-update progress (scalinggroup.go:105-129)."""
+    _mark_pcsg_progress(ctx, pcs, replica)
     tmpl_root = pcs.spec.template
     for pclq in _replica_pclqs(ctx, pcs, replica):
         if pclq.metadata.deletion_timestamp is not None:
@@ -216,7 +218,42 @@ def _replica_update_done(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) 
     return True
 
 
+def _mark_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    from grove_tpu.api.types import PCSGRollingUpdateProgress
+
+    sel = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+    }
+    for pcsg in ctx.store.list("PodCliqueScalingGroup", pcs.metadata.namespace, sel):
+        if pcsg.status.rolling_update_progress is None or (
+            pcsg.status.rolling_update_progress.update_ended_at is not None
+        ):
+            pcsg.status.rolling_update_progress = PCSGRollingUpdateProgress(
+                update_started_at=ctx.clock.now(),
+                ready_replica_indices_selected_to_update=list(
+                    range(pcsg.spec.replicas)
+                ),
+            )
+            ctx.store.update_status(pcsg)
+
+
+def _finish_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    sel = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+    }
+    for pcsg in ctx.store.list("PodCliqueScalingGroup", pcs.metadata.namespace, sel):
+        progress = pcsg.status.rolling_update_progress
+        if progress is not None and progress.update_ended_at is None:
+            progress.update_ended_at = ctx.clock.now()
+            progress.updated_replica_indices = list(range(pcsg.spec.replicas))
+            progress.ready_replica_indices_selected_to_update = []
+            ctx.store.update_status(pcsg)
+
+
 def _complete_replica(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    _finish_pcsg_progress(ctx, pcs, replica)
     progress = pcs.status.rolling_update_progress
     for pclq in _replica_pclqs(ctx, pcs, replica):
         if UPDATE_IN_PROGRESS_ANNOTATION in pclq.metadata.annotations:

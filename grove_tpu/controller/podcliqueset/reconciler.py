@@ -174,9 +174,52 @@ class PodCliqueSetReconciler:
             for g in gangs
         ]
         pcs.status.available_replicas = self._count_available_replicas(pcs)
+        pcs.status.updated_replicas = self._count_updated_replicas(pcs)
         pcs.status.selector = f"{namegen.LABEL_PART_OF}={name}"
         pcs.status.last_errors = []  # cleared on a clean reconcile
         self.ctx.store.update_status(pcs)
+
+    def _count_updated_replicas(self, pcs: PodCliqueSet) -> int:
+        """Replicas whose every PCLQ carries the current template hash with
+        all pods updated (podcliqueset.go:68-70 UpdatedReplicas)."""
+        from grove_tpu.api.hashing import compute_pod_template_hash
+        from grove_tpu.controller.podcliqueset.components.rollingupdate import (
+            _clique_template_name,
+        )
+
+        ns = pcs.metadata.namespace
+        tmpl = pcs.spec.template
+        # hash depends only on the template — compute once per clique
+        want_hash = {
+            clique.name: compute_pod_template_hash(
+                clique, tmpl.priority_class_name
+            )
+            for clique in tmpl.cliques
+        }
+        count = 0
+        for replica in range(pcs.spec.replicas):
+            sel = {
+                **namegen.default_labels(pcs.metadata.name),
+                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+            }
+            pclqs = self.ctx.store.list("PodClique", ns, sel, cached=True)
+            if not pclqs:
+                continue
+            updated = True
+            for pclq in pclqs:
+                want = want_hash.get(_clique_template_name(pcs, pclq))
+                if want is None:
+                    continue
+                if (
+                    pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+                    != want
+                    or pclq.status.updated_replicas < pclq.spec.replicas
+                ):
+                    updated = False
+                    break
+            if updated:
+                count += 1
+        return count
 
     def _count_available_replicas(self, pcs: PodCliqueSet) -> int:
         """A PCS replica is available when every standalone PCLQ is actually

@@ -53,7 +53,8 @@ class SimHarness:
                     f"cluster topology invalid: {'; '.join(res.errors)}"
                 )
             self.topology.metadata.name = self.config.cluster_topology.name
-        self.store.create(self.topology)
+        # the stored CR is the source of truth — keep its identity (uid/rv)
+        self.topology = self.store.create(self.topology)
         if self.config.authorizer.enabled:
             from grove_tpu.admission.authorization import AuthorizationGuard
 

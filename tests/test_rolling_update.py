@@ -59,6 +59,15 @@ class TestRollingUpdate:
         assert progress.update_ended_at is not None
         assert "simple1-0-sga" in progress.updated_pod_clique_scaling_groups
         assert "simple1-0-pca" in progress.updated_pod_cliques
+        assert pcs.status.updated_replicas == 1
+        # PCSG tracks its own progress bookkeeping
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        sg_progress = pcsg.status.rolling_update_progress
+        assert sg_progress is not None
+        assert sg_progress.update_ended_at is not None
+        assert sg_progress.updated_replica_indices == [0]
 
     def test_one_replica_at_a_time(self):
         harness = SimHarness(num_nodes=32)
