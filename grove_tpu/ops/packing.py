@@ -49,6 +49,14 @@ class GangInputs(NamedTuple):
     min_count: jnp.ndarray  # [P]
     req_level: jnp.ndarray  # scalar
     pref_level: jnp.ndarray  # scalar
+    # per-GROUP required pack level (-1 none): the PodGroup/PCSG constraint
+    # tier — each group must fit inside ONE domain at its level, chosen
+    # independently per group inside the gang's own domain
+    group_req: jnp.ndarray = None  # [P]
+    # pinned domain id per group at its required level (-1 none): recovery
+    # replacements must rejoin the domain where the group's surviving pods
+    # already live instead of re-choosing by free capacity
+    group_pin: jnp.ndarray = None  # [P]
 
 
 def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
@@ -77,6 +85,86 @@ def _fill_floors_first(free, mask, demand, count, min_count):
     alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
     alloc_ext, placed_ext, free2 = _fill(free1, mask, demand, extras)
     return alloc_min + alloc_ext, placed_min + placed_ext, placed_min, free2
+
+
+def _fill_grouped(
+    free, mask, demand, count, min_count, group_req, group_pin,
+    topo, seg_starts, seg_ends, seed,
+):
+    """Floors-first fill honoring per-GROUP pack constraints: a group with
+    group_req[p] >= 0 must land inside ONE domain at that level (chosen
+    inside `mask`); unconstrained groups use `mask` directly. Floors of ALL
+    groups place before any group's extras, and a constrained group's extras
+    never leave its chosen domain.
+    Returns (alloc [P,N], placed [P], placed_min [P], free_after)."""
+    n_nodes, n_levels = topo.shape
+    p_dim = demand.shape[0]
+    floors = jnp.minimum(min_count, count)
+    extras = jnp.maximum(count - min_count, 0)
+
+    def group_mask(free_c, p):
+        """Domain choice for group p at its required level (inside mask)."""
+        k = _pods_fit_per_node(free_c, demand[p])
+        k = jnp.minimum(jnp.where(mask, k, 0), jnp.maximum(floors[p], 1))
+        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), jnp.cumsum(k)])
+        any_req = group_req[p] >= 0
+        lvl = jnp.where(any_req, group_req[p], 0)
+        starts = seg_starts[lvl]
+        ends = seg_ends[lvl]
+        K = cs[ends] - cs[starts]  # pods of group p fitting per domain
+        feas = (K >= floors[p]) & (ends > starts)
+        # capacity-weighted strided pick (seed 0 → deterministic first-best)
+        w = jnp.where(feas, K, 0).astype(jnp.float32)
+        cum_w = jnp.cumsum(w)
+        h = jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32) / (
+            1 << 16
+        )
+        u = h * cum_w[-1]
+        best = jnp.argmax(cum_w > u)
+        best = jnp.where(cum_w[-1] > 0, best, jnp.argmax(feas))
+        ok_any = jnp.any(feas)
+        # recovery pin: rejoin the surviving pods' domain unconditionally
+        # (the fill validates whether the floor still fits there)
+        pinned = group_pin[p] >= 0
+        best = jnp.where(pinned, group_pin[p], best)
+        ok_any = ok_any | pinned
+        slab = topo[:, lvl] == best
+        return jnp.where(any_req, slab & mask & ok_any, mask)
+
+    free_c = free
+    masks = []
+    alloc_rows = []
+    floor_placed = []
+    extra_placed = []
+    for p in range(p_dim):  # static unroll (P small): floors first
+        mask_p = group_mask(free_c, p)
+        masks.append(mask_p)
+        a, pl, free_c = _fill(free_c, mask_p, demand[p : p + 1], floors[p : p + 1])
+        alloc_rows.append(a[0])
+        floor_placed.append(pl[0])
+    for p in range(p_dim):  # then extras, inside each group's own mask
+        a, pl, free_c = _fill(free_c, masks[p], demand[p : p + 1], extras[p : p + 1])
+        alloc_rows[p] = alloc_rows[p] + a[0]
+        extra_placed.append(pl[0])
+    alloc = jnp.stack(alloc_rows)
+    placed_min = jnp.stack(floor_placed)
+    placed = placed_min + jnp.stack(extra_placed)
+    return alloc, placed, placed_min, free_c
+
+
+def _fill_dispatch(
+    grouped, free, mask, demand, count, min_count, group_req, group_pin,
+    topo, seg_starts, seg_ends, seed,
+):
+    """Static dispatch: problems with no group-level constraints (the common
+    case — checked host-side) compile the cheap two-phase fill; the grouped
+    fill with per-group domain selection is only paid when used."""
+    if grouped:
+        return _fill_grouped(
+            free, mask, demand, count, min_count, group_req, group_pin,
+            topo, seg_starts, seg_ends, seed,
+        )
+    return _fill_floors_first(free, mask, demand, count, min_count)
 
 
 def _fill(free, mask, demand, count):
@@ -158,6 +246,7 @@ def gang_select_and_fill(
     seg_starts: jnp.ndarray,  # [L, D] contiguous-domain boundaries
     seg_ends: jnp.ndarray,  # [L, D]
     gang: GangInputs,
+    grouped: bool = False,
 ):
     """One gang's placement decision against `free`.
 
@@ -218,8 +307,10 @@ def gang_select_and_fill(
     for l in range(n_levels):
         ok_l, best_l = level_candidate(l)
         mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
-        alloc_l, placed_l, placed_min_l, free_l = _fill_floors_first(
-            free, mask_l, gang.demand, gang.count, gang.min_count
+        alloc_l, placed_l, placed_min_l, free_l = _fill_dispatch(
+            grouped, free, mask_l, gang.demand, gang.count, gang.min_count,
+            gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
+            jnp.int32(0),
         )
         fill_ok = (
             ok_l
@@ -231,8 +322,10 @@ def gang_select_and_fill(
         cand_free.append(free_l)
         cand_ok.append(fill_ok)
     # cluster-wide fallback (only when no required pack level)
-    alloc_c, placed_c, placed_min_c, free_c = _fill_floors_first(
-        free, all_nodes, gang.demand, gang.count, gang.min_count
+    alloc_c, placed_c, placed_min_c, free_c = _fill_dispatch(
+        grouped, free, all_nodes, gang.demand, gang.count, gang.min_count,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
+        jnp.int32(0),
     )
     cluster_ok = (
         (gang.req_level < 0)
@@ -265,10 +358,13 @@ def gang_select_and_fill(
     free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
 
     # best-effort extras: pods beyond the packed domain scatter cluster-wide
-    # (no required constraint only)
+    # (no gang-level required constraint, and never for group-constrained
+    # groups — their extras must stay inside their chosen domain)
     chose_packed_level = ok_min & (chosen < n_levels)
     spill = (gang.req_level < 0) & chose_packed_level
-    remaining = jnp.where(spill, gang.count - placed, 0)
+    remaining = jnp.where(
+        spill & (gang.group_req < 0), gang.count - placed, 0
+    )
     alloc2, placed2, free_after2 = _fill(free_after, all_nodes, gang.demand, remaining)
     alloc = jnp.where(spill, alloc + alloc2, alloc)
     placed_total = jnp.where(spill, placed + placed2, placed)
@@ -286,7 +382,7 @@ def gang_select_and_fill(
     return free_new, alloc, placed_total, ok_min, chosen_l, score
 
 
-@partial(jax.jit, static_argnames=("with_alloc",))
+@partial(jax.jit, static_argnames=("with_alloc", "grouped"))
 def solve_packing(
     capacity: jnp.ndarray,  # [N, R] float32
     topo: jnp.ndarray,  # [N, L] int32, dense ids per level
@@ -297,13 +393,20 @@ def solve_packing(
     min_count: jnp.ndarray,  # [G, P] int32
     req_level: jnp.ndarray,  # [G] int32 (-1 none)
     pref_level: jnp.ndarray,  # [G] int32 (-1 → narrowest)
+    group_req: jnp.ndarray = None,  # [G, P] int32 (-1 none)
+    group_pin: jnp.ndarray = None,  # [G, P] int32 (-1 none)
     with_alloc: bool = True,
+    grouped: bool = False,
 ):
     """Exact sequential greedy (oracle-parity kernel)."""
+    if group_req is None:
+        group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
 
     def gang_step(free, gang: GangInputs):
         free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
-            free, topo, seg_starts, seg_ends, gang
+            free, topo, seg_starts, seg_ends, gang, grouped=grouped
         )
         ys = (ok_min, placed, score, chosen_l)
         if with_alloc:
@@ -316,6 +419,8 @@ def solve_packing(
         min_count=min_count,
         req_level=req_level,
         pref_level=pref_level,
+        group_req=group_req,
+        group_pin=group_pin,
     )
     free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
     if with_alloc:
@@ -333,7 +438,7 @@ def solve_packing(
     }
 
 
-@partial(jax.jit, static_argnames=("commit_iters",))
+@partial(jax.jit, static_argnames=("commit_iters", "grouped"))
 def solve_wave_chunk(
     free: jnp.ndarray,  # [N, R]
     topo: jnp.ndarray,  # [N, L]
@@ -347,10 +452,17 @@ def solve_wave_chunk(
     pending: jnp.ndarray,  # [C] bool
     narrow_cap: jnp.ndarray,  # [C] int32
     seeds: jnp.ndarray,  # [C] int32
+    group_req: jnp.ndarray = None,  # [C, P]
+    group_pin: jnp.ndarray = None,  # [C, P]
     commit_iters: int = 2,
+    grouped: bool = False,
 ):
     """One wave over one chunk, with per-pod allocations materialized (the
     binding path). Same core as the device-resident stats solver."""
+    if group_req is None:
+        group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
     free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
         wave_chunk_core(
             free,
@@ -365,7 +477,10 @@ def solve_wave_chunk(
             pending,
             narrow_cap,
             seeds,
+            group_req,
+            group_pin,
             commit_iters,
+            grouped,
         )
     )
     n_levels = topo.shape[1]
@@ -391,7 +506,8 @@ def solve_wave_chunk(
 
 def wave_chunk_core(
     free, topo, seg_starts, seg_ends,
-    dem, cnt, mn, rq, pf, pend, ncap, seeds, commit_iters,
+    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, commit_iters,
+    grouped=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
@@ -400,9 +516,10 @@ def wave_chunk_core(
     Returns (free, accept, placed, score, chosen, retry, new_cap,
     fill_failed, alloc)."""
     cnt = cnt * pend[:, None]
-    inputs = GangInputs(dem, cnt, mn, rq, pf)
+    inputs = GangInputs(dem, cnt, mn, rq, pf, grq, gpin)
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
-        gang_select_single, in_axes=(None, None, None, None, 0, 0, 0)
+        lambda *xs: gang_select_single(*xs, grouped=grouped),
+        in_axes=(None, None, None, None, 0, 0, 0),
     )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
 
     usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
@@ -438,7 +555,8 @@ def wave_chunk_core(
 
 
 def gang_select_single(
-    free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed
+    free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
+    grouped: bool = False,
 ):
     """Single-fill variant of gang_select_and_fill for the wave solver.
 
@@ -516,8 +634,9 @@ def gang_select_single(
         has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
     )
 
-    alloc, placed, placed_min, free_after = _fill_floors_first(
-        free, mask, gang.demand, gang.count, gang.min_count
+    alloc, placed, placed_min, free_after = _fill_dispatch(
+        grouped, free, mask, gang.demand, gang.count, gang.min_count,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
     )
     level_fill_ok = (
         had_candidate
@@ -545,12 +664,18 @@ def gang_select_single(
     )
     spill = level_fill_ok & has_level & (gang.req_level < 0)
     base_free = jnp.where(cluster_rescue, free, free_after)
+    # extras of group-constrained groups must stay inside their chosen
+    # domain — only unconstrained groups may spill cluster-wide
+    spillable = gang.group_req < 0
     remaining = jnp.where(
-        cluster_rescue, gang.count, jnp.where(spill, gang.count - placed, 0)
+        cluster_rescue,
+        gang.count,
+        jnp.where(spill & spillable, gang.count - placed, 0),
     )
     rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
-    alloc2, placed2, placed2_min, _ = _fill_floors_first(
-        base_free, all_nodes, gang.demand, remaining, rescue_min
+    alloc2, placed2, placed2_min, _ = _fill_dispatch(
+        grouped, base_free, all_nodes, gang.demand, remaining, rescue_min,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
     )
     rescue_ok = cluster_rescue & jnp.all(
         jnp.where(active, placed2_min >= gang.min_count, True)
@@ -577,7 +702,7 @@ def gang_select_single(
     return alloc, placed, fill_ok, chosen, score, had_candidate, fallback_cap
 
 
-@partial(jax.jit, static_argnames=("n_chunks", "max_waves", "commit_iters"))
+@partial(jax.jit, static_argnames=("n_chunks", "max_waves", "commit_iters", "grouped"))
 def solve_waves_device(
     capacity,  # [N, R]
     topo,  # [N, L]
@@ -588,9 +713,11 @@ def solve_waves_device(
     min_count,  # [G, P]
     req_level,  # [G]
     pref_level,  # [G]
+    group_req=None,  # [G, P]
     n_chunks: int = 20,
     max_waves: int = 8,
     commit_iters: int = 2,
+    grouped: bool = False,
 ):
     """Whole multi-wave wave-parallel solve in ONE device program — zero
     host↔device round trips until the final results (critical when the chip
@@ -607,6 +734,8 @@ def solve_waves_device(
     """
     g_total, p_max, _ = demand.shape
     n_nodes, n_levels = topo.shape
+    if group_req is None:
+        group_req = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
     c = g_total // n_chunks
 
     def reshape_chunks(a):
@@ -628,7 +757,7 @@ def solve_waves_device(
     def chunk_step(free, xs):
         # settled chunks skip the whole decision+commit (lax.cond executes
         # one branch): waves after the first mostly touch a few chunks
-        dem, cnt, mn, rq, pf, pend, ncap, seeds = xs
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin = xs
         c_gangs = dem.shape[0]
 
         def passthrough(free):
@@ -647,11 +776,12 @@ def solve_waves_device(
         )
 
     def _active_chunk_step(free, xs):
-        dem, cnt, mn, rq, pf, pend, ncap, seeds = xs
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin = xs
         free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
             wave_chunk_core(
                 free, topo, seg_starts, seg_ends,
-                dem, cnt, mn, rq, pf, pend, ncap, seeds, commit_iters,
+                dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin,
+                commit_iters, grouped,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)
@@ -676,6 +806,8 @@ def solve_waves_device(
                 reshape_chunks(state["pending"]),
                 reshape_chunks(state["narrow_cap"]),
                 seeds_c,
+                reshape_chunks(group_req),
+                reshape_chunks(jnp.full_like(group_req, -1)),
             ),
         )
         accept, placed, score, chosen, retry, new_cap, fill_failed = (

@@ -145,6 +145,7 @@ def encode_gangs(
     demand = np.zeros((gp, pp, r), dtype=np.float32)
     count = np.zeros((gp, pp), dtype=np.int32)
     min_count = np.zeros((gp, pp), dtype=np.int32)
+    group_req = np.full((gp, pp), -1, dtype=np.int32)
     req_level = np.full((gp,), -1, dtype=np.int32)
     pref_level = np.full((gp,), -1, dtype=np.int32)
     priority = np.zeros((gp,), dtype=np.int32)
@@ -160,6 +161,9 @@ def encode_gangs(
                 demand[gi, pi, ri] = grp["demand"].get(rname, 0.0)
             count[gi, pi] = grp["count"]
             min_count[gi, pi] = grp["min_count"]
+            group_req[gi, pi] = level_index_for_key(
+                level_keys, grp.get("required_key"), required=True
+            )
         group_names.append(names)
         req_level[gi] = level_index_for_key(
             level_keys, spec.get("required_key"), required=True
@@ -174,6 +178,7 @@ def encode_gangs(
         req_level,
         pref_level,
         priority,
+        group_req,
         gang_names,
         group_names,
     )
@@ -233,6 +238,7 @@ def build_problem(
         req_level,
         pref_level,
         priority,
+        group_req,
         gang_names,
         group_names,
     ) = encode_gangs(gang_specs, resource_names, level_keys, pad_gangs, pad_groups)
@@ -240,11 +246,24 @@ def build_problem(
     capacity, demand = _quantize_resources(capacity, demand)
     seg_starts, seg_ends = domain_boundaries(topo)
 
+    # recovery pins: a constrained group with surviving pods must rejoin
+    # their domain — map the pinned node to its domain id at the group level
+    group_pin = np.full_like(group_req, -1)
+    node_index = {name: i for i, name in enumerate(node_names)}
+    for gi, spec in enumerate(gang_specs):
+        for pi, grp in enumerate(spec["groups"]):
+            pin_node = grp.get("pinned_node")
+            lvl = group_req[gi, pi]
+            if pin_node is not None and lvl >= 0 and pin_node in node_index:
+                group_pin[gi, pi] = topo[node_index[pin_node], lvl]
+
     return PackingProblem(
         capacity=capacity,
         topo=topo,
         seg_starts=seg_starts,
         seg_ends=seg_ends,
+        group_req=group_req,
+        group_pin=group_pin,
         demand=demand,
         count=count,
         min_count=min_count,

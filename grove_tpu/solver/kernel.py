@@ -25,12 +25,14 @@ from grove_tpu.solver.types import PackingProblem, PackingResult
 _compiled_cache: Dict[Tuple, object] = {}
 
 
-def _get_compiled(args, with_alloc: bool):
-    sig = tuple((a.shape, str(a.dtype)) for a in args) + (with_alloc,)
+def _get_compiled(args, with_alloc: bool, grouped: bool):
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (with_alloc, grouped)
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         t0 = time.perf_counter()
-        compiled = solve_packing.lower(*args, with_alloc=with_alloc).compile()
+        compiled = solve_packing.lower(
+            *args, with_alloc=with_alloc, grouped=grouped
+        ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
     return compiled
@@ -47,8 +49,11 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         jnp.asarray(problem.min_count),
         jnp.asarray(problem.req_level),
         jnp.asarray(problem.pref_level),
+        jnp.asarray(problem.group_req),
+        jnp.asarray(problem.group_pin),
     )
-    compiled = _get_compiled(args, with_alloc)
+    grouped = bool((problem.group_req >= 0).any())
+    compiled = _get_compiled(args, with_alloc, grouped)
     t0 = time.perf_counter()
     out = compiled(*args)
     admitted = np.asarray(out["admitted"])  # device sync
@@ -96,6 +101,8 @@ def solve_waves(
     min_count = pad(problem.min_count)
     req_level = pad(problem.req_level, -1)
     pref_level = pad(problem.pref_level, -1)
+    group_req = pad(problem.group_req, -1)
+    group_pin = pad(problem.group_pin, -1)
 
     free = jnp.asarray(problem.capacity)
     topo = jnp.asarray(problem.topo)
@@ -116,6 +123,7 @@ def solve_waves(
         else None
     )
 
+    grouped = bool((problem.group_req >= 0).any())
     # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
     # change between waves; re-uploading per wave would pay the remote-link
     # latency this path exists to avoid)
@@ -123,6 +131,10 @@ def solve_waves(
         tuple(
             jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
             for a in (demand, count, min_count, req_level, pref_level)
+        )
+        + (
+            jnp.asarray(group_req[c * chunk_size : (c + 1) * chunk_size]),
+            jnp.asarray(group_pin[c * chunk_size : (c + 1) * chunk_size]),
         )
         for c in range(n_chunks)
     ]
@@ -140,15 +152,23 @@ def solve_waves(
             mask = pending[sl]
             if not mask.any():
                 continue
+            dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c = chunk_const[c]
             out = solve_wave_chunk(
                 free,
                 topo,
                 seg_starts,
                 seg_ends,
-                *chunk_const[c],
+                dem_c,
+                cnt_c,
+                mn_c,
+                rq_c,
+                pf_c,
                 jnp.asarray(mask),
                 jnp.asarray(narrow_cap[sl]),
                 jnp.asarray(seeds[sl]),
+                group_req=grq_c,
+                group_pin=gpin_c,
+                grouped=grouped,
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -214,13 +234,19 @@ def solve_waves_stats(
         jnp.asarray(pad(problem.min_count)),
         jnp.asarray(pad(problem.req_level, -1)),
         jnp.asarray(pad(problem.pref_level, -1)),
+        jnp.asarray(pad(problem.group_req, -1)),
     )
-    sig = tuple((a.shape, str(a.dtype)) for a in args) + (n_chunks, max_waves)
+    grouped = bool((problem.group_req >= 0).any())
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (
+        n_chunks,
+        max_waves,
+        grouped,
+    )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         t0 = time.perf_counter()
         compiled = solve_waves_device.lower(
-            *args, n_chunks=n_chunks, max_waves=max_waves
+            *args, n_chunks=n_chunks, max_waves=max_waves, grouped=grouped
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
@@ -259,6 +285,7 @@ def solve_waves_stats(
             min_count=tpad(problem.min_count),
             req_level=tpad(problem.req_level, -1),
             pref_level=tpad(problem.pref_level, -1),
+            group_req=tpad(problem.group_req, -1),
             priority=tpad(problem.priority),
             seg_starts=problem.seg_starts,
             seg_ends=problem.seg_ends,

@@ -41,14 +41,56 @@ def _fill(free, mask, demand, count):
     return alloc, placed, free
 
 
-def _fill_floors_first(free, mask, demand, count, min_count):
-    """Mirror of the kernel's two-phase fill: floors first (clamped to the
-    available count), then non-negative extras."""
+def _fill_grouped(
+    free, mask, demand, count, min_count, group_req, group_pin,
+    topo, seg_starts, seg_ends,
+):
+    """Mirror of the kernel's grouped fill (seed 0): per-group domain choice
+    at each group's required level inside `mask`; floors of all groups before
+    any extras; a constrained group's extras stay in its domain."""
+    p_dim = demand.shape[0]
     floors = np.minimum(min_count, count)
     extras = np.maximum(count - min_count, 0)
-    alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
-    alloc_ext, placed_ext, free2 = _fill(free1, mask, demand, extras)
-    return alloc_min + alloc_ext, placed_min + placed_ext, placed_min, free2
+
+    def group_mask(free_c, p):
+        k = _pods_fit(free_c, demand[p])
+        k = np.minimum(np.where(mask, k, 0), max(int(floors[p]), 1))
+        if group_req[p] < 0:
+            return mask
+        lvl = int(group_req[p])
+        cs = np.concatenate([[0], np.cumsum(k)])
+        starts, ends = seg_starts[lvl], seg_ends[lvl]
+        K = cs[ends] - cs[starts]
+        feas = (K >= floors[p]) & (ends > starts)
+        w = np.where(feas, K, 0).astype(np.float32)
+        cum_w = np.cumsum(w, dtype=np.float32)
+        # seed 0 → u = 0 → first feasible domain (kernel parity)
+        best = int(np.argmax(cum_w > 0)) if cum_w[-1] > 0 else int(np.argmax(feas))
+        ok_any = bool(feas.any())
+        if group_pin[p] >= 0:  # recovery pin (kernel parity)
+            best = int(group_pin[p])
+            ok_any = True
+        return (topo[:, lvl] == best) & mask & ok_any
+
+    free_c = free.copy()
+    masks = []
+    alloc_rows = []
+    floor_placed = []
+    extra_placed = []
+    for p in range(p_dim):
+        mask_p = group_mask(free_c, p)
+        masks.append(mask_p)
+        a, pl, free_c = _fill(free_c, mask_p, demand[p : p + 1], floors[p : p + 1])
+        alloc_rows.append(a[0])
+        floor_placed.append(pl[0])
+    for p in range(p_dim):
+        a, pl, free_c = _fill(free_c, masks[p], demand[p : p + 1], extras[p : p + 1])
+        alloc_rows[p] = alloc_rows[p] + a[0]
+        extra_placed.append(pl[0])
+    alloc = np.stack(alloc_rows)
+    placed_min = np.array(floor_placed)
+    placed = placed_min + np.array(extra_placed)
+    return alloc, placed, placed_min, free_c
 
 
 def _level_weights(L: int) -> np.ndarray:
@@ -73,6 +115,8 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         demand = problem.demand[g].astype(np.float64)
         count = problem.count[g].astype(np.int64)
         min_count = problem.min_count[g].astype(np.int64)
+        group_req = problem.group_req[g].astype(np.int64)
+        group_pin = problem.group_pin[g].astype(np.int64)
         active = count > 0
         if not active.any():
             continue
@@ -131,7 +175,10 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
             key = spare.astype(np.float32) + tie
             key[~feas] = np.inf
             mask = topo[:, l] == int(np.argmin(key))
-            a, pl, pl_min, fa = _fill_floors_first(cap, mask, demand, count, min_count)
+            a, pl, pl_min, fa = _fill_grouped(
+                cap, mask, demand, count, min_count, group_req, group_pin,
+                topo, problem.seg_starts, problem.seg_ends,
+            )
             if all(pl_min[p] >= min_count[p] for p in range(P) if active[p]):
                 chosen_level, alloc, placed, free_after = l, a, pl, fa
                 break
@@ -140,15 +187,17 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
             if req >= 0:
                 continue  # required pack unsatisfiable → unplaced
             mask = np.ones((N,), dtype=bool)  # cluster-wide fallback
-            alloc, placed, pl_min, free_after = _fill_floors_first(
-                cap, mask, demand, count, min_count
+            alloc, placed, pl_min, free_after = _fill_grouped(
+                cap, mask, demand, count, min_count, group_req, group_pin,
+                topo, problem.seg_starts, problem.seg_ends,
             )
             if not all(pl_min[p] >= min_count[p] for p in range(P) if active[p]):
                 continue  # all-or-nothing: no capacity consumed
         elif req < 0:
-            # best-effort extras spill cluster-wide
+            # best-effort extras spill cluster-wide (unconstrained groups only)
+            spill_counts = np.where(group_req < 0, count - placed, 0)
             alloc2, placed2, free_after = _fill(
-                free_after, np.ones((N,), dtype=bool), demand, count - placed
+                free_after, np.ones((N,), dtype=bool), demand, spill_counts
             )
             alloc += alloc2
             placed += placed2

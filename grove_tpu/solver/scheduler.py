@@ -215,12 +215,47 @@ class GangScheduler:
                 by_pclq[pod.metadata.labels.get(namegen.LABEL_PODCLIQUE, "")].append(
                     pod
                 )
+            # PCSG-tier pack groups (scheduler podgang.go:117-126): a config
+            # covering EVERY pending group is an exact collective constraint
+            # and folds into the gang-level required key; a config covering a
+            # subset is approximated by confining each member group to one
+            # domain at that level (each member stays packed; the subset as a
+            # whole may span domains — conservative per-member, relaxed
+            # collectively)
+            pending_group_names = set(by_pclq)
+            collective_req = None
+            group_cfg_req = {}
+            for cfg in gang_cr.spec.topology_constraint_group_configs:
+                tc = cfg.topology_constraint
+                if tc is None or tc.pack_constraint is None:
+                    continue
+                cfg_key = tc.pack_constraint.required
+                if set(cfg.pod_group_names) >= pending_group_names:
+                    collective_req = self._narrower_key(collective_req, cfg_key)
+                else:
+                    for member in cfg.pod_group_names:
+                        group_cfg_req[member] = self._narrower_key(
+                            group_cfg_req.get(member), cfg_key
+                        )
+
             groups = []
             for pclq_fqn, members in sorted(by_pclq.items()):
                 members.sort(key=lambda p: p.metadata.name)
                 group_cr = groups_cr.get(pclq_fqn)
                 min_replicas = group_cr.min_replicas if group_cr else len(members)
                 already = self._scheduled_count(namespace, pclq_fqn)
+                own_req = None
+                if group_cr is not None and group_cr.topology_constraint is not None:
+                    pc = group_cr.topology_constraint.pack_constraint
+                    own_req = pc.required if pc is not None else None
+                group_required = self._narrower_key(
+                    own_req, group_cfg_req.get(pclq_fqn)
+                )
+                # recovery pin: surviving pods of a constrained group anchor
+                # the replacement pods to their domain
+                pinned_node = None
+                if group_required is not None and already > 0:
+                    pinned_node = self._any_bound_node(namespace, pclq_fqn)
                 groups.append(
                     {
                         "name": pclq_fqn,
@@ -229,6 +264,8 @@ class GangScheduler:
                         # floor reduced by already-scheduled pods (recovery)
                         "min_count": max(0, min_replicas - already),
                         "partial": already > 0,
+                        "required_key": group_required,
+                        "pinned_node": pinned_node,
                     }
                 )
             required_key = preferred_key = None
@@ -236,6 +273,7 @@ class GangScheduler:
             if tc is not None and tc.pack_constraint is not None:
                 required_key = tc.pack_constraint.required
                 preferred_key = tc.pack_constraint.preferred
+            required_key = self._narrower_key(required_key, collective_req)
             gang_specs.append(
                 {
                     "name": gang_name,
@@ -256,6 +294,25 @@ class GangScheduler:
         )
         gang_specs = [gang_specs[i] for i in order]
         return gang_specs, gang_pods, loose
+
+    def _narrower_key(self, a: Optional[str], b: Optional[str]) -> Optional[str]:
+        """Narrower (higher level index) of two topology keys."""
+        keys = [k for k in self.topology.spec.levels]
+        order = {lvl.key: i for i, lvl in enumerate(keys)}
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a if order.get(a, -1) >= order.get(b, -1) else b
+
+    def _any_bound_node(self, namespace: str, pclq_fqn: str) -> Optional[str]:
+        for p in self.store.list(
+            "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
+        ):
+            node = self.cluster.bindings.get((namespace, p.metadata.name))
+            if node is not None:
+                return node
+        return None
 
     def _scheduled_count(self, namespace: str, pclq_fqn: str) -> int:
         return sum(

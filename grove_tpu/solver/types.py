@@ -41,6 +41,10 @@ class PackingProblem:
     # as prefix-sum gathers instead of TPU-hostile scatter segment-sums.
     seg_starts: np.ndarray = None  # [L, D] int32
     seg_ends: np.ndarray = None  # [L, D] int32
+    # per-group required pack level (-1 none): PodGroup/PCSG constraint tier
+    group_req: np.ndarray = None  # [G, P] int32
+    # pinned domain id per group at its required level (-1 none)
+    group_pin: np.ndarray = None  # [G, P] int32
 
     # bookkeeping (host side, not shipped to device)
     node_names: List[str] = field(default_factory=list)

@@ -210,6 +210,107 @@ class TestMultiReplicaSets:
         assert all(is_ready(p) for p in waiting_pods), harness.tree()
 
 
+class TestGroupLevelConstraints:
+    def test_clique_pack_domain_confines_each_group(self):
+        """PodClique-level packDomain: every clique's pods land inside ONE
+        ici-block, but different cliques may use different blocks."""
+        from grove_tpu.api.load import load_podcliqueset_file as load
+
+        harness = SimHarness(num_nodes=16)  # 4 hosts/block, cpu 8 each
+        pcs = load(str(REPO / "samples" / "multinode-disaggregated.yaml"))
+        # shrink so each clique fits one block but the gang spans several
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.containers[0].requests = {"cpu": 2.0}
+        for c in pcs.spec.template.cliques:
+            c.topology_constraint = TopologyConstraint(pack_domain="ici-block")
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 1
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert pods and all(is_ready(p) for p in pods), harness.tree()
+        node_by_name = {n.name: n for n in harness.cluster.nodes}
+        from collections import defaultdict
+
+        blocks_per_clique = defaultdict(set)
+        for p in pods:
+            clique = p.metadata.labels["grove.io/podclique"]
+            blocks_per_clique[clique].add(
+                node_by_name[p.status.node_name].labels[
+                    "cloud.google.com/gke-tpu-ici-block"
+                ]
+            )
+        for clique, blocks in blocks_per_clique.items():
+            assert len(blocks) == 1, (clique, blocks, harness.tree())
+        # sanity: PodGroups carry the translated constraint
+        gang = harness.store.get(
+            "PodGang", "default", "multinode-disaggregated-0"
+        )
+        for group in gang.spec.pod_groups:
+            assert (
+                group.topology_constraint.pack_constraint.required
+                == "cloud.google.com/gke-tpu-ici-block"
+            )
+
+    def test_replacement_pod_rejoins_surviving_domain(self):
+        """Recovery pin: a constrained clique's replacement pod returns to
+        the block where its surviving pods live, even when another block has
+        more free capacity."""
+        harness = SimHarness(num_nodes=8)  # blocks of 4 hosts
+        pcs = simple1()
+        pcs.spec.template.cliques[0].spec.min_available = 1
+        pcs.spec.template.cliques[0].topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        harness.apply(pcs)
+        harness.converge()
+        node_by_name = {n.name: n for n in harness.cluster.nodes}
+
+        def pca_blocks():
+            return {
+                node_by_name[p.status.node_name].labels[
+                    "cloud.google.com/gke-tpu-ici-block"
+                ]
+                for p in harness.store.list(
+                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+                )
+                if p.status.node_name
+            }
+
+        blocks_before = pca_blocks()
+        assert len(blocks_before) == 1
+        # kill one pca pod; disable sticky reuse so the solver must decide
+        harness.cluster.last_node.clear()
+        harness.store.delete("Pod", "default", "simple1-0-pca-0")
+        harness.converge()
+        pods = harness.store.list(
+            "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+        )
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), harness.tree()
+        assert pca_blocks() == blocks_before
+
+    def test_unsatisfiable_group_constraint_blocks_gang(self):
+        from grove_tpu.api.load import load_podcliqueset_file as load
+
+        harness = SimHarness(num_nodes=16)
+        for n in harness.cluster.nodes:
+            n.capacity = {"cpu": 4.0}
+        pcs = load(str(REPO / "samples" / "multinode-disaggregated.yaml"))
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.containers[0].requests = {"cpu": 4.0}
+        # pworker (4 pods x 4cpu = a whole block's worth of 4x4) fits, but
+        # bump it beyond one block's capacity
+        pcs.spec.template.cliques[1].spec.replicas = 5
+        pcs.spec.template.cliques[1].topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 1
+        harness.apply(pcs)
+        harness.converge()
+        # the whole gang stays pending: pworker can never fit one block
+        pods = harness.store.list("Pod")
+        assert pods and all(not is_scheduled(p) for p in pods), harness.tree()
+
+
 class TestPlacementScore:
     def test_score_reported_on_gang_status(self):
         harness = SimHarness(num_nodes=16)

@@ -234,6 +234,11 @@ class TestOracleEquivalence:
                 for p in range(n_groups)
             ]
             req = BLOCK_KEY if rng.random() < 0.3 else None
+            # per-group constraints sometimes, so the grouped-fill mirrors
+            # are exercised in the parity gate too
+            for grp in groups:
+                if rng.random() < 0.3:
+                    grp["required_key"] = BLOCK_KEY
             gangs.append(gang(f"g{i}", groups, required_key=req))
         problem = build_problem(nodes, gangs, TOPO)
         kernel_res = solve(problem)
