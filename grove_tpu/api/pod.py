@@ -9,7 +9,7 @@ status — podclique/reconcilestatus.go:39-89).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from grove_tpu.api.meta import Condition, ObjectMeta, get_condition
 from grove_tpu.api.types import PodSpec

@@ -9,7 +9,7 @@ the authorizer, and the cluster-topology reference.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import yaml
 

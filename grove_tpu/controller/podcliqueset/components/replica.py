@@ -10,7 +10,7 @@ remaining wait.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 from grove_tpu.api import names as namegen
 from grove_tpu.api.meta import get_condition

@@ -10,8 +10,6 @@ replica availability and PodGang phases.
 
 from __future__ import annotations
 
-from typing import Optional
-
 from grove_tpu.api import names as namegen
 from grove_tpu.api.hashing import compute_pcs_generation_hash
 from grove_tpu.api.meta import get_condition

@@ -21,7 +21,7 @@ semantics in common/flow.go.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.clock import Clock

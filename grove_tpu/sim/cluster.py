@@ -12,7 +12,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from grove_tpu.api import names as namegen
 from grove_tpu.api.meta import Condition, set_condition
 from grove_tpu.api.pod import (
     COND_POD_READY,
