@@ -1,0 +1,86 @@
+"""Rolling updates under stress: concurrent breach, scale during update,
+back-to-back updates."""
+
+from grove_tpu.api.pod import is_ready
+from grove_tpu.sim.harness import SimHarness
+from tests.test_rolling_update import converge_update, simple1
+
+
+def with_image(image):
+    pcs = simple1()
+    for clique in pcs.spec.template.cliques:
+        clique.spec.pod_spec.containers[0].image = image
+    return pcs
+
+
+class TestUpdateStress:
+    def test_breach_during_update_does_not_gang_terminate(self):
+        """The update-in-progress marker suspends MinAvailableBreached, so a
+        crash mid-update never triggers gang termination (which would fight
+        the updater)."""
+        harness = SimHarness(num_nodes=32)
+        pcs = simple1()
+        pcs.spec.template.termination_delay = 10.0  # hair-trigger
+        harness.apply(pcs)
+        harness.converge()
+        pclq_uid = harness.store.get(
+            "PodClique", "default", "simple1-0-pcd"
+        ).metadata.uid
+
+        updated = with_image("busybox:v2")
+        updated.spec.template.termination_delay = 10.0
+        harness.apply(updated)
+        harness.engine.drain()
+        # crash pcd mid-update and sit well past the termination delay
+        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
+        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        assert converge_update(harness, max_rounds=240), harness.tree()
+        harness.converge()
+        # the PCLQ was updated in place, not gang-terminated (same uid)
+        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        assert pclq.metadata.uid == pclq_uid
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        # the crashed pods were rebuilt from the NEW template, not the old
+        assert {c.image for p in pods for c in p.spec.containers} == {
+            "busybox:v2"
+        }
+
+    def test_scale_out_during_update_lands_on_new_template(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.apply(with_image("busybox:v2"))
+        harness.engine.drain()
+        # HPA scales the group out while the update runs
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+        )
+        pcsg.spec.replicas = 3
+        harness.store.update(pcsg)
+        assert converge_update(harness, max_rounds=240), harness.tree()
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert len(pods) == 9 + 2 * 4
+        assert all(is_ready(p) for p in pods), harness.tree()
+        assert {c.image for p in pods for c in p.spec.containers} == {
+            "busybox:v2"
+        }
+
+    def test_back_to_back_updates_converge_to_last(self):
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        harness.apply(with_image("busybox:v2"))
+        harness.engine.drain()
+        harness.advance(2.0)
+        harness.engine.drain()
+        # supersede mid-flight
+        harness.apply(with_image("busybox:v3"))
+        assert converge_update(harness, max_rounds=240), harness.tree()
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        assert {c.image for p in pods for c in p.spec.containers} == {
+            "busybox:v3"
+        }
