@@ -51,16 +51,26 @@ def _map_pcs_to_children_of_kind(ctx: OperatorContext, kind: str):
     return map_fn
 
 
-def register_controllers(engine: Engine, ctx: OperatorContext) -> None:
+def register_controllers(engine: Engine, ctx: OperatorContext, config=None) -> None:
     pcs = PodCliqueSetReconciler(ctx)
     pclq = PodCliqueReconciler(ctx)
     pcsg = PodCliqueScalingGroupReconciler(ctx)
+    syncs = (
+        (
+            config.controllers.pod_clique_set.concurrent_syncs,
+            config.controllers.pod_clique.concurrent_syncs,
+            config.controllers.pod_clique_scaling_group.concurrent_syncs,
+        )
+        if config is not None
+        else (1, 1, 1)
+    )
 
     engine.register(
         Controller(
             name="podcliqueset",
             kind="PodCliqueSet",
             reconcile=pcs.reconcile,
+            concurrent_syncs=syncs[0],
             watches=[
                 ("PodClique", _map_to_part_of),
                 ("PodCliqueScalingGroup", _map_to_part_of),
@@ -74,6 +84,7 @@ def register_controllers(engine: Engine, ctx: OperatorContext) -> None:
             name="podclique",
             kind="PodClique",
             reconcile=pclq.reconcile,
+            concurrent_syncs=syncs[1],
             watches=[
                 ("Pod", _map_pod_to_pclq),
                 ("PodGang", _map_podgang_to_pclqs),
@@ -86,6 +97,7 @@ def register_controllers(engine: Engine, ctx: OperatorContext) -> None:
             name="podcliquescalinggroup",
             kind="PodCliqueScalingGroup",
             reconcile=pcsg.reconcile,
+            concurrent_syncs=syncs[2],
             watches=[
                 ("PodClique", _map_pclq_to_pcsg),
                 (

@@ -40,6 +40,9 @@ class Controller:
     reconcile: ReconcileFn
     watches: List[Tuple[str, MapFn]] = field(default_factory=list)
     queue: WorkQueue = field(default_factory=WorkQueue)
+    # ConcurrentSyncs equivalent: keys processed per engine round (the
+    # engine is single-threaded, so this is batching, not parallelism)
+    concurrent_syncs: int = 1
 
 
 class Engine:
@@ -99,27 +102,30 @@ class Engine:
             self._route_events()
             progressed = False
             for ctrl in self.controllers:
-                key = ctrl.queue.pop(now)
-                if key is None:
-                    continue
-                progressed = True
-                executed += 1
-                METRICS.inc(f"reconcile_total/{ctrl.name}")
-                try:
-                    result = ctrl.reconcile(key)
-                except Exception:
-                    METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
-                    # RecoverPanic equivalent (manager.go:99-101): requeue.
-                    ctrl.queue.add_rate_limited(key, now)
-                    continue
-                if result.result == "requeue":
-                    METRICS.inc(f"reconcile_errors_total/{ctrl.name}")
-                    ctrl.queue.add_rate_limited(key, now)
-                elif result.result == "requeue_after":
-                    ctrl.queue.forget(key)
-                    ctrl.queue.add_after(key, result.requeue_after or 0.0, now)
-                else:
-                    ctrl.queue.forget(key)
+                for _slot in range(max(ctrl.concurrent_syncs, 1)):
+                    key = ctrl.queue.pop(now)
+                    if key is None:
+                        break
+                    progressed = True
+                    executed += 1
+                    METRICS.inc(f"reconcile_total/{ctrl.name}")
+                    try:
+                        result = ctrl.reconcile(key)
+                    except Exception:
+                        METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
+                        # RecoverPanic equivalent (manager.go:99-101): requeue
+                        ctrl.queue.add_rate_limited(key, now)
+                        continue
+                    if result.result == "requeue":
+                        METRICS.inc(f"reconcile_errors_total/{ctrl.name}")
+                        ctrl.queue.add_rate_limited(key, now)
+                    elif result.result == "requeue_after":
+                        ctrl.queue.forget(key)
+                        ctrl.queue.add_after(
+                            key, result.requeue_after or 0.0, now
+                        )
+                    else:
+                        ctrl.queue.forget(key)
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()

@@ -66,7 +66,7 @@ class SimHarness:
         self.ctx = OperatorContext(
             store=self.store, clock=self.clock, topology=self.topology
         )
-        register_controllers(self.engine, self.ctx)
+        register_controllers(self.engine, self.ctx, self.config)
         self.cluster = SimCluster(store=self.store, nodes=make_nodes(num_nodes))
         # TPU-solver-backed gang scheduler (the KAI-replacement); set to None
         # to fall back to the cluster's naive first-fit binder.
@@ -77,6 +77,8 @@ class SimHarness:
             self.cluster,
             self.topology,
             priority_map=self.config.solver.priority_classes,
+            chunk_size=min(self.config.solver.chunk_size, 64),
+            max_waves=self.config.solver.max_waves,
         )
         # HPA controller equivalent (multi-level autoscaling)
         from grove_tpu.autoscale.hpa import (

@@ -40,12 +40,16 @@ class GangScheduler:
         cluster: SimCluster,
         topology: Optional[ClusterTopology] = None,
         priority_map: Optional[Dict[str, int]] = None,
+        chunk_size: int = 32,
+        max_waves: int = 16,
     ) -> None:
         self.store = store
         self.cluster = cluster
         self.topology = topology or ClusterTopology()
         # priorityClassName -> numeric priority (higher schedules first)
         self.priority_map = priority_map or {}
+        self.chunk_size = chunk_size
+        self.max_waves = max_waves
 
     # -- main loop -------------------------------------------------------
 
@@ -78,7 +82,11 @@ class GangScheduler:
                 # wave solver with allocations: cheap-to-compile vmapped
                 # decisions (the exact scan kernel stays on the parity/bench
                 # paths; unadmitted gangs retry on the next control round)
-                result = solve_waves(problem)
+                result = solve_waves(
+                    problem,
+                    chunk_size=self.chunk_size,
+                    max_waves=self.max_waves,
+                )
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
                 preempted = self._maybe_preempt(namespace, gang_specs, result)
                 assignments = result.assignments(problem)
