@@ -190,6 +190,53 @@ spec:
         assert rc == 0
         assert "pcs/simple1" in out and "pg/simple1-0" in out
 
+    def test_get_exports_yaml(self, capsys):
+        import yaml
+
+        from grove_tpu.cli import main
+
+        rc = main(
+            [
+                "get",
+                str(REPO / "samples" / "simple1.yaml"),
+                "--kind",
+                "PodGang",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        docs = list(yaml.safe_load_all(out))
+        assert docs[0]["apiVersion"] == "scheduler.grove.io/v1alpha1"
+        assert docs[0]["kind"] == "PodGang"
+        assert docs[0]["spec"]["podGroups"]
+
+    def test_waiter_blocking_form(self):
+        """initc Waiter.wait polls on the store clock until parents ready."""
+        from grove_tpu.initc.waiter import Waiter
+
+        harness = SimHarness(num_nodes=16)
+        harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+        harness.converge()
+        waiter = Waiter(
+            harness.store,
+            "default",
+            {
+                "podcliques": [{"pclq": "simple1-0-pca", "min_available": 3}],
+                "podgang": "simple1-0",
+            },
+        )
+        assert waiter.wait(timeout=5.0)
+        # unreachable parent: times out on the virtual clock
+        waiter2 = Waiter(
+            harness.store,
+            "default",
+            {
+                "podcliques": [{"pclq": "simple1-0-pca", "min_available": 99}],
+                "podgang": "simple1-0",
+            },
+        )
+        assert not waiter2.wait(poll_interval=1.0, timeout=5.0)
+
     def test_config_check(self, tmp_path, capsys):
         from grove_tpu.cli import main
 
