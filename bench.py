@@ -79,42 +79,6 @@ def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
     return build_problem(nodes, gangs, ClusterTopology())
 
 
-def _probe_device_health(timeout_s: float = 120.0) -> bool:
-    """Run a trivial jit in a subprocess: a wedged accelerator link would
-    otherwise hang the whole benchmark with no output."""
-    import pathlib
-    import subprocess
-    import tempfile
-
-    # Detached child writing to a temp file; on timeout we kill and ABANDON
-    # it (a child wedged in uninterruptible device sleep ignores SIGKILL, and
-    # waiting on it would hang the very benchmark the probe protects).
-    out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
-    proc = subprocess.Popen(
-        [
-            sys.executable,
-            "-c",
-            "import jax, jax.numpy as jnp;"
-            "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256)));"
-            "jax.block_until_ready(x); print('OK', jax.default_backend())",
-        ],
-        stdout=out,
-        stderr=subprocess.STDOUT,
-        cwd=pathlib.Path(__file__).resolve().parent,
-        start_new_session=True,
-    )
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            break
-        time.sleep(0.5)
-    else:
-        proc.kill()
-        return False  # abandoned — do not block on a D-state child
-    out.seek(0)
-    return proc.returncode == 0 and "OK" in out.read()
-
-
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--small", action="store_true", help="reduced size smoke run")
@@ -123,25 +87,15 @@ def main() -> None:
     args = parser.parse_args()
 
     backend_note = "default"
-    if not args.skip_health_probe and not _probe_device_health():
-        # accelerator link wedged — fall back to host CPU so the benchmark
-        # still produces its artifact (marked in the output)
-        import os
+    if not args.skip_health_probe:
+        from grove_tpu.utils.platform import ensure_healthy_backend
 
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        # the env var alone is NOT sufficient on this image: sitecustomize
-        # registers the accelerator plugin at interpreter start and pins the
-        # platform, so it must be re-pinned via config after import
-        # (same workaround as tests/conftest.py)
-        jax.config.update("jax_platforms", "cpu")
-        backend_note = "cpu-fallback (accelerator probe failed)"
-        print(
-            "WARNING: accelerator health probe failed; benchmarking on CPU",
-            file=sys.stderr,
-        )
+        backend_note = ensure_healthy_backend(timeout_s=120.0)
+        if backend_note != "default":
+            print(
+                "WARNING: accelerator health probe failed; benchmarking on CPU",
+                file=sys.stderr,
+            )
 
     import jax
 

@@ -3,7 +3,7 @@ resource tree, validate manifests, and run the benchmark.
 
     python -m grove_tpu.cli apply samples/simple1.yaml
     python -m grove_tpu.cli validate samples/*.yaml
-    python -m grove_tpu.cli tree samples/simple1.yaml --scale sga=3
+    python -m grove_tpu.cli tree samples/simple1.yaml --scale workers=3
     python -m grove_tpu.cli bench --small
 """
 
@@ -148,6 +148,14 @@ def _cmd_config_check(args) -> int:
 
 
 def main(argv: List[str] | None = None) -> int:
+    # sim-backed commands run the placement solver; a wedged accelerator
+    # link must degrade to CPU instead of hanging the CLI
+    from grove_tpu.utils.platform import ensure_healthy_backend
+
+    note = ensure_healthy_backend(timeout_s=45.0)
+    if note != "default":
+        print(f"note: {note}", file=sys.stderr)
+
     parser = argparse.ArgumentParser(prog="grove-tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
 

@@ -286,6 +286,7 @@ def solve_waves_stats(
             req_level=tpad(problem.req_level, -1),
             pref_level=tpad(problem.pref_level, -1),
             group_req=tpad(problem.group_req, -1),
+            group_pin=tpad(problem.group_pin, -1),
             priority=tpad(problem.priority),
             seg_starts=problem.seg_starts,
             seg_ends=problem.seg_ends,

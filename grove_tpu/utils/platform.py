@@ -11,6 +11,63 @@ import os
 from typing import Dict, Optional
 
 
+def probe_device_health(timeout_s: float = 60.0) -> bool:
+    """Run a trivial jit in a detached subprocess; on timeout the child is
+    killed and ABANDONED (a child wedged in uninterruptible device sleep
+    ignores SIGKILL — blocking on its exit would hang the caller, the exact
+    condition the probe exists to detect)."""
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp;"
+            "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)));"
+            "jax.block_until_ready(x); print('OK', jax.default_backend())",
+        ],
+        stdout=out,
+        stderr=subprocess.STDOUT,
+        cwd=pathlib.Path(__file__).resolve().parents[2],
+        start_new_session=True,
+    )
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(0.5)
+    else:
+        proc.kill()
+        return False
+    out.seek(0)
+    return proc.returncode == 0 and "OK" in out.read()
+
+
+def force_cpu_platform() -> None:
+    """Re-pin this process onto host CPU. The env var alone is NOT enough on
+    images whose sitecustomize registers an accelerator plugin at interpreter
+    start — the platform must be re-pinned via jax.config after import."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_healthy_backend(timeout_s: float = 60.0) -> str:
+    """Probe the default accelerator; fall back to CPU when wedged.
+    Returns a human-readable backend note."""
+    if probe_device_health(timeout_s):
+        return "default"
+    force_cpu_platform()
+    return "cpu-fallback (accelerator probe failed)"
+
+
 def cpu_subprocess_env(n_devices: Optional[int] = None) -> Dict[str, str]:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon sitecustomize pin

@@ -50,7 +50,7 @@ class TestDefaulting:
             assert (
                 clique.spec.pod_spec.extra["terminationGracePeriodSeconds"] == 30
             )
-        # pca has autoscaling: minReplicas defaults to replicas (3)
+        # frontend has autoscaling: minReplicas defaults to replicas (3)
         assert tmpl.cliques[0].spec.auto_scaling_config.min_replicas == 3
         sg = tmpl.pod_clique_scaling_group_configs[0]
         assert sg.replicas == 1 and sg.min_available == 1
@@ -72,7 +72,7 @@ class TestValidationCreate:
 
     def test_duplicate_clique_names(self):
         pcs = defaulted_pcs()
-        pcs.spec.template.cliques[1].name = "pca"
+        pcs.spec.template.cliques[1].name = "frontend"
         res = validate_podcliqueset(pcs)
         assert any("unique" in e for e in res.errors)
 
@@ -95,7 +95,7 @@ class TestValidationCreate:
         cfg = pcs.spec.template.pod_clique_scaling_group_configs[0]
         other = copy.deepcopy(cfg)
         other.name = "sgb"
-        other.clique_names = ["pcc", "pcd"]
+        other.clique_names = ["compute", "logger"]
         pcs.spec.template.pod_clique_scaling_group_configs.append(other)
         default_podcliqueset(pcs)
         res = validate_podcliqueset(pcs)
@@ -130,8 +130,8 @@ class TestValidationCreate:
         pcs = make_pcs()
         tmpl = pcs.spec.template
         tmpl.startup_type = STARTUP_EXPLICIT
-        tmpl.cliques[0].spec.starts_after = ["pcd"]
-        tmpl.cliques[3].spec.starts_after = ["pca"]
+        tmpl.cliques[0].spec.starts_after = ["logger"]
+        tmpl.cliques[3].spec.starts_after = ["frontend"]
         default_podcliqueset(pcs)
         res = validate_podcliqueset(pcs)
         assert any("circular" in e for e in res.errors)
@@ -140,7 +140,7 @@ class TestValidationCreate:
         pcs = make_pcs()
         tmpl = pcs.spec.template
         tmpl.startup_type = STARTUP_EXPLICIT
-        tmpl.cliques[0].spec.starts_after = ["pca"]
+        tmpl.cliques[0].spec.starts_after = ["frontend"]
         default_podcliqueset(pcs)
         res = validate_podcliqueset(pcs)
         assert any("refer to itself" in e for e in res.errors)
@@ -169,7 +169,7 @@ class TestValidationCreate:
         pcs = defaulted_pcs()
         sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
         sg.topology_constraint = TopologyConstraint(pack_domain="ici-block")
-        # member pcb demands broader 'slice' than its group's 'ici-block'
+        # member prefetch demands broader 'slice' than its group's 'ici-block'
         pcs.spec.template.cliques[1].topology_constraint = TopologyConstraint(
             pack_domain="slice"
         )
@@ -180,8 +180,8 @@ class TestValidationCreate:
         pcs = make_pcs()
         tmpl = pcs.spec.template
         tmpl.startup_type = STARTUP_EXPLICIT
-        tmpl.cliques[1].spec.starts_after = ["pca"]
-        tmpl.cliques[2].spec.starts_after = ["pca", "pcb"]
+        tmpl.cliques[1].spec.starts_after = ["frontend"]
+        tmpl.cliques[2].spec.starts_after = ["frontend", "prefetch"]
         default_podcliqueset(pcs)
         res = validate_podcliqueset(pcs)
         assert res.ok, res.errors
@@ -279,7 +279,7 @@ class TestValidationUpdate:
     def test_sg_clique_names_immutable(self):
         old = defaulted_pcs()
         new = copy.deepcopy(old)
-        new.spec.template.pod_clique_scaling_group_configs[0].clique_names = ["pcb"]
+        new.spec.template.pod_clique_scaling_group_configs[0].clique_names = ["prefetch"]
         res = validate_podcliqueset_update(new, old)
         assert any("cliqueNames" in e for e in res.errors)
 

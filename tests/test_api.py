@@ -59,9 +59,9 @@ class TestNamegen:
     """Naming parity with reference operator/api/common/namegen.go."""
 
     def test_children(self):
-        assert names.podclique_name("simple1", 0, "pca") == "simple1-0-pca"
-        assert names.pcsg_name("simple1", 0, "sga") == "simple1-0-sga"
-        assert names.podclique_name("simple1-0-sga", 1, "pcb") == "simple1-0-sga-1-pcb"
+        assert names.podclique_name("simple1", 0, "frontend") == "simple1-0-frontend"
+        assert names.pcsg_name("simple1", 0, "workers") == "simple1-0-workers"
+        assert names.podclique_name("simple1-0-workers", 1, "prefetch") == "simple1-0-workers-1-prefetch"
         assert names.headless_service_name("simple1", 2) == "simple1-2"
         assert (
             names.headless_service_address("simple1", 0, "default")
@@ -76,16 +76,16 @@ class TestNamegen:
     def test_base_vs_scaled_podgang_split(self):
         """namegen.go:100-118: PCSG replicas < minAvailable go to the base
         gang; others get 0-based scaled gangs."""
-        fqn = names.pcsg_name("simple1", 0, "sga")
+        fqn = names.pcsg_name("simple1", 0, "workers")
         got = [
             names.podgang_name_for_pcsg_replica("simple1", 0, fqn, r, 2)
             for r in range(4)
         ]
-        assert got == ["simple1-0", "simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"]
+        assert got == ["simple1-0", "simple1-0", "simple1-0-workers-0", "simple1-0-workers-1"]
 
     def test_extract_sg_name(self):
         assert (
-            names.extract_sg_name_from_pcsg_fqn("simple1-0-sga", "simple1", 0) == "sga"
+            names.extract_sg_name_from_pcsg_fqn("simple1-0-workers", "simple1", 0) == "workers"
         )
 
 
@@ -122,15 +122,15 @@ class TestYamlLoad:
         assert pcs.metadata.name == "simple1"
         assert pcs.spec.replicas == 1
         tmpl = pcs.spec.template
-        assert [c.name for c in tmpl.cliques] == ["pca", "pcb", "pcc", "pcd"]
+        assert [c.name for c in tmpl.cliques] == ["frontend", "prefetch", "compute", "logger"]
         assert tmpl.cliques[0].spec.auto_scaling_config.max_replicas == 5
         assert tmpl.cliques[0].spec.pod_spec.containers[0].requests["cpu"] == (
             pytest.approx(0.01)
         )
         assert len(tmpl.pod_clique_scaling_group_configs) == 1
         sg = tmpl.pod_clique_scaling_group_configs[0]
-        assert sg.name == "sga" and sg.clique_names == ["pcb", "pcc"]
-        assert [c.name for c in tmpl.standalone_clique_templates()] == ["pca", "pcd"]
+        assert sg.name == "workers" and sg.clique_names == ["prefetch", "compute"]
+        assert [c.name for c in tmpl.standalone_clique_templates()] == ["frontend", "logger"]
 
 
 class TestHashing:

@@ -19,18 +19,18 @@ class TestHPA:
         harness = SimHarness(num_nodes=32)
         harness.apply(simple1())
         harness.converge()
-        # pca: 3 replicas, target 80% CPU; observe 160% → desired 6 → cap 5
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        # frontend: 3 replicas, target 80% CPU; observe 160% → desired 6 → cap 5
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 160.0)
         harness.converge()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         assert pclq.spec.replicas == 5  # maxReplicas cap
         pods = harness.store.list(
-            "Pod", "default", {"grove.io/podclique": "simple1-0-pca"}
+            "Pod", "default", {"grove.io/podclique": "simple1-0-frontend"}
         )
         assert len(pods) == 5 and all(is_ready(p) for p in pods)
         # the base gang's PodGroup follows the scaled clique
         gang = harness.store.get("PodGang", "default", "simple1-0")
-        group = next(g for g in gang.spec.pod_groups if g.name == "simple1-0-pca")
+        group = next(g for g in gang.spec.pod_groups if g.name == "simple1-0-frontend")
         assert len(group.pod_references) == 5
 
     def test_scaling_group_scale_up_creates_scaled_gangs(self):
@@ -38,42 +38,42 @@ class TestHPA:
         harness.apply(simple1())
         harness.converge()
         harness.metrics_provider.set(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga", 250.0
+            "PodCliqueScalingGroup", "default", "simple1-0-workers", 250.0
         )
         harness.converge()
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         # sustained high utilization walks the group to maxReplicas (6)
         assert pcsg.spec.replicas == 6
         gangs = {g.metadata.name for g in harness.store.list("PodGang")}
         # minAvailable=1 → base + 5 scaled gangs (0-based)
-        assert {f"simple1-0-sga-{i}" for i in range(5)} <= gangs
+        assert {f"simple1-0-workers-{i}" for i in range(5)} <= gangs
         assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
 
     def test_scale_down_waits_for_stabilization(self):
         harness = SimHarness(num_nodes=32)
         harness.apply(simple1())
         harness.converge()
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 160.0)
         harness.converge()
         assert (
-            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            harness.store.get("PodClique", "default", "simple1-0-frontend").spec.replicas
             == 5
         )
         # load drops; within the 60s stabilization window nothing shrinks
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 40.0)
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 40.0)
         harness.autoscaler.tick()
         assert (
-            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            harness.store.get("PodClique", "default", "simple1-0-frontend").spec.replicas
             == 5
         )
         harness.advance(61.0)
         harness.converge()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         assert pclq.spec.replicas == 3  # ceil(5*40/80)=3, floor minReplicas=3
         pods = harness.store.list(
-            "Pod", "default", {"grove.io/podclique": "simple1-0-pca"}
+            "Pod", "default", {"grove.io/podclique": "simple1-0-frontend"}
         )
         assert len(pods) == 3
 
@@ -81,10 +81,10 @@ class TestHPA:
         harness = SimHarness(num_nodes=32)
         harness.apply(simple1())
         harness.converge()
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 1.0)
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 1.0)
         harness.advance(61.0)
         harness.converge()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         # minReplicas defaulted to template replicas (3)
         assert pclq.spec.replicas == 3
 
@@ -93,14 +93,14 @@ class TestHPA:
         harness.apply(simple1())
         harness.converge()
         harness.metrics_provider.set(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga", 250.0
+            "PodCliqueScalingGroup", "default", "simple1-0-workers", 250.0
         )
         harness.converge()
-        assert "simple1-0-sga-1" in {
+        assert "simple1-0-workers-1" in {
             g.metadata.name for g in harness.store.list("PodGang")
         }
         harness.metrics_provider.set(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga", 10.0
+            "PodCliqueScalingGroup", "default", "simple1-0-workers", 10.0
         )
         harness.autoscaler.tick()  # records the scale-down candidate
         harness.advance(61.0)  # stabilization window elapses

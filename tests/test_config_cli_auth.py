@@ -62,7 +62,7 @@ solver: {chunkSize: 256, maxWaves: 8, priorityClasses: {critical: 100}}
 
 class TestAuthorizationGuard:
     def _managed_pod(self, harness):
-        return harness.store.get("Pod", "default", "simple1-0-pca-0")
+        return harness.store.get("Pod", "default", "simple1-0-frontend-0")
 
     def test_blocks_users_allows_operator(self):
         harness = SimHarness()
@@ -109,7 +109,7 @@ class TestAuthorizationWiring:
         assert all(is_ready(p) for p in harness.store.list("Pod"))
         with harness.store.as_user("dev-user"):
             with pytest.raises(GroveError, match="managed by the grove operator"):
-                harness.store.delete("Pod", "default", "simple1-0-pca-0")
+                harness.store.delete("Pod", "default", "simple1-0-frontend-0")
             # the user's own PCS stays editable
             pcs = harness.store.get("PodCliqueSet", "default", "simple1")
             pcs.spec.replicas = 1
@@ -121,10 +121,10 @@ class TestAuthorizationWiring:
         pcs.metadata.namespace = "prod"
         harness.apply(pcs)
         harness.converge()
-        harness.metrics_provider.set("PodClique", "prod", "simple1-0-pca", 160.0)
+        harness.metrics_provider.set("PodClique", "prod", "simple1-0-frontend", 160.0)
         harness.converge()
         assert (
-            harness.store.get("PodClique", "prod", "simple1-0-pca").spec.replicas
+            harness.store.get("PodClique", "prod", "simple1-0-frontend").spec.replicas
             == 5
         )
 
@@ -134,12 +134,12 @@ class TestAuthorizationWiring:
         harness = SimHarness(num_nodes=32)
         harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
         harness.converge()
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 160.0)
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 160.0)
         harness.converge()
-        harness.metrics_provider.set("PodClique", "default", "simple1-0-pca", 40.0)
+        harness.metrics_provider.set("PodClique", "default", "simple1-0-frontend", 40.0)
         harness.converge(max_ticks=200)
         assert (
-            harness.store.get("PodClique", "default", "simple1-0-pca").spec.replicas
+            harness.store.get("PodClique", "default", "simple1-0-frontend").spec.replicas
             == 3
         )
 
@@ -149,7 +149,7 @@ class TestCLI:
         from grove_tpu.cli import main
 
         rc = main(
-            ["tree", str(REPO / "samples" / "simple1.yaml"), "--scale", "sga"]
+            ["tree", str(REPO / "samples" / "simple1.yaml"), "--scale", "workers"]
         )
         assert rc == 2
         assert "GROUP=REPLICAS" in capsys.readouterr().err
@@ -221,7 +221,7 @@ spec:
             harness.store,
             "default",
             {
-                "podcliques": [{"pclq": "simple1-0-pca", "min_available": 3}],
+                "podcliques": [{"pclq": "simple1-0-frontend", "min_available": 3}],
                 "podgang": "simple1-0",
             },
         )
@@ -231,7 +231,7 @@ spec:
             harness.store,
             "default",
             {
-                "podcliques": [{"pclq": "simple1-0-pca", "min_available": 99}],
+                "podcliques": [{"pclq": "simple1-0-frontend", "min_available": 99}],
                 "podgang": "simple1-0",
             },
         )

@@ -39,13 +39,13 @@ class TestSimple1EndToEnd:
 
         pclqs = {p.metadata.name for p in harness.store.list("PodClique")}
         assert pclqs == {
-            "simple1-0-pca",
-            "simple1-0-pcd",
-            "simple1-0-sga-0-pcb",
-            "simple1-0-sga-0-pcc",
+            "simple1-0-frontend",
+            "simple1-0-logger",
+            "simple1-0-workers-0-prefetch",
+            "simple1-0-workers-0-compute",
         }
         pcsgs = [g.metadata.name for g in harness.store.list("PodCliqueScalingGroup")]
-        assert pcsgs == ["simple1-0-sga"]
+        assert pcsgs == ["simple1-0-workers"]
         gangs = [g.metadata.name for g in harness.store.list("PodGang")]
         assert gangs == ["simple1-0"]  # replicas=1 == minAvailable → base only
 
@@ -57,7 +57,7 @@ class TestSimple1EndToEnd:
         # infra children
         assert harness.store.get("Service", "default", "simple1-0") is not None
         hpas = {h.metadata.name for h in harness.store.list("HorizontalPodAutoscaler")}
-        assert hpas == {"simple1-0-pca", "simple1-0-sga"}
+        assert hpas == {"simple1-0-frontend", "simple1-0-workers"}
         assert harness.store.get("ServiceAccount", "default", "simple1") is not None
 
     def test_podgroups_shape(self, harness):
@@ -66,26 +66,26 @@ class TestSimple1EndToEnd:
         gang = harness.store.get("PodGang", "default", "simple1-0")
         groups = {g.name: g for g in gang.spec.pod_groups}
         assert set(groups) == {
-            "simple1-0-pca",
-            "simple1-0-pcd",
-            "simple1-0-sga-0-pcb",
-            "simple1-0-sga-0-pcc",
+            "simple1-0-frontend",
+            "simple1-0-logger",
+            "simple1-0-workers-0-prefetch",
+            "simple1-0-workers-0-compute",
         }
-        assert groups["simple1-0-pca"].min_replicas == 3  # defaulted to replicas
-        assert len(groups["simple1-0-pca"].pod_references) == 3
-        names = [r.name for r in groups["simple1-0-pca"].pod_references]
+        assert groups["simple1-0-frontend"].min_replicas == 3  # defaulted to replicas
+        assert len(groups["simple1-0-frontend"].pod_references) == 3
+        names = [r.name for r in groups["simple1-0-frontend"].pod_references]
         assert names == sorted(names)
 
     def test_pod_identity(self, harness):
         harness.apply(simple1())
         harness.converge()
-        pod = harness.store.get("Pod", "default", "simple1-0-pca-0")
-        assert pod.spec.hostname == "simple1-0-pca-0"
+        pod = harness.store.get("Pod", "default", "simple1-0-frontend-0")
+        assert pod.spec.hostname == "simple1-0-frontend-0"
         assert pod.spec.subdomain == "simple1-0"
         env = {e["name"]: e.get("value") for e in pod.spec.containers[0].env}
         assert env["GROVE_PCS_NAME"] == "simple1"
         assert env["GROVE_PCS_INDEX"] == "0"
-        assert env["GROVE_PCLQ_NAME"] == "simple1-0-pca"
+        assert env["GROVE_PCLQ_NAME"] == "simple1-0-frontend"
         assert env["GROVE_HEADLESS_SERVICE"] == "simple1-0.default.svc.cluster.local"
         assert env["GROVE_PCLQ_POD_INDEX"] == "0"
         assert pod.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
@@ -104,20 +104,20 @@ class TestScaledGangs:
         harness.apply(simple1())
         harness.converge()
         # HPA-style scale: PCSG replicas 1 -> 3 (minAvailable=1)
-        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-workers")
         pcsg.spec.replicas = 3
         harness.store.update(pcsg)
         harness.converge()
 
         gangs = {g.metadata.name for g in harness.store.list("PodGang")}
-        assert gangs == {"simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"}
-        scaled = harness.store.get("PodGang", "default", "simple1-0-sga-0")
+        assert gangs == {"simple1-0", "simple1-0-workers-0", "simple1-0-workers-1"}
+        scaled = harness.store.get("PodGang", "default", "simple1-0-workers-0")
         assert (
             scaled.metadata.labels[namegen.LABEL_BASE_PODGANG] == "simple1-0"
         )
         # scaled PCLQs carry the base-podgang label; base replicas don't
-        base_pclq = harness.store.get("PodClique", "default", "simple1-0-sga-0-pcb")
-        scaled_pclq = harness.store.get("PodClique", "default", "simple1-0-sga-1-pcb")
+        base_pclq = harness.store.get("PodClique", "default", "simple1-0-workers-0-prefetch")
+        scaled_pclq = harness.store.get("PodClique", "default", "simple1-0-workers-1-prefetch")
         assert namegen.LABEL_BASE_PODGANG not in base_pclq.metadata.labels
         assert (
             scaled_pclq.metadata.labels[namegen.LABEL_BASE_PODGANG] == "simple1-0"
@@ -130,18 +130,18 @@ class TestScaledGangs:
     def test_scale_in_removes_highest_replicas(self, harness):
         harness.apply(simple1())
         harness.converge()
-        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-workers")
         pcsg.spec.replicas = 3
         harness.store.update(pcsg)
         harness.converge()
-        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-sga")
+        pcsg = harness.store.get("PodCliqueScalingGroup", "default", "simple1-0-workers")
         pcsg.spec.replicas = 1
         harness.store.update(pcsg)
         harness.converge()
         pclqs = {p.metadata.name for p in harness.store.list("PodClique")}
-        assert "simple1-0-sga-2-pcb" not in pclqs
-        assert "simple1-0-sga-1-pcb" not in pclqs
-        assert "simple1-0-sga-0-pcb" in pclqs
+        assert "simple1-0-workers-2-prefetch" not in pclqs
+        assert "simple1-0-workers-1-prefetch" not in pclqs
+        assert "simple1-0-workers-0-prefetch" in pclqs
         gangs = {g.metadata.name for g in harness.store.list("PodGang")}
         assert gangs == {"simple1-0"}
 
@@ -185,8 +185,8 @@ class TestStartupOrdering:
         harness = SimHarness(num_nodes=32)
         pcs = simple1()
         pcs.spec.template.startup_type = STARTUP_EXPLICIT
-        # pcd starts after pca
-        pcs.spec.template.cliques[3].spec.starts_after = ["pca"]
+        # logger starts after frontend
+        pcs.spec.template.cliques[3].spec.starts_after = ["frontend"]
         harness.apply(pcs)
 
         # converge in fine steps, recording first-ready times
@@ -201,8 +201,8 @@ class TestStartupOrdering:
                     first_ready[pod.metadata.name] = harness.clock.now()
             harness.advance(1.0)
 
-        pca_times = [t for n, t in first_ready.items() if "-pca-" in n]
-        pcd_times = [t for n, t in first_ready.items() if "-pcd-" in n]
+        pca_times = [t for n, t in first_ready.items() if "-frontend-" in n]
+        pcd_times = [t for n, t in first_ready.items() if "-logger-" in n]
         assert pca_times and pcd_times
         assert max(pca_times) < min(pcd_times), first_ready
 
@@ -210,13 +210,13 @@ class TestStartupOrdering:
         harness = SimHarness()
         pcs = simple1()
         pcs.spec.template.startup_type = STARTUP_EXPLICIT
-        pcs.spec.template.cliques[3].spec.starts_after = ["pca"]
+        pcs.spec.template.cliques[3].spec.starts_after = ["frontend"]
         harness.apply(pcs)
         harness.converge()
-        pod = harness.store.get("Pod", "default", "simple1-0-pcd-0")
+        pod = harness.store.get("Pod", "default", "simple1-0-logger-0")
         cfg = pod.spec.extra["groveInitWaiter"]
         assert cfg["podcliques"] == [
-            {"pclq": "simple1-0-pca", "min_available": 3}
+            {"pclq": "simple1-0-frontend", "min_available": 3}
         ]
         assert cfg["podgang"] == "simple1-0"
 
@@ -228,11 +228,11 @@ class TestGangTermination:
         harness.apply(pcs)
         harness.converge()
 
-        # crash pcd below minAvailable (2 replicas, minAvailable=2)
-        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
-        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        # crash logger below minAvailable (2 replicas, minAvailable=2)
+        harness.cluster.fail_pod("default", "simple1-0-logger-0")
+        harness.cluster.fail_pod("default", "simple1-0-logger-1")
         harness.engine.drain()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-logger")
         cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
         assert cond is not None and cond.is_true()
         uid_before = pclq.metadata.uid
@@ -241,14 +241,14 @@ class TestGangTermination:
         harness.advance(300.0)
         harness.engine.drain()
         assert (
-            harness.store.get("PodClique", "default", "simple1-0-pcd").metadata.uid
+            harness.store.get("PodClique", "default", "simple1-0-logger").metadata.uid
             == uid_before
         )
 
         # past the delay: whole replica's PCLQs deleted and recreated
         harness.advance(301.0)
         harness.converge()
-        pclq_after = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        pclq_after = harness.store.get("PodClique", "default", "simple1-0-logger")
         assert pclq_after is not None and pclq_after.metadata.uid != uid_before
         assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
 
@@ -259,7 +259,7 @@ class TestGangTermination:
             n.cordoned = True
         harness.apply(simple1())
         harness.converge()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-logger")
         cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
         assert cond is not None and not cond.is_true()
         assert cond.reason == "InsufficientScheduledPods"
@@ -290,8 +290,8 @@ class TestAvailability:
         harness.apply(pcs)
         harness.converge()
         assert all(is_ready(p) for p in harness.store.list("Pod"))
-        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
-        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        harness.cluster.fail_pod("default", "simple1-0-logger-0")
+        harness.cluster.fail_pod("default", "simple1-0-logger-1")
         harness.engine.drain()
         harness.advance(61.0)
         harness.converge()

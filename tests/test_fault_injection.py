@@ -58,7 +58,7 @@ class TestFaultInjection:
             > errors_before
         )
         # the typed error is persisted on status (LastErrors parity)
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         assert pclq.status.last_errors
         assert pclq.status.last_errors[0]["code"] == "ERR_SYNC_PODS"
         # clearing the fault heals the system — the key sits in capped
@@ -68,7 +68,7 @@ class TestFaultInjection:
         harness.converge()
         assert len(harness.store.list("Pod")) == 9
         # errors clear once reconciles succeed again
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         assert pclq.status.last_errors == []
 
     def test_transient_status_update_failures_recover(self):

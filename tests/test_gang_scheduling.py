@@ -62,7 +62,7 @@ class TestAllOrNothing:
         scaled_pods = [
             p
             for p in harness.store.list("Pod")
-            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0-sga-0"
+            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0-workers-0"
         ]
         assert base_pods and all(is_ready(p) for p in base_pods), harness.tree()
         assert scaled_pods and all(not is_scheduled(p) for p in scaled_pods)
@@ -132,9 +132,9 @@ class TestMinReplicasSemantics:
         harness = SimHarness(num_nodes=1)
         harness.cluster.nodes[0].capacity = {"cpu": 0.05}  # 5 pods of 10m
         pcs = simple1()
-        # pca: 3 replicas but floor of 1; others floor = replicas (7 pods)
+        # frontend: 3 replicas but floor of 1; others floor = replicas (7 pods)
         pcs.spec.template.cliques[0].spec.min_available = 1
-        # shrink others so floor total fits: pcb/pcc/pcd 1 replica each
+        # shrink others so floor total fits: prefetch/compute/logger 1 replica each
         for clique in pcs.spec.template.cliques[1:]:
             clique.spec.replicas = 1
             clique.spec.min_available = 1
@@ -142,14 +142,14 @@ class TestMinReplicasSemantics:
         harness.converge()
         pods = harness.store.list("Pod")
         scheduled = [p for p in pods if is_scheduled(p)]
-        # 3 (pcb+pcc+pcd) + at least 1 pca, at most 5 total (capacity)
+        # 3 (prefetch+compute+logger) + at least 1 frontend, at most 5 total (capacity)
         assert len(scheduled) == 5, harness.tree()
         gang = harness.store.get("PodGang", "default", "simple1-0")
         assert gang.status.placement_score is not None  # admitted at the floor
         pca_pending = [
             p
             for p in pods
-            if "pca" in p.metadata.name and not is_scheduled(p)
+            if "frontend" in p.metadata.name and not is_scheduled(p)
         ]
         assert len(pca_pending) == 1  # best-effort extra waits for capacity
 
@@ -271,19 +271,19 @@ class TestGroupLevelConstraints:
                     "cloud.google.com/gke-tpu-ici-block"
                 ]
                 for p in harness.store.list(
-                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-frontend"}
                 )
                 if p.status.node_name
             }
 
         blocks_before = pca_blocks()
         assert len(blocks_before) == 1
-        # kill one pca pod; disable sticky reuse so the solver must decide
+        # kill one frontend pod; disable sticky reuse so the solver must decide
         harness.cluster.last_node.clear()
-        harness.store.delete("Pod", "default", "simple1-0-pca-0")
+        harness.store.delete("Pod", "default", "simple1-0-frontend-0")
         harness.converge()
         pods = harness.store.list(
-            "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+            "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-frontend"}
         )
         assert len(pods) == 3 and all(is_ready(p) for p in pods), harness.tree()
         assert pca_blocks() == blocks_before

@@ -36,23 +36,23 @@ class TestComputeExpectedPodGangs:
         by_name = {g.fqn: g for g in gangs}
         # worked example from syncflow.go:227-229: minAvailable=3 → replicas
         # 0,1,2 fold into the base; 3,4 become scaled gangs 0,1
-        assert set(by_name) == {"simple1-0", "simple1-0-sga-0", "simple1-0-sga-1"}
+        assert set(by_name) == {"simple1-0", "simple1-0-workers-0", "simple1-0-workers-1"}
         base = by_name["simple1-0"]
         base_pclqs = {p.fqn for p in base.pclqs}
         assert base_pclqs == {
-            "simple1-0-pca",
-            "simple1-0-pcd",
-            "simple1-0-sga-0-pcb",
-            "simple1-0-sga-0-pcc",
-            "simple1-0-sga-1-pcb",
-            "simple1-0-sga-1-pcc",
-            "simple1-0-sga-2-pcb",
-            "simple1-0-sga-2-pcc",
+            "simple1-0-frontend",
+            "simple1-0-logger",
+            "simple1-0-workers-0-prefetch",
+            "simple1-0-workers-0-compute",
+            "simple1-0-workers-1-prefetch",
+            "simple1-0-workers-1-compute",
+            "simple1-0-workers-2-prefetch",
+            "simple1-0-workers-2-compute",
         }
-        scaled = by_name["simple1-0-sga-0"]
+        scaled = by_name["simple1-0-workers-0"]
         assert {p.fqn for p in scaled.pclqs} == {
-            "simple1-0-sga-3-pcb",
-            "simple1-0-sga-3-pcc",
+            "simple1-0-workers-3-prefetch",
+            "simple1-0-workers-3-compute",
         }
         assert scaled.base_fqn == "simple1-0"
 
@@ -61,7 +61,7 @@ class TestComputeExpectedPodGangs:
         harness = setup_harness()
         harness.converge()
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         pcsg.spec.replicas = 4
         harness.store.update(pcsg)
@@ -71,26 +71,26 @@ class TestComputeExpectedPodGangs:
         names = {g.fqn for g in gangs}
         assert names == {
             "simple1-0",
-            "simple1-0-sga-0",
-            "simple1-0-sga-1",
-            "simple1-0-sga-2",
+            "simple1-0-workers-0",
+            "simple1-0-workers-1",
+            "simple1-0-workers-2",
         }
 
     def test_autoscaled_clique_uses_live_replicas(self):
         harness = setup_harness()
         harness.converge()
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pca")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         pclq.spec.replicas = 5  # HPA scaled the autoscaled clique
         harness.store.update(pclq)
         harness.engine.drain()
         pcs = harness.store.get("PodCliqueSet", "default", "simple1")
         gangs = compute_expected_podgangs(harness.ctx, pcs)
         base = next(g for g in gangs if g.fqn == "simple1-0")
-        pca = next(p for p in base.pclqs if p.fqn == "simple1-0-pca")
-        assert pca.replicas == 5
+        frontend = next(p for p in base.pclqs if p.fqn == "simple1-0-frontend")
+        assert frontend.replicas == 5
         # non-autoscaled cliques always follow the template
-        pcd = next(p for p in base.pclqs if p.fqn == "simple1-0-pcd")
-        assert pcd.replicas == 2
+        logger = next(p for p in base.pclqs if p.fqn == "simple1-0-logger")
+        assert logger.replicas == 2
 
     def test_gang_creation_deferred_until_pods_labeled(self):
         """syncflow.go:394-461: a gang pending creation is skipped while any
@@ -121,26 +121,26 @@ class TestComputeExpectedPodGangs:
             names = [r.name for r in group.pod_references]
             assert names == sorted(names)
         by_name = {g.name: g for g in gang.spec.pod_groups}
-        assert by_name["simple1-0-pca"].min_replicas == 3
-        assert by_name["simple1-0-sga-0-pcb"].min_replicas == 2
+        assert by_name["simple1-0-frontend"].min_replicas == 3
+        assert by_name["simple1-0-workers-0-prefetch"].min_replicas == 2
 
     def test_excess_gangs_deleted_on_scale_in(self):
         harness = setup_harness()
         harness.converge()
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         pcsg.spec.replicas = 3
         harness.store.update(pcsg)
         harness.converge()
         assert (
-            harness.store.get("PodGang", "default", "simple1-0-sga-1") is not None
+            harness.store.get("PodGang", "default", "simple1-0-workers-1") is not None
         )
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         pcsg.spec.replicas = 1
         harness.store.update(pcsg)
         harness.converge()
-        assert harness.store.get("PodGang", "default", "simple1-0-sga-0") is None
-        assert harness.store.get("PodGang", "default", "simple1-0-sga-1") is None
+        assert harness.store.get("PodGang", "default", "simple1-0-workers-0") is None
+        assert harness.store.get("PodGang", "default", "simple1-0-workers-1") is None

@@ -155,8 +155,8 @@ class TestGangHealth:
         gang = h.store.get("PodGang", "default", "simple1-0")
         cond = get_condition(gang.status.conditions, "Unhealthy")
         assert cond is not None and not cond.is_true()
-        h.cluster.fail_pod("default", "simple1-0-pcd-0")
-        h.cluster.fail_pod("default", "simple1-0-pcd-1")
+        h.cluster.fail_pod("default", "simple1-0-logger-0")
+        h.cluster.fail_pod("default", "simple1-0-logger-1")
         h.engine.drain()
         h.schedule()  # health refresh
         gang = h.store.get("PodGang", "default", "simple1-0")

@@ -57,12 +57,12 @@ class TestRollingUpdate:
         pcs = harness.store.get("PodCliqueSet", "default", "simple1")
         progress = pcs.status.rolling_update_progress
         assert progress.update_ended_at is not None
-        assert "simple1-0-sga" in progress.updated_pod_clique_scaling_groups
-        assert "simple1-0-pca" in progress.updated_pod_cliques
+        assert "simple1-0-workers" in progress.updated_pod_clique_scaling_groups
+        assert "simple1-0-frontend" in progress.updated_pod_cliques
         assert pcs.status.updated_replicas == 1
         # PCSG tracks its own progress bookkeeping
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         sg_progress = pcsg.status.rolling_update_progress
         assert sg_progress is not None
@@ -97,7 +97,7 @@ class TestRollingUpdate:
         (beyond the single in-flight replacement)."""
         harness = SimHarness(num_nodes=32)
         pcs = simple1()
-        # pca: 3 replicas, minAvailable defaults to 3 → set 2 to allow churn
+        # frontend: 3 replicas, minAvailable defaults to 3 → set 2 to allow churn
         pcs.spec.template.cliques[0].spec.min_available = 2
         harness.apply(pcs)
         harness.converge()
@@ -117,7 +117,7 @@ class TestRollingUpdate:
             ready = sum(
                 1
                 for p in harness.store.list(
-                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-pca"}
+                    "Pod", "default", {namegen.LABEL_PODCLIQUE: "simple1-0-frontend"}
                 )
                 if is_ready(p)
             )

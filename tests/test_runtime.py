@@ -186,8 +186,8 @@ class TestExpectations:
 
 class TestIndexer:
     def test_parse(self):
-        assert parse_index("pcs-0-pca", "pcs-0-pca-3") == 3
-        assert parse_index("pcs-0-pca", "pcs-0-pcb-3") == -1
+        assert parse_index("pcs-0-frontend", "pcs-0-frontend-3") == 3
+        assert parse_index("pcs-0-frontend", "pcs-0-prefetch-3") == -1
 
     def test_hole_filling(self):
         got = allocate_indices("c", ["c-0", "c-2", "c-5"], 3)

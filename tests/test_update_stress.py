@@ -24,20 +24,20 @@ class TestUpdateStress:
         harness.apply(pcs)
         harness.converge()
         pclq_uid = harness.store.get(
-            "PodClique", "default", "simple1-0-pcd"
+            "PodClique", "default", "simple1-0-logger"
         ).metadata.uid
 
         updated = with_image("busybox:v2")
         updated.spec.template.termination_delay = 10.0
         harness.apply(updated)
         harness.engine.drain()
-        # crash pcd mid-update and sit well past the termination delay
-        harness.cluster.fail_pod("default", "simple1-0-pcd-0")
-        harness.cluster.fail_pod("default", "simple1-0-pcd-1")
+        # crash logger mid-update and sit well past the termination delay
+        harness.cluster.fail_pod("default", "simple1-0-logger-0")
+        harness.cluster.fail_pod("default", "simple1-0-logger-1")
         assert converge_update(harness, max_rounds=240), harness.tree()
         harness.converge()
         # the PCLQ was updated in place, not gang-terminated (same uid)
-        pclq = harness.store.get("PodClique", "default", "simple1-0-pcd")
+        pclq = harness.store.get("PodClique", "default", "simple1-0-logger")
         assert pclq.metadata.uid == pclq_uid
         pods = harness.store.list("Pod")
         assert all(is_ready(p) for p in pods), harness.tree()
@@ -54,7 +54,7 @@ class TestUpdateStress:
         harness.engine.drain()
         # HPA scales the group out while the update runs
         pcsg = harness.store.get(
-            "PodCliqueScalingGroup", "default", "simple1-0-sga"
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
         )
         pcsg.spec.replicas = 3
         harness.store.update(pcsg)
