@@ -45,6 +45,7 @@ def _cmd_validate(args) -> int:
 
 
 def _cmd_apply(args) -> int:
+    _ensure_backend()
     from grove_tpu.sim.harness import SimHarness
 
     harness = SimHarness(num_nodes=args.nodes)
@@ -59,6 +60,7 @@ def _cmd_apply(args) -> int:
 
 
 def _cmd_tree(args) -> int:
+    _ensure_backend()
     from grove_tpu.sim.harness import SimHarness
 
     harness = SimHarness(num_nodes=args.nodes)
@@ -147,15 +149,18 @@ def _cmd_config_check(args) -> int:
     return 0
 
 
-def main(argv: List[str] | None = None) -> int:
-    # sim-backed commands run the placement solver; a wedged accelerator
-    # link must degrade to CPU instead of hanging the CLI
+def _ensure_backend() -> None:
+    """Sim-backed commands run the placement solver; a wedged accelerator
+    link must degrade to CPU instead of hanging the CLI. Lazy + memoized —
+    pure-CPU commands (validate/config-check/bench-subprocess) never pay."""
     from grove_tpu.utils.platform import ensure_healthy_backend
 
     note = ensure_healthy_backend(timeout_s=45.0)
     if note != "default":
         print(f"note: {note}", file=sys.stderr)
 
+
+def main(argv: List[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="grove-tpu", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
 

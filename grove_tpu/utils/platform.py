@@ -23,6 +23,7 @@ def probe_device_health(timeout_s: float = 60.0) -> bool:
     import time
 
     out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
+    out_path = out.name
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -36,16 +37,24 @@ def probe_device_health(timeout_s: float = 60.0) -> bool:
         cwd=pathlib.Path(__file__).resolve().parents[2],
         start_new_session=True,
     )
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
-        if proc.poll() is not None:
-            break
-        time.sleep(0.5)
-    else:
-        proc.kill()
-        return False
-    out.seek(0)
-    return proc.returncode == 0 and "OK" in out.read()
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            return False  # abandoned child may still hold the temp file
+        out.seek(0)
+        return proc.returncode == 0 and "OK" in out.read()
+    finally:
+        out.close()
+        if proc.poll() is not None:  # only unlink when the child is gone
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
 
 
 def force_cpu_platform() -> None:
@@ -59,13 +68,30 @@ def force_cpu_platform() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+_backend_note: Optional[str] = None
+
+
 def ensure_healthy_backend(timeout_s: float = 60.0) -> str:
     """Probe the default accelerator; fall back to CPU when wedged.
-    Returns a human-readable backend note."""
-    if probe_device_health(timeout_s):
-        return "default"
-    force_cpu_platform()
-    return "cpu-fallback (accelerator probe failed)"
+    Memoized per process (one subprocess probe). Returns a backend note."""
+    global _backend_note
+    if _backend_note is None:
+        import sys
+
+        # already initialized on CPU in this process (e.g. the test
+        # harness pinned it): nothing to probe
+        if "jax" in sys.modules:
+            import jax
+
+            if jax.config.jax_platforms == "cpu":
+                _backend_note = "default"
+                return _backend_note
+        if probe_device_health(timeout_s):
+            _backend_note = "default"
+        else:
+            force_cpu_platform()
+            _backend_note = "cpu-fallback (accelerator probe failed)"
+    return _backend_note
 
 
 def cpu_subprocess_env(n_devices: Optional[int] = None) -> Dict[str, str]:
