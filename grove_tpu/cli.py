@@ -102,6 +102,7 @@ def _cmd_tree(args) -> int:
 
 
 def _cmd_get(args) -> int:
+    _ensure_backend()
     import yaml
 
     from grove_tpu.api.serialize import export_object

@@ -17,6 +17,13 @@ def main() -> None:
     parser.add_argument("--nodes", type=int, default=32)
     args = parser.parse_args()
 
+    # degrade to CPU when the accelerator link is wedged (memoized probe)
+    from grove_tpu.utils.platform import ensure_healthy_backend
+
+    note = ensure_healthy_backend(timeout_s=45.0)
+    if note != "default":
+        print(f"note: {note}")
+
     harness = SimHarness(num_nodes=args.nodes)
     with open(args.manifest) as f:
         applied = harness.apply_yaml(f.read())
