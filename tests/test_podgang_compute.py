@@ -22,6 +22,81 @@ def setup_harness(mutate=None):
     return harness
 
 
+import pytest
+
+
+class TestScaleTransitionTable:
+    """Verbatim port of the reference's scale-transition table
+    (podgang/syncflow_test.go:40-95) — base/scaled names across scale
+    transitions with varying minAvailable."""
+
+    @pytest.mark.parametrize(
+        "min_available,initial,scaled,expected_scaled",
+        [
+            # Scale up from 2 to 4 with minAvailable=1
+            (1, 2, 4, ["-0", "-1", "-2"]),
+            # Scale up from 3 to 6 with minAvailable=2
+            (2, 3, 6, ["-0", "-1", "-2", "-3"]),
+            # Scale down from 5 to 3 with minAvailable=1
+            (1, 5, 3, ["-0", "-1"]),
+            # Scale to exactly minAvailable
+            (2, 4, 2, []),
+        ],
+    )
+    def test_transition(self, min_available, initial, scaled, expected_scaled):
+        def mutate(pcs):
+            sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+            sg.min_available = min_available
+            sg.replicas = initial
+
+        harness = setup_harness(mutate)
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
+        )
+        pcsg.spec.replicas = scaled
+        harness.store.update(pcsg)
+        harness.engine.drain()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        gangs = compute_expected_podgangs(harness.ctx, pcs)
+        names = sorted(g.fqn for g in gangs)
+        want = sorted(
+            ["simple1-0"]
+            + [f"simple1-0-workers{suffix}" for suffix in expected_scaled]
+        )
+        assert names == want
+        # base always folds exactly minAvailable scaling-group replicas
+        base = next(g for g in gangs if g.fqn == "simple1-0")
+        sg_members = [p.fqn for p in base.pclqs if "-workers-" in p.fqn]
+        got_replicas = {fqn.split("-workers-")[1].split("-")[0] for fqn in sg_members}
+        assert got_replicas == {str(i) for i in range(min_available)}
+
+
+class TestPCSGStartupTable:
+    """Port of the PCSG-startup table (syncflow_test.go:200-230): expected
+    gangs straight from template configs at first materialization."""
+
+    @pytest.mark.parametrize(
+        "replicas,min_available,expected_scaled_count",
+        [(2, 1, 1), (3, 1, 2), (3, 2, 1)],
+    )
+    def test_startup(self, replicas, min_available, expected_scaled_count):
+        def mutate(pcs):
+            sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+            sg.replicas = replicas
+            sg.min_available = min_available
+
+        harness = setup_harness(mutate)
+        harness.engine.drain()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        gangs = compute_expected_podgangs(harness.ctx, pcs)
+        scaled = [g for g in gangs if not g.base]
+        assert len(scaled) == expected_scaled_count
+        assert [g.fqn for g in scaled] == [
+            f"simple1-0-workers-{i}" for i in range(expected_scaled_count)
+        ]
+
+
 class TestComputeExpectedPodGangs:
     def test_base_contains_standalone_and_min_available_sg_replicas(self):
         def mutate(pcs):
