@@ -78,10 +78,10 @@ class SimCluster:
 
     # -- scheduler (simple binder; TPU solver slots in here) -------------
 
-    def schedule_pending(self, namespace: str = "default") -> int:
-        """Bind every ungated, unscheduled pod to the first node that fits
-        (placeholder first-fit; the solver-backed gang scheduler replaces
-        this for topology-aware all-or-nothing placement)."""
+    def schedule_pending(self, namespace: Optional[str] = None) -> int:
+        """Bind every ungated, unscheduled pod (all namespaces by default)
+        to the first node that fits (placeholder first-fit; the solver-backed
+        gang scheduler replaces this for topology-aware placement)."""
         bound = 0
         self._gc_bindings()
         for pod in self.store.list("Pod", namespace):
@@ -116,9 +116,10 @@ class SimCluster:
 
     # -- kubelet ---------------------------------------------------------
 
-    def kubelet_tick(self, namespace: str = "default") -> int:
-        """Advance scheduled pods toward Ready: run the init waiter, then
-        start containers and flip Ready. Returns pods transitioned."""
+    def kubelet_tick(self, namespace: Optional[str] = None) -> int:
+        """Advance scheduled pods (all namespaces by default) toward Ready:
+        run the init waiter, then start containers and flip Ready. Returns
+        pods transitioned."""
         progressed = 0
         # Two-phase: decide against the tick-start state, then apply — so a
         # dependent pod never starts in the same tick its parent became Ready
@@ -130,7 +131,9 @@ class SimCluster:
                 continue
             waiter_cfg = pod.spec.extra.get("groveInitWaiter")
             if waiter_cfg and not pod.status.init_waiter_done:
-                if not is_ready_to_start(self.store, namespace, waiter_cfg):
+                if not is_ready_to_start(
+                    self.store, pod.metadata.namespace, waiter_cfg
+                ):
                     continue
                 pod.status.init_waiter_done = True
             to_start.append(pod)

@@ -53,7 +53,19 @@ class GangScheduler:
 
     # -- main loop -------------------------------------------------------
 
-    def schedule_pending(self, namespace: str = "default") -> int:
+    def schedule_pending(self, namespace: Optional[str] = None) -> int:
+        """Schedule pending work. namespace=None (default) covers EVERY
+        namespace with pending pods — a gang in a non-default namespace must
+        never silently pend forever."""
+        if namespace is None:
+            # every namespace with pending pods OR existing gangs: gang
+            # phase/health maintenance must keep running after everything is
+            # scheduled (Starting → Running, Unhealthy upkeep)
+            namespaces = sorted(
+                {p.metadata.namespace for p in self._pending_pods(None)}
+                | {g.metadata.namespace for g in self.store.list("PodGang")}
+            ) or ["default"]
+            return sum(self.schedule_pending(ns) for ns in namespaces)
         self.cluster._gc_bindings()
         self.update_gang_phases(namespace)
         self.update_gang_health(namespace)
@@ -191,7 +203,7 @@ class GangScheduler:
 
     # -- helpers ---------------------------------------------------------
 
-    def _pending_pods(self, namespace: str) -> List:
+    def _pending_pods(self, namespace: Optional[str]) -> List:
         return [
             p
             for p in self.store.list("Pod", namespace)

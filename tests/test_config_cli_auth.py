@@ -121,12 +121,23 @@ class TestAuthorizationWiring:
         pcs.metadata.namespace = "prod"
         harness.apply(pcs)
         harness.converge()
+        # scheduling covers non-default namespaces (pods actually run)
+        pods = harness.store.list("Pod", "prod")
+        assert pods and all(is_ready(p) for p in pods), harness.tree("prod")
         harness.metrics_provider.set("PodClique", "prod", "simple1-0-frontend", 160.0)
         harness.converge()
         assert (
             harness.store.get("PodClique", "prod", "simple1-0-frontend").spec.replicas
             == 5
         )
+        pods = harness.store.list(
+            "Pod", "prod", {"grove.io/podclique": "simple1-0-frontend"}
+        )
+        assert len(pods) == 5 and all(is_ready(p) for p in pods)
+        # gang lifecycle maintenance also covers the namespace: the gang
+        # flips Starting → Running once everything is ready
+        gang = harness.store.get("PodGang", "prod", "simple1-0")
+        assert gang.status.phase == "Running"
 
     def test_converge_drives_pending_scale_down(self):
         """converge() alone must fire held scale-downs (stabilization
