@@ -57,6 +57,12 @@ class GangInputs(NamedTuple):
     # replacements must rejoin the domain where the group's surviving pods
     # already live instead of re-choosing by free capacity
     group_pin: jnp.ndarray = None  # [P]
+    # pinned domain id for the WHOLE gang at req_level (-1 none): a gang
+    # with a gang-level required pack whose surviving pods already occupy a
+    # domain must place its replacements in that same domain — otherwise a
+    # recovery delta-solve could split the live gang across two domains in
+    # violation of TopologyPackConstraint.Required
+    gang_pin: jnp.ndarray = None  # scalar
 
 
 def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
@@ -193,6 +199,19 @@ def _level_weights(num_levels: int) -> jnp.ndarray:
     return w / w.sum()
 
 
+def _gang_pin_mask(free: jnp.ndarray, topo: jnp.ndarray, gang: GangInputs):
+    """Node mask confining a pinned gang to its surviving pods' domain at
+    req_level (all-true when unpinned), plus the capacity view with
+    out-of-domain nodes zeroed so aggregate feasibility and domain selection
+    never look outside the pin."""
+    pin = gang.gang_pin if gang.gang_pin is not None else jnp.int32(-1)
+    pin_on = (pin >= 0) & (gang.req_level >= 0)
+    rq = jnp.maximum(gang.req_level, 0)
+    pin_mask = jnp.where(pin_on, jnp.take(topo, rq, axis=1) == pin, True)
+    free_vis = jnp.where(pin_mask[:, None], free, 0.0)
+    return pin_mask, free_vis
+
+
 def _aggregate_tables(free: jnp.ndarray, gang: GangInputs):
     """Shared prelude of both per-gang selectors: capped per-node fit counts,
     prefix-sum tables for boundary gathers, float-cumsum tolerance, and the
@@ -261,7 +280,10 @@ def gang_select_and_fill(
     n_nodes, n_levels = topo.shape
     weights = _level_weights(n_levels)
 
-    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(free, gang)
+    pin_mask, free_vis = _gang_pin_mask(free, topo, gang)
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(
+        free_vis, gang
+    )
     any_active = jnp.any(active)
     all_nodes = jnp.ones((n_nodes,), dtype=bool)
     no_nodes = jnp.zeros((n_nodes,), dtype=bool)
@@ -306,7 +328,7 @@ def gang_select_and_fill(
     cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
     for l in range(n_levels):
         ok_l, best_l = level_candidate(l)
-        mask_l = jnp.where(ok_l, topo[:, l] == best_l, no_nodes)
+        mask_l = jnp.where(ok_l, (topo[:, l] == best_l) & pin_mask, no_nodes)
         alloc_l, placed_l, placed_min_l, free_l = _fill_dispatch(
             grouped, free, mask_l, gang.demand, gang.count, gang.min_count,
             gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
@@ -395,6 +417,7 @@ def solve_packing(
     pref_level: jnp.ndarray,  # [G] int32 (-1 → narrowest)
     group_req: jnp.ndarray = None,  # [G, P] int32 (-1 none)
     group_pin: jnp.ndarray = None,  # [G, P] int32 (-1 none)
+    gang_pin: jnp.ndarray = None,  # [G] int32 (-1 none)
     with_alloc: bool = True,
     grouped: bool = False,
 ):
@@ -403,6 +426,8 @@ def solve_packing(
         group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
     if group_pin is None:
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
 
     def gang_step(free, gang: GangInputs):
         free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
@@ -421,6 +446,7 @@ def solve_packing(
         pref_level=pref_level,
         group_req=group_req,
         group_pin=group_pin,
+        gang_pin=gang_pin,
     )
     free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
     if with_alloc:
@@ -454,6 +480,7 @@ def solve_wave_chunk(
     seeds: jnp.ndarray,  # [C] int32
     group_req: jnp.ndarray = None,  # [C, P]
     group_pin: jnp.ndarray = None,  # [C, P]
+    gang_pin: jnp.ndarray = None,  # [C]
     commit_iters: int = 2,
     grouped: bool = False,
 ):
@@ -463,6 +490,8 @@ def solve_wave_chunk(
         group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
     if group_pin is None:
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
     free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
         wave_chunk_core(
             free,
@@ -479,6 +508,7 @@ def solve_wave_chunk(
             seeds,
             group_req,
             group_pin,
+            gang_pin,
             commit_iters,
             grouped,
         )
@@ -506,7 +536,7 @@ def solve_wave_chunk(
 
 def wave_chunk_core(
     free, topo, seg_starts, seg_ends,
-    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, commit_iters,
+    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin, commit_iters,
     grouped=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
@@ -516,7 +546,7 @@ def wave_chunk_core(
     Returns (free, accept, placed, score, chosen, retry, new_cap,
     fill_failed, alloc)."""
     cnt = cnt * pend[:, None]
-    inputs = GangInputs(dem, cnt, mn, rq, pf, grq, gpin)
+    inputs = GangInputs(dem, cnt, mn, rq, pf, grq, gpin, gangpin)
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
         lambda *xs: gang_select_single(*xs, grouped=grouped),
         in_axes=(None, None, None, None, 0, 0, 0),
@@ -573,7 +603,10 @@ def gang_select_single(
     n_nodes, n_levels = topo.shape
     weights = _level_weights(n_levels)
 
-    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(free, gang)
+    pin_mask, free_vis = _gang_pin_mask(free, topo, gang)
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(
+        free_vis, gang
+    )
     any_active = jnp.any(active)
 
     oks, bests = [], []
@@ -629,7 +662,7 @@ def gang_select_single(
 
     all_nodes = jnp.ones((n_nodes,), dtype=bool)
     no_nodes = jnp.zeros((n_nodes,), dtype=bool)
-    packed_mask = topo[:, chosen_level] == bests[chosen_level]
+    packed_mask = (topo[:, chosen_level] == bests[chosen_level]) & pin_mask
     mask = jnp.where(
         has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
     )
@@ -714,6 +747,8 @@ def solve_waves_device(
     req_level,  # [G]
     pref_level,  # [G]
     group_req=None,  # [G, P]
+    group_pin=None,  # [G, P]
+    gang_pin=None,  # [G]
     n_chunks: int = 20,
     max_waves: int = 8,
     commit_iters: int = 2,
@@ -736,6 +771,10 @@ def solve_waves_device(
     n_nodes, n_levels = topo.shape
     if group_req is None:
         group_req = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full((g_total,), -1, dtype=jnp.int32)
     c = g_total // n_chunks
 
     def reshape_chunks(a):
@@ -757,7 +796,7 @@ def solve_waves_device(
     def chunk_step(free, xs):
         # settled chunks skip the whole decision+commit (lax.cond executes
         # one branch): waves after the first mostly touch a few chunks
-        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin = xs
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
         c_gangs = dem.shape[0]
 
         def passthrough(free):
@@ -776,11 +815,11 @@ def solve_waves_device(
         )
 
     def _active_chunk_step(free, xs):
-        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin = xs
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
         free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
             wave_chunk_core(
                 free, topo, seg_starts, seg_ends,
-                dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin,
+                dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
                 commit_iters, grouped,
             )
         )
@@ -807,7 +846,8 @@ def solve_waves_device(
                 reshape_chunks(state["narrow_cap"]),
                 seeds_c,
                 reshape_chunks(group_req),
-                reshape_chunks(jnp.full_like(group_req, -1)),
+                reshape_chunks(group_pin),
+                reshape_chunks(gang_pin),
             ),
         )
         accept, placed, score, chosen, retry, new_cap, fill_failed = (

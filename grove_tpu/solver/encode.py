@@ -249,6 +249,7 @@ def build_problem(
     # recovery pins: a constrained group with surviving pods must rejoin
     # their domain — map the pinned node to its domain id at the group level
     group_pin = np.full_like(group_req, -1)
+    gang_pin = np.full_like(req_level, -1)
     node_index = {name: i for i, name in enumerate(node_names)}
     for gi, spec in enumerate(gang_specs):
         for pi, grp in enumerate(spec["groups"]):
@@ -256,6 +257,12 @@ def build_problem(
             lvl = group_req[gi, pi]
             if pin_node is not None and lvl >= 0 and pin_node in node_index:
                 group_pin[gi, pi] = topo[node_index[pin_node], lvl]
+        # gang-level recovery pin: survivors of a gang with a gang-level
+        # required pack anchor the whole delta-solve to their domain
+        gpin_node = spec.get("gang_pinned_node")
+        glvl = req_level[gi]
+        if gpin_node is not None and glvl >= 0 and gpin_node in node_index:
+            gang_pin[gi] = topo[node_index[gpin_node], glvl]
 
     return PackingProblem(
         capacity=capacity,
@@ -264,6 +271,7 @@ def build_problem(
         seg_ends=seg_ends,
         group_req=group_req,
         group_pin=group_pin,
+        gang_pin=gang_pin,
         demand=demand,
         count=count,
         min_count=min_count,

@@ -51,6 +51,7 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         jnp.asarray(problem.pref_level),
         jnp.asarray(problem.group_req),
         jnp.asarray(problem.group_pin),
+        jnp.asarray(problem.gang_pin),
     )
     grouped = bool((problem.group_req >= 0).any())
     compiled = _get_compiled(args, with_alloc, grouped)
@@ -103,6 +104,7 @@ def solve_waves(
     pref_level = pad(problem.pref_level, -1)
     group_req = pad(problem.group_req, -1)
     group_pin = pad(problem.group_pin, -1)
+    gang_pin = pad(problem.gang_pin, -1)
 
     free = jnp.asarray(problem.capacity)
     topo = jnp.asarray(problem.topo)
@@ -135,6 +137,7 @@ def solve_waves(
         + (
             jnp.asarray(group_req[c * chunk_size : (c + 1) * chunk_size]),
             jnp.asarray(group_pin[c * chunk_size : (c + 1) * chunk_size]),
+            jnp.asarray(gang_pin[c * chunk_size : (c + 1) * chunk_size]),
         )
         for c in range(n_chunks)
     ]
@@ -152,7 +155,9 @@ def solve_waves(
             mask = pending[sl]
             if not mask.any():
                 continue
-            dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c = chunk_const[c]
+            dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c = (
+                chunk_const[c]
+            )
             out = solve_wave_chunk(
                 free,
                 topo,
@@ -168,6 +173,7 @@ def solve_waves(
                 jnp.asarray(seeds[sl]),
                 group_req=grq_c,
                 group_pin=gpin_c,
+                gang_pin=gangpin_c,
                 grouped=grouped,
             )
             committed = np.asarray(out["admitted"])
@@ -235,6 +241,8 @@ def solve_waves_stats(
         jnp.asarray(pad(problem.req_level, -1)),
         jnp.asarray(pad(problem.pref_level, -1)),
         jnp.asarray(pad(problem.group_req, -1)),
+        jnp.asarray(pad(problem.group_pin, -1)),
+        jnp.asarray(pad(problem.gang_pin, -1)),
     )
     grouped = bool((problem.group_req >= 0).any())
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
@@ -287,6 +295,7 @@ def solve_waves_stats(
             pref_level=tpad(problem.pref_level, -1),
             group_req=tpad(problem.group_req, -1),
             group_pin=tpad(problem.group_pin, -1),
+            gang_pin=tpad(problem.gang_pin, -1),
             priority=tpad(problem.priority),
             seg_starts=problem.seg_starts,
             seg_ends=problem.seg_ends,

@@ -121,6 +121,15 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         if not active.any():
             continue
         req = int(problem.req_level[g])
+        gang_pin = int(problem.gang_pin[g]) if problem.gang_pin is not None else -1
+
+        # gang-level recovery pin (kernel parity): confine aggregates and
+        # fills to the survivors' domain at the required level
+        if gang_pin >= 0 and req >= 0:
+            pin_mask = topo[:, req] == gang_pin
+        else:
+            pin_mask = np.ones((N,), dtype=bool)
+        cap_vis = np.where(pin_mask[:, None], cap, 0.0)
 
         # per-level candidate domain (joint-aware aggregate feasibility,
         # best-fit tie-break), attempted in preference order; the fill is the
@@ -129,7 +138,7 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         # count, contiguous-domain boundary gathers on prefix sums, float32
         # capacity prefix sums with the same tolerance slack.
         k_all = np.stack(
-            [np.minimum(_pods_fit(cap, demand[p]), count[p]) for p in range(P)]
+            [np.minimum(_pods_fit(cap_vis, demand[p]), count[p]) for p in range(P)]
         )
         cs_k = np.concatenate(
             [np.zeros((P, 1), dtype=np.int64), np.cumsum(k_all, axis=1)], axis=1
@@ -137,7 +146,7 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
         cs_free = np.concatenate(
             [
                 np.zeros((1, R), dtype=np.float32),
-                np.cumsum(cap.astype(np.float32), axis=0, dtype=np.float32),
+                np.cumsum(cap_vis.astype(np.float32), axis=0, dtype=np.float32),
             ],
             axis=0,
         )
@@ -174,7 +183,7 @@ def solve_oracle(problem: PackingProblem) -> PackingResult:
             tie = (free_total / (free_total.max() + 1.0)).astype(np.float32)
             key = spare.astype(np.float32) + tie
             key[~feas] = np.inf
-            mask = topo[:, l] == int(np.argmin(key))
+            mask = (topo[:, l] == int(np.argmin(key))) & pin_mask
             a, pl, pl_min, fa = _fill_grouped(
                 cap, mask, demand, count, min_count, group_req, group_pin,
                 topo, problem.seg_starts, problem.seg_ends,

@@ -55,8 +55,10 @@ class GangScheduler:
 
     def schedule_pending(self, namespace: Optional[str] = None) -> int:
         """Schedule pending work. namespace=None (default) covers EVERY
-        namespace with pending pods — a gang in a non-default namespace must
-        never silently pend forever."""
+        namespace with pending pods in ONE priority-ordered global solve —
+        nodes are shared cluster-wide, so per-namespace rounds would let a
+        low-priority gang in an alphabetically-earlier namespace take
+        capacity a high-priority gang elsewhere needs (priority inversion)."""
         if namespace is None:
             # every namespace with pending pods OR existing gangs: gang
             # phase/health maintenance must keep running after everything is
@@ -65,19 +67,33 @@ class GangScheduler:
                 {p.metadata.namespace for p in self._pending_pods(None)}
                 | {g.metadata.namespace for g in self.store.list("PodGang")}
             ) or ["default"]
-            return sum(self.schedule_pending(ns) for ns in namespaces)
+        else:
+            namespaces = [namespace]
         self.cluster._gc_bindings()
-        self.update_gang_phases(namespace)
-        self.update_gang_health(namespace)
-        pending = self._pending_pods(namespace)
-        if not pending:
-            return 0
-        sticky_bound, pending = self._bind_with_reused_reservations(
-            namespace, pending
+        sticky_bound = 0
+        gang_specs: List[dict] = []
+        gang_pods: Dict[str, Dict[str, List]] = {}
+        loose_pods: List = []  # (namespace, pod)
+        for ns in namespaces:
+            self.update_gang_phases(ns)
+            self.update_gang_health(ns)
+            pending = self._pending_pods(ns)
+            if not pending:
+                continue
+            sticky, pending = self._bind_with_reused_reservations(ns, pending)
+            sticky_bound += sticky
+            specs, pods, loose = self._encode_pending(ns, pending)
+            gang_specs.extend(specs)
+            gang_pods.update(pods)
+            loose_pods.extend((ns, p) for p in loose)
+
+        # global priority order across all namespaces (kernel admits in
+        # input order; ties broken by name for determinism)
+        order = sorted(
+            range(len(gang_specs)),
+            key=lambda i: (-gang_specs[i]["priority"], gang_specs[i]["name"]),
         )
-        if not pending:
-            return sticky_bound
-        gang_specs, gang_pods, loose_pods = self._encode_pending(namespace, pending)
+        gang_specs = [gang_specs[i] for i in order]
 
         bound = 0
         if gang_specs:
@@ -100,16 +116,18 @@ class GangScheduler:
                     max_waves=self.max_waves,
                 )
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
-                preempted = self._maybe_preempt(namespace, gang_specs, result)
+                preempted = self._maybe_preempt(gang_specs, result)
                 assignments = result.assignments(problem)
                 for gi, spec in enumerate(gang_specs):
-                    gang_name = spec["name"]
-                    if not result.admitted[gi] or gang_name in preempted:
+                    ns = spec["namespace"]
+                    if not result.admitted[gi] or (
+                        (ns, spec["gang_name"]) in preempted
+                    ):
                         # a victim's stale admission from this solve must not
                         # overwrite its Preempted status (its pods are gone)
                         continue
-                    for pclq_fqn, node_names in assignments[gang_name].items():
-                        pods = gang_pods[gang_name].get(pclq_fqn, [])
+                    for pclq_fqn, node_names in assignments[spec["name"]].items():
+                        pods = gang_pods[spec["name"]].get(pclq_fqn, [])
                         for pod, node_name in zip(pods, node_names):
                             self.cluster.bind(pod, node_name)
                             bound += 1
@@ -118,13 +136,13 @@ class GangScheduler:
                     # nothing about the whole gang — keep the original.
                     partial = any(g["partial"] for g in spec["groups"])
                     self._mark_scheduled(
-                        namespace,
-                        gang_name,
+                        ns,
+                        spec["gang_name"],
                         None if partial else float(result.score[gi]),
                     )
 
         # pods not in any gang (shouldn't happen for grove pods): first-fit
-        for pod in loose_pods:
+        for _ns, pod in loose_pods:
             for node in self.cluster.nodes:
                 if not node.cordoned and self.cluster.fits(node, pod):
                     self.cluster.bind(pod, node.name)
@@ -294,25 +312,42 @@ class GangScheduler:
                 required_key = tc.pack_constraint.required
                 preferred_key = tc.pack_constraint.preferred
             required_key = self._narrower_key(required_key, collective_req)
+            # gang-level recovery pin: a gang-level required pack (template
+            # constraint or collective PCSG fold) with surviving pods must
+            # anchor its replacements to the survivors' domain, or the live
+            # gang could end up spanning two required-level domains
+            gang_pinned_node = None
+            if required_key is not None and any(g["partial"] for g in groups):
+                # scan ALL groups for a survivor on a live node before
+                # settling for a cordoned fallback (the encoder drops pins
+                # resolved to nodes outside the solve's node set)
+                cordoned = {n.name for n in self.cluster.nodes if n.cordoned}
+                for grp in groups:
+                    node = self._any_bound_node(namespace, grp["name"])
+                    if node is None:
+                        continue
+                    if node not in cordoned:
+                        gang_pinned_node = node
+                        break
+                    gang_pinned_node = gang_pinned_node or node
             gang_specs.append(
                 {
-                    "name": gang_name,
+                    # globally-unique solver key (gangs from different
+                    # namespaces meet in one solve); the bare CR name stays
+                    # in gang_name
+                    "name": f"{namespace}/{gang_name}",
+                    "gang_name": gang_name,
+                    "namespace": namespace,
                     "groups": groups,
                     "required_key": required_key,
                     "preferred_key": preferred_key,
+                    "gang_pinned_node": gang_pinned_node,
                     "priority": self.priority_map.get(
                         gang_cr.spec.priority_class_name, 0
                     ),
                 }
             )
-            gang_pods[gang_name] = dict(by_pclq)
-
-        # higher priority commits first (kernel admits in input order)
-        order = sorted(
-            range(len(gang_specs)),
-            key=lambda i: (-gang_specs[i]["priority"], gang_specs[i]["name"]),
-        )
-        gang_specs = [gang_specs[i] for i in order]
+            gang_pods[f"{namespace}/{gang_name}"] = dict(by_pclq)
         return gang_specs, gang_pods, loose
 
     def _narrower_key(self, a: Optional[str], b: Optional[str]) -> Optional[str]:
@@ -326,13 +361,21 @@ class GangScheduler:
         return a if order.get(a, -1) >= order.get(b, -1) else b
 
     def _any_bound_node(self, namespace: str, pclq_fqn: str) -> Optional[str]:
+        """A node hosting a bound pod of the clique — preferring non-cordoned
+        nodes (cordoned nodes are excluded from the solve's node set, so a
+        pin resolved to one would be silently dropped by the encoder)."""
+        cordoned = {n.name for n in self.cluster.nodes if n.cordoned}
+        fallback = None
         for p in self.store.list(
             "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
         ):
             node = self.cluster.bindings.get((namespace, p.metadata.name))
-            if node is not None:
+            if node is None:
+                continue
+            if node not in cordoned:
                 return node
-        return None
+            fallback = fallback or node
+        return fallback
 
     def _scheduled_count(self, namespace: str, pclq_fqn: str) -> int:
         return sum(
@@ -382,46 +425,86 @@ class GangScheduler:
 
     # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----
 
-    def _maybe_preempt(self, namespace: str, gang_specs, result) -> set:
-        """A higher-priority pending gang that the solver could not admit may
+    def _maybe_preempt(self, gang_specs, result) -> set:
+        """Higher-priority pending gangs that the solver could not admit may
         evict strictly-lower-priority scheduled gangs: victims get the
         DisruptionTarget condition (scheduler podgang.go:157-165) and their
-        pods are deleted; the controllers recreate them gated and the gang
-        re-queues, while the preemptor is admitted in the next round against
-        the freed capacity. Returns the victim gang names.
+        pods are deleted; the controllers recreate them gated and the gangs
+        re-queue, while each preemptor is admitted in a later round against
+        the freed capacity. Returns victim (namespace, gang_name) keys.
+
+        Victims are searched across ALL namespaces — nodes are shared
+        cluster-wide, so a high-priority gang must never pend behind
+        lower-priority gangs that happen to live elsewhere. Multiple
+        preemptors are processed per round, highest priority first; each
+        preemptor's trial counts only its OWN victims' freed capacity (no
+        double-spending another preemptor's evictions).
 
         Thrash guards: only BOUND victim pods count as freeable capacity, and
-        the eviction only proceeds when a TRIAL SOLVE of the preemptor
-        against the hypothetically-freed cluster admits it (a topologically
+        an eviction only proceeds when a TRIAL SOLVE of the preemptor against
+        the hypothetically-freed cluster admits it (a topologically
         infeasible preemptor — e.g. a required pack no single domain can ever
-        satisfy — must never cost victims their placement)."""
-        rejected = [
-            spec
-            for i, spec in enumerate(gang_specs)
-            if not result.admitted[i] and spec["priority"] > 0
-        ]
+        satisfy — must never cost victims their placement). After a
+        successful trial the victim set is PRUNED to an inclusion-minimal
+        one: victims whose removal keeps the trial admitting are dropped,
+        highest-priority candidates first, so a topology-constrained
+        preemptor never evicts gangs on nodes irrelevant to its pack."""
+        rejected = sorted(
+            (
+                spec
+                for i, spec in enumerate(gang_specs)
+                if not result.admitted[i] and spec["priority"] > 0
+            ),
+            key=lambda s: (-s["priority"], s["name"]),
+        )
         if not rejected:
             return set()
-        preemptor = max(rejected, key=lambda s: s["priority"])
+        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        if not nodes:
+            return set()
 
+        # Snapshot free capacity ONCE: _evict_victim deletes victim pods from
+        # the store, which would silently add the freed capacity to every
+        # LATER preemptor's solo check and trial solve (double-spending
+        # capacity already earmarked for an earlier preemptor — the later
+        # preemptor would either skip a needed eviction or evict a
+        # too-small victim set that never makes it placeable).
+        base_free = {
+            node.name: dict(self.cluster.node_free(node)) for node in nodes
+        }
+        all_victim_keys: set = set()
+        for preemptor in rejected:
+            victims_chosen = self._select_preemption_victims(
+                preemptor, nodes, base_free, exclude=all_victim_keys
+            )
+            for gang in victims_chosen:
+                self._evict_victim(gang, preemptor)
+                all_victim_keys.add(
+                    (gang.metadata.namespace, gang.metadata.name)
+                )
+        return all_victim_keys
+
+    def _select_preemption_victims(
+        self, preemptor: dict, nodes: List, base_free: Dict, exclude: set
+    ) -> List:
+        """Choose an inclusion-minimal set of scheduled lower-priority gangs
+        (any namespace, not already in `exclude`) whose eviction makes the
+        preemptor placeable; empty when no eviction helps. `base_free` is the
+        pre-eviction capacity snapshot shared by all preemptors this round."""
         # The wave solver is heuristic: "not admitted" can be a seed/budget
         # artifact, not infeasibility. If the gang fits the CURRENT free
         # capacity on its own, it will simply be placed next round — never
         # evict for it.
-        nodes = [n for n in self.cluster.nodes if not n.cordoned]
-        if not nodes:
-            return set()
-        current_free = {
-            node.name: self.cluster.node_free(node) for node in nodes
-        }
         solo = build_problem(
-            nodes, [preemptor], self.topology, free_capacity=current_free
+            nodes, [preemptor], self.topology, free_capacity=base_free
         )
         if solve_waves(solo, with_alloc=False).admitted[0]:
-            return set()
+            return []
 
         victims = []
-        for gang in self.store.list("PodGang", namespace):
+        for gang in self.store.list("PodGang"):  # every namespace
+            if (gang.metadata.namespace, gang.metadata.name) in exclude:
+                continue
             cond = get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
             if cond is None or not cond.is_true():
                 continue
@@ -431,20 +514,20 @@ class GangScheduler:
             if victim_priority < preemptor["priority"]:
                 victims.append((victim_priority, gang))
         if not victims:
-            return set()
-        victims.sort(key=lambda v: v[0])
+            return []
+        victims.sort(
+            key=lambda v: (v[0], v[1].metadata.namespace, v[1].metadata.name)
+        )
 
         demand_total: Dict[str, float] = {}
         for group in preemptor["groups"]:
             for r, q in group["demand"].items():
                 demand_total[r] = demand_total.get(r, 0.0) + q * group["min_count"]
 
-        # freed capacity per NODE, counting only pods actually bound
-        freed: Dict[str, float] = {}
-        freed_per_node: Dict[str, Dict[str, float]] = {}
-        chosen = []
-        for _, gang in victims:
-            chosen.append(gang)
+        def gang_freed_per_node(gang) -> Dict[str, Dict[str, float]]:
+            """Per-node resources released by evicting this gang (bound pods
+            only)."""
+            per_node: Dict[str, Dict[str, float]] = {}
             for group in gang.spec.pod_groups:
                 for ref in group.pod_references:
                     node_name = self.cluster.bindings.get(
@@ -455,61 +538,91 @@ class GangScheduler:
                     pod = self.store.get("Pod", ref.namespace, ref.name)
                     if pod is None:
                         continue
-                    per_node = freed_per_node.setdefault(node_name, {})
+                    caps = per_node.setdefault(node_name, {})
                     for r, q in pod.spec.total_requests().items():
-                        freed[r] = freed.get(r, 0.0) + q
-                        per_node[r] = per_node.get(r, 0.0) + q
+                        caps[r] = caps.get(r, 0.0) + q
+            return per_node
+
+        # accumulate lowest-priority-first until cluster-total freed covers
+        # the preemptor's aggregate floor demand (necessary condition)
+        freed: Dict[str, float] = {}
+        chosen: List = []
+        chosen_freed: List[Dict[str, Dict[str, float]]] = []
+        for _, gang in victims:
+            per_node = gang_freed_per_node(gang)
+            if not per_node:
+                continue  # nothing bound → eviction frees nothing
+            chosen.append(gang)
+            chosen_freed.append(per_node)
+            for caps in per_node.values():
+                for r, q in caps.items():
+                    freed[r] = freed.get(r, 0.0) + q
             if all(freed.get(r, 0.0) >= q for r, q in demand_total.items()):
                 break
         else:
-            return set()  # evicting everything lower still wouldn't fit
+            return []  # evicting everything lower still wouldn't fit
 
-        # trial solve: preemptor alone against free + hypothetically freed
-        trial_free = {}
-        for node in nodes:
-            caps = dict(self.cluster.node_free(node))
-            for r, q in freed_per_node.get(node.name, {}).items():
-                caps[r] = caps.get(r, 0.0) + q
-            trial_free[node.name] = caps
-        trial_problem = build_problem(
-            nodes, [preemptor], self.topology, free_capacity=trial_free
+        def trial_admits(keep: List[int]) -> bool:
+            trial_free = {}
+            add: Dict[str, Dict[str, float]] = {}
+            for i in keep:
+                for node_name, caps in chosen_freed[i].items():
+                    acc = add.setdefault(node_name, {})
+                    for r, q in caps.items():
+                        acc[r] = acc.get(r, 0.0) + q
+            for node in nodes:
+                caps = dict(base_free[node.name])
+                for r, q in add.get(node.name, {}).items():
+                    caps[r] = caps.get(r, 0.0) + q
+                trial_free[node.name] = caps
+            trial_problem = build_problem(
+                nodes, [preemptor], self.topology, free_capacity=trial_free
+            )
+            return bool(solve_waves(trial_problem, with_alloc=False).admitted[0])
+
+        keep = list(range(len(chosen)))
+        if not trial_admits(keep):
+            return []  # eviction would not make the preemptor placeable
+
+        # prune to an inclusion-minimal victim set: drop the most valuable
+        # (highest-priority, i.e. latest-accumulated) victims first
+        for i in reversed(range(len(chosen))):
+            reduced = [j for j in keep if j != i]
+            if reduced and trial_admits(reduced):
+                keep = reduced
+        return [chosen[i] for i in keep]
+
+    def _evict_victim(self, gang, preemptor: dict) -> None:
+        now = self.store.clock.now()
+        set_condition(
+            gang.status.conditions,
+            Condition(
+                type=COND_PODGANG_DISRUPTION_TARGET,
+                status="True",
+                reason="PreemptedByHigherPriority",
+                message=f"preempted by {preemptor['name']}",
+            ),
+            now,
         )
-        trial = solve_waves(trial_problem, with_alloc=False)
-        if not trial.admitted[0]:
-            return set()  # eviction would not make the preemptor placeable
-
-        for gang in chosen:
-            now = self.store.clock.now()
-            set_condition(
-                gang.status.conditions,
-                Condition(
-                    type=COND_PODGANG_DISRUPTION_TARGET,
-                    status="True",
-                    reason="PreemptedByHigherPriority",
-                    message=f"preempted by {preemptor['name']}",
-                ),
-                now,
-            )
-            set_condition(
-                gang.status.conditions,
-                Condition(
-                    type=COND_PODGANG_SCHEDULED,
-                    status="False",
-                    reason="Preempted",
-                    message=f"preempted by {preemptor['name']}",
-                ),
-                now,
-            )
-            gang.status.phase = PHASE_PENDING
-            gang.status.placement_score = None
-            self.store.update_status(gang)
-            # victim pods recreate gated via their PCLQs
-            for group in gang.spec.pod_groups:
-                for ref in group.pod_references:
-                    if self.store.get("Pod", ref.namespace, ref.name) is not None:
-                        self.store.delete("Pod", ref.namespace, ref.name)
-            METRICS.inc("gang_preemptions_total")
-        return {g.metadata.name for g in chosen}
+        set_condition(
+            gang.status.conditions,
+            Condition(
+                type=COND_PODGANG_SCHEDULED,
+                status="False",
+                reason="Preempted",
+                message=f"preempted by {preemptor['name']}",
+            ),
+            now,
+        )
+        gang.status.phase = PHASE_PENDING
+        gang.status.placement_score = None
+        self.store.update_status(gang)
+        # victim pods recreate gated via their PCLQs
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                if self.store.get("Pod", ref.namespace, ref.name) is not None:
+                    self.store.delete("Pod", ref.namespace, ref.name)
+        METRICS.inc("gang_preemptions_total")
 
     def update_gang_health(self, namespace: str = "default") -> None:
         """Unhealthy condition: any constituent PCLQ currently breaching

@@ -45,6 +45,10 @@ class PackingProblem:
     group_req: np.ndarray = None  # [G, P] int32
     # pinned domain id per group at its required level (-1 none)
     group_pin: np.ndarray = None  # [G, P] int32
+    # pinned domain id for the whole gang at req_level (-1 none): recovery
+    # replacements of a gang-level-constrained gang rejoin the survivors'
+    # domain (never split a live gang across required domains)
+    gang_pin: np.ndarray = None  # [G] int32
 
     # bookkeeping (host side, not shipped to device)
     node_names: List[str] = field(default_factory=list)

@@ -147,6 +147,174 @@ class TestPreemptionGuards:
         assert dt.reason == "Rescheduled"
 
 
+class TestCrossNamespacePreemption:
+    def _harness(self):
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        h = SimHarness(num_nodes=2, config=cfg)
+        for n in h.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        return h
+
+    def test_high_priority_preempts_across_namespaces(self):
+        """Nodes are shared cluster-wide: a critical gang in one namespace
+        evicts a batch gang living in another namespace (no per-namespace
+        priority inversion)."""
+        h = self._harness()
+        low = small_pcs("low", cpu=4, priority_class="batch")
+        low.metadata.namespace = "tenant-b"
+        h.apply(low)
+        h.converge()
+        low_pods = h.store.list("Pod", "tenant-b")
+        assert low_pods and all(is_ready(p) for p in low_pods)
+
+        h.apply(small_pcs("high", cpu=4, priority_class="critical"))
+        h.converge()
+
+        high_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "high"})
+        assert high_pods and all(is_ready(p) for p in high_pods), h.tree()
+        low_gang = h.store.get("PodGang", "tenant-b", "low-0")
+        dt = get_condition(low_gang.status.conditions, "DisruptionTarget")
+        assert dt is not None and dt.is_true()
+        assert dt.reason == "PreemptedByHigherPriority"
+
+    def test_low_priority_in_earlier_namespace_never_starves_high(self):
+        """Global priority-ordered solve: with both namespaces pending at
+        once, the critical gang (later namespace alphabetically) wins the
+        capacity over the batch gang."""
+        h = self._harness()
+        low = small_pcs("low", cpu=4, priority_class="batch")
+        low.metadata.namespace = "aaa-first"
+        h.apply(low)
+        high = small_pcs("high", cpu=4, priority_class="critical")
+        high.metadata.namespace = "zzz-last"
+        h.apply(high)
+        h.converge()
+        high_pods = h.store.list("Pod", "zzz-last")
+        assert high_pods and all(is_ready(p) for p in high_pods), h.tree()
+
+
+class TestMinimalVictimSet:
+    def test_no_over_eviction_of_topology_irrelevant_victims(self):
+        """A pack-constrained preemptor must not evict gangs whose nodes can
+        never host it: lowA sits on a small node (cap 4 < preemptor's 8), so
+        only lowB — on the big node — may be evicted (ADVICE round 1)."""
+        from grove_tpu.api.types import TopologyConstraint
+        from grove_tpu.sim.cluster import make_nodes
+
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        h = SimHarness(num_nodes=2, config=cfg)
+        # two ici-blocks of one host each; block of node-0000 is small
+        h.cluster.nodes = make_nodes(2, capacity={"cpu": 8.0}, hosts_per_ici_block=1)
+        h.cluster.nodes[0].capacity = {"cpu": 4.0}
+
+        h.apply(small_pcs("lowa", cpu=2, priority_class="batch", replicas=1))
+        h.converge()
+        # lowa landed on the small node (only node that matters: pin check)
+        lowa_pod = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "lowa"})[0]
+        assert h.cluster.bindings[("default", lowa_pod.metadata.name)] is not None
+
+        h.apply(small_pcs("lowb", cpu=4, priority_class="batch", replicas=2))
+        h.converge()
+        lowb_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "lowb"})
+        assert all(is_ready(p) for p in lowb_pods), h.tree()
+
+        # preemptor needs 2x4 cpu inside ONE ici-block → only the big block
+        # (node-0001, held by lowb) can ever host it
+        high = small_pcs("high", cpu=4, priority_class="critical", replicas=2)
+        high.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        h.apply(high)
+        h.converge()
+
+        high_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "high"})
+        assert high_pods and all(is_ready(p) for p in high_pods), h.tree()
+        # lowb was evicted...
+        lowb_gang = h.store.get("PodGang", "default", "lowb-0")
+        dt = get_condition(lowb_gang.status.conditions, "DisruptionTarget")
+        assert dt is not None and dt.is_true()
+        # ...but lowa — whose node is irrelevant to the preemptor — was NOT
+        lowa_gang = h.store.get("PodGang", "default", "lowa-0")
+        dt = get_condition(lowa_gang.status.conditions, "DisruptionTarget")
+        assert dt is None or not dt.is_true(), h.tree()
+        lowa_pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "lowa"})
+        assert lowa_pods and all(is_ready(p) for p in lowa_pods)
+
+
+class TestGangLevelRecoveryPin:
+    def test_replacement_pods_stay_in_survivors_required_domain(self):
+        """A gang with a gang-level required pack whose pod dies must place
+        the replacement in the SAME required-level domain as the survivors —
+        even when another domain has strictly more free capacity
+        (ADVICE round 1: the delta-solve previously only pinned group-level
+        constraints)."""
+        from grove_tpu.api.types import TopologyConstraint
+        from grove_tpu.sim.cluster import make_nodes
+
+        h = SimHarness(num_nodes=4)
+        # two ici-blocks x two hosts, 8 cpu each
+        h.cluster.nodes = make_nodes(
+            4, capacity={"cpu": 8.0}, hosts_per_ici_block=2
+        )
+        block_of = {
+            n.name: n.labels["cloud.google.com/gke-tpu-ici-block"]
+            for n in h.cluster.nodes
+        }
+
+        # blocker fills block 0 entirely so the constrained gang lands in
+        # block 1
+        h.apply(small_pcs("blocker", cpu=8, replicas=2))
+        h.converge()
+        pinned = small_pcs("pinned", cpu=4, replicas=3)
+        pinned.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        h.apply(pinned)
+        h.converge()
+        pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "pinned"})
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+        home_blocks = {
+            block_of[h.cluster.bindings[("default", p.metadata.name)]]
+            for p in pods
+        }
+        assert len(home_blocks) == 1  # required pack honored at placement
+        home = next(iter(home_blocks))
+
+        # blocker leaves: the OTHER block is now empty (16 cpu free — more
+        # than the home block) and would win a free-capacity re-choice
+        h.delete("blocker")
+        h.converge()
+        # DELETE a pod on the home-block node that hosts TWO pods (node-loss
+        # style recovery: the PCLQ recreates it unbound → delta-solve), and
+        # cordon that node so the sticky same-node rebind can't fire — the
+        # full solver decides the replacement's domain (the other home node
+        # still has 4 cpu free — exactly one replacement's worth)
+        by_node = {}
+        for p in pods:
+            by_node.setdefault(
+                h.cluster.bindings[("default", p.metadata.name)], []
+            ).append(p)
+        double_node = next(n for n, ps in by_node.items() if len(ps) == 2)
+        h.store.delete("Pod", "default", by_node[double_node][0].metadata.name)
+        next(n for n in h.cluster.nodes if n.name == double_node).cordoned = True
+        h.engine.drain()
+        h.converge()
+
+        pods = h.store.list("Pod", "default", {namegen.LABEL_PART_OF: "pinned"})
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+        blocks_now = {
+            block_of[h.cluster.bindings[("default", p.metadata.name)]]
+            for p in pods
+        }
+        assert blocks_now == {home}, (
+            f"replacement left the survivors' required domain: {blocks_now}"
+        )
+
+
 class TestGangHealth:
     def test_unhealthy_condition_follows_breach(self):
         h = SimHarness(num_nodes=16)
