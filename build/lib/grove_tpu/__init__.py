@@ -1,0 +1,23 @@
+"""grove-tpu: a TPU-native gang-scheduling control plane.
+
+A ground-up re-host of NVIDIA Grove's capabilities (declarative multi-role AI
+serving systems with hierarchical gang scheduling, topology-aware placement,
+multi-level autoscaling, startup ordering, rolling updates, and gang
+termination) where the placement hot path — gang admission and topology
+scoring — runs on TPU as a JAX/XLA batched packing kernel instead of being
+delegated to an external scheduler.
+
+Layout:
+- ``api``        domain model (CRD-equivalent types, names, topology, hashing)
+- ``admission``  defaulting + validation (webhook-equivalent pure functions)
+- ``runtime``    in-memory store/watch, workqueue, reconcile engine, infra
+- ``controller`` PodCliqueSet / PodClique / PodCliqueScalingGroup reconcilers
+- ``solver``     tensor encoder, packing kernels, reference oracle
+- ``ops``        low-level JAX/Pallas kernels
+- ``parallel``   device-mesh sharded solve (multi-chip)
+- ``models``     workload scenario models (disaggregated serving, agentic, stress)
+- ``sim``        simulated cluster (nodes, kubelet, scheduler binding loop)
+- ``initc``      pod-side startup-ordering waiter
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""`python -m grove_tpu` → the CLI."""
+
+import sys
+
+from grove_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

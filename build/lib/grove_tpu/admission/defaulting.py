@@ -1,0 +1,65 @@
+"""Defaulting: webhook-equivalent pure functions.
+
+Rule-for-rule re-host of
+/root/reference/operator/internal/webhook/admission/pcs/defaulting/podcliqueset.go:35-120
+(plus the kubebuilder schema defaults the apiserver applies before the webhook:
+startupType=AnyOrder, PCSG replicas=1, PCSG minAvailable=1).
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api.types import (
+    DEFAULT_TERMINATION_DELAY_SECONDS,
+    STARTUP_ANY_ORDER,
+    HeadlessServiceConfig,
+    PodCliqueSet,
+)
+
+DEFAULT_TERMINATION_GRACE_PERIOD = 30
+
+
+def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
+    """Mutates `pcs` in place (callers hold the only copy pre-store) and
+    returns it."""
+    if not pcs.metadata.namespace:
+        pcs.metadata.namespace = "default"
+    tmpl = pcs.spec.template
+
+    # kubebuilder default — podcliqueset.go:128
+    if tmpl.startup_type is None:
+        tmpl.startup_type = STARTUP_ANY_ORDER
+    # defaulting/podcliqueset.go:52-54 (4h)
+    if tmpl.termination_delay is None:
+        tmpl.termination_delay = DEFAULT_TERMINATION_DELAY_SECONDS
+    # defaulting/podcliqueset.go:59-66
+    if tmpl.headless_service_config is None:
+        tmpl.headless_service_config = HeadlessServiceConfig(
+            publish_not_ready_addresses=True
+        )
+
+    for clique in tmpl.cliques:
+        spec = clique.spec
+        if spec.replicas == 0:
+            spec.replicas = 1
+        if spec.min_available is None:
+            spec.min_available = spec.replicas
+        if spec.auto_scaling_config is not None:
+            if spec.auto_scaling_config.min_replicas is None:
+                spec.auto_scaling_config.min_replicas = spec.replicas
+        pod_spec = spec.pod_spec
+        if not pod_spec.restart_policy:
+            pod_spec.restart_policy = "Always"
+        pod_spec.extra.setdefault(
+            "terminationGracePeriodSeconds", DEFAULT_TERMINATION_GRACE_PERIOD
+        )
+
+    for sg in tmpl.pod_clique_scaling_group_configs:
+        # kubebuilder defaults — podcliqueset.go:211, :224
+        if sg.replicas is None:
+            sg.replicas = 1
+        if sg.min_available is None:
+            sg.min_available = 1
+        if sg.scale_config is not None and sg.scale_config.min_replicas is None:
+            sg.scale_config.min_replicas = sg.replicas
+
+    return pcs

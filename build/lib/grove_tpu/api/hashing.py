@@ -1,0 +1,63 @@
+"""Deterministic object hashing for rolling-update triggers.
+
+Equivalent of the reference's ComputeHash over all pod templates
+(/root/reference/operator/internal/controller/podcliqueset/reconcilespec.go:110-123
+and internal/utils/kubernetes object hashing): a generation hash of the PCS
+template that, when changed, starts a rolling update; and a per-clique
+pod-template hash stamped as the `grove.io/pod-template-hash` label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any
+
+
+def _normalize(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _normalize(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, dict):
+        return {str(k): _normalize(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (list, tuple)):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def compute_hash(obj: Any) -> str:
+    """Stable short hash of any dataclass/dict tree."""
+    payload = json.dumps(_normalize(obj), sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _clique_template_payload(clique_template, priority_class_name: str = ""):
+    """The hashed view of one clique: mirrors the reference, which hashes a
+    PodTemplateSpec carrying the clique's labels/annotations with the PCS
+    template's priorityClassName overlaid (component/utils/podclique.go)."""
+    return {
+        "name": clique_template.name,
+        "labels": dict(clique_template.labels),
+        "annotations": dict(clique_template.annotations),
+        "roleName": clique_template.spec.role_name,
+        "priorityClassName": priority_class_name,
+        "podSpec": _normalize(clique_template.spec.pod_spec),
+    }
+
+
+def compute_pcs_generation_hash(pcs) -> str:
+    """Hash of every clique's pod template (not replica counts — scaling is
+    not an update); changing it starts the rolling update flow
+    (reconcilespec.go:72-123)."""
+    pcn = pcs.spec.template.priority_class_name
+    parts = [
+        _clique_template_payload(c, pcn) for c in pcs.spec.template.cliques
+    ]
+    return compute_hash({"cliques": parts})
+
+
+def compute_pod_template_hash(clique_template, priority_class_name: str = "") -> str:
+    return compute_hash(_clique_template_payload(clique_template, priority_class_name))

@@ -1,0 +1,34 @@
+"""YAML loading for reference-format manifests.
+
+Accepts the exact CR format of the reference samples
+(/root/reference/operator/samples/simple/simple1.yaml etc.), so a Grove user
+can apply their manifests unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import yaml
+
+from grove_tpu.api.types import PodCliqueSet
+
+
+def load_podcliquesets(text: str) -> List[PodCliqueSet]:
+    out: List[PodCliqueSet] = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        kind = doc.get("kind")
+        if kind != "PodCliqueSet":
+            raise ValueError(f"unsupported kind {kind!r}")
+        out.append(PodCliqueSet.from_dict(doc))
+    return out
+
+
+def load_podcliqueset_file(path: str) -> PodCliqueSet:
+    with open(path) as f:
+        sets = load_podcliquesets(f.read())
+    if len(sets) != 1:
+        raise ValueError(f"{path}: expected exactly one PodCliqueSet, got {len(sets)}")
+    return sets[0]

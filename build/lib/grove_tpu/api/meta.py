@@ -1,0 +1,179 @@
+"""Object metadata, conditions, and resource-quantity primitives.
+
+TPU-native re-host of the apimachinery subset the reference relies on
+(metav1.ObjectMeta / metav1.Condition / resource.Quantity). Semantics follow
+the reference's usage, not the k8s implementation:
+- reference types: /root/reference/operator/api/core/v1alpha1/podcliqueset.go
+- conditions usage: /root/reference/operator/internal/controller/podclique/reconcilestatus.go
+
+All timestamps are float unix seconds supplied by an injectable clock so the
+simulator can run virtual time (the reference gets wall time from the informer
+cache; we need determinism for the 10k-gang stress sim).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import pickle
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Resource quantities
+# ---------------------------------------------------------------------------
+
+_QTY_RE = re.compile(r"^([+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)([a-zA-Z]*)$")
+
+_SUFFIX = {
+    "": 1.0,
+    "n": 1e-9,
+    "u": 1e-6,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "Ki": 2.0**10,
+    "Mi": 2.0**20,
+    "Gi": 2.0**30,
+    "Ti": 2.0**40,
+    "Pi": 2.0**50,
+}
+
+
+def parse_quantity(value: Any) -> float:
+    """Parse a k8s-style resource quantity ('10m', '4Gi', 2, '2') into a float.
+
+    Mirrors the subset of resource.Quantity the reference samples use
+    (/root/reference/operator/samples/simple/simple1.yaml requests cpu '10m').
+    """
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    m = _QTY_RE.match(s)
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    num, suffix = m.groups()
+    if suffix not in _SUFFIX:
+        raise ValueError(f"invalid quantity suffix: {value!r}")
+    return float(num) * _SUFFIX[suffix]
+
+
+def parse_resource_map(raw: Optional[Dict[str, Any]]) -> Dict[str, float]:
+    return {k: parse_quantity(v) for k, v in (raw or {}).items()}
+
+
+# ---------------------------------------------------------------------------
+# Conditions
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Condition:
+    """metav1.Condition equivalent (type/status/reason/message/lastTransitionTime)."""
+
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+    def is_true(self) -> bool:
+        return self.status == "True"
+
+
+def get_condition(conditions: List[Condition], ctype: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def set_condition(conditions: List[Condition], new: Condition, now: float) -> bool:
+    """Upsert, bumping last_transition_time only on status change.
+
+    Mirrors apimachinery meta.SetStatusCondition, which the reference uses for
+    MinAvailableBreached / PodCliqueScheduled breach-age computation
+    (gangterminate.go computes breach duration from lastTransitionTime).
+    Returns True if the condition changed.
+    """
+    existing = get_condition(conditions, new.type)
+    if existing is None:
+        new.last_transition_time = now
+        conditions.append(new)
+        return True
+    changed = (
+        existing.status != new.status
+        or existing.reason != new.reason
+        or existing.message != new.message
+    )
+    if existing.status != new.status:
+        existing.last_transition_time = now
+    existing.status = new.status
+    existing.reason = new.reason
+    existing.message = new.message
+    return changed
+
+
+# ---------------------------------------------------------------------------
+# ObjectMeta
+# ---------------------------------------------------------------------------
+
+_uid_counter = itertools.count(1)
+
+
+def next_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str = ""
+    controller: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    generation: int = 0
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    def controller_owner(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass(frozen=True, order=True)
+class NamespacedName:
+    """scheduler/api/core/v1alpha1/podgang.go:129-137 equivalent."""
+
+    namespace: str
+    name: str
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return f"{self.namespace}/{self.name}"
+
+
+def deep_copy(obj):
+    """Deep-copy an API object. pickle round-trip is several times faster
+    than copy.deepcopy for plain dataclass trees (the store copies on every
+    read/write, so this is the control plane's hottest function)."""
+    try:
+        return pickle.loads(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    except Exception:
+        return copy.deepcopy(obj)

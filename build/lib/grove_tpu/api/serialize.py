@@ -1,0 +1,59 @@
+"""Object → YAML-able dict export (kubectl get -o yaml UX).
+
+Round-trips the camelCase wire convention of the manifest format: snake_case
+dataclass fields become camelCase keys; metadata/status included so operators
+can inspect live state from the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def to_dict(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            value = to_dict(getattr(obj, f.name))
+            if value in (None, [], {}, ""):
+                continue
+            out[_camel(f.name)] = value
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+_API_VERSIONS = {
+    "PodGang": "scheduler.grove.io/v1alpha1",
+    "PodCliqueSet": "grove.io/v1alpha1",
+    "PodClique": "grove.io/v1alpha1",
+    "PodCliqueScalingGroup": "grove.io/v1alpha1",
+    "ClusterTopology": "grove.io/v1alpha1",
+    "Pod": "v1",
+    "Service": "v1",
+    "ServiceAccount": "v1",
+    "Secret": "v1",
+    "Event": "v1",
+    "Role": "rbac.authorization.k8s.io/v1",
+    "RoleBinding": "rbac.authorization.k8s.io/v1",
+    "HorizontalPodAutoscaler": "autoscaling/v2",
+}
+
+
+def export_object(obj) -> dict:
+    doc = to_dict(obj)
+    kind = doc.pop("kind", getattr(obj, "kind", ""))
+    return {
+        "apiVersion": _API_VERSIONS.get(kind, "grove.io/v1alpha1"),
+        "kind": kind,
+        **doc,
+    }

@@ -1,0 +1,259 @@
+"""Wire codec: camelCase JSON/YAML documents ⇄ typed API objects.
+
+The decode half of `api/serialize.py`'s export: a reflective dataclass
+decoder keyed on type hints, with the handful of format quirks the reference
+wire format carries (nested container `resources`, `cliqueStartupType`,
+`podCliqueScalingGroups`, quantity/duration strings). Together they give the
+real-cluster mode (grove_tpu.cluster) a lossless object round trip, while
+still accepting reference-format user manifests unchanged
+(/root/reference/operator/samples/).
+
+Also holds the kind registry (group/version/plural) mirroring the CRDs the
+reference embeds (/root/reference/operator/api/core/v1alpha1/crds/,
+/root/reference/scheduler/api/core/v1alpha1/crds/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional
+
+from grove_tpu.api.meta import (
+    NamespacedName,
+    ObjectMeta,
+    parse_quantity,
+)
+from grove_tpu.api.pod import Pod
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.api.types import (
+    Container,
+    GenericObject,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodCliqueSetTemplateSpec,
+    PodGang,
+    parse_duration,
+)
+
+# ---------------------------------------------------------------------------
+# Kind registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KindInfo:
+    kind: str
+    cls: type
+    group: str  # "" = core
+    version: str
+    plural: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+
+_KINDS = [
+    KindInfo("PodCliqueSet", PodCliqueSet, "grove.io", "v1alpha1", "podcliquesets"),
+    KindInfo("PodClique", PodClique, "grove.io", "v1alpha1", "podcliques"),
+    KindInfo(
+        "PodCliqueScalingGroup",
+        PodCliqueScalingGroup,
+        "grove.io",
+        "v1alpha1",
+        "podcliquescalinggroups",
+    ),
+    KindInfo(
+        "ClusterTopology",
+        ClusterTopology,
+        "grove.io",
+        "v1alpha1",
+        "clustertopologies",
+        namespaced=False,
+    ),
+    KindInfo(
+        "PodGang", PodGang, "scheduler.grove.io", "v1alpha1", "podgangs"
+    ),
+    KindInfo("Pod", Pod, "", "v1", "pods"),
+    # generic child kinds the operator materializes (sim-shaped spec dicts)
+    KindInfo("Service", GenericObject, "", "v1", "services"),
+    KindInfo("ServiceAccount", GenericObject, "", "v1", "serviceaccounts"),
+    KindInfo("Secret", GenericObject, "", "v1", "secrets"),
+    KindInfo("Event", GenericObject, "", "v1", "events"),
+    KindInfo(
+        "Role", GenericObject, "rbac.authorization.k8s.io", "v1", "roles"
+    ),
+    KindInfo(
+        "RoleBinding",
+        GenericObject,
+        "rbac.authorization.k8s.io",
+        "v1",
+        "rolebindings",
+    ),
+    KindInfo(
+        "HorizontalPodAutoscaler",
+        GenericObject,
+        "autoscaling",
+        "v2",
+        "horizontalpodautoscalers",
+    ),
+]
+
+KIND_REGISTRY: Dict[str, KindInfo] = {k.kind: k for k in _KINDS}
+PLURAL_REGISTRY: Dict[str, KindInfo] = {k.plural: k for k in _KINDS}
+
+
+# ---------------------------------------------------------------------------
+# Reflective decoder
+# ---------------------------------------------------------------------------
+
+
+def _snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+# wire key → field name aliases where the reference format diverges from
+# plain camelization (reference podcliqueset.go:123-156)
+_FIELD_ALIASES: Dict[type, Dict[str, str]] = {
+    PodCliqueSetTemplateSpec: {
+        "cliqueStartupType": "startup_type",
+        "podCliqueScalingGroups": "pod_clique_scaling_group_configs",
+        # our own export camelizes the field name — accept it back
+        "startupType": "startup_type",
+        "podCliqueScalingGroupConfigs": "pod_clique_scaling_group_configs",
+    },
+}
+
+
+def _coerce_scalar(hint: type, value: Any, quantity: bool = False) -> Any:
+    if hint is float:
+        if isinstance(value, str):
+            # resource maps carry quantity strings ("200m" = 0.2 cpu);
+            # scalar float fields carry durations ("4h") — the two notations
+            # collide on the m/h suffixes, so context decides
+            if quantity:
+                return parse_quantity(value)
+            try:
+                return parse_duration(value)
+            except ValueError:
+                return parse_quantity(value)
+        return float(value)
+    if hint is int:
+        return int(value)
+    if hint is bool:
+        return bool(value)
+    if hint is str:
+        return str(value)
+    return value
+
+
+def _decode_value(hint: Any, value: Any) -> Any:
+    if value is None:
+        return None
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        return _decode_value(args[0], value) if args else value
+    if origin in (list, typing.List):
+        (item_hint,) = typing.get_args(hint) or (Any,)
+        return [_decode_value(item_hint, v) for v in value]
+    if origin in (dict, typing.Dict):
+        args = typing.get_args(hint)
+        val_hint = args[1] if len(args) == 2 else Any
+        if val_hint in (float, int):
+            return {
+                k: _coerce_scalar(val_hint, v, quantity=True)
+                for k, v in value.items()
+            }
+        return dict(value)
+    if dataclasses.is_dataclass(hint):
+        return decode_dataclass(hint, value)
+    if hint in (float, int, bool, str):
+        return _coerce_scalar(hint, value)
+    return value
+
+
+def decode_dataclass(cls: type, doc: Dict[str, Any]):
+    """Wire dict → dataclass instance (inverse of serialize.to_dict)."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"expected object for {cls.__name__}, got {doc!r}")
+    doc = dict(doc)
+    if cls is Container and "resources" in doc:
+        # reference container format nests requests/limits under `resources`
+        res = doc.pop("resources") or {}
+        doc.setdefault("requests", res.get("requests") or {})
+        doc.setdefault("limits", res.get("limits") or {})
+    hints = typing.get_type_hints(cls)
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    aliases = _FIELD_ALIASES.get(cls, {})
+    kwargs: Dict[str, Any] = {}
+    leftovers: Dict[str, Any] = {}
+    for key, value in doc.items():
+        fname = aliases.get(key) or (
+            key if key in fields else _snake(key)
+        )
+        if fname in fields:
+            kwargs[fname] = _decode_value(hints[fname], value)
+        else:
+            leftovers[key] = value
+    # unmodeled keys pass through into `extra` when the type carries one
+    # (Container/PodSpec — keeps template hashing change-sensitive)
+    if leftovers and "extra" in fields:
+        extra = dict(kwargs.get("extra") or {})
+        for k, v in leftovers.items():
+            extra.setdefault(k, v)
+        kwargs["extra"] = extra
+    return cls(**kwargs)
+
+
+def _decode_metadata(doc: Dict[str, Any]) -> ObjectMeta:
+    meta = decode_dataclass(ObjectMeta, doc or {})
+    if not meta.namespace:
+        meta.namespace = "default"
+    return meta
+
+
+def decode_object(doc: Dict[str, Any]):
+    """Full CR document (apiVersion/kind/metadata/spec/status) → object."""
+    kind = doc.get("kind")
+    info = KIND_REGISTRY.get(kind or "")
+    if info is None:
+        raise ValueError(f"unsupported kind {kind!r}")
+    cls = info.cls
+    meta = _decode_metadata(doc.get("metadata") or {})
+    if not info.namespaced:
+        meta.namespace = ""
+    if cls is GenericObject:
+        return GenericObject(kind=kind, metadata=meta, spec=dict(doc.get("spec") or {}))
+    hints = typing.get_type_hints(cls)
+    kwargs: Dict[str, Any] = {"metadata": meta}
+    if "spec" in hints and doc.get("spec") is not None:
+        kwargs["spec"] = _decode_value(hints["spec"], doc["spec"])
+    if "status" in hints and doc.get("status") is not None:
+        kwargs["status"] = _decode_value(hints["status"], doc["status"])
+    obj = cls(**kwargs)
+    return obj
+
+
+def resolve_path_kind(group: str, version: str, plural: str) -> Optional[KindInfo]:
+    info = PLURAL_REGISTRY.get(plural)
+    if info is None:
+        return None
+    if info.group != group or info.version != version:
+        return None
+    return info

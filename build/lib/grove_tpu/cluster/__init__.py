@@ -1,0 +1,11 @@
+"""Real-cluster mode: HTTP apiserver, typed HTTP client, webhook server,
+webhook TLS certs, and CRD manifests.
+
+The deployable surface the reference gets from kube-apiserver +
+controller-runtime (SURVEY §1 'Admission layer' + §2.2 manager): an
+envtest-style apiserver speaking k8s-shaped REST over the same Store
+semantics the sim uses, an HTTP client implementing the Store interface so
+the controllers run unchanged against it, and admission webhooks served
+over HTTP(S) exactly at the boundary of
+/root/reference/operator/internal/webhook/register.go:35-75.
+"""

@@ -1,0 +1,495 @@
+"""Envtest-style HTTP apiserver over the in-memory Store.
+
+Speaks the k8s REST wire shape the reference operator talks to:
+
+    GET/POST   /apis/{group}/{version}/namespaces/{ns}/{plural}
+    GET/PUT/DELETE  .../{plural}/{name}
+    PUT        .../{plural}/{name}/status          (status subresource)
+    GET        .../{plural}?watch=true             (list+watch stream)
+    DELETE     .../{plural}?labelSelector=...      (delete collection)
+    /api/v1/... for core kinds; cluster-scoped paths omit namespaces/{ns}
+
+plus /healthz /readyz /metrics. Admission webhooks (mutating → validating)
+are invoked over HTTP on create/update of configured kinds, mirroring the
+registration boundary of
+/root/reference/operator/internal/webhook/register.go:35-75. Writes carry an
+optional Impersonate-User header honored via the store's actor context
+(authorizer parity: admission/pcs/authorization/handler.go:51-158).
+
+This is both the e2e harness's fake cluster (reference envtest tier,
+SURVEY §4.2) and the wire contract an external scheduler (KAI-equivalent)
+can consume PodGangs from.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from grove_tpu.api.serialize import export_object
+from grove_tpu.api.wire import (
+    KIND_REGISTRY,
+    KindInfo,
+    decode_object,
+    resolve_path_kind,
+)
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.errors import (
+    ERR_CONFLICT,
+    ERR_FORBIDDEN,
+    ERR_NOT_FOUND,
+    GroveError,
+)
+from grove_tpu.runtime.store import Store, WatchEvent
+
+
+@dataclass
+class WebhookRegistration:
+    """One admission webhook the server calls for matching writes
+    (webhook/register.go registers defaulting, validation, authorization)."""
+
+    name: str
+    kinds: List[str]
+    url: str
+    mutating: bool = False
+    operations: Tuple[str, ...] = ("CREATE", "UPDATE")
+    # CA bundle file for TLS webhook endpoints (cert.py output)
+    ca_file: Optional[str] = None
+
+
+@dataclass
+class AdmissionDenied(Exception):
+    message: str
+
+
+def _http_status_for(err: GroveError) -> int:
+    return {
+        ERR_NOT_FOUND: 404,
+        ERR_CONFLICT: 409,
+        ERR_FORBIDDEN: 403,
+    }.get(err.code, 500)
+
+
+@dataclass
+class _WatchSub:
+    q: "queue.Queue[Optional[WatchEvent]]"
+    kind: str
+    namespace: Optional[str]
+    selector: Optional[Dict[str, str]]
+
+
+def parse_label_selector(raw: Optional[str]) -> Optional[Dict[str, str]]:
+    if not raw:
+        return None
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"unsupported label selector: {raw!r}")
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+class APIServer:
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        webhooks: Optional[List[WebhookRegistration]] = None,
+    ) -> None:
+        self.store = store or Store(Clock())
+        self.lock = threading.RLock()
+        self.webhooks = webhooks or []
+        self._subs: List[_WatchSub] = []
+        self._subs_lock = threading.Lock()
+        self.store.subscribe(self._fanout)
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="grove-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        with self._subs_lock:
+            for sub in self._subs:
+                sub.q.put(None)
+            self._subs.clear()
+
+    # -- watch fanout ----------------------------------------------------
+
+    def _fanout(self, ev: WatchEvent) -> None:
+        from grove_tpu.runtime.store import matches_labels
+
+        with self._subs_lock:
+            subs = list(self._subs)
+        for sub in subs:
+            if sub.kind != ev.kind:
+                continue
+            if (
+                sub.namespace is not None
+                and ev.obj.metadata.namespace != sub.namespace
+            ):
+                continue
+            if not matches_labels(ev.obj, sub.selector):
+                continue
+            sub.q.put(ev)
+
+    # -- admission -------------------------------------------------------
+
+    def _call_webhook(
+        self, reg: WebhookRegistration, review: dict
+    ) -> dict:
+        data = json.dumps(review).encode()
+        req = urllib.request.Request(
+            reg.url,
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        import ssl
+
+        ctx = None
+        if reg.url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=reg.ca_file)
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
+            return json.loads(resp.read())
+
+    def _admit(
+        self,
+        doc: dict,
+        operation: str,
+        username: str,
+        old_doc: Optional[dict] = None,
+    ) -> dict:
+        """Run the webhook chain: mutating first, then validating — the
+        order register.go implies (defaulting webhook path precedes
+        validation)."""
+        kind = doc.get("kind", "")
+        for reg in self.webhooks:
+            if kind not in reg.kinds or operation not in reg.operations:
+                continue
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "operation": operation,
+                    "userInfo": {"username": username},
+                    "object": doc,
+                    "oldObject": old_doc,
+                },
+            }
+            out = self._call_webhook(reg, review).get("response", {})
+            if not out.get("allowed", False):
+                raise AdmissionDenied(
+                    out.get("status", {}).get("message", "admission denied")
+                )
+            if reg.mutating and out.get("patchedObject") is not None:
+                doc = out["patchedObject"]
+        return doc
+
+    # -- handler ---------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            # ---- helpers
+
+            def _send_json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, message: str, reason: str = "") -> None:
+                self._send_json(
+                    code,
+                    {
+                        "kind": "Status",
+                        "status": "Failure",
+                        "code": code,
+                        "reason": reason,
+                        "message": message,
+                    },
+                )
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            def _route(self):
+                """Parse path → (info, namespace, name, subresource, query)."""
+                parsed = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(parsed.query)
+                parts = [
+                    urllib.parse.unquote(p)
+                    for p in parsed.path.split("/")
+                    if p
+                ]
+                # /api/v1/... (core) or /apis/{group}/{version}/...
+                if not parts:
+                    return None
+                if parts[0] == "api" and len(parts) >= 2:
+                    group, version, rest = "", parts[1], parts[2:]
+                elif parts[0] == "apis" and len(parts) >= 3:
+                    group, version, rest = parts[1], parts[2], parts[3:]
+                else:
+                    return None
+                namespace: Optional[str] = None
+                if len(rest) >= 2 and rest[0] == "namespaces":
+                    namespace, rest = rest[1], rest[2:]
+                if not rest:
+                    return None
+                info = resolve_path_kind(group, version, rest[0])
+                if info is None:
+                    return None
+                name = rest[1] if len(rest) >= 2 else None
+                sub = rest[2] if len(rest) >= 3 else None
+                if info.namespaced and namespace is None and name is not None:
+                    # namespaced kind addressed without a namespace
+                    return None
+                if not info.namespaced:
+                    namespace = ""
+                return info, namespace, name, sub, query
+
+            def _username(self) -> str:
+                from grove_tpu.admission.authorization import OPERATOR_USERNAME
+
+                return self.headers.get("Impersonate-User") or OPERATOR_USERNAME
+
+            # ---- verbs
+
+            def do_GET(self):
+                path = urllib.parse.urlsplit(self.path).path
+                if path in ("/healthz", "/readyz", "/livez"):
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/metrics":
+                    body = METRICS.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                route = self._route()
+                if route is None:
+                    return self._error(404, f"unknown path {self.path}")
+                info, namespace, name, _sub, query = route
+                try:
+                    selector = parse_label_selector(
+                        (query.get("labelSelector") or [None])[0]
+                    )
+                except ValueError as e:
+                    return self._error(400, str(e))
+                if name is not None:
+                    with server.lock:
+                        obj = server.store.get(info.kind, namespace or "", name)
+                    if obj is None:
+                        return self._error(
+                            404, f"{info.kind} {namespace}/{name} not found",
+                            "NotFound",
+                        )
+                    return self._send_json(200, export_object(obj))
+                if (query.get("watch") or ["false"])[0] == "true":
+                    return self._watch(info, namespace, selector)
+                with server.lock:
+                    objs = server.store.list(info.kind, namespace or None, selector)
+                return self._send_json(
+                    200,
+                    {
+                        "apiVersion": info.api_version,
+                        "kind": f"{info.kind}List",
+                        "items": [export_object(o) for o in objs],
+                    },
+                )
+
+            def _watch(self, info: KindInfo, namespace, selector):
+                sub = _WatchSub(
+                    q=queue.Queue(), kind=info.kind,
+                    namespace=namespace or None, selector=selector,
+                )
+                # list+watch without a gap: snapshot synthetic ADDED events
+                # and register the live subscription under the store lock
+                with server.lock:
+                    existing = server.store.list(
+                        info.kind, namespace or None, selector
+                    )
+                    with server._subs_lock:
+                        server._subs.append(sub)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(payload: dict) -> None:
+                    line = (json.dumps(payload) + "\n").encode()
+                    self.wfile.write(f"{len(line):x}\r\n".encode())
+                    self.wfile.write(line + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for obj in existing:
+                        write_chunk(
+                            {"type": "ADDED", "object": export_object(obj)}
+                        )
+                    while True:
+                        ev = sub.q.get()
+                        if ev is None:
+                            break
+                        write_chunk(
+                            {
+                                "type": ev.type.upper(),
+                                "object": export_object(ev.obj),
+                            }
+                        )
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with server._subs_lock:
+                        if sub in server._subs:
+                            server._subs.remove(sub)
+
+            def do_POST(self):
+                route = self._route()
+                if route is None:
+                    return self._error(404, f"unknown path {self.path}")
+                info, namespace, _name, _sub, _query = route
+                doc = self._body()
+                if doc.get("kind") != info.kind:
+                    return self._error(
+                        400,
+                        f"body kind {doc.get('kind')!r} does not match path "
+                        f"kind {info.kind!r}",
+                    )
+                username = self._username()
+                try:
+                    doc = server._admit(doc, "CREATE", username)
+                    obj = decode_object(doc)
+                    if info.namespaced:
+                        obj.metadata.namespace = namespace or "default"
+                    with server.lock, server.store.as_user(username):
+                        stored = server.store.create(obj)
+                except AdmissionDenied as e:
+                    return self._error(422, e.message, "Invalid")
+                except GroveError as e:
+                    return self._error(_http_status_for(e), str(e))
+                return self._send_json(201, export_object(stored))
+
+            def do_PUT(self):
+                route = self._route()
+                if route is None:
+                    return self._error(404, f"unknown path {self.path}")
+                info, namespace, name, sub, _query = route
+                if name is None:
+                    return self._error(405, "PUT requires a resource name")
+                doc = self._body()
+                username = self._username()
+                try:
+                    if sub == "status":
+                        with server.lock, server.store.as_user(username):
+                            current = server.store.get(
+                                info.kind, namespace or "", name
+                            )
+                            if current is None:
+                                return self._error(
+                                    404, f"{info.kind} {name} not found",
+                                    "NotFound",
+                                )
+                            incoming = decode_object(doc)
+                            current.status = incoming.status
+                            # status writes respect optimistic concurrency
+                            current.metadata.resource_version = (
+                                incoming.metadata.resource_version
+                            )
+                            stored = server.store.update_status(current)
+                        return self._send_json(200, export_object(stored))
+                    with server.lock:
+                        current = server.store.get(info.kind, namespace or "", name)
+                    old_doc = export_object(current) if current is not None else None
+                    doc = server._admit(doc, "UPDATE", username, old_doc)
+                    obj = decode_object(doc)
+                    with server.lock, server.store.as_user(username):
+                        stored = server.store.update(obj)
+                        # apiserver rule: removing the last finalizer of a
+                        # deleting object completes the deletion
+                        server.store.complete_deletion_if_drained(
+                            info.kind, stored.metadata.namespace,
+                            stored.metadata.name,
+                        )
+                except AdmissionDenied as e:
+                    return self._error(422, e.message, "Invalid")
+                except GroveError as e:
+                    return self._error(_http_status_for(e), str(e))
+                return self._send_json(200, export_object(stored))
+
+            def do_DELETE(self):
+                route = self._route()
+                if route is None:
+                    return self._error(404, f"unknown path {self.path}")
+                info, namespace, name, _sub, query = route
+                username = self._username()
+                try:
+                    if name is None:
+                        selector = parse_label_selector(
+                            (query.get("labelSelector") or [None])[0]
+                        )
+                        with server.lock, server.store.as_user(username):
+                            n = server.store.delete_collection(
+                                info.kind, namespace or "", selector
+                            )
+                        return self._send_json(200, {"deleted": n})
+                    with server.lock:
+                        current = server.store.get(info.kind, namespace or "", name)
+                    if current is not None:
+                        server._admit(
+                            export_object(current), "DELETE", username
+                        )
+                    with server.lock, server.store.as_user(username):
+                        server.store.delete(info.kind, namespace or "", name)
+                except AdmissionDenied as e:
+                    return self._error(403, e.message, "Forbidden")
+                except GroveError as e:
+                    return self._error(_http_status_for(e), str(e))
+                return self._send_json(200, {"status": "Success"})
+
+        return Handler

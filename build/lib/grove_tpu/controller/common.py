@@ -1,0 +1,240 @@
+"""Shared controller machinery: operator context + component protocol.
+
+Re-host of the component-operator pattern in
+/root/reference/operator/internal/controller/common/component/types.go:44-92 —
+each reconciler iterates an *ordered* list of components, each owning one child
+kind with Sync/Delete; plus cross-component helpers from
+controller/common/component/utils/.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.api.types import (
+    PodCliqueScalingGroupConfig,
+    PodCliqueSet,
+    SchedTopologyConstraint,
+    TopologyPackConstraint,
+)
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.expectations import ExpectationsStore
+from grove_tpu.runtime.store import Store
+
+FINALIZER = "grove.io/operator"
+
+
+@dataclass
+class OperatorContext:
+    """Everything a component needs (the reference passes client/scheme/
+    eventRecorder; we pass the store + clock + topology + expectations)."""
+
+    store: Store
+    clock: Clock
+    topology: Optional[ClusterTopology] = None
+    pod_expectations: ExpectationsStore = field(
+        default_factory=lambda: ExpectationsStore("pod")
+    )
+    events: List[str] = field(default_factory=list)
+    _event_seq: int = 0
+    max_events: int = 1000  # ring buffer (k8s Events have a TTL; we cap)
+
+    def record_event(self, kind: str, reason: str, message: str) -> None:
+        """k8s-Event equivalent: kept as a readable log AND materialized as an
+        Event object in the store (the reference emits corev1 Events on every
+        important transition — SURVEY §5). Capped as a ring buffer so long
+        sims don't accumulate unbounded Event objects."""
+        self.events.append(f"{kind} {reason}: {message}")
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import GenericObject
+
+        self._event_seq += 1
+        try:
+            self.store.create(
+                GenericObject(
+                    kind="Event",
+                    metadata=ObjectMeta(name=f"evt-{self._event_seq}"),
+                    spec={
+                        "involvedKind": kind,
+                        "reason": reason,
+                        "message": message,
+                        "timestamp": self.clock.now(),
+                    },
+                )
+            )
+        except Exception:
+            pass  # events are best-effort (conflict on replayed names etc.)
+        expired = self._event_seq - self.max_events
+        if expired > 0:
+            try:
+                self.store.delete("Event", "default", f"evt-{expired}")
+            except Exception:
+                pass
+
+
+class Component(Protocol):
+    kind: str
+
+    def sync(self, ctx: OperatorContext, owner) -> None: ...
+
+    def delete(self, ctx: OperatorContext, owner) -> None: ...
+
+
+def record_last_error(
+    ctx: OperatorContext, kind: str, namespace: str, name: str, err
+) -> None:
+    """Persist a typed error on the object's status (errors.go:88-103
+    LastErrors). Skips the write when the same code+description is already
+    recorded — a timestamp-only rewrite would emit a self-watch event and
+    defeat the workqueue's backoff with an immediate re-reconcile."""
+    fresh = ctx.store.get(kind, namespace, name)
+    if fresh is None:
+        return
+    entry = {
+        "code": err.code,
+        "description": str(err),
+        "observedAt": ctx.clock.now(),
+    }
+    existing = fresh.status.last_errors
+    if existing and all(
+        existing[0].get(k) == entry[k] for k in ("code", "description")
+    ):
+        return
+    fresh.status.last_errors = [entry]
+    try:
+        ctx.store.update_status(fresh)
+    except Exception:
+        pass  # a failing status write must not mask the original error
+
+
+def create_or_adopt(ctx: OperatorContext, desired) -> None:
+    """Create the child if missing; otherwise adopt label/annotation drift.
+
+    Spec is NOT adopted (it is owned by the child's own controller / HPA),
+    and neither is the pod-template-hash label: the hash only moves together
+    with a spec push during a rolling update (the replica-by-replica
+    orchestrator does both atomically) — otherwise pods would be replaced
+    against the old spec.
+    """
+    ns = desired.metadata.namespace
+    current = ctx.store.get(desired.kind, ns, desired.metadata.name)
+    if current is None:
+        ctx.store.create(desired)
+        return
+    if current.metadata.deletion_timestamp is not None:
+        return
+    from grove_tpu.controller.podclique.status import UPDATE_IN_PROGRESS_ANNOTATION
+
+    want_labels = dict(desired.metadata.labels)
+    cur_hash = current.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+    if cur_hash is not None:
+        want_labels[namegen.LABEL_POD_TEMPLATE_HASH] = cur_hash
+    want_annotations = dict(desired.metadata.annotations)
+    # the update-in-progress marker is owned by the rolling updater too
+    if UPDATE_IN_PROGRESS_ANNOTATION in current.metadata.annotations:
+        want_annotations[UPDATE_IN_PROGRESS_ANNOTATION] = (
+            current.metadata.annotations[UPDATE_IN_PROGRESS_ANNOTATION]
+        )
+    if (
+        current.metadata.labels != want_labels
+        or current.metadata.annotations != want_annotations
+    ):
+        current.metadata.labels = want_labels
+        current.metadata.annotations = want_annotations
+        ctx.store.update(current, bump_generation=False)
+
+
+def find_scaling_group_config_for_clique(
+    configs: List[PodCliqueScalingGroupConfig], clique_name: str
+) -> Optional[PodCliqueScalingGroupConfig]:
+    """component/utils FindScalingGroupConfigForClique."""
+    for cfg in configs:
+        if clique_name in cfg.clique_names:
+            return cfg
+    return None
+
+
+def translate_topology_constraint(
+    tc, topology: Optional[ClusterTopology]
+) -> Optional[SchedTopologyConstraint]:
+    """Operator-side level *name* → scheduler-side topology *key* translation
+    (docs/designs/topology.md:541-616): the user's packDomain becomes the
+    `required` key; the topology's narrowest level becomes the auto-generated
+    `preferred` key."""
+    if tc is None or tc.pack_domain is None or topology is None:
+        return None
+    return SchedTopologyConstraint(
+        pack_constraint=TopologyPackConstraint(
+            required=topology.translate_pack_domain(tc.pack_domain),
+            preferred=topology.narrowest_key(),
+        )
+    )
+
+
+def pcs_child_selector(pcs_name: str) -> Dict[str, str]:
+    return dict(namegen.default_labels(pcs_name))
+
+
+def resolve_starts_after(
+    pcs: PodCliqueSet,
+    pcs_replica: int,
+    clique_name: str,
+    owner_pcsg_fqn: Optional[str] = None,
+    owner_pcsg_replica: Optional[int] = None,
+) -> List[Dict[str, object]]:
+    """Resolve startup dependencies to (parent PCLQ FQN, minAvailable) pairs —
+    the grove-initc contract (`--podcliques=<fqn>:<minAvailable>`,
+    reference initc/cmd/opts/options.go; FQN resolution
+    pcsg components/podclique/podclique.go:349-409).
+
+    - InOrder: the dependency chain is the template clique order.
+    - Explicit: template startsAfter names.
+    - A dependency inside the *same* scaling-group replica resolves to that
+      replica's sibling PCLQ; a standalone dependency resolves to the PCS
+      replica's PCLQ; a dependency on another scaling group's clique resolves
+      to that group's base replicas (0..minAvailable-1).
+    """
+    from grove_tpu.api.types import STARTUP_EXPLICIT, STARTUP_IN_ORDER
+
+    tmpl = pcs.spec.template
+    startup = tmpl.startup_type
+    dep_names: List[str] = []
+    if startup == STARTUP_IN_ORDER:
+        clique_order = [c.name for c in tmpl.cliques]
+        idx = clique_order.index(clique_name)
+        if idx > 0:
+            dep_names = [clique_order[idx - 1]]
+    elif startup == STARTUP_EXPLICIT:
+        clique = tmpl.clique_template(clique_name)
+        dep_names = list(clique.spec.starts_after) if clique else []
+
+    out: List[Dict[str, object]] = []
+    for dep in dep_names:
+        dep_template = tmpl.clique_template(dep)
+        if dep_template is None:
+            continue
+        dep_min_available = dep_template.spec.min_available or 1
+        dep_sg = find_scaling_group_config_for_clique(
+            tmpl.pod_clique_scaling_group_configs, dep
+        )
+        if dep_sg is None:
+            fqn = namegen.podclique_name(pcs.metadata.name, pcs_replica, dep)
+            out.append({"pclq": fqn, "min_available": dep_min_available})
+        elif (
+            owner_pcsg_fqn is not None
+            and owner_pcsg_replica is not None
+            and clique_name in dep_sg.clique_names
+        ):
+            # same-group sibling within the same PCSG replica
+            fqn = namegen.podclique_name(owner_pcsg_fqn, owner_pcsg_replica, dep)
+            out.append({"pclq": fqn, "min_available": dep_min_available})
+        else:
+            # another scaling group: wait on its base replicas
+            dep_sg_fqn = namegen.pcsg_name(pcs.metadata.name, pcs_replica, dep_sg.name)
+            for r in range(dep_sg.min_available or 1):
+                fqn = namegen.podclique_name(dep_sg_fqn, r, dep)
+                out.append({"pclq": fqn, "min_available": dep_min_available})
+    return out

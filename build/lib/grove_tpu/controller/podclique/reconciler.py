@@ -1,0 +1,72 @@
+"""PodClique reconciler: get → delete-flow → spec-flow (pods) → status-flow.
+
+Re-host of /root/reference/operator/internal/controller/podclique/reconciler.go
+with the pod component as its single ordered component
+(podclique/reconcilespec.go:213-217).
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import names as namegen
+from grove_tpu.controller.common import (
+    FINALIZER,
+    OperatorContext,
+    record_last_error,
+)
+from grove_tpu.controller.podclique import pods as pod_component
+from grove_tpu.controller.podclique.status import reconcile_status
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.flow import (
+    ReconcileStepResult,
+    continue_reconcile,
+    do_not_requeue,
+    reconcile_after,
+    reconcile_with_errors,
+)
+from grove_tpu.runtime.workqueue import Key
+
+GATE_RETRY_SECONDS = 2.0
+
+
+class PodCliqueReconciler:
+    def __init__(self, ctx: OperatorContext) -> None:
+        self.ctx = ctx
+
+    def reconcile(self, key: Key) -> ReconcileStepResult:
+        _, ns, name = key
+        pclq = self.ctx.store.get("PodClique", ns, name)
+        if pclq is None:
+            return do_not_requeue()
+        if pclq.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pclq)
+        try:
+            if FINALIZER not in pclq.metadata.finalizers:
+                pclq.metadata.finalizers.append(FINALIZER)
+                pclq = self.ctx.store.update(pclq, bump_generation=False)
+            skipped_gated = pod_component.sync_pods(self.ctx, pclq)
+            fresh = self.ctx.store.get("PodClique", ns, name)
+            if fresh is not None and fresh.metadata.deletion_timestamp is None:
+                reconcile_status(self.ctx, fresh)
+                fresh.status.observed_generation = fresh.metadata.generation
+                fresh.status.last_errors = []  # cleared on a clean reconcile
+                self.ctx.store.update_status(fresh)
+        except GroveError as err:
+            record_last_error(self.ctx, "PodClique", ns, name, err)
+            return reconcile_with_errors(f"podclique {ns}/{name}", err)
+        if skipped_gated:
+            # pods still gated (not in PodGang yet / base gang unscheduled):
+            # retry gate removal (reference pod.go:125-130 ErrCodeRequeueAfter)
+            return reconcile_after(GATE_RETRY_SECONDS, "pods still schedule-gated")
+        return continue_reconcile()
+
+    def _reconcile_delete(self, pclq) -> ReconcileStepResult:
+        ns = pclq.metadata.namespace
+        try:
+            self.ctx.store.delete_collection(
+                "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
+            )
+            self.ctx.pod_expectations.delete_expectations(f"{ns}/{pclq.metadata.name}")
+            self.ctx.store.remove_finalizer("PodClique", ns, pclq.metadata.name, FINALIZER)
+        except GroveError as err:
+            return reconcile_with_errors(f"delete podclique {pclq.metadata.name}", err)
+        return do_not_requeue()

@@ -1,0 +1,140 @@
+"""PodClique status flow.
+
+Re-host of /root/reference/operator/internal/controller/podclique/reconcilestatus.go:
+pod categorization → replica counters → PodCliqueScheduled and
+MinAvailableBreached conditions. The two subtle rules preserved exactly:
+- NOT breached while scheduledReplicas < minAvailable (never gang-terminate a
+  gang that was never scheduled — reconcilestatus.go:192-201)
+- "starting" pods (scheduled, no container started-and-failed signal yet)
+  count as available; pods with a non-zero container exit, or started-but-
+  not-ready pods, count against availability (reconcilestatus.go:205-215)
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.pod import (
+    has_erroneous_exit,
+    is_ready,
+    is_schedule_gated,
+    is_scheduled,
+    is_terminating,
+)
+from grove_tpu.api.types import (
+    COND_MIN_AVAILABLE_BREACHED,
+    COND_POD_CLIQUE_SCHEDULED,
+    PodClique,
+)
+from grove_tpu.controller.common import OperatorContext
+
+UPDATE_IN_PROGRESS_ANNOTATION = "grove.io/update-in-progress"
+
+
+def reconcile_status(ctx: OperatorContext, pclq: PodClique) -> PodClique:
+    ns = pclq.metadata.namespace
+    pods = [
+        p
+        for p in ctx.store.list(
+            "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
+        )
+        if not is_terminating(p)
+    ]
+    st = pclq.status
+    st.replicas = len(pods)
+    st.ready_replicas = sum(1 for p in pods if is_ready(p))
+    st.scheduled_replicas = sum(1 for p in pods if is_scheduled(p))
+    st.schedule_gated_replicas = sum(1 for p in pods if is_schedule_gated(p))
+    current_hash = pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+    st.updated_replicas = sum(
+        1
+        for p in pods
+        if current_hash
+        and p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) == current_hash
+    )
+    st.selector = f"{namegen.LABEL_PODCLIQUE}={pclq.metadata.name}"
+
+    num_error_exits = sum(
+        1 for p in pods if not is_ready(p) and has_erroneous_exit(p)
+    )
+    num_started_not_ready = sum(
+        1
+        for p in pods
+        if is_scheduled(p)
+        and not is_ready(p)
+        and not has_erroneous_exit(p)
+        and any(cs.started for cs in p.status.container_statuses)
+    )
+    now = ctx.clock.now()
+    set_condition(
+        st.conditions, _scheduled_condition(pclq), now
+    )
+    set_condition(
+        st.conditions,
+        _min_available_breached_condition(pclq, num_error_exits, num_started_not_ready),
+        now,
+    )
+    return pclq
+
+
+def _scheduled_condition(pclq: PodClique) -> Condition:
+    """reconcilestatus.go:238-254."""
+    min_available = pclq.spec.min_available or 0
+    if pclq.status.scheduled_replicas < min_available:
+        return Condition(
+            type=COND_POD_CLIQUE_SCHEDULED,
+            status="False",
+            reason="InsufficientScheduledPods",
+            message=(
+                f"Insufficient scheduled pods. expected at least: {min_available},"
+                f" found: {pclq.status.scheduled_replicas}"
+            ),
+        )
+    return Condition(
+        type=COND_POD_CLIQUE_SCHEDULED,
+        status="True",
+        reason="SufficientScheduledPods",
+        message="Sufficient scheduled pods found",
+    )
+
+
+def _min_available_breached_condition(
+    pclq: PodClique, num_error_exits: int, num_started_not_ready: int
+) -> Condition:
+    """reconcilestatus.go:177-225."""
+    if pclq.metadata.annotations.get(UPDATE_IN_PROGRESS_ANNOTATION):
+        return Condition(
+            type=COND_MIN_AVAILABLE_BREACHED,
+            status="Unknown",
+            reason="UpdateInProgress",
+            message="Update is in progress",
+        )
+    min_available = pclq.spec.min_available or 0
+    scheduled = pclq.status.scheduled_replicas
+    if scheduled < min_available:
+        return Condition(
+            type=COND_MIN_AVAILABLE_BREACHED,
+            status="False",
+            reason="InsufficientScheduledPods",
+            message=(
+                f"Insufficient scheduled pods. expected at least: {min_available},"
+                f" found: {scheduled}"
+            ),
+        )
+    ready_or_starting = scheduled - num_error_exits - num_started_not_ready
+    if ready_or_starting < min_available:
+        return Condition(
+            type=COND_MIN_AVAILABLE_BREACHED,
+            status="True",
+            reason="InsufficientReadyPods",
+            message=(
+                f"Insufficient ready or starting pods. expected at least:"
+                f" {min_available}, found: {ready_or_starting}"
+            ),
+        )
+    return Condition(
+        type=COND_MIN_AVAILABLE_BREACHED,
+        status="False",
+        reason="SufficientReadyPods",
+        message="Sufficient ready or starting pods found",
+    )

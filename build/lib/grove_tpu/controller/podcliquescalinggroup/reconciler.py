@@ -1,0 +1,327 @@
+"""PodCliqueScalingGroup reconciler.
+
+Re-host of /root/reference/operator/internal/controller/podcliquescalinggroup/
+(reconcilespec.go, components/podclique/{podclique,sync}.go, reconcilestatus.go):
+- materializes one PodClique per (PCSG replica × member clique) with the gang
+  labels that encode the base/scaled split (podclique.go:423-449)
+- scale-in removes the highest replica indices (sync.go:130-172)
+- a *scaled* replica whose MinAvailableBreached persisted past TerminationDelay
+  is torn down and recreated (sync.go:206-251); base-replica breaches are
+  handled one level up by the PCS replica component (gang termination)
+- status aggregates Scheduled/Available/Updated per PCSG replica
+  (reconcilestatus.go:40-207)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.meta import Condition, ObjectMeta, get_condition, set_condition
+from grove_tpu.api.types import (
+    COND_MIN_AVAILABLE_BREACHED,
+    COND_POD_CLIQUE_SCHEDULED,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+)
+from grove_tpu.controller.common import (
+    FINALIZER,
+    OperatorContext,
+    create_or_adopt,
+    record_last_error,
+    resolve_starts_after,
+)
+from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.flow import (
+    ReconcileStepResult,
+    continue_reconcile,
+    do_not_requeue,
+    reconcile_after,
+    reconcile_with_errors,
+)
+from grove_tpu.runtime.workqueue import Key
+
+
+class PodCliqueScalingGroupReconciler:
+    def __init__(self, ctx: OperatorContext) -> None:
+        self.ctx = ctx
+
+    # -- entry -----------------------------------------------------------
+
+    def reconcile(self, key: Key) -> ReconcileStepResult:
+        _, ns, name = key
+        pcsg = self.ctx.store.get("PodCliqueScalingGroup", ns, name)
+        if pcsg is None:
+            return do_not_requeue()
+        if pcsg.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pcsg)
+        pcs = self._owner_pcs(pcsg)
+        if pcs is None:
+            return do_not_requeue()
+        try:
+            if FINALIZER not in pcsg.metadata.finalizers:
+                pcsg.metadata.finalizers.append(FINALIZER)
+                pcsg = self.ctx.store.update(pcsg, bump_generation=False)
+            requeue_in = self._sync_podcliques(pcsg, pcs)
+            self._reconcile_status(pcsg, pcs)
+        except GroveError as err:
+            record_last_error(self.ctx, "PodCliqueScalingGroup", ns, name, err)
+            return reconcile_with_errors(f"pcsg {ns}/{name}", err)
+        if requeue_in is not None:
+            return reconcile_after(requeue_in, "scaled-replica breach wait")
+        return continue_reconcile()
+
+    def _owner_pcs(self, pcsg) -> Optional[PodCliqueSet]:
+        pcs_name = pcsg.metadata.labels.get(namegen.LABEL_PART_OF, "")
+        return self.ctx.store.get("PodCliqueSet", pcsg.metadata.namespace, pcs_name)
+
+    def _reconcile_delete(self, pcsg) -> ReconcileStepResult:
+        ns = pcsg.metadata.namespace
+        self.ctx.store.delete_collection(
+            "PodClique", ns, {namegen.LABEL_PCSG: pcsg.metadata.name}
+        )
+        self.ctx.store.remove_finalizer(
+            "PodCliqueScalingGroup", ns, pcsg.metadata.name, FINALIZER
+        )
+        return do_not_requeue()
+
+    # -- spec flow -------------------------------------------------------
+
+    def _sync_podcliques(
+        self, pcsg: PodCliqueScalingGroup, pcs: PodCliqueSet
+    ) -> Optional[float]:
+        ns = pcsg.metadata.namespace
+        pcs_replica = int(
+            pcsg.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0")
+        )
+        sg_name = namegen.extract_sg_name_from_pcsg_fqn(
+            pcsg.metadata.name, pcs.metadata.name, pcs_replica
+        )
+
+        existing = self.ctx.store.list(
+            "PodClique", ns, {namegen.LABEL_PCSG: pcsg.metadata.name}, cached=True
+        )
+        existing_by_name = {p.metadata.name: p for p in existing}
+
+        expected: Dict[str, PodClique] = {}
+        for replica in range(pcsg.spec.replicas):
+            for clique_name in pcsg.spec.clique_names:
+                pclq = self._build_pclq(
+                    pcs, pcs_replica, pcsg, sg_name, replica, clique_name
+                )
+                if pclq is not None:
+                    expected[pclq.metadata.name] = pclq
+
+        # create missing; adopt label/annotation drift on existing
+        for pclq in expected.values():
+            create_or_adopt(self.ctx, pclq)
+
+        # scale-in: delete excess (highest replica indices first — sync.go:130-172)
+        for name in sorted(set(existing_by_name) - set(expected), reverse=True):
+            self.ctx.store.delete("PodClique", ns, name)
+
+        return self._terminate_breached_scaled_replicas(pcsg, pcs, pcs_replica)
+
+    def _build_pclq(
+        self,
+        pcs: PodCliqueSet,
+        pcs_replica: int,
+        pcsg: PodCliqueScalingGroup,
+        sg_name: str,
+        replica: int,
+        clique_name: str,
+    ) -> Optional[PodClique]:
+        tmpl = pcs.spec.template.clique_template(clique_name)
+        if tmpl is None:
+            return None
+        sg_cfg = None
+        for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+            if cfg.name == sg_name:
+                sg_cfg = cfg
+        min_available = (
+            pcsg.spec.min_available
+            if pcsg.spec.min_available
+            else (sg_cfg.min_available if sg_cfg else 1)
+        )
+
+        fqn = namegen.podclique_name(pcsg.metadata.name, replica, clique_name)
+        gang = namegen.podgang_name_for_pcsg_replica(
+            pcs.metadata.name, pcs_replica, pcsg.metadata.name, replica, min_available
+        )
+        labels = dict(namegen.default_labels(pcs.metadata.name))
+        labels.update(tmpl.labels)
+        labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PCSG_PODCLIQUE
+        labels[namegen.LABEL_PCS_REPLICA_INDEX] = str(pcs_replica)
+        labels[namegen.LABEL_PCSG] = pcsg.metadata.name
+        labels[namegen.LABEL_PCSG_REPLICA_INDEX] = str(replica)
+        labels[namegen.LABEL_PODGANG] = gang
+        labels[namegen.LABEL_POD_TEMPLATE_HASH] = compute_pod_template_hash(
+            tmpl, pcs.spec.template.priority_class_name
+        )
+        if replica >= min_available:
+            # scaled replica: points back at its base gang (podclique.go:423-449)
+            labels[namegen.LABEL_BASE_PODGANG] = namegen.base_podgang_name(
+                pcs.metadata.name, pcs_replica
+            )
+
+        annotations = dict(tmpl.annotations)
+        deps = resolve_starts_after(
+            pcs,
+            pcs_replica,
+            clique_name,
+            owner_pcsg_fqn=pcsg.metadata.name,
+            owner_pcsg_replica=replica,
+        )
+        if deps:
+            annotations[STARTUP_DEPS_ANNOTATION] = json.dumps(deps)
+
+        from grove_tpu.api.meta import deep_copy
+
+        spec = deep_copy(tmpl.spec)
+        return PodClique(
+            metadata=ObjectMeta(
+                name=fqn,
+                namespace=pcs.metadata.namespace,
+                labels=labels,
+                annotations=annotations,
+            ),
+            spec=spec,
+        )
+
+    # -- scaled-replica gang termination ---------------------------------
+
+    def _terminate_breached_scaled_replicas(
+        self, pcsg: PodCliqueScalingGroup, pcs: PodCliqueSet, pcs_replica: int
+    ) -> Optional[float]:
+        """sync.go:206-251: a scaled replica breached longer than
+        TerminationDelay is deleted (then recreated by the next sync).
+        Returns the minimum remaining wait if any replica is breached."""
+        delay = pcs.spec.template.termination_delay or 0.0
+        now = self.ctx.clock.now()
+        min_available = pcsg.spec.min_available
+        ns = pcsg.metadata.namespace
+        min_wait: Optional[float] = None
+        for replica in range(min_available, pcsg.spec.replicas):
+            breach_since = self._replica_breach_since(pcsg, replica)
+            if breach_since is None:
+                continue
+            age = now - breach_since
+            if age >= delay:
+                for clique_name in pcsg.spec.clique_names:
+                    fqn = namegen.podclique_name(
+                        pcsg.metadata.name, replica, clique_name
+                    )
+                    if self.ctx.store.get("PodClique", ns, fqn) is not None:
+                        self.ctx.store.delete("PodClique", ns, fqn)
+                self.ctx.record_event(
+                    "PodCliqueScalingGroup",
+                    "ScaledReplicaGangTerminated",
+                    f"{pcsg.metadata.name} replica {replica}",
+                )
+            else:
+                remaining = delay - age
+                min_wait = remaining if min_wait is None else min(min_wait, remaining)
+        return min_wait
+
+    def _replica_breach_since(
+        self, pcsg: PodCliqueScalingGroup, replica: int
+    ) -> Optional[float]:
+        """Earliest still-True MinAvailableBreached transition among the
+        replica's constituent PCLQs (None if none breached)."""
+        ns = pcsg.metadata.namespace
+        since: Optional[float] = None
+        for clique_name in pcsg.spec.clique_names:
+            fqn = namegen.podclique_name(pcsg.metadata.name, replica, clique_name)
+            pclq = self.ctx.store.get("PodClique", ns, fqn, cached=True)
+            if pclq is None:
+                continue
+            cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
+            if cond is not None and cond.is_true():
+                t = cond.last_transition_time
+                since = t if since is None else min(since, t)
+        return since
+
+    # -- status flow -----------------------------------------------------
+
+    def _reconcile_status(
+        self, pcsg: PodCliqueScalingGroup, pcs: PodCliqueSet
+    ) -> None:
+        ns = pcsg.metadata.namespace
+        fresh = self.ctx.store.get("PodCliqueScalingGroup", ns, pcsg.metadata.name)
+        if fresh is None or fresh.metadata.deletion_timestamp is not None:
+            return
+        scheduled = available = updated = 0
+        for replica in range(fresh.spec.replicas):
+            pclqs: List[PodClique] = []
+            for clique_name in fresh.spec.clique_names:
+                fqn = namegen.podclique_name(fresh.metadata.name, replica, clique_name)
+                pclq = self.ctx.store.get("PodClique", ns, fqn, cached=True)
+                if pclq is not None:
+                    pclqs.append(pclq)
+            if len(pclqs) < len(fresh.spec.clique_names):
+                continue
+            if all(
+                (c := get_condition(p.status.conditions, COND_POD_CLIQUE_SCHEDULED))
+                is not None
+                and c.is_true()
+                for p in pclqs
+            ):
+                scheduled += 1
+            if not any(
+                (c := get_condition(p.status.conditions, COND_MIN_AVAILABLE_BREACHED))
+                is not None
+                and c.is_true()
+                for p in pclqs
+            ):
+                available += 1
+            if all(
+                p.status.updated_replicas >= p.spec.replicas for p in pclqs
+            ):
+                updated += 1
+
+        st = fresh.status
+        st.observed_generation = fresh.metadata.generation
+        st.replicas = fresh.spec.replicas
+        st.scheduled_replicas = scheduled
+        st.available_replicas = available
+        st.updated_replicas = updated
+        st.selector = f"{namegen.LABEL_PCSG}={fresh.metadata.name}"
+        now = self.ctx.clock.now()
+        set_condition(st.conditions, self._breached_condition(fresh), now)
+        self.ctx.store.update_status(fresh)
+
+    @staticmethod
+    def _breached_condition(pcsg: PodCliqueScalingGroup) -> Condition:
+        """reconcilestatus.go:149-207 — with the same never-scheduled guard
+        as the PCLQ condition."""
+        min_available = pcsg.spec.min_available
+        if pcsg.status.scheduled_replicas < min_available:
+            return Condition(
+                type=COND_MIN_AVAILABLE_BREACHED,
+                status="False",
+                reason="InsufficientScheduledReplicas",
+                message=(
+                    f"Insufficient scheduled replicas. expected at least:"
+                    f" {min_available}, found: {pcsg.status.scheduled_replicas}"
+                ),
+            )
+        if pcsg.status.available_replicas < min_available:
+            return Condition(
+                type=COND_MIN_AVAILABLE_BREACHED,
+                status="True",
+                reason="InsufficientAvailableReplicas",
+                message=(
+                    f"Insufficient available replicas. expected at least:"
+                    f" {min_available}, found: {pcsg.status.available_replicas}"
+                ),
+            )
+        return Condition(
+            type=COND_MIN_AVAILABLE_BREACHED,
+            status="False",
+            reason="SufficientAvailableReplicas",
+            message="Sufficient available replicas",
+        )

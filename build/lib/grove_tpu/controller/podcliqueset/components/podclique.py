@@ -1,0 +1,75 @@
+"""PCS podclique component: standalone PodCliques per PCS replica.
+
+Re-host of /root/reference/operator/internal/controller/podcliqueset/components/
+podclique/podclique.go (395 LoC): one PCLQ per (PCS replica × standalone
+clique template), labeled with the base PodGang of its replica; deletes
+PCLQs of removed PCS replicas.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.meta import ObjectMeta, deep_copy
+from grove_tpu.api.types import PodClique, PodCliqueSet
+from grove_tpu.controller.common import (
+    OperatorContext,
+    create_or_adopt,
+    resolve_starts_after,
+)
+from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
+
+
+def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
+    ns = pcs.metadata.namespace
+    selector = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_COMPONENT: namegen.COMPONENT_PCS_PODCLIQUE,
+    }
+    existing = {
+        p.metadata.name: p for p in ctx.store.list("PodClique", ns, selector)
+    }
+    expected: Dict[str, PodClique] = {}
+    for replica in range(pcs.spec.replicas):
+        for clique in pcs.spec.template.standalone_clique_templates():
+            pclq = build_pclq(pcs, replica, clique)
+            expected[pclq.metadata.name] = pclq
+
+    for name, pclq in expected.items():
+        if name not in existing:
+            ctx.record_event("PodClique", "PodCliqueCreateSuccessful", name)
+        create_or_adopt(ctx, pclq)
+
+    for name in set(existing) - set(expected):
+        ctx.store.delete("PodClique", ns, name)
+        ctx.record_event("PodClique", "PodCliqueDeleteSuccessful", name)
+
+
+def build_pclq(pcs: PodCliqueSet, replica: int, clique) -> PodClique:
+    fqn = namegen.podclique_name(pcs.metadata.name, replica, clique.name)
+    labels = dict(namegen.default_labels(pcs.metadata.name))
+    labels.update(clique.labels)
+    labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PCS_PODCLIQUE
+    labels[namegen.LABEL_PCS_REPLICA_INDEX] = str(replica)
+    labels[namegen.LABEL_PODGANG] = namegen.base_podgang_name(
+        pcs.metadata.name, replica
+    )
+    labels[namegen.LABEL_POD_TEMPLATE_HASH] = compute_pod_template_hash(
+        clique, pcs.spec.template.priority_class_name
+    )
+    annotations = dict(clique.annotations)
+    deps = resolve_starts_after(pcs, replica, clique.name)
+    if deps:
+        annotations[STARTUP_DEPS_ANNOTATION] = json.dumps(deps)
+    return PodClique(
+        metadata=ObjectMeta(
+            name=fqn,
+            namespace=pcs.metadata.namespace,
+            labels=labels,
+            annotations=annotations,
+        ),
+        spec=deep_copy(clique.spec),
+    )

@@ -1,0 +1,277 @@
+"""PCS rolling-update orchestration: one replica at a time.
+
+Re-host of /root/reference/operator/internal/controller/podcliqueset/components/
+podcliquesetreplica/rollingupdate.go:39-260:
+- triggered by a generation-hash change (reconcilespec.go:72-123; the
+  reconciler seeds status.rolling_update_progress)
+- replica pick order (rollingupdate.go:196-223): no-scheduled-pods first,
+  then MinAvailableBreached-but-not-expired, then ascending index
+- the selected replica's PodCliques (standalone + scaling-group-owned) get
+  the new template spec + pod-template-hash pushed atomically, plus the
+  update-in-progress annotation that turns MinAvailableBreached Unknown
+  (podclique/reconcilestatus.go UpdateInProgress) so the gang terminator
+  never fires mid-update
+- a replica completes when every PCLQ reports updatedReplicas >= replicas and
+  ready >= minAvailable; then the next replica is picked; when none remain,
+  update_ended_at is stamped
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.meta import deep_copy, get_condition
+from grove_tpu.api.types import (
+    COND_MIN_AVAILABLE_BREACHED,
+    PCSReplicaRollingUpdateProgress,
+    PodCliqueSet,
+)
+from grove_tpu.controller.common import (
+    OperatorContext,
+    find_scaling_group_config_for_clique,
+    resolve_starts_after,
+)
+from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
+from grove_tpu.controller.podclique.status import UPDATE_IN_PROGRESS_ANNOTATION
+
+
+def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
+    """Run one step of the rolling update. Returns a requeue delay while the
+    update is in flight, None when idle/complete."""
+    progress = pcs.status.rolling_update_progress
+    if progress is None or progress.update_ended_at is not None:
+        return None
+
+    current = progress.currently_updating
+    if current is not None:
+        if not _replica_update_done(ctx, pcs, current.replica_index):
+            _push_template_to_replica(ctx, pcs, current.replica_index)
+            return 2.0
+        _complete_replica(ctx, pcs, current.replica_index)
+        pcs = ctx.store.get("PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name)
+        progress = pcs.status.rolling_update_progress
+
+    next_replica = _pick_next_replica(ctx, pcs)
+    if next_replica is None:
+        progress.update_ended_at = ctx.clock.now()
+        progress.currently_updating = None
+        ctx.store.update_status(pcs)
+        ctx.record_event("PodCliqueSet", "RollingUpdateCompleted", pcs.metadata.name)
+        return None
+    progress.currently_updating = PCSReplicaRollingUpdateProgress(
+        replica_index=next_replica, update_started_at=ctx.clock.now()
+    )
+    ctx.store.update_status(pcs)
+    ctx.record_event(
+        "PodCliqueSet",
+        "RollingUpdateReplicaStarted",
+        f"{pcs.metadata.name} replica {next_replica}",
+    )
+    _push_template_to_replica(ctx, pcs, next_replica)
+    return 2.0
+
+
+# ---------------------------------------------------------------------------
+# replica selection
+# ---------------------------------------------------------------------------
+
+
+def _replica_pclqs(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> List:
+    return ctx.store.list(
+        "PodClique",
+        pcs.metadata.namespace,
+        {
+            **namegen.default_labels(pcs.metadata.name),
+            namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+        },
+    )
+
+
+def _replica_needs_update(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> bool:
+    for pclq in _replica_pclqs(ctx, pcs, replica):
+        tmpl_name = _clique_template_name(pcs, pclq)
+        tmpl = pcs.spec.template.clique_template(tmpl_name)
+        if tmpl is None:
+            continue
+        want = compute_pod_template_hash(
+            tmpl, pcs.spec.template.priority_class_name
+        )
+        if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want:
+            return True
+        if pclq.status.updated_replicas < pclq.spec.replicas:
+            return True
+    return False
+
+
+def _clique_template_name(pcs: PodCliqueSet, pclq) -> str:
+    """PCLQ FQN → clique template name (strip owner + replica prefix)."""
+    pcsg = pclq.metadata.labels.get(namegen.LABEL_PCSG)
+    owner = pcsg if pcsg else pcs.metadata.name
+    owner_replica = (
+        pclq.metadata.labels.get(namegen.LABEL_PCSG_REPLICA_INDEX)
+        if pcsg
+        else pclq.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0")
+    )
+    prefix = f"{owner}-{owner_replica}-"
+    return pclq.metadata.name[len(prefix):]
+
+
+def _pick_next_replica(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[int]:
+    """rollingupdate.go:196-250 ordering."""
+    candidates = []
+    for replica in range(pcs.spec.replicas):
+        if not _replica_needs_update(ctx, pcs, replica):
+            continue
+        pclqs = _replica_pclqs(ctx, pcs, replica)
+        scheduled = sum(p.status.scheduled_replicas for p in pclqs)
+        breached = any(
+            (c := get_condition(p.status.conditions, COND_MIN_AVAILABLE_BREACHED))
+            is not None
+            and c.is_true()
+            for p in pclqs
+        )
+        candidates.append((0 if scheduled == 0 else 1, 0 if breached else 1, replica))
+    if not candidates:
+        return None
+    return sorted(candidates)[0][2]
+
+
+# ---------------------------------------------------------------------------
+# template push + completion
+# ---------------------------------------------------------------------------
+
+
+def _push_template_to_replica(
+    ctx: OperatorContext, pcs: PodCliqueSet, replica: int
+) -> None:
+    """Atomically update spec + hash label (+ update-in-progress marker) on
+    every PCLQ of the replica; PCSGs of the replica track their own
+    rolling-update progress (scalinggroup.go:105-129)."""
+    _mark_pcsg_progress(ctx, pcs, replica)
+    tmpl_root = pcs.spec.template
+    for pclq in _replica_pclqs(ctx, pcs, replica):
+        if pclq.metadata.deletion_timestamp is not None:
+            continue
+        name = _clique_template_name(pcs, pclq)
+        tmpl = tmpl_root.clique_template(name)
+        if tmpl is None:
+            continue
+        want_hash = compute_pod_template_hash(tmpl, tmpl_root.priority_class_name)
+        changed = False
+        if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want_hash:
+            new_spec = deep_copy(tmpl.spec)
+            # preserve HPA-scaled replica counts on standalone cliques
+            sg = find_scaling_group_config_for_clique(
+                tmpl_root.pod_clique_scaling_group_configs, name
+            )
+            if sg is None and pclq.spec.auto_scaling_config is not None:
+                new_spec.replicas = pclq.spec.replicas
+            pclq.spec = new_spec
+            pclq.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = want_hash
+            _refresh_startup_deps(pcs, pclq, name)
+            changed = True
+        if UPDATE_IN_PROGRESS_ANNOTATION not in pclq.metadata.annotations:
+            pclq.metadata.annotations[UPDATE_IN_PROGRESS_ANNOTATION] = "true"
+            changed = True
+        if changed:
+            ctx.store.update(pclq)
+
+
+def _refresh_startup_deps(pcs: PodCliqueSet, pclq, clique_name: str) -> None:
+    pcsg_fqn = pclq.metadata.labels.get(namegen.LABEL_PCSG)
+    pcs_replica = int(pclq.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0"))
+    sg_replica = pclq.metadata.labels.get(namegen.LABEL_PCSG_REPLICA_INDEX)
+    deps = resolve_starts_after(
+        pcs,
+        pcs_replica,
+        clique_name,
+        owner_pcsg_fqn=pcsg_fqn,
+        owner_pcsg_replica=int(sg_replica) if sg_replica is not None else None,
+    )
+    if deps:
+        pclq.metadata.annotations[STARTUP_DEPS_ANNOTATION] = json.dumps(deps)
+    else:
+        pclq.metadata.annotations.pop(STARTUP_DEPS_ANNOTATION, None)
+
+
+def _replica_update_done(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> bool:
+    pclqs = _replica_pclqs(ctx, pcs, replica)
+    if not pclqs:
+        return True
+    for pclq in pclqs:
+        name = _clique_template_name(pcs, pclq)
+        tmpl = pcs.spec.template.clique_template(name)
+        if tmpl is None:
+            continue
+        want = compute_pod_template_hash(
+            tmpl, pcs.spec.template.priority_class_name
+        )
+        if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want:
+            return False
+        if pclq.status.updated_replicas < pclq.spec.replicas:
+            return False
+        if pclq.status.ready_replicas < (pclq.spec.min_available or 1):
+            return False
+    return True
+
+
+def _mark_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    from grove_tpu.api.types import PCSGRollingUpdateProgress
+
+    sel = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+    }
+    for pcsg in ctx.store.list("PodCliqueScalingGroup", pcs.metadata.namespace, sel):
+        if pcsg.status.rolling_update_progress is None or (
+            pcsg.status.rolling_update_progress.update_ended_at is not None
+        ):
+            pcsg.status.rolling_update_progress = PCSGRollingUpdateProgress(
+                update_started_at=ctx.clock.now(),
+                ready_replica_indices_selected_to_update=list(
+                    range(pcsg.spec.replicas)
+                ),
+            )
+            ctx.store.update_status(pcsg)
+
+
+def _finish_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    sel = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+    }
+    for pcsg in ctx.store.list("PodCliqueScalingGroup", pcs.metadata.namespace, sel):
+        progress = pcsg.status.rolling_update_progress
+        if progress is not None and progress.update_ended_at is None:
+            progress.update_ended_at = ctx.clock.now()
+            progress.updated_replica_indices = list(range(pcsg.spec.replicas))
+            progress.ready_replica_indices_selected_to_update = []
+            ctx.store.update_status(pcsg)
+
+
+def _complete_replica(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
+    _finish_pcsg_progress(ctx, pcs, replica)
+    progress = pcs.status.rolling_update_progress
+    for pclq in _replica_pclqs(ctx, pcs, replica):
+        if UPDATE_IN_PROGRESS_ANNOTATION in pclq.metadata.annotations:
+            pclq.metadata.annotations.pop(UPDATE_IN_PROGRESS_ANNOTATION)
+            ctx.store.update(pclq, bump_generation=False)
+        if pclq.metadata.labels.get(namegen.LABEL_PCSG):
+            if pclq.metadata.labels[namegen.LABEL_PCSG] not in (
+                progress.updated_pod_clique_scaling_groups
+            ):
+                progress.updated_pod_clique_scaling_groups.append(
+                    pclq.metadata.labels[namegen.LABEL_PCSG]
+                )
+        elif pclq.metadata.name not in progress.updated_pod_cliques:
+            progress.updated_pod_cliques.append(pclq.metadata.name)
+    progress.currently_updating = None
+    ctx.store.update_status(pcs)
+    ctx.record_event(
+        "PodCliqueSet",
+        "RollingUpdateReplicaCompleted",
+        f"{pcs.metadata.name} replica {replica}",
+    )

@@ -1,0 +1,58 @@
+"""PCS scalinggroup component: PodCliqueScalingGroup CRs from template configs.
+
+Re-host of /root/reference/operator/internal/controller/podcliqueset/components/
+podcliquescalinggroup/podcliquescalinggroup.go (250 LoC). Replicas on an
+existing PCSG are owned by its HPA (scale subresource) — sync must not clobber
+them back to the template value.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import (
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupSpec,
+    PodCliqueSet,
+)
+from grove_tpu.controller.common import OperatorContext
+
+
+def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
+    ns = pcs.metadata.namespace
+    selector = {
+        **namegen.default_labels(pcs.metadata.name),
+        namegen.LABEL_COMPONENT: namegen.COMPONENT_PCSG,
+    }
+    existing = {
+        g.metadata.name: g
+        for g in ctx.store.list("PodCliqueScalingGroup", ns, selector)
+    }
+    expected: Dict[str, PodCliqueScalingGroup] = {}
+    for replica in range(pcs.spec.replicas):
+        for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+            fqn = namegen.pcsg_name(pcs.metadata.name, replica, cfg.name)
+            labels = dict(namegen.default_labels(pcs.metadata.name))
+            labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PCSG
+            labels[namegen.LABEL_PCS_REPLICA_INDEX] = str(replica)
+            labels[namegen.LABEL_PCSG] = fqn
+            expected[fqn] = PodCliqueScalingGroup(
+                metadata=ObjectMeta(name=fqn, namespace=ns, labels=labels),
+                spec=PodCliqueScalingGroupSpec(
+                    replicas=cfg.replicas or 1,
+                    min_available=cfg.min_available or 1,
+                    clique_names=list(cfg.clique_names),
+                ),
+            )
+
+    for name, pcsg in expected.items():
+        if name not in existing:
+            ctx.store.create(pcsg)
+            ctx.record_event("PodCliqueScalingGroup", "PCSGCreateSuccessful", name)
+        # existing PCSGs keep their (possibly HPA-scaled) replicas
+
+    for name in set(existing) - set(expected):
+        ctx.store.delete("PodCliqueScalingGroup", ns, name)
+        ctx.record_event("PodCliqueScalingGroup", "PCSGDeleteSuccessful", name)

@@ -1,0 +1,263 @@
+"""PodCliqueSet reconciler: get → delete-flow → spec-flow → status-flow.
+
+Re-host of /root/reference/operator/internal/controller/podcliqueset/
+{reconciler.go,reconcilespec.go,reconcilestatus.go}: ensureFinalizer →
+processGenerationHashChange → sync ordered components (SA, Role, RoleBinding,
+SATokenSecret, HeadlessService, HPA, PCSReplica, PodClique, PCSG, PodGang —
+reconcilespec.go:202-215) → updateObservedGeneration; status aggregates
+replica availability and PodGang phases.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.hashing import compute_pcs_generation_hash
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.types import (
+    COND_MIN_AVAILABLE_BREACHED,
+    COND_POD_CLIQUE_SCHEDULED,
+    COND_PODGANG_SCHEDULED,
+    PHASE_PENDING,
+    PHASE_RUNNING,
+    PHASE_STARTING,
+    PCSRollingUpdateProgress,
+    PodCliqueSet,
+    PodGangStatusSummary,
+)
+from grove_tpu.controller.common import (
+    FINALIZER,
+    OperatorContext,
+    record_last_error,
+)
+from grove_tpu.controller.podcliqueset.components import (
+    infra,
+    podclique,
+    podgang,
+    replica as replica_component,
+    rollingupdate,
+    scalinggroup,
+)
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.runtime.flow import (
+    ReconcileStepResult,
+    continue_reconcile,
+    do_not_requeue,
+    reconcile_after,
+    reconcile_with_errors,
+)
+from grove_tpu.runtime.workqueue import Key
+
+CHILD_KINDS_CASCADE = [
+    "PodGang",
+    "PodClique",
+    "PodCliqueScalingGroup",
+    "Service",
+    "HorizontalPodAutoscaler",
+    "ServiceAccount",
+    "Role",
+    "RoleBinding",
+    "Secret",
+]
+
+
+class PodCliqueSetReconciler:
+    def __init__(self, ctx: OperatorContext) -> None:
+        self.ctx = ctx
+
+    def reconcile(self, key: Key) -> ReconcileStepResult:
+        _, ns, name = key
+        pcs = self.ctx.store.get("PodCliqueSet", ns, name)
+        if pcs is None:
+            return do_not_requeue()
+        if pcs.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pcs)
+        try:
+            result = self._reconcile_spec(pcs)
+            self._reconcile_status(ns, name)
+        except GroveError as err:
+            record_last_error(self.ctx, "PodCliqueSet", ns, name, err)
+            return reconcile_with_errors(f"pcs {ns}/{name}", err)
+        return result
+
+    # -- delete flow -----------------------------------------------------
+
+    def _reconcile_delete(self, pcs: PodCliqueSet) -> ReconcileStepResult:
+        ns = pcs.metadata.namespace
+        selector = namegen.default_labels(pcs.metadata.name)
+        remaining = 0
+        for kind in CHILD_KINDS_CASCADE:
+            victims = self.ctx.store.list(kind, ns, selector)
+            for v in victims:
+                if v.metadata.deletion_timestamp is None:
+                    self.ctx.store.delete(kind, ns, v.metadata.name)
+            remaining += len(self.ctx.store.list(kind, ns, selector))
+        if remaining:
+            # children drain asynchronously (their finalizers); check back
+            return reconcile_after(0.001, "waiting for child deletion")
+        self.ctx.store.remove_finalizer(
+            "PodCliqueSet", ns, pcs.metadata.name, FINALIZER
+        )
+        return do_not_requeue()
+
+    # -- spec flow -------------------------------------------------------
+
+    def _reconcile_spec(self, pcs: PodCliqueSet) -> ReconcileStepResult:
+        if FINALIZER not in pcs.metadata.finalizers:
+            pcs.metadata.finalizers.append(FINALIZER)
+            pcs = self.ctx.store.update(pcs, bump_generation=False)
+
+        pcs = self._process_generation_hash(pcs)
+
+        infra.sync_rbac(self.ctx, pcs)
+        infra.sync_headless_services(self.ctx, pcs)
+        infra.sync_hpas(self.ctx, pcs)
+        breach_wait = replica_component.sync(self.ctx, pcs)
+        update_wait = rollingupdate.sync(self.ctx, pcs)
+        podclique.sync(self.ctx, pcs)
+        scalinggroup.sync(self.ctx, pcs)
+        podgang.sync(self.ctx, pcs)
+
+        fresh = self.ctx.store.get(
+            "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
+        )
+        if fresh is not None and fresh.metadata.deletion_timestamp is None:
+            fresh.status.observed_generation = fresh.metadata.generation
+            self.ctx.store.update_status(fresh)
+
+        waits = [w for w in (breach_wait, update_wait) if w is not None]
+        if waits:
+            return reconcile_after(min(waits), "breach/rolling-update wait")
+        return continue_reconcile()
+
+    def _process_generation_hash(self, pcs: PodCliqueSet) -> PodCliqueSet:
+        """reconcilespec.go:72-123: template hash change starts a rolling
+        update (progress tracked in status)."""
+        new_hash = compute_pcs_generation_hash(pcs)
+        if pcs.status.current_generation_hash is None:
+            pcs.status.current_generation_hash = new_hash
+            return self.ctx.store.update_status(pcs)
+        if pcs.status.current_generation_hash != new_hash:
+            pcs.status.current_generation_hash = new_hash
+            pcs.status.rolling_update_progress = PCSRollingUpdateProgress(
+                update_started_at=self.ctx.clock.now()
+            )
+            self.ctx.record_event(
+                "PodCliqueSet", "RollingUpdateStarted", pcs.metadata.name
+            )
+            return self.ctx.store.update_status(pcs)
+        return pcs
+
+    # -- status flow -----------------------------------------------------
+
+    def _reconcile_status(self, ns: str, name: str) -> None:
+        pcs = self.ctx.store.get("PodCliqueSet", ns, name)
+        if pcs is None or pcs.metadata.deletion_timestamp is not None:
+            return
+        gangs = self.ctx.store.list(
+            "PodGang",
+            ns,
+            {
+                **namegen.default_labels(name),
+                namegen.LABEL_COMPONENT: namegen.COMPONENT_PODGANG,
+            },
+            cached=True,
+        )
+        pcs.status.replicas = pcs.spec.replicas
+        pcs.status.pod_gang_statuses = [
+            PodGangStatusSummary(
+                name=g.metadata.name,
+                phase=g.status.phase,
+                conditions=list(g.status.conditions),
+            )
+            for g in gangs
+        ]
+        pcs.status.available_replicas = self._count_available_replicas(pcs)
+        pcs.status.updated_replicas = self._count_updated_replicas(pcs)
+        pcs.status.selector = f"{namegen.LABEL_PART_OF}={name}"
+        pcs.status.last_errors = []  # cleared on a clean reconcile
+        self.ctx.store.update_status(pcs)
+
+    def _count_updated_replicas(self, pcs: PodCliqueSet) -> int:
+        """Replicas whose every PCLQ carries the current template hash with
+        all pods updated (podcliqueset.go:68-70 UpdatedReplicas)."""
+        from grove_tpu.api.hashing import compute_pod_template_hash
+        from grove_tpu.controller.podcliqueset.components.rollingupdate import (
+            _clique_template_name,
+        )
+
+        ns = pcs.metadata.namespace
+        tmpl = pcs.spec.template
+        # hash depends only on the template — compute once per clique
+        want_hash = {
+            clique.name: compute_pod_template_hash(
+                clique, tmpl.priority_class_name
+            )
+            for clique in tmpl.cliques
+        }
+        count = 0
+        for replica in range(pcs.spec.replicas):
+            sel = {
+                **namegen.default_labels(pcs.metadata.name),
+                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+            }
+            pclqs = self.ctx.store.list("PodClique", ns, sel, cached=True)
+            if not pclqs:
+                continue
+            updated = True
+            for pclq in pclqs:
+                want = want_hash.get(_clique_template_name(pcs, pclq))
+                if want is None:
+                    continue
+                if (
+                    pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+                    != want
+                    or pclq.status.updated_replicas < pclq.spec.replicas
+                ):
+                    updated = False
+                    break
+            if updated:
+                count += 1
+        return count
+
+    def _count_available_replicas(self, pcs: PodCliqueSet) -> int:
+        """A PCS replica is available when every standalone PCLQ is actually
+        scheduled up to minAvailable (PodCliqueScheduled=True), every PCSG has
+        scheduledReplicas >= minAvailable, and none of them currently breach
+        MinAvailable (podcliqueset/reconcilestatus.go availability rule —
+        never count a never-scheduled replica as available)."""
+        ns = pcs.metadata.namespace
+        count = 0
+        for replica in range(pcs.spec.replicas):
+            sel = {
+                **namegen.default_labels(pcs.metadata.name),
+                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+            }
+            pclqs = [
+                p
+                for p in self.ctx.store.list("PodClique", ns, sel, cached=True)
+                if p.metadata.labels.get(namegen.LABEL_COMPONENT)
+                == namegen.COMPONENT_PCS_PODCLIQUE
+            ]
+            pcsgs = self.ctx.store.list(
+                "PodCliqueScalingGroup", ns, sel, cached=True
+            )
+            entities = pclqs + pcsgs
+            if not entities:
+                continue
+            scheduled = all(
+                (c := get_condition(p.status.conditions, COND_POD_CLIQUE_SCHEDULED))
+                is not None
+                and c.is_true()
+                for p in pclqs
+            ) and all(
+                g.status.scheduled_replicas >= g.spec.min_available for g in pcsgs
+            )
+            breached = any(
+                (c := get_condition(e.status.conditions, COND_MIN_AVAILABLE_BREACHED))
+                is not None
+                and c.is_true()
+                for e in entities
+            )
+            if scheduled and not breached:
+                count += 1
+        return count

@@ -1,0 +1,109 @@
+"""Controller registration: wire the three reconcilers + watch mappings.
+
+Re-host of /root/reference/operator/internal/controller/register.go:29-43 and
+the per-controller watch wiring (podclique/register.go:49-278 etc.), in the
+same PCS → PCLQ → PCSG order.
+"""
+
+from __future__ import annotations
+
+from grove_tpu.api import names as namegen
+from grove_tpu.controller.common import OperatorContext
+from grove_tpu.controller.podclique.reconciler import PodCliqueReconciler
+from grove_tpu.controller.podcliquescalinggroup.reconciler import (
+    PodCliqueScalingGroupReconciler,
+)
+from grove_tpu.controller.podcliqueset.reconciler import PodCliqueSetReconciler
+from grove_tpu.runtime.engine import Controller, Engine
+
+
+def _map_to_part_of(ev):
+    """Child event → owning PodCliqueSet (via app.kubernetes.io/part-of)."""
+    owner = ev.obj.metadata.labels.get(namegen.LABEL_PART_OF)
+    return [(ev.obj.metadata.namespace, owner)] if owner else []
+
+
+def _map_pod_to_pclq(ev):
+    pclq = ev.obj.metadata.labels.get(namegen.LABEL_PODCLIQUE)
+    return [(ev.obj.metadata.namespace, pclq)] if pclq else []
+
+
+def _map_podgang_to_pclqs(ev):
+    """podclique/register.go:242-278: PodGang events map back to the PCLQs
+    named by its PodGroups (drives the ungating handshake)."""
+    ns = ev.obj.metadata.namespace
+    return [(ns, group.name) for group in ev.obj.spec.pod_groups]
+
+
+def _map_pclq_to_pcsg(ev):
+    pcsg = ev.obj.metadata.labels.get(namegen.LABEL_PCSG)
+    return [(ev.obj.metadata.namespace, pcsg)] if pcsg else []
+
+
+def _map_pcs_to_children_of_kind(ctx: OperatorContext, kind: str):
+    def map_fn(ev):
+        sel = namegen.default_labels(ev.obj.metadata.name)
+        return [
+            (o.metadata.namespace, o.metadata.name)
+            for o in ctx.store.list(kind, ev.obj.metadata.namespace, sel)
+        ]
+
+    return map_fn
+
+
+def register_controllers(engine: Engine, ctx: OperatorContext, config=None) -> None:
+    pcs = PodCliqueSetReconciler(ctx)
+    pclq = PodCliqueReconciler(ctx)
+    pcsg = PodCliqueScalingGroupReconciler(ctx)
+    syncs = (
+        (
+            config.controllers.pod_clique_set.concurrent_syncs,
+            config.controllers.pod_clique.concurrent_syncs,
+            config.controllers.pod_clique_scaling_group.concurrent_syncs,
+        )
+        if config is not None
+        else (1, 1, 1)
+    )
+
+    engine.register(
+        Controller(
+            name="podcliqueset",
+            kind="PodCliqueSet",
+            reconcile=pcs.reconcile,
+            concurrent_syncs=syncs[0],
+            watches=[
+                ("PodClique", _map_to_part_of),
+                ("PodCliqueScalingGroup", _map_to_part_of),
+                ("PodGang", _map_to_part_of),
+                ("Pod", _map_to_part_of),
+            ],
+        )
+    )
+    engine.register(
+        Controller(
+            name="podclique",
+            kind="PodClique",
+            reconcile=pclq.reconcile,
+            concurrent_syncs=syncs[1],
+            watches=[
+                ("Pod", _map_pod_to_pclq),
+                ("PodGang", _map_podgang_to_pclqs),
+                ("PodCliqueSet", _map_pcs_to_children_of_kind(ctx, "PodClique")),
+            ],
+        )
+    )
+    engine.register(
+        Controller(
+            name="podcliquescalinggroup",
+            kind="PodCliqueScalingGroup",
+            reconcile=pcsg.reconcile,
+            concurrent_syncs=syncs[2],
+            watches=[
+                ("PodClique", _map_pclq_to_pcsg),
+                (
+                    "PodCliqueSet",
+                    _map_pcs_to_children_of_kind(ctx, "PodCliqueScalingGroup"),
+                ),
+            ],
+        )
+    )

@@ -1,0 +1,70 @@
+"""Pod-side startup-ordering waiter (grove-initc equivalent).
+
+Re-host of /root/reference/operator/initc/internal/wait.go:110-275: an init
+step that blocks the pod's main containers until every parent clique has at
+least minAvailable Ready pods. Like the reference, it observes only pods
+carrying its own `grove.io/podgang` label (the downward-API-provided gang
+name, wait.go:76-90) and maps pods to parent cliques by name prefix
+(wait.go:240-265).
+
+In the simulator the kubelet calls `is_ready_to_start` each tick instead of
+running a blocking informer; `Waiter` keeps the blocking-CLI shape for a real
+deployment (it polls the same predicate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.pod import is_ready
+from grove_tpu.runtime.store import Store
+
+
+def parent_ready_counts(
+    store: Store, namespace: str, podgang: str, parent_pclqs: List[str]
+) -> Dict[str, int]:
+    pods = store.list("Pod", namespace, {namegen.LABEL_PODGANG: podgang})
+    counts = {p: 0 for p in parent_pclqs}
+    for pod in pods:
+        if not is_ready(pod):
+            continue
+        # exact pod→clique mapping via the podclique label (the reference
+        # prefix-matches, wait.go:240-265, but picks exactly one parent;
+        # the label avoids prefix collisions between clique names)
+        parent = pod.metadata.labels.get(namegen.LABEL_PODCLIQUE)
+        if parent in counts:
+            counts[parent] += 1
+    return counts
+
+
+def is_ready_to_start(store: Store, namespace: str, waiter_config: Dict) -> bool:
+    """waiter_config = {"podcliques": [{"pclq": fqn, "min_available": n}...],
+    "podgang": name} — the initcontainer args contract
+    (initc/cmd/opts/options.go)."""
+    deps = waiter_config.get("podcliques", [])
+    if not deps:
+        return True
+    podgang = waiter_config.get("podgang", "")
+    counts = parent_ready_counts(
+        store, namespace, podgang, [d["pclq"] for d in deps]
+    )
+    return all(counts[d["pclq"]] >= int(d["min_available"]) for d in deps)
+
+
+class Waiter:
+    """Blocking form for real-pod usage: poll until ready (wait.go:110-164)."""
+
+    def __init__(self, store: Store, namespace: str, waiter_config: Dict) -> None:
+        self.store = store
+        self.namespace = namespace
+        self.config = waiter_config
+
+    def wait(self, poll_interval: float = 1.0, timeout: float = 3600.0) -> bool:
+        elapsed = 0.0
+        while elapsed <= timeout:
+            if is_ready_to_start(self.store, self.namespace, self.config):
+                return True
+            self.store.clock.sleep(poll_interval)
+            elapsed += poll_interval
+        return False

@@ -1,0 +1,70 @@
+"""Minimal metrics registry (counter/gauge/histogram).
+
+Stands in for the controller-runtime Prometheus metrics server the reference
+exposes (manager.go:88-90). Exportable as Prometheus text format for a real
+deployment; in the sim it feeds assertions and the bench report.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, List[float]] = defaultdict(list)
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] += value
+
+    def set(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        self.histograms[name].append(value)
+
+    def percentile(self, name: str, q: float) -> float:
+        values = sorted(self.histograms.get(name, []))
+        if not values:
+            return math.nan
+        idx = min(len(values) - 1, max(0, math.ceil(q * len(values)) - 1))
+        return values[idx]
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.gauges.clear()
+        self.histograms.clear()
+
+    def prometheus_text(self) -> str:
+        lines = []
+        for name, v in sorted(self.counters.items()):
+            lines.append(f"{_promname(name)} {v}")
+        for name, v in sorted(self.gauges.items()):
+            lines.append(f"{_promname(name)} {v}")
+        for name, values in sorted(self.histograms.items()):
+            base, label = _prom_parts(name)
+            lines.append(f"{base}_count{label and '{' + label + '}'} {len(values)}")
+            lines.append(f"{base}_sum{label and '{' + label + '}'} {sum(values)}")
+            for q in (0.5, 0.9, 0.99):
+                qlabel = f'quantile="{q}"' + (f",{label}" if label else "")
+                lines.append(f"{base}{{{qlabel}}} {self.percentile(name, q)}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_parts(name: str):
+    if "/" in name:
+        base, label = name.split("/", 1)
+        return f"grove_tpu_{base}", f'name="{label}"'
+    return f"grove_tpu_{name}", ""
+
+
+def _promname(name: str) -> str:
+    base, label = _prom_parts(name)
+    return f"{base}{{{label}}}" if label else base
+
+
+METRICS = Metrics()

@@ -1,0 +1,890 @@
+"""Batched all-or-nothing gang packing kernels (JAX/XLA, TPU-first).
+
+The hot path of the framework: places G pending gangs onto N nodes with
+hierarchical topology packing, replacing the external KAI scheduler of the
+reference architecture (SURVEY §2, BASELINE.json north star).
+
+Two kernels share one per-gang placement routine (`gang_select_and_fill`):
+
+- `solve_packing` — EXACT sequential greedy: one `lax.scan` over gangs,
+  matching the NumPy oracle decision-for-decision. The parity baseline.
+- `solve_wave_chunk` — the SCALE path: a chunk of gangs is decided in
+  parallel (vmap) against the same capacity snapshot, then committed by a
+  cheap sequential capacity-check scan; conflicting gangs retry in the next
+  wave (host loop in grove_tpu.solver.kernel). Wave convergence trades exact
+  greedy order within a chunk for massive parallelism; quality is gated
+  against the oracle (≤0.5% regression, BASELINE.md).
+
+Design for the MXU/VPU + XLA compilation model: static shapes (bucketed
+padding), wide vector math over the node axis, `segment_sum` over pre-sorted
+contiguously-numbered topology domains, branch-free level selection, L+1
+unrolled fused fills.
+
+Semantics (mirroring the PodGang contract, scheduler podgang.go:50-114):
+- a gang is ADMITTED iff every group places >= min_count pods (MinReplicas
+  floor); extra pods up to `count` are placed best-effort with the gang.
+- `req_level` (TopologyPackConstraint.Required): the gang must fit inside ONE
+  domain at that level or narrower; no cluster-wide fallback.
+- `pref_level` (…Preferred): that level is tried first, then levels closest
+  to it (narrower wins ties), then cluster-wide scatter. -1 → narrowest.
+- PlacementScore: level-weighted co-location — for each level, the fraction
+  of the gang's pods inside its dominant domain, weighted toward narrow
+  levels; 1.0 = everything inside one narrowest-level domain.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_INT_CAP = 1 << 20  # cap on pods-per-node fit counts (avoid inf→int wrap)
+
+
+class GangInputs(NamedTuple):
+    demand: jnp.ndarray  # [P, R]
+    count: jnp.ndarray  # [P]
+    min_count: jnp.ndarray  # [P]
+    req_level: jnp.ndarray  # scalar
+    pref_level: jnp.ndarray  # scalar
+    # per-GROUP required pack level (-1 none): the PodGroup/PCSG constraint
+    # tier — each group must fit inside ONE domain at its level, chosen
+    # independently per group inside the gang's own domain
+    group_req: jnp.ndarray = None  # [P]
+    # pinned domain id per group at its required level (-1 none): recovery
+    # replacements must rejoin the domain where the group's surviving pods
+    # already live instead of re-choosing by free capacity
+    group_pin: jnp.ndarray = None  # [P]
+    # pinned domain id for the WHOLE gang at req_level (-1 none): a gang
+    # with a gang-level required pack whose surviving pods already occupy a
+    # domain must place its replacements in that same domain — otherwise a
+    # recovery delta-solve could split the live gang across two domains in
+    # violation of TopologyPackConstraint.Required
+    gang_pin: jnp.ndarray = None  # scalar
+
+
+def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
+    """k[n] = how many pods of this group fit on node n given free capacity."""
+    safe = jnp.where(demand_p > 0, demand_p, 1.0)
+    ratio = jnp.floor(free / safe[None, :])
+    ratio = jnp.where(demand_p[None, :] > 0, ratio, jnp.inf)
+    k = jnp.min(ratio, axis=1)
+    return jnp.clip(k, 0, _INT_CAP).astype(jnp.int32)
+
+
+def _fill_floors_first(free, mask, demand, count, min_count):
+    """Two-phase fill: place every group's admission FLOOR first, then the
+    best-effort extras — a full-count greedy would let an early group's
+    extras starve a later group's floor (guaranteed gang scheduling is for
+    MinReplicas; extras must never defeat it).
+
+    Floors are clamped to the available count and extras to >= 0: a recovery
+    delta-solve can momentarily have fewer pending pods than the remaining
+    floor (count < min_count), and a negative extras count would corrupt the
+    fill (negative allocations inflate free capacity). The clamped floor can
+    never satisfy `placed_min >= min_count`, so such gangs correctly wait.
+    Returns (alloc [P,N], placed [P], placed_min [P], free_after)."""
+    floors = jnp.minimum(min_count, count)
+    extras = jnp.maximum(count - min_count, 0)
+    alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
+    alloc_ext, placed_ext, free2 = _fill(free1, mask, demand, extras)
+    return alloc_min + alloc_ext, placed_min + placed_ext, placed_min, free2
+
+
+def _fill_grouped(
+    free, mask, demand, count, min_count, group_req, group_pin,
+    topo, seg_starts, seg_ends, seed,
+):
+    """Floors-first fill honoring per-GROUP pack constraints: a group with
+    group_req[p] >= 0 must land inside ONE domain at that level (chosen
+    inside `mask`); unconstrained groups use `mask` directly. Floors of ALL
+    groups place before any group's extras, and a constrained group's extras
+    never leave its chosen domain.
+    Returns (alloc [P,N], placed [P], placed_min [P], free_after)."""
+    n_nodes, n_levels = topo.shape
+    p_dim = demand.shape[0]
+    floors = jnp.minimum(min_count, count)
+    extras = jnp.maximum(count - min_count, 0)
+
+    def group_mask(free_c, p):
+        """Domain choice for group p at its required level (inside mask)."""
+        k = _pods_fit_per_node(free_c, demand[p])
+        k = jnp.minimum(jnp.where(mask, k, 0), jnp.maximum(floors[p], 1))
+        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), jnp.cumsum(k)])
+        any_req = group_req[p] >= 0
+        lvl = jnp.where(any_req, group_req[p], 0)
+        starts = seg_starts[lvl]
+        ends = seg_ends[lvl]
+        K = cs[ends] - cs[starts]  # pods of group p fitting per domain
+        feas = (K >= floors[p]) & (ends > starts)
+        # capacity-weighted strided pick (seed 0 → deterministic first-best)
+        w = jnp.where(feas, K, 0).astype(jnp.float32)
+        cum_w = jnp.cumsum(w)
+        h = jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32) / (
+            1 << 16
+        )
+        u = h * cum_w[-1]
+        best = jnp.argmax(cum_w > u)
+        best = jnp.where(cum_w[-1] > 0, best, jnp.argmax(feas))
+        ok_any = jnp.any(feas)
+        # recovery pin: rejoin the surviving pods' domain unconditionally
+        # (the fill validates whether the floor still fits there)
+        pinned = group_pin[p] >= 0
+        best = jnp.where(pinned, group_pin[p], best)
+        ok_any = ok_any | pinned
+        slab = topo[:, lvl] == best
+        return jnp.where(any_req, slab & mask & ok_any, mask)
+
+    free_c = free
+    masks = []
+    alloc_rows = []
+    floor_placed = []
+    extra_placed = []
+    for p in range(p_dim):  # static unroll (P small): floors first
+        mask_p = group_mask(free_c, p)
+        masks.append(mask_p)
+        a, pl, free_c = _fill(free_c, mask_p, demand[p : p + 1], floors[p : p + 1])
+        alloc_rows.append(a[0])
+        floor_placed.append(pl[0])
+    for p in range(p_dim):  # then extras, inside each group's own mask
+        a, pl, free_c = _fill(free_c, masks[p], demand[p : p + 1], extras[p : p + 1])
+        alloc_rows[p] = alloc_rows[p] + a[0]
+        extra_placed.append(pl[0])
+    alloc = jnp.stack(alloc_rows)
+    placed_min = jnp.stack(floor_placed)
+    placed = placed_min + jnp.stack(extra_placed)
+    return alloc, placed, placed_min, free_c
+
+
+def _fill_dispatch(
+    grouped, free, mask, demand, count, min_count, group_req, group_pin,
+    topo, seg_starts, seg_ends, seed,
+):
+    """Static dispatch: problems with no group-level constraints (the common
+    case — checked host-side) compile the cheap two-phase fill; the grouped
+    fill with per-group domain selection is only paid when used."""
+    if grouped:
+        return _fill_grouped(
+            free, mask, demand, count, min_count, group_req, group_pin,
+            topo, seg_starts, seg_ends, seed,
+        )
+    return _fill_floors_first(free, mask, demand, count, min_count)
+
+
+def _fill(free, mask, demand, count):
+    """Sequentially fill each group inside `mask` (nodes are topology-sorted,
+    so the exclusive-cumsum take packs into contiguous domains first).
+    Returns (alloc [P,N], placed [P], free_after)."""
+
+    def group_step(free_c, inputs):
+        demand_p, count_p = inputs
+        k = _pods_fit_per_node(free_c, demand_p)
+        # cap at the group's own count: bounds the int32 cumsum below at
+        # count*N (a zero-demand group would otherwise contribute _INT_CAP
+        # per node and wrap the prefix sum negative)
+        k = jnp.minimum(jnp.where(mask, k, 0), count_p)
+        cum = jnp.cumsum(k) - k  # exclusive prefix
+        take = jnp.clip(count_p - cum, 0, k)
+        free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
+        return free_c, (take, take.sum())
+
+    free_after, (alloc, placed) = jax.lax.scan(group_step, free, (demand, count))
+    return alloc, placed, free_after
+
+
+def _level_weights(num_levels: int) -> jnp.ndarray:
+    w = jnp.arange(1, num_levels + 1, dtype=jnp.float32)
+    return w / w.sum()
+
+
+def _gang_pin_mask(free: jnp.ndarray, topo: jnp.ndarray, gang: GangInputs):
+    """Node mask confining a pinned gang to its surviving pods' domain at
+    req_level (all-true when unpinned), plus the capacity view with
+    out-of-domain nodes zeroed so aggregate feasibility and domain selection
+    never look outside the pin."""
+    pin = gang.gang_pin if gang.gang_pin is not None else jnp.int32(-1)
+    pin_on = (pin >= 0) & (gang.req_level >= 0)
+    rq = jnp.maximum(gang.req_level, 0)
+    pin_mask = jnp.where(pin_on, jnp.take(topo, rq, axis=1) == pin, True)
+    free_vis = jnp.where(pin_mask[:, None], free, 0.0)
+    return pin_mask, free_vis
+
+
+def _aggregate_tables(free: jnp.ndarray, gang: GangInputs):
+    """Shared prelude of both per-gang selectors: capped per-node fit counts,
+    prefix-sum tables for boundary gathers, float-cumsum tolerance, and the
+    admission floor's joint resource demand."""
+    active = gang.count > 0
+    k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
+    # cap per-node fits at the group count: preserves every >=min/>=count
+    # comparison (sum-of-mins bound) while keeping int32 prefix sums exact
+    k_all = jnp.minimum(k_all, gang.count[:, None])
+    min_demand = jnp.sum(
+        gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
+    )  # [R]
+    zero_col = jnp.zeros((k_all.shape[0], 1), dtype=k_all.dtype)
+    cs_k = jnp.concatenate([zero_col, jnp.cumsum(k_all, axis=1)], axis=1)
+    cs_free = jnp.concatenate(
+        [jnp.zeros((1, free.shape[1]), dtype=free.dtype), jnp.cumsum(free, axis=0)],
+        axis=0,
+    )
+    # float32 prefix sums of byte-scale capacity accumulate rounding error;
+    # slack the joint check so it can only false-KEEP (the fill is exact)
+    free_tol = 1e-5 * cs_free[-1]
+    return active, cs_k, cs_free, free_tol, min_demand
+
+
+def _coloc_score(
+    alloc, placed_total, seg_starts, seg_ends, weights, ok
+):
+    """Level-weighted dominant-domain co-location score (shared)."""
+    n_levels = seg_starts.shape[0]
+    pods_per_node = alloc.sum(axis=0)
+    total = jnp.maximum(placed_total.sum(), 1)
+    cs_pods = jnp.concatenate(
+        [jnp.zeros((1,), dtype=pods_per_node.dtype), jnp.cumsum(pods_per_node)]
+    )
+    score = sum(
+        weights[l]
+        * (
+            jnp.max(cs_pods[seg_ends[l]] - cs_pods[seg_starts[l]]).astype(
+                jnp.float32
+            )
+            / total.astype(jnp.float32)
+        )
+        for l in range(n_levels)
+    )
+    return jnp.clip(jnp.where(ok, score, 0.0), 0.0, 1.0)
+
+
+def gang_select_and_fill(
+    free: jnp.ndarray,
+    topo: jnp.ndarray,
+    seg_starts: jnp.ndarray,  # [L, D] contiguous-domain boundaries
+    seg_ends: jnp.ndarray,  # [L, D]
+    gang: GangInputs,
+    grouped: bool = False,
+):
+    """One gang's placement decision against `free`.
+
+    Shared by the exact sequential kernel (inside lax.scan) and the wave
+    kernel (vmapped across a chunk against one capacity snapshot).
+    Returns (free_new, alloc [P,N], placed [P], ok_min, chosen_l, score).
+
+    Topology-sorted nodes make every domain a contiguous slab, so all
+    per-domain aggregates are prefix-sum boundary gathers — no scatters
+    (TPU scatters serialize; gathers vectorize).
+    """
+    n_nodes, n_levels = topo.shape
+    weights = _level_weights(n_levels)
+
+    pin_mask, free_vis = _gang_pin_mask(free, topo, gang)
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(
+        free_vis, gang
+    )
+    any_active = jnp.any(active)
+    all_nodes = jnp.ones((n_nodes,), dtype=bool)
+    no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+
+    # Per-level candidate domain: per-group fit counts AND joint resource
+    # feasibility (both optimistic w.r.t. fragmentation — the actual fill
+    # below is the ground truth). Best-fit tie-break by smallest spare.
+    def level_candidate(l):
+        starts = seg_starts[l]
+        ends = seg_ends[l]
+        K = cs_k[:, ends] - cs_k[:, starts]  # [P, D] gather
+        free_agg = cs_free[ends] - cs_free[starts]  # [D, R] gather
+        feas = jnp.all(
+            jnp.where(active[:, None], K >= gang.min_count[:, None], True),
+            axis=0,
+        )
+        feas &= jnp.all(
+            free_agg >= (min_demand - free_tol)[None, :], axis=1
+        )
+        feas &= ends > starts  # padded empty domains never selected
+        feas &= any_active  # a fully-padded gang selects nothing
+        # Best-fit: primary key is leftover fit-count (K is capped at the
+        # gang's count, so full-fit domains tie at spare=0 — break the tie
+        # toward the domain with the least total free capacity, preserving
+        # large domains for large gangs)
+        spare = jnp.sum(
+            jnp.where(active[:, None], K - gang.count[:, None], 0), axis=0
+        )
+        free_total = jnp.sum(free_agg, axis=1)
+        tie = free_total / (jnp.max(free_total) + 1.0)
+        key = spare.astype(jnp.float32) + tie.astype(jnp.float32)
+        best = jnp.argmin(jnp.where(feas, key, jnp.inf))
+        return jnp.any(feas), best
+
+    # Try the actual fill at every level (narrow masks included) plus a
+    # cluster-wide candidate; choose by preference among levels whose fill
+    # truly meets the admission floor. L is small and static → L+1 fused
+    # unrolled fills.
+    lv = jnp.arange(n_levels)
+    min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
+
+    cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
+    for l in range(n_levels):
+        ok_l, best_l = level_candidate(l)
+        mask_l = jnp.where(ok_l, (topo[:, l] == best_l) & pin_mask, no_nodes)
+        alloc_l, placed_l, placed_min_l, free_l = _fill_dispatch(
+            grouped, free, mask_l, gang.demand, gang.count, gang.min_count,
+            gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
+            jnp.int32(0),
+        )
+        fill_ok = (
+            ok_l
+            & (lv[l] >= min_allowed)
+            & jnp.all(jnp.where(active, placed_min_l >= gang.min_count, True))
+        )
+        cand_alloc.append(alloc_l)
+        cand_placed.append(placed_l)
+        cand_free.append(free_l)
+        cand_ok.append(fill_ok)
+    # cluster-wide fallback (only when no required pack level)
+    alloc_c, placed_c, placed_min_c, free_c = _fill_dispatch(
+        grouped, free, all_nodes, gang.demand, gang.count, gang.min_count,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
+        jnp.int32(0),
+    )
+    cluster_ok = (
+        (gang.req_level < 0)
+        & any_active
+        & jnp.all(jnp.where(active, placed_min_c >= gang.min_count, True))
+    )
+    cand_alloc.append(alloc_c)
+    cand_placed.append(placed_c)
+    cand_free.append(free_c)
+    cand_ok.append(cluster_ok)
+
+    oks = jnp.stack(cand_ok)  # [L+1]
+    # Preference order (TopologyPackConstraint.Preferred): preferred level
+    # first, then closest levels (narrower wins ties), cluster-wide last.
+    pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
+    pref_rank = jnp.concatenate(
+        [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
+    )  # cluster rank 0
+    chosen = jnp.argmax(jnp.where(oks, pref_rank + 1, 0))
+    ok_min = jnp.any(oks)
+
+    one_hot = jax.nn.one_hot(chosen, n_levels + 1, dtype=free.dtype)
+    alloc = sum(
+        one_hot[i] * cand_alloc[i].astype(free.dtype) for i in range(n_levels + 1)
+    ).astype(jnp.int32)
+    placed = sum(
+        one_hot[i] * cand_placed[i].astype(free.dtype) for i in range(n_levels + 1)
+    ).astype(jnp.int32)
+    free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
+
+    # best-effort extras: pods beyond the packed domain scatter cluster-wide
+    # (no gang-level required constraint, and never for group-constrained
+    # groups — their extras must stay inside their chosen domain)
+    chose_packed_level = ok_min & (chosen < n_levels)
+    spill = (gang.req_level < 0) & chose_packed_level
+    remaining = jnp.where(
+        spill & (gang.group_req < 0), gang.count - placed, 0
+    )
+    alloc2, placed2, free_after2 = _fill(free_after, all_nodes, gang.demand, remaining)
+    alloc = jnp.where(spill, alloc + alloc2, alloc)
+    placed_total = jnp.where(spill, placed + placed2, placed)
+    free_final = jnp.where(spill, free_after2, free_after)
+
+    # all-or-nothing: revert capacity if not admitted
+    free_new = jnp.where(ok_min, free_final, free)
+    alloc = jnp.where(ok_min, alloc, 0)
+    placed_total = jnp.where(ok_min, placed_total, 0)
+    any_level = ok_min & (chosen < n_levels)
+    chosen_l = jnp.where(any_level, chosen, -1)
+
+    score = _coloc_score(alloc, placed_total, seg_starts, seg_ends, weights, ok_min)
+
+    return free_new, alloc, placed_total, ok_min, chosen_l, score
+
+
+@partial(jax.jit, static_argnames=("with_alloc", "grouped"))
+def solve_packing(
+    capacity: jnp.ndarray,  # [N, R] float32
+    topo: jnp.ndarray,  # [N, L] int32, dense ids per level
+    seg_starts: jnp.ndarray,  # [L, D] contiguous-domain boundaries
+    seg_ends: jnp.ndarray,  # [L, D]
+    demand: jnp.ndarray,  # [G, P, R] float32
+    count: jnp.ndarray,  # [G, P] int32
+    min_count: jnp.ndarray,  # [G, P] int32
+    req_level: jnp.ndarray,  # [G] int32 (-1 none)
+    pref_level: jnp.ndarray,  # [G] int32 (-1 → narrowest)
+    group_req: jnp.ndarray = None,  # [G, P] int32 (-1 none)
+    group_pin: jnp.ndarray = None,  # [G, P] int32 (-1 none)
+    gang_pin: jnp.ndarray = None,  # [G] int32 (-1 none)
+    with_alloc: bool = True,
+    grouped: bool = False,
+):
+    """Exact sequential greedy (oracle-parity kernel)."""
+    if group_req is None:
+        group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
+
+    def gang_step(free, gang: GangInputs):
+        free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
+            free, topo, seg_starts, seg_ends, gang, grouped=grouped
+        )
+        ys = (ok_min, placed, score, chosen_l)
+        if with_alloc:
+            ys = ys + (alloc,)
+        return free_new, ys
+
+    inputs = GangInputs(
+        demand=demand,
+        count=count,
+        min_count=min_count,
+        req_level=req_level,
+        pref_level=pref_level,
+        group_req=group_req,
+        group_pin=group_pin,
+        gang_pin=gang_pin,
+    )
+    free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
+    if with_alloc:
+        admitted, placed, score, chosen_level, alloc = ys
+    else:
+        admitted, placed, score, chosen_level = ys
+        alloc = None
+    return {
+        "admitted": admitted,
+        "placed": placed,
+        "score": score,
+        "chosen_level": chosen_level,
+        "alloc": alloc,
+        "free_after": free_after,
+    }
+
+
+@partial(jax.jit, static_argnames=("commit_iters", "grouped"))
+def solve_wave_chunk(
+    free: jnp.ndarray,  # [N, R]
+    topo: jnp.ndarray,  # [N, L]
+    seg_starts: jnp.ndarray,  # [L, D]
+    seg_ends: jnp.ndarray,  # [L, D]
+    demand: jnp.ndarray,  # [C, P, R] — one CHUNK of gangs
+    count: jnp.ndarray,  # [C, P]
+    min_count: jnp.ndarray,  # [C, P]
+    req_level: jnp.ndarray,  # [C]
+    pref_level: jnp.ndarray,  # [C]
+    pending: jnp.ndarray,  # [C] bool
+    narrow_cap: jnp.ndarray,  # [C] int32
+    seeds: jnp.ndarray,  # [C] int32
+    group_req: jnp.ndarray = None,  # [C, P]
+    group_pin: jnp.ndarray = None,  # [C, P]
+    gang_pin: jnp.ndarray = None,  # [C]
+    commit_iters: int = 2,
+    grouped: bool = False,
+):
+    """One wave over one chunk, with per-pod allocations materialized (the
+    binding path). Same core as the device-resident stats solver."""
+    if group_req is None:
+        group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
+    free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
+        wave_chunk_core(
+            free,
+            topo,
+            seg_starts,
+            seg_ends,
+            demand,
+            count,
+            min_count,
+            req_level,
+            pref_level,
+            pending,
+            narrow_cap,
+            seeds,
+            group_req,
+            group_pin,
+            gang_pin,
+            commit_iters,
+            grouped,
+        )
+    )
+    n_levels = topo.shape[1]
+    return {
+        "admitted": accept,
+        "retry": retry,
+        "new_cap": new_cap,
+        "placed": jnp.where(accept[:, None], placed, 0),
+        "score": jnp.where(accept, score, 0.0),
+        "chosen_level": jnp.where(
+            accept, jnp.where(chosen >= n_levels, -1, chosen), -1
+        ),
+        "alloc": jnp.where(accept[:, None, None], alloc, 0),
+        "free_after": free_after,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Wave-solver core (shared by the chunked binding path and the
+# device-resident stats loop)
+# ---------------------------------------------------------------------------
+
+
+def wave_chunk_core(
+    free, topo, seg_starts, seg_ends,
+    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin, commit_iters,
+    grouped=False,
+):
+    """Decide one chunk of gangs in parallel (gang_select_single vmapped over
+    the chunk against one capacity snapshot), commit via iterative vectorized
+    prefix-acceptance with a final joint-feasibility guarantee, and produce
+    the retry/narrow-cap bookkeeping for the next wave.
+    Returns (free, accept, placed, score, chosen, retry, new_cap,
+    fill_failed, alloc)."""
+    cnt = cnt * pend[:, None]
+    inputs = GangInputs(dem, cnt, mn, rq, pf, grq, gpin, gangpin)
+    alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
+        lambda *xs: gang_select_single(*xs, grouped=grouped),
+        in_axes=(None, None, None, None, 0, 0, 0),
+    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
+
+    usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
+    accept = ok
+    for _ in range(commit_iters):
+        cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+        fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+        accept = ok & fits
+    # final guarantee: with this accept set, every accepted prefix fits
+    cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+    fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
+    accept &= fits
+    free = free - jnp.sum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+
+    # retry bookkeeping: a failed fill jumps the cap straight to the next
+    # broader aggregate-feasible level; cluster fallback was already
+    # attempted in-wave, so a -1 cap means the gang is done for good
+    fill_failed = pend & had_cand & ~ok
+    new_cap = jnp.where(fill_failed, fallback_cap, ncap)
+    min_allowed = jnp.where(rq >= 0, rq, 0)
+    retry = pend & ((ok & ~accept) | (fill_failed & (new_cap >= min_allowed)))
+    return (
+        free,
+        accept & pend,
+        placed,
+        score,
+        chosen,
+        retry,
+        new_cap,
+        fill_failed,
+        alloc,
+    )
+
+
+def gang_select_single(
+    free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
+    grouped: bool = False,
+):
+    """Single-fill variant of gang_select_and_fill for the wave solver.
+
+    Candidate levels are ranked by aggregate feasibility (cheap prefix-sum
+    gathers); ONE fill is attempted at the best allowed level (or
+    cluster-wide when none). A fill that misses the floor is signalled to the
+    caller, which lowers `narrow_cap` (the narrowest level this gang may try)
+    and retries next wave — amortizing the L+1 fills of the exact kernel
+    across waves instead of paying them per gang.
+
+    Returns (alloc, placed, ok, chosen, score, had_candidate).
+    chosen: level index, n_levels for cluster-wide, -1 when nothing allowed.
+    """
+    n_nodes, n_levels = topo.shape
+    weights = _level_weights(n_levels)
+
+    pin_mask, free_vis = _gang_pin_mask(free, topo, gang)
+    active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(
+        free_vis, gang
+    )
+    any_active = jnp.any(active)
+
+    oks, bests = [], []
+    for l in range(n_levels):
+        starts, ends = seg_starts[l], seg_ends[l]
+        K = cs_k[:, ends] - cs_k[:, starts]
+        free_agg = cs_free[ends] - cs_free[starts]
+        feas = jnp.all(
+            jnp.where(active[:, None], K >= gang.min_count[:, None], True), axis=0
+        )
+        feas &= jnp.all(free_agg >= (min_demand - free_tol)[None, :], axis=1)
+        feas &= ends > starts
+        feas &= any_active
+        # STRIDED choice: gangs deciding in parallel against the same
+        # capacity snapshot must not all pick the same best-fit domain (the
+        # whole chunk would collide at commit). Each gang takes the
+        # (seed mod n)-th domain among the candidates — perfect spread, and
+        # co-location score is unaffected by WHICH single domain is chosen.
+        # Prefer domains that hold the FULL count (extras stay in-domain
+        # instead of spilling cluster-wide, which would dilute the score).
+        feas_full = feas & jnp.all(
+            jnp.where(active[:, None], K >= gang.count[:, None], True), axis=0
+        )
+        pool = jnp.where(jnp.any(feas_full), feas_full, feas)
+        # CAPACITY-WEIGHTED pick: spread gangs across candidate domains in
+        # proportion to how many copies of this gang each domain can host —
+        # commits per wave then approach the capacity-limited maximum.
+        w = jnp.where(pool, jnp.sum(K, axis=0), 0).astype(jnp.float32)
+        cum_w = jnp.cumsum(w)
+        total_w = cum_w[-1]
+        h = (
+            jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32)
+            / (1 << 16)
+        )
+        u = h * total_w
+        best = jnp.argmax(cum_w > u)
+        # degenerate fallback (all weights zero): first pool domain
+        best = jnp.where(total_w > 0, best, jnp.argmax(pool))
+        oks.append(jnp.any(feas))
+        bests.append(best)
+    oks = jnp.stack(oks)
+    bests = jnp.stack(bests)
+
+    lv = jnp.arange(n_levels)
+    min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
+    allowed = oks & (lv >= min_allowed) & (lv <= narrow_cap)
+    pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
+    has_level = jnp.any(allowed)
+    chosen_level = jnp.argmax(jnp.where(allowed, level_rank + 1, 0))
+    use_cluster = (~has_level) & (gang.req_level < 0) & any_active
+    had_candidate = has_level | use_cluster
+
+    all_nodes = jnp.ones((n_nodes,), dtype=bool)
+    no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+    packed_mask = (topo[:, chosen_level] == bests[chosen_level]) & pin_mask
+    mask = jnp.where(
+        has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
+    )
+
+    alloc, placed, placed_min, free_after = _fill_dispatch(
+        grouped, free, mask, gang.demand, gang.count, gang.min_count,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+    )
+    level_fill_ok = (
+        had_candidate
+        & any_active
+        & jnp.all(jnp.where(active, placed_min >= gang.min_count, True))
+    )
+
+    # when the level fill fails, the retry cap jumps straight to the next
+    # BROADER level whose aggregates looked feasible (skip hopeless levels)
+    lower_feasible = jnp.where(allowed & (lv < chosen_level), lv, -1)
+    fallback_cap = jnp.max(lower_feasible)
+
+    # Second fill doubles as both paths:
+    # - level fill met the floor → best-effort extras spill cluster-wide
+    # - level fill missed the floor AND no broader feasible level remains
+    #   (and no required pack) → cluster-wide scatter as a last resort;
+    #   otherwise the gang retries at the fallback level next wave, keeping
+    #   it packed instead of eagerly scattering
+    cluster_rescue = (
+        has_level
+        & ~level_fill_ok
+        & (gang.req_level < 0)
+        & (fallback_cap < 0)
+        & any_active
+    )
+    spill = level_fill_ok & has_level & (gang.req_level < 0)
+    base_free = jnp.where(cluster_rescue, free, free_after)
+    # extras of group-constrained groups must stay inside their chosen
+    # domain — only unconstrained groups may spill cluster-wide
+    spillable = gang.group_req < 0
+    remaining = jnp.where(
+        cluster_rescue,
+        gang.count,
+        jnp.where(spill & spillable, gang.count - placed, 0),
+    )
+    rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
+    alloc2, placed2, placed2_min, _ = _fill_dispatch(
+        grouped, base_free, all_nodes, gang.demand, remaining, rescue_min,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+    )
+    rescue_ok = cluster_rescue & jnp.all(
+        jnp.where(active, placed2_min >= gang.min_count, True)
+    )
+    alloc = jnp.where(
+        rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)
+    )
+    placed = jnp.where(
+        rescue_ok, placed2, jnp.where(spill, placed + placed2, placed)
+    )
+    fill_ok = level_fill_ok | rescue_ok
+    chosen_level = jnp.where(rescue_ok, n_levels, chosen_level)
+    has_level = has_level & ~rescue_ok
+    use_cluster = use_cluster | rescue_ok
+
+    alloc = jnp.where(fill_ok, alloc, 0)
+    placed = jnp.where(fill_ok, placed, 0)
+
+    score = _coloc_score(alloc, placed, seg_starts, seg_ends, weights, fill_ok)
+
+    chosen = jnp.where(
+        has_level, chosen_level, jnp.where(use_cluster, n_levels, -1)
+    )
+    return alloc, placed, fill_ok, chosen, score, had_candidate, fallback_cap
+
+
+@partial(jax.jit, static_argnames=("n_chunks", "max_waves", "commit_iters", "grouped"))
+def solve_waves_device(
+    capacity,  # [N, R]
+    topo,  # [N, L]
+    seg_starts,  # [L, D]
+    seg_ends,  # [L, D]
+    demand,  # [G, P, R], G divisible by n_chunks
+    count,  # [G, P]
+    min_count,  # [G, P]
+    req_level,  # [G]
+    pref_level,  # [G]
+    group_req=None,  # [G, P]
+    group_pin=None,  # [G, P]
+    gang_pin=None,  # [G]
+    n_chunks: int = 20,
+    max_waves: int = 8,
+    commit_iters: int = 2,
+    grouped: bool = False,
+):
+    """Whole multi-wave wave-parallel solve in ONE device program — zero
+    host↔device round trips until the final results (critical when the chip
+    sits behind a high-latency link, and cheap dispatch regardless).
+
+    Per wave, per chunk: decide all C gangs in parallel against the chunk's
+    capacity snapshot (gang_select_single), then commit with an iterative
+    vectorized prefix-acceptance (no per-gang scan): accept the set of gangs
+    whose cumulative usage fits, re-checking `commit_iters` times as rejected
+    gangs' usage is removed, with a final masking pass that guarantees the
+    accepted set is jointly feasible. Conflicting or fill-failed gangs retry
+    in the next wave (fill failures lower the gang's narrow_cap so it retries
+    at a coarser level).
+    """
+    g_total, p_max, _ = demand.shape
+    n_nodes, n_levels = topo.shape
+    if group_req is None:
+        group_req = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
+    if group_pin is None:
+        group_pin = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
+    if gang_pin is None:
+        gang_pin = jnp.full((g_total,), -1, dtype=jnp.int32)
+    c = g_total // n_chunks
+
+    def reshape_chunks(a):
+        return a.reshape((n_chunks, c) + a.shape[1:])
+
+    state0 = {
+        "free": capacity,
+        "pending": jnp.ones((g_total,), dtype=bool),
+        "narrow_cap": jnp.full((g_total,), n_levels - 1, dtype=jnp.int32),
+        "admitted": jnp.zeros((g_total,), dtype=bool),
+        "placed": jnp.zeros((g_total, p_max), dtype=jnp.int32),
+        "score": jnp.zeros((g_total,), dtype=jnp.float32),
+        "chosen": jnp.full((g_total,), -1, dtype=jnp.int32),
+        "rescue": jnp.zeros((g_total,), dtype=bool),
+        "wave": jnp.asarray(0, dtype=jnp.int32),
+        "progress": jnp.asarray(True),
+    }
+
+    def chunk_step(free, xs):
+        # settled chunks skip the whole decision+commit (lax.cond executes
+        # one branch): waves after the first mostly touch a few chunks
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
+        c_gangs = dem.shape[0]
+
+        def passthrough(free):
+            return free, (
+                jnp.zeros((c_gangs,), dtype=bool),
+                jnp.zeros((c_gangs, dem.shape[1]), dtype=jnp.int32),
+                jnp.zeros((c_gangs,), dtype=jnp.float32),
+                jnp.full((c_gangs,), -1, dtype=jnp.int32),
+                jnp.zeros((c_gangs,), dtype=bool),
+                ncap,
+                jnp.zeros((c_gangs,), dtype=bool),
+            )
+
+        return jax.lax.cond(
+            jnp.any(pend), lambda f: _active_chunk_step(f, xs), passthrough, free
+        )
+
+    def _active_chunk_step(free, xs):
+        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
+        free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
+            wave_chunk_core(
+                free, topo, seg_starts, seg_ends,
+                dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
+                commit_iters, grouped,
+            )
+        )
+        return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)
+
+    def wave_body(state):
+        # NOTE: pending gangs are deliberately NOT compacted into fewer
+        # chunks — spreading stragglers across chunks lets later chunks see
+        # earlier commits' capacity updates within the same wave, which
+        # converges faster than concentrating the contention (measured).
+        seeds_c = reshape_chunks(
+            jnp.arange(g_total, dtype=jnp.int32) + state["wave"] * jnp.int32(7919)
+        )
+        free, ys = jax.lax.scan(
+            chunk_step,
+            state["free"],
+            (
+                reshape_chunks(demand),
+                reshape_chunks(count),
+                reshape_chunks(min_count),
+                reshape_chunks(req_level),
+                reshape_chunks(pref_level),
+                reshape_chunks(state["pending"]),
+                reshape_chunks(state["narrow_cap"]),
+                seeds_c,
+                reshape_chunks(group_req),
+                reshape_chunks(group_pin),
+                reshape_chunks(gang_pin),
+            ),
+        )
+        accept, placed, score, chosen, retry, new_cap, fill_failed = (
+            y.reshape((g_total,) + y.shape[2:]) for y in ys
+        )
+        return {
+            "free": free,
+            "pending": retry,
+            "narrow_cap": new_cap,
+            "admitted": state["admitted"] | accept,
+            "placed": jnp.where(accept[:, None], placed, state["placed"]),
+            "score": jnp.where(accept, score, state["score"]),
+            "chosen": jnp.where(accept, chosen, state["chosen"]),
+            # gangs whose heuristic single fill ever missed the floor are
+            # exact-tail candidates (the seed-picked domain may simply have
+            # been the wrong one)
+            "rescue": state["rescue"] | fill_failed,
+            "wave": state["wave"] + 1,
+            "progress": jnp.any(accept) | jnp.any(retry),
+        }
+
+    def cond(state):
+        return (
+            (state["wave"] < max_waves)
+            & state["progress"]
+            & jnp.any(state["pending"] | (state["wave"] == 0))
+        )
+
+    final = jax.lax.while_loop(cond, wave_body, state0)
+    chosen = final["chosen"]
+    return {
+        "admitted": final["admitted"],
+        "placed": final["placed"],
+        "score": final["score"],
+        "chosen_level": jnp.where(chosen >= n_levels, -1, chosen),
+        "free_after": final["free"],
+        "waves": final["wave"],
+        "pending": final["pending"]
+        | (final["rescue"] & ~final["admitted"]),
+    }

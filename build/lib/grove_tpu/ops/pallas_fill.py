@@ -1,0 +1,98 @@
+"""Experimental Pallas TPU kernel for the gang fill hot-op.
+
+The packing kernels' inner loop (`ops.packing._fill`) is P sequential rounds
+of {per-node fit counts → masked exclusive cumsum → clipped take → capacity
+update} over the node axis. Under vmap across a chunk of gangs XLA already
+fuses this well; this module implements the same op as ONE fused Pallas
+kernel (grid = gangs, whole fill in VMEM) to measure whether hand-fusion
+beats the XLA schedule. Layouts follow TPU tiling: node axis last (lanes,
+multiple of 128), resources/groups on sublanes.
+
+Verdict (measured on TPU v5e, N=5120 C=512 P=4): the XLA-compiled vmapped
+fill runs in **0.04 ms** — it is nowhere near the solver's critical path
+(wave time is dominated by candidate selection + the while/scan structure) —
+and current Pallas TPU lowering lacks `cumsum` for TC kernels, so the fused
+version would need a hand-rolled log-step prefix scan for no attainable win.
+`ops.packing` therefore stays on pure XLA; this module is kept as the
+measured record (correctness verified against `_fill` in interpret mode,
+tests/test_pallas_fill.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from grove_tpu.ops.packing import _INT_CAP  # one cap for both kernels
+
+
+def _fill_kernel(free_ref, mask_ref, demand_ref, count_ref, alloc_ref, placed_ref):
+    """One gang's fill. Blocks:
+    free   [R, N] f32   (transposed: nodes on lanes)
+    mask   [1, N] f32   (1.0 pack-eligible)
+    demand [P, R] f32
+    count  [P, 1] i32
+    alloc  [P, N] i32 out
+    placed [P, 1] i32 out
+    """
+    r_dim = free_ref.shape[0]
+    p_dim = demand_ref.shape[1]
+    free = free_ref[:, :]  # [R, N] — local working copy
+    mask = mask_ref[0, 0, :]  # [N]
+
+    for p in range(p_dim):  # static unroll: groups are few
+        count_p = count_ref[0, p, 0]
+        # k[n] = min over resources of floor(free/demand), demand>0 only
+        k = jnp.full(free.shape[1:], float(_INT_CAP), dtype=jnp.float32)
+        for r in range(r_dim):
+            d = demand_ref[0, p, r]
+            ratio = jnp.floor(free[r, :] / jnp.where(d > 0, d, 1.0))
+            k = jnp.where(d > 0, jnp.minimum(k, ratio), k)
+        # integer prefix math exactly as ops.packing._fill (float32 cumsum
+        # would lose integer exactness past 2^24 at large count*N)
+        k_i = jnp.minimum(
+            jnp.where(mask > 0, k, 0.0).astype(jnp.int32), count_p
+        )
+        cum = jnp.cumsum(k_i) - k_i  # exclusive prefix along lanes
+        take = jnp.clip(count_p - cum, 0, k_i)
+        take_f = take.astype(jnp.float32)
+        for r in range(r_dim):
+            free = free.at[r, :].set(free[r, :] - take_f * demand_ref[0, p, r])
+        alloc_ref[0, p, :] = take
+        placed_ref[0, p, 0] = jnp.sum(take)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def pallas_fill_batch(
+    free_t: jnp.ndarray,  # [R, N] (shared capacity view, transposed)
+    masks: jnp.ndarray,  # [G, 1, N] f32
+    demand: jnp.ndarray,  # [G, P, R] f32
+    count: jnp.ndarray,  # [G, P, 1] i32
+    interpret: bool = False,
+):
+    """Fill G gangs independently against the same capacity snapshot (the
+    wave solver's phase-A shape). Returns (alloc [G,P,N], placed [G,P,1])."""
+    g, p_dim, r_dim = demand.shape
+    n = free_t.shape[1]
+    return pl.pallas_call(
+        _fill_kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((r_dim, n), lambda i: (0, 0)),  # shared capacity
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, p_dim, r_dim), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, p_dim, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, p_dim, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, p_dim, 1), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, p_dim, n), jnp.int32),
+            jax.ShapeDtypeStruct((g, p_dim, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(free_t, masks, demand, count)

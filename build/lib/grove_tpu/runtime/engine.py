@@ -1,0 +1,166 @@
+"""Controller engine: watch → map → keyed workqueue → reconcile.
+
+Single-threaded, virtual-time re-host of the controller-runtime manager the
+reference builds in controller/manager.go + the per-controller watch wiring in
+each register.go. Determinism is a feature: the 10k-gang stress sim and every
+timing test replay identically. Concurrency hazards the reference absorbs with
+its expectations store are reproduced via the store's cache-lag mode rather
+than threads.
+
+A Controller owns:
+- a primary kind (reconciled on its own events)
+- watch mappings: (watched kind, map_fn(event) -> [primary keys]) — the
+  equivalent of handler.EnqueueRequestsFromMapFunc + predicates
+  (e.g. podclique/register.go:49-80, :242-278).
+
+Reconcile functions return a ReconcileStepResult; "requeue" gets exponential
+backoff, "requeue_after" a fixed delay — matching the ReconcileStepResult DSL
+semantics in common/flow.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.flow import ReconcileStepResult
+from grove_tpu.runtime.store import Store, WatchEvent
+from grove_tpu.runtime.workqueue import Key, WorkQueue
+
+MapFn = Callable[[WatchEvent], List[Tuple[str, str]]]  # -> [(namespace, name)]
+ReconcileFn = Callable[[Key], ReconcileStepResult]
+
+
+@dataclass
+class Controller:
+    name: str
+    kind: str
+    reconcile: ReconcileFn
+    watches: List[Tuple[str, MapFn]] = field(default_factory=list)
+    queue: WorkQueue = field(default_factory=WorkQueue)
+    # ConcurrentSyncs equivalent: keys processed per engine round (the
+    # engine is single-threaded, so this is batching, not parallelism)
+    concurrent_syncs: int = 1
+
+
+class Engine:
+    def __init__(self, store: Store, clock: Optional[Clock] = None) -> None:
+        self.store = store
+        self.clock = clock or store.clock
+        self.controllers: List[Controller] = []
+        self._event_backlog: List[WatchEvent] = []
+        self.held_kinds: set = set()
+        store.subscribe(self._event_backlog.append)
+
+    def register(self, controller: Controller) -> None:
+        self.controllers.append(controller)
+
+    # -- event delivery --------------------------------------------------
+
+    def hold_events(self, kind: str) -> None:
+        """Delay delivery of a kind's watch events (that kind's informer
+        'falls behind') — used by tests to surface staleness races."""
+        self.held_kinds.add(kind)
+
+    def release_events(self, kind: str) -> None:
+        self.held_kinds.discard(kind)
+
+    def _route_events(self) -> None:
+        # Drain in place: reconciles emit new events while we iterate.
+        remaining: List[WatchEvent] = []
+        events = list(self._event_backlog)
+        self._event_backlog.clear()
+        for ev in events:
+            if ev.kind in self.held_kinds:
+                remaining.append(ev)
+                continue
+            # a kind's cache advances exactly when its events are delivered
+            # (incremental informer application); held kinds stay stale
+            if self.store.cache_lag:
+                self.store.apply_event_to_cache(ev)
+            for ctrl in self.controllers:
+                if ev.kind == ctrl.kind:
+                    ctrl.queue.add(
+                        (ctrl.kind, ev.obj.metadata.namespace, ev.obj.metadata.name)
+                    )
+                for watched_kind, map_fn in ctrl.watches:
+                    if ev.kind == watched_kind:
+                        for ns, name in map_fn(ev):
+                            ctrl.queue.add((ctrl.kind, ns, name))
+        self._event_backlog.extend(remaining)
+
+    # -- run loop --------------------------------------------------------
+
+    def drain(self, max_rounds: int = 10_000) -> int:
+        """Process until no controller has a ready item at the current time.
+        Returns the number of reconciles executed."""
+        executed = 0
+        now = self.clock.now()
+        for _ in range(max_rounds):
+            self._route_events()
+            progressed = False
+            for ctrl in self.controllers:
+                for _slot in range(max(ctrl.concurrent_syncs, 1)):
+                    key = ctrl.queue.pop(now)
+                    if key is None:
+                        break
+                    progressed = True
+                    executed += 1
+                    METRICS.inc(f"reconcile_total/{ctrl.name}")
+                    try:
+                        result = ctrl.reconcile(key)
+                    except Exception:
+                        METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
+                        # RecoverPanic equivalent (manager.go:99-101): requeue
+                        ctrl.queue.add_rate_limited(key, now)
+                        continue
+                    if result.result == "requeue":
+                        METRICS.inc(f"reconcile_errors_total/{ctrl.name}")
+                        ctrl.queue.add_rate_limited(key, now)
+                    elif result.result == "requeue_after":
+                        ctrl.queue.forget(key)
+                        ctrl.queue.add_after(
+                            key, result.requeue_after or 0.0, now
+                        )
+                    else:
+                        ctrl.queue.forget(key)
+            if not progressed:
+                # new events may have landed during the last round
+                self._route_events()
+                if all(c.queue.empty(now) for c in self.controllers):
+                    return executed
+        raise RuntimeError(
+            f"engine did not quiesce within {max_rounds} rounds "
+            "(reconcile livelock?)"
+        )
+
+    def advance(self, seconds: float) -> None:
+        self.clock.advance(seconds)  # type: ignore[attr-defined]
+
+    def advance_and_drain(self, seconds: float) -> int:
+        """Advance virtual time then drain — fires due requeue_after items
+        (gang termination delays, rolling-update waits)."""
+        self.advance(seconds)
+        return self.drain()
+
+    def next_wakeup(self) -> Optional[float]:
+        """Earliest scheduled requeue across controllers (None if idle)."""
+        times = [
+            t for c in self.controllers if (t := c.queue.next_delayed_at()) is not None
+        ]
+        return min(times) if times else None
+
+    def run_until_idle(self, max_virtual_seconds: float = 3600.0) -> int:
+        """Drain, then keep advancing virtual time to the next scheduled
+        requeue until nothing is pending or the budget is exhausted."""
+        total = self.drain()
+        budget_end = self.clock.now() + max_virtual_seconds
+        while True:
+            wake = self.next_wakeup()
+            if wake is None or wake > budget_end:
+                return total
+            if wake > self.clock.now():
+                self.advance(wake - self.clock.now())
+            total += self.drain()

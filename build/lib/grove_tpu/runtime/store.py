@@ -355,7 +355,10 @@ class Store:
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._emit(MODIFIED, obj)
-        self.complete_deletion_if_drained(kind, namespace, name)
+        if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+            del kind_objs[key]
+            self._index_remove(obj)
+            self._emit(DELETED, obj)
 
     def complete_deletion_if_drained(
         self, kind: str, namespace: str, name: str

@@ -1,0 +1,77 @@
+"""Keyed work queue with dedup, delayed re-adds and per-key backoff.
+
+Single-process, virtual-time equivalent of client-go's rate-limited workqueue
+as used by the reference's controllers (manager concurrency model,
+controller/manager.go). Items are (kind, namespace, name) keys; a key is
+deduped while pending, like the real workqueue.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+BASE_BACKOFF = 0.005
+MAX_BACKOFF = 1000.0
+
+
+@dataclass(order=True)
+class _Delayed:
+    ready_at: float
+    seq: int
+    key: Key
+
+
+class WorkQueue:
+    def __init__(self) -> None:
+        self._ready: Deque[Key] = deque()
+        self._pending: Set[Key] = set()
+        self._delayed: List[_Delayed] = []
+        self._seq = itertools.count()
+        self._failures: Dict[Key, int] = {}
+
+    def add(self, key: Key) -> None:
+        if key not in self._pending:
+            self._pending.add(key)
+            self._ready.append(key)
+
+    def add_after(self, key: Key, delay: float, now: float) -> None:
+        heapq.heappush(self._delayed, _Delayed(now + delay, next(self._seq), key))
+
+    def add_rate_limited(self, key: Key, now: float) -> None:
+        """Exponential per-key backoff (client-go ItemExponentialFailureRateLimiter)."""
+        failures = self._failures.get(key, 0)
+        delay = min(BASE_BACKOFF * (2**failures), MAX_BACKOFF)
+        self._failures[key] = failures + 1
+        self.add_after(key, delay, now)
+
+    def forget(self, key: Key) -> None:
+        self._failures.pop(key, None)
+
+    def _promote_delayed(self, now: float) -> None:
+        while self._delayed and self._delayed[0].ready_at <= now:
+            item = heapq.heappop(self._delayed)
+            self.add(item.key)
+
+    def pop(self, now: float) -> Optional[Key]:
+        self._promote_delayed(now)
+        if not self._ready:
+            return None
+        key = self._ready.popleft()
+        self._pending.discard(key)
+        return key
+
+    def next_delayed_at(self) -> Optional[float]:
+        return self._delayed[0].ready_at if self._delayed else None
+
+    def __len__(self) -> int:
+        return len(self._ready)
+
+    def empty(self, now: float) -> bool:
+        self._promote_delayed(now)
+        return not self._ready

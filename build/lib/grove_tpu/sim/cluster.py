@@ -1,0 +1,203 @@
+"""Simulated cluster: nodes, a binding scheduler, and a kubelet.
+
+Plays the roles external to the reference operator: the KAI scheduler (binds
+ungated pods to nodes — here the placement decision will be delegated to the
+TPU solver) and the kubelets (pods start containers and become Ready, honoring
+the grove-initc startup-ordering waiter). The e2e analogue of the reference's
+k3d harness (SURVEY §4.3), driven on virtual time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.pod import (
+    COND_POD_READY,
+    COND_POD_SCHEDULED,
+    POD_PENDING,
+    POD_RUNNING,
+    ContainerStatus,
+    Pod,
+    is_ready,
+    is_scheduled,
+    is_terminating,
+)
+from grove_tpu.initc.waiter import is_ready_to_start
+from grove_tpu.runtime.store import Store
+
+
+@dataclass
+class Node:
+    name: str
+    capacity: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)  # topology keys
+    cordoned: bool = False
+
+
+@dataclass
+class SimCluster:
+    store: Store
+    nodes: List[Node] = field(default_factory=list)
+    # (namespace, pod name) -> node name
+    bindings: Dict[tuple, str] = field(default_factory=dict)
+    # sticky history surviving deletion: reservation-reuse hints rebind
+    # recreated pods (stable names) to their previous node when it still fits
+    last_node: Dict[tuple, str] = field(default_factory=dict)
+    start_delay: float = 0.0  # container start latency (virtual seconds)
+
+    def _gc_bindings(self) -> None:
+        """Drop bindings whose pod is gone or no longer carries the binding
+        (deleted-and-recreated pods reuse stable names)."""
+        stale = []
+        for (ns, name), _node in self.bindings.items():
+            pod = self.store.get("Pod", ns, name)
+            if pod is None or not is_scheduled(pod):
+                stale.append((ns, name))
+        for key in stale:
+            del self.bindings[key]
+
+    # -- capacity --------------------------------------------------------
+
+    def node_free(self, node: Node) -> Dict[str, float]:
+        free = dict(node.capacity)
+        for (ns, pod_name), node_name in self.bindings.items():
+            if node_name != node.name:
+                continue
+            pod = self.store.get("Pod", ns, pod_name)
+            if pod is None or is_terminating(pod):
+                continue
+            for k, v in pod.spec.total_requests().items():
+                free[k] = free.get(k, 0.0) - v
+        return free
+
+    def fits(self, node: Node, pod: Pod) -> bool:
+        free = self.node_free(node)
+        return all(free.get(k, 0.0) >= v for k, v in pod.spec.total_requests().items())
+
+    # -- scheduler (simple binder; TPU solver slots in here) -------------
+
+    def schedule_pending(self, namespace: Optional[str] = None) -> int:
+        """Bind every ungated, unscheduled pod (all namespaces by default)
+        to the first node that fits (placeholder first-fit; the solver-backed
+        gang scheduler replaces this for topology-aware placement)."""
+        bound = 0
+        self._gc_bindings()
+        for pod in self.store.list("Pod", namespace):
+            if (
+                pod.spec.scheduling_gates
+                or is_scheduled(pod)
+                or is_terminating(pod)
+            ):
+                continue
+            for node in self.nodes:
+                if node.cordoned or not self.fits(node, pod):
+                    continue
+                self.bind(pod, node.name)
+                bound += 1
+                break
+        return bound
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        fresh = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
+        if fresh is None:
+            return
+        key = (fresh.metadata.namespace, fresh.metadata.name)
+        self.bindings[key] = node_name
+        self.last_node[key] = node_name
+        fresh.status.node_name = node_name
+        set_condition(
+            fresh.status.conditions,
+            Condition(type=COND_POD_SCHEDULED, status="True", reason="Bound"),
+            self.store.clock.now(),
+        )
+        self.store.update_status(fresh)
+
+    # -- kubelet ---------------------------------------------------------
+
+    def kubelet_tick(self, namespace: Optional[str] = None) -> int:
+        """Advance scheduled pods (all namespaces by default) toward Ready:
+        run the init waiter, then start containers and flip Ready. Returns
+        pods transitioned."""
+        progressed = 0
+        # Two-phase: decide against the tick-start state, then apply — so a
+        # dependent pod never starts in the same tick its parent became Ready
+        # (real kubelets are independent processes; the init waiter observes
+        # parent readiness with at least one tick of delay).
+        to_start = []
+        for pod in self.store.list("Pod", namespace):
+            if not is_scheduled(pod) or is_ready(pod) or is_terminating(pod):
+                continue
+            waiter_cfg = pod.spec.extra.get("groveInitWaiter")
+            if waiter_cfg and not pod.status.init_waiter_done:
+                if not is_ready_to_start(
+                    self.store, pod.metadata.namespace, waiter_cfg
+                ):
+                    continue
+                pod.status.init_waiter_done = True
+            to_start.append(pod)
+        for pod in to_start:
+            pod.status.phase = POD_RUNNING
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name, ready=True, started=True)
+                for c in pod.spec.containers
+            ]
+            set_condition(
+                pod.status.conditions,
+                Condition(type=COND_POD_READY, status="True", reason="Started"),
+                self.store.clock.now(),
+            )
+            self.store.update_status(pod)
+            progressed += 1
+        return progressed
+
+    def fail_pod(self, namespace: str, name: str, exit_code: int = 1) -> None:
+        """Crash a pod's containers (fault injection for breach tests)."""
+        pod = self.store.get("Pod", namespace, name)
+        if pod is None:
+            return
+        pod.status.phase = POD_PENDING
+        for cs in pod.status.container_statuses:
+            cs.ready = False
+            cs.exit_code = exit_code
+            cs.restart_count += 1
+        if not pod.status.container_statuses:
+            pod.status.container_statuses = [
+                ContainerStatus(name=c.name, started=True, exit_code=exit_code)
+                for c in pod.spec.containers
+            ]
+        set_condition(
+            pod.status.conditions,
+            Condition(type=COND_POD_READY, status="False", reason="CrashLoop"),
+            self.store.clock.now(),
+        )
+        self.store.update_status(pod)
+
+
+def make_nodes(
+    count: int,
+    capacity: Optional[Dict[str, float]] = None,
+    hosts_per_ici_block: int = 4,
+    blocks_per_slice: int = 4,
+) -> List[Node]:
+    """Synthetic TPU-ish topology: hosts grouped into ici-blocks into slices."""
+    capacity = capacity or {"cpu": 8.0, "memory": 32 * 2**30, "tpu": 4.0}
+    nodes = []
+    for i in range(count):
+        block = i // hosts_per_ici_block
+        slice_ = block // blocks_per_slice
+        nodes.append(
+            Node(
+                name=f"node-{i}",
+                capacity=dict(capacity),
+                labels={
+                    "kubernetes.io/hostname": f"node-{i}",
+                    "cloud.google.com/gke-tpu-ici-block": f"block-{block}",
+                    "cloud.google.com/gke-tpu-slice": f"slice-{slice_}",
+                    "cloud.google.com/gke-cluster": "cluster-0",
+                    "topology.kubernetes.io/zone": "zone-a",
+                },
+            )
+        )
+    return nodes

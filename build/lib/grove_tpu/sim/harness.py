@@ -1,0 +1,189 @@
+"""Simulation harness: operator + sim cluster in one virtual-time loop.
+
+The end-to-end driver mirroring the reference quickstart flow
+(README.md:26 — apply a PodCliqueSet, watch pcs/pclq/pcsg/pg/pod materialize).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional
+
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import (
+    ValidationError,
+    validate_or_raise,
+    validate_podcliqueset_update,
+)
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.api.types import PodCliqueSet
+from grove_tpu.controller.common import OperatorContext
+from grove_tpu.controller.register import register_controllers
+from grove_tpu.runtime.clock import VirtualClock
+from grove_tpu.runtime.engine import Engine
+from grove_tpu.runtime.store import Store
+from grove_tpu.sim.cluster import SimCluster, make_nodes
+
+
+class SimHarness:
+    def __init__(
+        self,
+        num_nodes: int = 16,
+        cache_lag: bool = True,
+        topology: Optional[ClusterTopology] = None,
+        config=None,  # Optional[OperatorConfiguration]
+    ) -> None:
+        from grove_tpu.config.operator import OperatorConfiguration
+
+        self.config = config or OperatorConfiguration()
+        self.clock = VirtualClock()
+        self.store = Store(self.clock, cache_lag=cache_lag)
+        # ClusterTopology lives in the store like any CR; when the config
+        # enables it, startup requires the named CR to exist (the reference
+        # crashes at boot if enabled-but-missing — cmd/main.go:72-75)
+        self.topology = topology or ClusterTopology()
+        if self.config.cluster_topology.enabled:
+            from grove_tpu.admission.validation import validate_cluster_topology
+
+            res = validate_cluster_topology(self.topology)
+            if not res.ok:
+                raise ValueError(
+                    f"cluster topology invalid: {'; '.join(res.errors)}"
+                )
+            self.topology.metadata.name = self.config.cluster_topology.name
+        # the stored CR is the source of truth — keep its identity (uid/rv)
+        self.topology = self.store.create(self.topology)
+        if self.config.authorizer.enabled:
+            from grove_tpu.admission.authorization import AuthorizationGuard
+
+            self.store.guard = AuthorizationGuard(
+                enabled=True,
+                exempt_users=self.config.authorizer.exempt_service_accounts,
+            )
+        self.engine = Engine(self.store, self.clock)
+        self.ctx = OperatorContext(
+            store=self.store, clock=self.clock, topology=self.topology
+        )
+        register_controllers(self.engine, self.ctx, self.config)
+        self.cluster = SimCluster(store=self.store, nodes=make_nodes(num_nodes))
+        # TPU-solver-backed gang scheduler (the KAI-replacement); set to None
+        # to fall back to the cluster's naive first-fit binder.
+        from grove_tpu.solver.scheduler import GangScheduler
+
+        self.scheduler = GangScheduler(
+            self.store,
+            self.cluster,
+            self.topology,
+            priority_map=self.config.solver.priority_classes,
+            chunk_size=min(self.config.solver.chunk_size, 64),
+            max_waves=self.config.solver.max_waves,
+        )
+        # HPA controller equivalent (multi-level autoscaling)
+        from grove_tpu.autoscale.hpa import (
+            HorizontalAutoscaler,
+            StaticMetricsProvider,
+        )
+
+        self.metrics_provider = StaticMetricsProvider()
+        self.autoscaler = HorizontalAutoscaler(
+            self.store, self.metrics_provider, scale_down_stabilization=60.0
+        )
+
+    def schedule(self) -> int:
+        if self.scheduler is not None:
+            return self.scheduler.schedule_pending()
+        return self.cluster.schedule_pending()
+
+    # -- user actions ----------------------------------------------------
+
+    def apply(self, pcs: PodCliqueSet) -> PodCliqueSet:
+        default_podcliqueset(pcs)
+        existing = self.store.get(
+            "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
+        )
+        if existing is None:
+            validate_or_raise(pcs, self.topology)
+            return self.store.create(pcs)
+        res = validate_podcliqueset_update(pcs, existing, self.topology)
+        if not res.ok:
+            raise ValidationError(res)
+        existing.spec = pcs.spec
+        return self.store.update(existing)
+
+    def apply_yaml(self, text: str) -> List[PodCliqueSet]:
+        return [self.apply(p) for p in load_podcliquesets(text)]
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self.store.delete("PodCliqueSet", namespace, name)
+
+    # -- convergence loop ------------------------------------------------
+
+    def converge(self, max_ticks: int = 60, tick_seconds: float = 1.0) -> int:
+        """Reconcile ⇄ schedule ⇄ kubelet until quiescent. Each tick advances
+        virtual time so requeue_after-based waits can fire."""
+        ticks = 0
+        for _ in range(max_ticks):
+            work = self.engine.drain()
+            work += self.autoscaler.tick()
+            bound = self.schedule()
+            started = self.cluster.kubelet_tick()
+            work += self.engine.drain()
+            ticks += 1
+            if bound == 0 and started == 0 and work == 0:
+                # idle now — but short-horizon requeues (gate retries) or a
+                # held HPA scale-down may be pending; jump to the earliest
+                # wakeup rather than stopping early
+                wakes = [
+                    w
+                    for w in (
+                        self.engine.next_wakeup(),
+                        self.autoscaler.next_deadline(),
+                    )
+                    if w is not None
+                ]
+                wake = min(wakes) if wakes else None
+                if wake is not None and wake - self.clock.now() <= 120.0:
+                    self.clock.advance(max(wake - self.clock.now(), 0.0))
+                    continue
+                break
+            self.clock.advance(tick_seconds)
+        return ticks
+
+    def advance(self, seconds: float) -> None:
+        self.clock.advance(seconds)
+
+    # -- inspection ------------------------------------------------------
+
+    def tree(self, namespace: str = "default") -> str:
+        """kubectl-tree-style dump: pcs > pclq/pcsg > pg > pod."""
+        out = io.StringIO()
+        for pcs in self.store.list("PodCliqueSet", namespace):
+            out.write(f"pcs/{pcs.metadata.name}\n")
+            sel = namegen.default_labels(pcs.metadata.name)
+            for pcsg in self.store.list("PodCliqueScalingGroup", namespace, sel):
+                st = pcsg.status
+                out.write(
+                    f"  pcsg/{pcsg.metadata.name} replicas={pcsg.spec.replicas}"
+                    f" scheduled={st.scheduled_replicas} available={st.available_replicas}\n"
+                )
+            for pclq in self.store.list("PodClique", namespace, sel):
+                st = pclq.status
+                out.write(
+                    f"  pclq/{pclq.metadata.name} replicas={st.replicas}"
+                    f" ready={st.ready_replicas} scheduled={st.scheduled_replicas}\n"
+                )
+            for pg in self.store.list("PodGang", namespace, sel):
+                groups = ", ".join(
+                    f"{g.name}(min={g.min_replicas},pods={len(g.pod_references)})"
+                    for g in pg.spec.pod_groups
+                )
+                out.write(f"  pg/{pg.metadata.name} [{groups}]\n")
+            for pod in self.store.list("Pod", namespace, sel):
+                gates = "gated" if pod.spec.scheduling_gates else "ungated"
+                node = pod.status.node_name or "-"
+                out.write(
+                    f"    pod/{pod.metadata.name} {pod.status.phase} {gates} node={node}\n"
+                )
+        return out.getvalue()

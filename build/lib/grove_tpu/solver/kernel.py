@@ -1,0 +1,327 @@
+"""JAX kernel wrapper: PackingProblem → PackingResult (device execution).
+
+Compilation is AOT-cached per shape signature so `solve_seconds` measures
+steady-state device execution only; compile time is recorded separately in
+the `gang_solve_compile_seconds` metric (one entry per new size bucket).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.ops.packing import (
+    solve_packing,
+    solve_wave_chunk,
+    solve_waves_device,
+)
+from grove_tpu.solver.types import PackingProblem, PackingResult
+
+_compiled_cache: Dict[Tuple, object] = {}
+
+
+def _get_compiled(args, with_alloc: bool, grouped: bool):
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (with_alloc, grouped)
+    compiled = _compiled_cache.get(sig)
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = solve_packing.lower(
+            *args, with_alloc=with_alloc, grouped=grouped
+        ).compile()
+        METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
+        _compiled_cache[sig] = compiled
+    return compiled
+
+
+def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
+    args = (
+        jnp.asarray(problem.capacity),
+        jnp.asarray(problem.topo),
+        jnp.asarray(problem.seg_starts),
+        jnp.asarray(problem.seg_ends),
+        jnp.asarray(problem.demand),
+        jnp.asarray(problem.count),
+        jnp.asarray(problem.min_count),
+        jnp.asarray(problem.req_level),
+        jnp.asarray(problem.pref_level),
+        jnp.asarray(problem.group_req),
+        jnp.asarray(problem.group_pin),
+        jnp.asarray(problem.gang_pin),
+    )
+    grouped = bool((problem.group_req >= 0).any())
+    compiled = _get_compiled(args, with_alloc, grouped)
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    admitted = np.asarray(out["admitted"])  # device sync
+    elapsed = time.perf_counter() - t0
+    return PackingResult(
+        admitted=admitted,
+        placed=np.asarray(out["placed"]),
+        score=np.asarray(out["score"]),
+        chosen_level=np.asarray(out["chosen_level"]),
+        alloc=None if out["alloc"] is None else np.asarray(out["alloc"]),
+        free_after=np.asarray(out["free_after"]),
+        solve_seconds=elapsed,
+    )
+
+
+def solve_waves(
+    problem: PackingProblem,
+    chunk_size: int = 32,
+    max_waves: int = 16,
+    with_alloc: bool = True,
+) -> PackingResult:
+    """Wave-parallel solve WITH per-pod allocations (the binding path).
+
+    Same algorithm as the device-resident stats solver (single-fill parallel
+    decisions, strided domain spread, prefix-acceptance commit, narrow-cap
+    retry walk), driven chunk-by-chunk from the host so allocations stream
+    out per chunk. Gangs still pending when the wave budget ends simply stay
+    pending — in the control loop they are re-solved on the next scheduling
+    round (no exact tail here; that kernel's compile cost is only paid on
+    the stats/bench path where alloc isn't materialized).
+    """
+    g = problem.num_gangs
+    chunk_size = min(chunk_size, max(g, 1))
+    n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
+    g_pad = n_chunks * chunk_size
+
+    def pad(a, value=0):
+        if a.shape[0] == g_pad:
+            return a
+        width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, width, constant_values=value)
+
+    demand = pad(problem.demand)
+    count = pad(problem.count)
+    min_count = pad(problem.min_count)
+    req_level = pad(problem.req_level, -1)
+    pref_level = pad(problem.pref_level, -1)
+    group_req = pad(problem.group_req, -1)
+    group_pin = pad(problem.group_pin, -1)
+    gang_pin = pad(problem.gang_pin, -1)
+
+    free = jnp.asarray(problem.capacity)
+    topo = jnp.asarray(problem.topo)
+    seg_starts = jnp.asarray(problem.seg_starts)
+    seg_ends = jnp.asarray(problem.seg_ends)
+    n_levels = problem.num_levels
+    pending = np.ones((g_pad,), dtype=bool)
+    pending[g:] = False
+    narrow_cap = np.full((g_pad,), n_levels - 1, dtype=np.int32)
+
+    admitted = np.zeros((g_pad,), dtype=bool)
+    placed = np.zeros_like(count)
+    score = np.zeros((g_pad,), dtype=np.float32)
+    chosen_level = np.full((g_pad,), -1, dtype=np.int32)
+    alloc = (
+        np.zeros((g_pad, problem.max_groups, problem.num_nodes), dtype=np.int32)
+        if with_alloc
+        else None
+    )
+
+    grouped = bool((problem.group_req >= 0).any())
+    # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
+    # change between waves; re-uploading per wave would pay the remote-link
+    # latency this path exists to avoid)
+    chunk_const = [
+        tuple(
+            jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
+            for a in (demand, count, min_count, req_level, pref_level)
+        )
+        + (
+            jnp.asarray(group_req[c * chunk_size : (c + 1) * chunk_size]),
+            jnp.asarray(group_pin[c * chunk_size : (c + 1) * chunk_size]),
+            jnp.asarray(gang_pin[c * chunk_size : (c + 1) * chunk_size]),
+        )
+        for c in range(n_chunks)
+    ]
+
+    t0 = time.perf_counter()
+    waves_used = 0
+    for wave in range(max_waves):
+        if not pending.any():
+            break
+        progress = False
+        waves_used += 1
+        seeds = np.arange(g_pad, dtype=np.int32) + np.int32(wave * 7919)
+        for c in range(n_chunks):
+            sl = slice(c * chunk_size, (c + 1) * chunk_size)
+            mask = pending[sl]
+            if not mask.any():
+                continue
+            dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c = (
+                chunk_const[c]
+            )
+            out = solve_wave_chunk(
+                free,
+                topo,
+                seg_starts,
+                seg_ends,
+                dem_c,
+                cnt_c,
+                mn_c,
+                rq_c,
+                pf_c,
+                jnp.asarray(mask),
+                jnp.asarray(narrow_cap[sl]),
+                jnp.asarray(seeds[sl]),
+                group_req=grq_c,
+                group_pin=gpin_c,
+                gang_pin=gangpin_c,
+                grouped=grouped,
+            )
+            committed = np.asarray(out["admitted"])
+            retry = np.asarray(out["retry"])
+            free = out["free_after"]
+            admitted[sl] |= committed
+            placed[sl] = np.where(committed[:, None], out["placed"], placed[sl])
+            score[sl] = np.where(committed, out["score"], score[sl])
+            chosen_level[sl] = np.where(
+                committed, out["chosen_level"], chosen_level[sl]
+            )
+            narrow_cap[sl] = np.asarray(out["new_cap"])
+            if with_alloc:
+                alloc[sl] = np.where(
+                    committed[:, None, None], np.asarray(out["alloc"]), alloc[sl]
+                )
+            pending[sl] = mask & retry
+            # retry counts as progress: the narrow-cap fallback walk admits
+            # gangs in LATER waves even when this one committed nothing
+            # (device-loop parity)
+            progress |= committed.any() or retry.any()
+        if not progress:
+            break
+    elapsed = time.perf_counter() - t0
+    METRICS.set("gang_solve_waves", waves_used)
+
+    return PackingResult(
+        admitted=admitted[:g],
+        placed=placed[:g],
+        score=score[:g],
+        chosen_level=chosen_level[:g],
+        alloc=None if alloc is None else alloc[:g],
+        free_after=np.asarray(free),
+        solve_seconds=elapsed,
+    )
+
+
+def solve_waves_stats(
+    problem: PackingProblem,
+    chunk_size: int = 128,
+    max_waves: int = 16,
+) -> PackingResult:
+    """Device-resident wave solve (ops.packing.solve_waves_device): the whole
+    multi-wave loop runs as one XLA program — the stress-bench path. Returns
+    stats only (no per-pod alloc); use solve_waves/solve for binding."""
+    g = problem.num_gangs
+    chunk_size = min(chunk_size, max(g, 1))
+    n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
+    g_pad = n_chunks * chunk_size
+
+    def pad(a, value=0):
+        if a.shape[0] == g_pad:
+            return a
+        width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, width, constant_values=value)
+
+    args = (
+        jnp.asarray(problem.capacity),
+        jnp.asarray(problem.topo),
+        jnp.asarray(problem.seg_starts),
+        jnp.asarray(problem.seg_ends),
+        jnp.asarray(pad(problem.demand)),
+        jnp.asarray(pad(problem.count)),
+        jnp.asarray(pad(problem.min_count)),
+        jnp.asarray(pad(problem.req_level, -1)),
+        jnp.asarray(pad(problem.pref_level, -1)),
+        jnp.asarray(pad(problem.group_req, -1)),
+        jnp.asarray(pad(problem.group_pin, -1)),
+        jnp.asarray(pad(problem.gang_pin, -1)),
+    )
+    grouped = bool((problem.group_req >= 0).any())
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (
+        n_chunks,
+        max_waves,
+        grouped,
+    )
+    compiled = _compiled_cache.get(sig)
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = solve_waves_device.lower(
+            *args, n_chunks=n_chunks, max_waves=max_waves, grouped=grouped
+        ).compile()
+        METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
+        _compiled_cache[sig] = compiled
+    t0 = time.perf_counter()
+    out = compiled(*args)
+    admitted = np.array(out["admitted"])[:g]
+    elapsed = time.perf_counter() - t0  # wave execution (sync on admitted)
+    placed = np.array(out["placed"])[:g]
+    score = np.array(out["score"])[:g]
+    chosen_level = np.array(out["chosen_level"])[:g]
+    free_after = np.asarray(out["free_after"])
+    pending = np.asarray(out["pending"])[:g]
+
+    # Hybrid tail: under extreme contention a handful of gangs can keep
+    # colliding past the wave budget — finish them with the exact sequential
+    # kernel against the remaining capacity (small G → cheap), guaranteeing
+    # convergence to near-greedy admissions.
+    n_pending = int(pending.sum())
+    if n_pending:
+        idx = np.flatnonzero(pending)
+        # pad the tail to a pow2 bucket (min 32) so repeat solves reuse one
+        # executable across varying tail sizes
+        t_pad = 32
+        while t_pad < n_pending:
+            t_pad *= 2
+
+        def tpad(a, value=0):
+            width = [(0, t_pad - n_pending)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a[idx], width, constant_values=value)
+
+        tail = PackingProblem(
+            capacity=free_after,
+            topo=problem.topo,
+            demand=tpad(problem.demand),
+            count=tpad(problem.count),
+            min_count=tpad(problem.min_count),
+            req_level=tpad(problem.req_level, -1),
+            pref_level=tpad(problem.pref_level, -1),
+            group_req=tpad(problem.group_req, -1),
+            group_pin=tpad(problem.group_pin, -1),
+            gang_pin=tpad(problem.gang_pin, -1),
+            priority=tpad(problem.priority),
+            seg_starts=problem.seg_starts,
+            seg_ends=problem.seg_ends,
+        )
+        tail_res = solve(tail, with_alloc=False)
+        # solve() excludes its own compile time; add execution only so
+        # solve_seconds keeps the steady-state-execution contract
+        elapsed += tail_res.solve_seconds
+        tail_admit = tail_res.admitted[:n_pending]
+        admitted[idx] = tail_admit
+        placed[idx] = np.where(
+            tail_admit[:, None], tail_res.placed[:n_pending], placed[idx]
+        )
+        score[idx] = np.where(tail_admit, tail_res.score[:n_pending], score[idx])
+        chosen_level[idx] = np.where(
+            tail_admit, tail_res.chosen_level[:n_pending], chosen_level[idx]
+        )
+        free_after = tail_res.free_after
+        METRICS.set("gang_solve_tail", n_pending)
+    METRICS.set("gang_solve_waves", int(np.asarray(out["waves"])))
+    return PackingResult(
+        admitted=admitted,
+        placed=placed,
+        score=score,
+        chosen_level=chosen_level,
+        alloc=None,
+        free_after=free_after,
+        solve_seconds=elapsed,
+    )

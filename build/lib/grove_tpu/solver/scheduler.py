@@ -468,18 +468,13 @@ class GangScheduler:
         # LATER preemptor's solo check and trial solve (double-spending
         # capacity already earmarked for an earlier preemptor — the later
         # preemptor would either skip a needed eviction or evict a
-        # too-small victim set that never makes it placeable). Each
-        # preemptor's PLANNED PLACEMENT (its trial alloc) is then debited
-        # from the snapshot, so a lower-priority preemptor can never clear
-        # its trial on base capacity a higher-priority preemptor is about to
-        # consume (which would evict victims for a gang that still can't
-        # place next round).
+        # too-small victim set that never makes it placeable).
         base_free = {
             node.name: dict(self.cluster.node_free(node)) for node in nodes
         }
         all_victim_keys: set = set()
         for preemptor in rejected:
-            victims_chosen, free_delta = self._select_preemption_victims(
+            victims_chosen = self._select_preemption_victims(
                 preemptor, nodes, base_free, exclude=all_victim_keys
             )
             for gang in victims_chosen:
@@ -487,53 +482,24 @@ class GangScheduler:
                 all_victim_keys.add(
                     (gang.metadata.namespace, gang.metadata.name)
                 )
-            for node_name, caps in free_delta.items():
-                acc = base_free.setdefault(node_name, {})
-                for r, q in caps.items():
-                    acc[r] = acc.get(r, 0.0) + q
         return all_victim_keys
-
-    @staticmethod
-    def _placement_usage(result, problem, preemptor: dict) -> Dict:
-        """Per-node resources the preemptor's trial placement consumes, in
-        ORIGINAL units (alloc holds pod counts, which are unit-free; the
-        quantized kernel capacities never leave the solver)."""
-        import numpy as np
-
-        demand_by_group = {g["name"]: g["demand"] for g in preemptor["groups"]}
-        usage: Dict[str, Dict[str, float]] = {}
-        alloc = result.alloc[0]  # [P, N]
-        for p, gname in enumerate(problem.group_names[0]):
-            dem = demand_by_group.get(gname, {})
-            for n in np.nonzero(alloc[p])[0]:
-                k = int(alloc[p][n])
-                caps = usage.setdefault(problem.node_names[int(n)], {})
-                for r, q in dem.items():
-                    caps[r] = caps.get(r, 0.0) - q * k  # negative = consumed
-        return usage
 
     def _select_preemption_victims(
         self, preemptor: dict, nodes: List, base_free: Dict, exclude: set
-    ):
+    ) -> List:
         """Choose an inclusion-minimal set of scheduled lower-priority gangs
         (any namespace, not already in `exclude`) whose eviction makes the
         preemptor placeable; empty when no eviction helps. `base_free` is the
-        capacity snapshot shared by all preemptors this round. Returns
-        (victims, free_delta) where free_delta is the per-node capacity
-        adjustment — victims' freed capacity minus the preemptor's planned
-        placement — the caller folds into the snapshot for later
-        preemptors."""
+        pre-eviction capacity snapshot shared by all preemptors this round."""
         # The wave solver is heuristic: "not admitted" can be a seed/budget
         # artifact, not infeasibility. If the gang fits the CURRENT free
         # capacity on its own, it will simply be placed next round — never
-        # evict for it (but DO reserve its planned placement against later
-        # preemptors' trials).
-        solo_problem = build_problem(
+        # evict for it.
+        solo = build_problem(
             nodes, [preemptor], self.topology, free_capacity=base_free
         )
-        solo = solve_waves(solo_problem, with_alloc=True)
-        if solo.admitted[0]:
-            return [], self._placement_usage(solo, solo_problem, preemptor)
+        if solve_waves(solo, with_alloc=False).admitted[0]:
+            return []
 
         victims = []
         for gang in self.store.list("PodGang"):  # every namespace
@@ -548,7 +514,7 @@ class GangScheduler:
             if victim_priority < preemptor["priority"]:
                 victims.append((victim_priority, gang))
         if not victims:
-            return [], {}
+            return []
         victims.sort(
             key=lambda v: (v[0], v[1].metadata.namespace, v[1].metadata.name)
         )
@@ -594,9 +560,9 @@ class GangScheduler:
             if all(freed.get(r, 0.0) >= q for r, q in demand_total.items()):
                 break
         else:
-            return [], {}  # evicting everything lower still wouldn't fit
+            return []  # evicting everything lower still wouldn't fit
 
-        def run_trial(keep: List[int], with_alloc: bool = False):
+        def trial_admits(keep: List[int]) -> bool:
             trial_free = {}
             add: Dict[str, Dict[str, float]] = {}
             for i in keep:
@@ -612,34 +578,19 @@ class GangScheduler:
             trial_problem = build_problem(
                 nodes, [preemptor], self.topology, free_capacity=trial_free
             )
-            return solve_waves(trial_problem, with_alloc=with_alloc), trial_problem
+            return bool(solve_waves(trial_problem, with_alloc=False).admitted[0])
 
         keep = list(range(len(chosen)))
-        result, _ = run_trial(keep)
-        if not result.admitted[0]:
-            return [], {}  # eviction would not make the preemptor placeable
+        if not trial_admits(keep):
+            return []  # eviction would not make the preemptor placeable
 
         # prune to an inclusion-minimal victim set: drop the most valuable
         # (highest-priority, i.e. latest-accumulated) victims first
         for i in reversed(range(len(chosen))):
             reduced = [j for j in keep if j != i]
-            if reduced:
-                result, _ = run_trial(reduced)
-                if result.admitted[0]:
-                    keep = reduced
-
-        # final kept trial with allocations: the free delta for later
-        # preemptors = kept victims' freed capacity − this placement
-        final, final_problem = run_trial(keep, with_alloc=True)
-        delta: Dict[str, Dict[str, float]] = {}
-        if final.admitted[0]:
-            delta = self._placement_usage(final, final_problem, preemptor)
-        for i in keep:
-            for node_name, caps in chosen_freed[i].items():
-                acc = delta.setdefault(node_name, {})
-                for r, q in caps.items():
-                    acc[r] = acc.get(r, 0.0) + q
-        return [chosen[i] for i in keep], delta
+            if reduced and trial_admits(reduced):
+                keep = reduced
+        return [chosen[i] for i in keep]
 
     def _evict_victim(self, gang, preemptor: dict) -> None:
         now = self.store.clock.now()

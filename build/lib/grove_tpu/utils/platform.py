@@ -1,0 +1,105 @@
+"""Subprocess environment for forced-CPU JAX children.
+
+Single home for the sitecustomize workaround (this image's axon TPU plugin
+pins the platform before user code runs — see tests/conftest.py): child
+processes that must run on host CPU devices get a sanitized env from here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def probe_device_health(timeout_s: float = 60.0) -> bool:
+    """Run a trivial jit in a detached subprocess; on timeout the child is
+    killed and ABANDONED (a child wedged in uninterruptible device sleep
+    ignores SIGKILL — blocking on its exit would hang the caller, the exact
+    condition the probe exists to detect)."""
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
+    out_path = out.name
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp;"
+            "x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)));"
+            "jax.block_until_ready(x); print('OK', jax.default_backend())",
+        ],
+        stdout=out,
+        stderr=subprocess.STDOUT,
+        cwd=pathlib.Path(__file__).resolve().parents[2],
+        start_new_session=True,
+    )
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.5)
+        else:
+            proc.kill()
+            return False  # abandoned child may still hold the temp file
+        out.seek(0)
+        return proc.returncode == 0 and "OK" in out.read()
+    finally:
+        out.close()
+        if proc.poll() is not None:  # only unlink when the child is gone
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+
+
+def force_cpu_platform() -> None:
+    """Re-pin this process onto host CPU. The env var alone is NOT enough on
+    images whose sitecustomize registers an accelerator plugin at interpreter
+    start — the platform must be re-pinned via jax.config after import."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+_backend_note: Optional[str] = None
+
+
+def ensure_healthy_backend(timeout_s: float = 60.0) -> str:
+    """Probe the default accelerator; fall back to CPU when wedged.
+    Memoized per process (one subprocess probe). Returns a backend note."""
+    global _backend_note
+    if _backend_note is None:
+        import sys
+
+        # already initialized on CPU in this process (e.g. the test
+        # harness pinned it): nothing to probe
+        if "jax" in sys.modules:
+            import jax
+
+            if jax.config.jax_platforms == "cpu":
+                _backend_note = "default"
+                return _backend_note
+        if probe_device_health(timeout_s):
+            _backend_note = "default"
+        else:
+            force_cpu_platform()
+            _backend_note = "cpu-fallback (accelerator probe failed)"
+    return _backend_note
+
+
+def cpu_subprocess_env(n_devices: Optional[int] = None) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disables the axon sitecustomize pin
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is None:
+        env["XLA_FLAGS"] = ""  # exactly one device
+    else:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env

@@ -1,0 +1,164 @@
+"""Deployable initc integration: the real `python -m grove_tpu.initc`
+process blocks against a live apiserver until parent cliques are ready.
+
+Covers the reference initc contract end to end
+(/root/reference/operator/initc/internal/wait.go:76-275): repeated
+--podcliques flags, downward-API file reads, watch-driven readiness, exit 0
+unblocking the main containers.
+"""
+
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import Condition, ObjectMeta, set_condition
+from grove_tpu.api.pod import COND_POD_READY, Pod
+from grove_tpu.cluster.apiserver import APIServer
+from grove_tpu.cluster.client import HttpStore
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _make_pod(name: str, gang: str, pclq: str) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace="default",
+            labels={
+                namegen.LABEL_PODGANG: gang,
+                namegen.LABEL_PODCLIQUE: pclq,
+            },
+        )
+    )
+
+
+@pytest.fixture
+def apiserver():
+    server = APIServer().start()
+    yield server
+    server.stop()
+
+
+class TestInitcBinary:
+    def test_blocks_until_parents_ready_then_exits_zero(
+        self, apiserver, tmp_path
+    ):
+        client = HttpStore(apiserver.address)
+        pods = [
+            client.create(_make_pod(f"myset-0-prefill-{i}", "myset-0", "myset-0-prefill"))
+            for i in range(2)
+        ]
+        # downward-API files the operator's injected volume provides
+        (tmp_path / "namespace").write_text("default\n")
+        (tmp_path / "podgang").write_text("myset-0\n")
+
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "grove_tpu.initc",
+                "--apiserver",
+                apiserver.address,
+                "--pod-info-dir",
+                str(tmp_path),
+                "--podcliques",
+                "myset-0-prefill:2",
+                "--poll-interval",
+                "0.2",
+                "--timeout",
+                "30",
+            ],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            time.sleep(1.2)
+            assert proc.poll() is None, (
+                f"initc exited early: {proc.stdout.read()}"
+            )
+            # one parent ready is not enough (minAvailable=2)
+            pod = client.get("Pod", "default", pods[0].metadata.name)
+            set_condition(
+                pod.status.conditions,
+                Condition(type=COND_POD_READY, status="True", reason="Started"),
+                time.time(),
+            )
+            client.update_status(pod)
+            time.sleep(0.8)
+            assert proc.poll() is None, "initc unblocked below minAvailable"
+            # second parent ready → unblock
+            pod = client.get("Pod", "default", pods[1].metadata.name)
+            set_condition(
+                pod.status.conditions,
+                Condition(type=COND_POD_READY, status="True", reason="Started"),
+                time.time(),
+            )
+            client.update_status(pod)
+            rc = proc.wait(timeout=20)
+            out = proc.stdout.read()
+            assert rc == 0, out
+            assert "all parent cliques ready" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_times_out_nonzero_when_parents_never_ready(
+        self, apiserver, tmp_path
+    ):
+        client = HttpStore(apiserver.address)
+        client.create(_make_pod("s-0-a-0", "s-0", "s-0-a"))
+        (tmp_path / "namespace").write_text("default")
+        (tmp_path / "podgang").write_text("s-0")
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "grove_tpu.initc",
+                "--apiserver",
+                apiserver.address,
+                "--pod-info-dir",
+                str(tmp_path),
+                "--podcliques",
+                "s-0-a:1",
+                "--poll-interval",
+                "0.1",
+                "--timeout",
+                "1.5",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        ).returncode
+        assert rc == 1
+
+    def test_rejects_malformed_flags(self, tmp_path):
+        rc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "grove_tpu.initc",
+                "--apiserver",
+                "http://127.0.0.1:1",
+                "--podcliques",
+                "not-a-valid-flag",
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        ).returncode
+        assert rc == 2
+
+    def test_no_parents_is_a_noop(self):
+        rc = subprocess.run(
+            [sys.executable, "-m", "grove_tpu.initc"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        ).returncode
+        assert rc == 0
