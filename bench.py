@@ -25,58 +25,11 @@ import numpy as np
 
 
 def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
-    from grove_tpu.api.topology import ClusterTopology
-    from grove_tpu.sim.cluster import make_nodes
-    from grove_tpu.solver.encode import build_problem
+    # single shared generator (grove_tpu.models) so bench and tests can't
+    # silently fork the stress shape
+    from grove_tpu.models import build_stress_problem as build
 
-    rng = np.random.default_rng(seed)
-    nodes = make_nodes(
-        n_nodes,
-        capacity={"cpu": 128.0, "tpu": 8.0},
-        hosts_per_ici_block=8,
-        blocks_per_slice=8,
-    )
-    gangs = []
-    for i in range(n_gangs):
-        # headline mix: mostly small gangs (the cluster can hold them all),
-        # a tail of multi-group disaggregated-style gangs with pack hints
-        if i % 8 == 0:
-            n_groups = int(rng.integers(2, 4))
-            groups = [
-                {
-                    "name": f"g{i}-{p}",
-                    "demand": {
-                        "tpu": float(rng.integers(1, 3)),
-                        "cpu": float(rng.integers(1, 9)),
-                    },
-                    "count": int(rng.integers(1, 5)),
-                    "min_count": None,
-                }
-                for p in range(n_groups)
-            ]
-            required = "cloud.google.com/gke-tpu-slice"
-        else:
-            groups = [
-                {
-                    "name": f"g{i}-0",
-                    "demand": {"tpu": 1.0, "cpu": 2.0},
-                    "count": int(rng.integers(2, 5)),
-                    "min_count": None,
-                }
-            ]
-            required = None
-        for g in groups:
-            g["min_count"] = g["count"]
-        gangs.append(
-            {
-                "name": f"g{i}",
-                "groups": groups,
-                "required_key": required,
-                "preferred_key": None,
-                "priority": 0,
-            }
-        )
-    return build_problem(nodes, gangs, ClusterTopology())
+    return build(n_nodes, n_gangs, seed)
 
 
 def main() -> None:

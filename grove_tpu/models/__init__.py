@@ -1,0 +1,27 @@
+"""Workload scenario models: the BASELINE.json acceptance shapes as
+reusable builders.
+
+Consumed by tests and bench.py (which previously inlined its own stress
+generator). Four sample families plus the synthetic stress generator mirror
+the acceptance configs in BASELINE.json:
+
+- ``simple``                  the quickstart shape (samples/simple1.yaml)
+- ``disaggregated``           single-node prefill/decode split
+- ``multinode_disaggregated`` multi-node instance with slice-packing hints
+- ``agentic``                 pipeline with explicit startup ordering
+- ``stress_problem``          the 10k-gang x 5k-node synthetic solver input
+"""
+
+from grove_tpu.models.scenarios import (
+    BASELINE_SAMPLES,
+    build_stress_problem,
+    load_sample,
+    stress_gang_specs,
+)
+
+__all__ = [
+    "BASELINE_SAMPLES",
+    "build_stress_problem",
+    "load_sample",
+    "stress_gang_specs",
+]

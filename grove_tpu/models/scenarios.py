@@ -1,0 +1,107 @@
+"""Scenario builders for the BASELINE acceptance configs.
+
+The sample manifests are the user-facing form of the first four scenarios
+(samples/*.yaml — reference-format CRs); `load_sample` parses them into
+domain objects for tests/sims. `stress_gang_specs`/`build_stress_problem`
+produce the synthetic 10k-gang x 5k-node solver input that bench.py times
+(BASELINE.json north star); bench and tests share this single generator so
+a shape change can't silently fork the benchmark from the test suite.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import List
+
+import numpy as np
+
+# canonical sample manifests ship INSIDE the package (pip-installed copies
+# must work without a repo checkout); the repo-root samples/ directory is
+# the user-facing mirror, drift-tested in tests/test_models.py
+SAMPLES_DIR = pathlib.Path(__file__).resolve().parent / "samples"
+
+# BASELINE.json acceptance configs (minus the stress sim, which is synthetic)
+BASELINE_SAMPLES = {
+    "simple": "simple1.yaml",
+    "disaggregated": "single-node-disaggregated.yaml",
+    "multinode_disaggregated": "multinode-disaggregated.yaml",
+    "agentic": "agentic-pipeline.yaml",
+}
+
+
+def load_sample(name: str):
+    """Scenario name (or bare filename) → PodCliqueSet domain object."""
+    from grove_tpu.api.load import load_podcliqueset_file
+
+    filename = BASELINE_SAMPLES.get(name, name)
+    return load_podcliqueset_file(str(SAMPLES_DIR / filename))
+
+
+def stress_gang_specs(n_gangs: int, seed: int = 0) -> List[dict]:
+    """Headline stress mix: mostly small single-group gangs (the cluster can
+    hold them all), a tail of multi-group disaggregated-style gangs carrying
+    slice-level pack hints."""
+    rng = np.random.default_rng(seed)
+    gangs = []
+    for i in range(n_gangs):
+        if i % 8 == 0:
+            n_groups = int(rng.integers(2, 4))
+            groups = [
+                {
+                    "name": f"g{i}-{p}",
+                    "demand": {
+                        "tpu": float(rng.integers(1, 3)),
+                        "cpu": float(rng.integers(1, 9)),
+                    },
+                    "count": int(rng.integers(1, 5)),
+                    "min_count": None,
+                }
+                for p in range(n_groups)
+            ]
+            required = "cloud.google.com/gke-tpu-slice"
+        else:
+            groups = [
+                {
+                    "name": f"g{i}-0",
+                    "demand": {"tpu": 1.0, "cpu": 2.0},
+                    "count": int(rng.integers(2, 5)),
+                    "min_count": None,
+                }
+            ]
+            required = None
+        for g in groups:
+            g["min_count"] = g["count"]
+        gangs.append(
+            {
+                "name": f"g{i}",
+                "groups": groups,
+                "required_key": required,
+                "preferred_key": None,
+                "priority": 0,
+            }
+        )
+    return gangs
+
+
+def build_stress_problem(
+    n_nodes: int,
+    n_gangs: int,
+    seed: int = 0,
+    hosts_per_ici_block: int = 8,
+    blocks_per_slice: int = 8,
+):
+    """The BASELINE.json stress sim input: n_gangs onto an n_nodes cluster
+    (5120 nodes x 8 TPU chips = 40k chips at full scale)."""
+    from grove_tpu.api.topology import ClusterTopology
+    from grove_tpu.sim.cluster import make_nodes
+    from grove_tpu.solver.encode import build_problem
+
+    nodes = make_nodes(
+        n_nodes,
+        capacity={"cpu": 128.0, "tpu": 8.0},
+        hosts_per_ici_block=hosts_per_ici_block,
+        blocks_per_slice=blocks_per_slice,
+    )
+    return build_problem(
+        nodes, stress_gang_specs(n_gangs, seed), ClusterTopology()
+    )

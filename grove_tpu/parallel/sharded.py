@@ -103,6 +103,53 @@ def batch_solve_sharded(
     return {k: np.asarray(v) for k, v in out.items() if v is not None}
 
 
+def solve_stress_sharded(
+    mesh: Mesh,
+    problem,
+    chunk_size: int = 128,
+    max_waves: int = 16,
+):
+    """ONE large placement problem with the NODE axis sharded across every
+    device of the mesh's ``tp`` axis — the flagship multi-chip path: each
+    chip holds a slab of the 5k-node cluster's capacity/topology tensors and
+    the whole device-resident wave loop (lax.while_loop over chunked
+    vmap+commit waves) runs under GSPMD, with XLA inserting the ICI
+    collectives for the node-axis prefix sums, boundary gathers, and
+    reductions.
+
+    Deterministic: admissions are bit-identical to the single-device
+    solve_waves_device run (asserted in tests/test_solver.py), so sharding
+    is purely a throughput/memory choice, never a semantics one.
+    """
+    from grove_tpu.ops.packing import solve_waves_device
+    from grove_tpu.solver.kernel import pad_problem_for_waves
+
+    g = problem.num_gangs
+    raw_args, n_chunks, grouped = pad_problem_for_waves(problem, chunk_size)
+    node_sh = NamedSharding(mesh, P("tp", None))
+    rep = NamedSharding(mesh, P())
+    # capacity and topo carry the node axis (sharded); everything else
+    # (domain bounds + gang tensors) is replicated
+    shardings = (node_sh, node_sh) + (rep,) * (len(raw_args) - 2)
+    placed = [
+        jax.device_put(jnp.asarray(a), s)
+        for a, s in zip(raw_args, shardings)
+    ]
+    with mesh:
+        out = solve_waves_device(
+            *placed, n_chunks=n_chunks, max_waves=max_waves, grouped=grouped
+        )
+    return {
+        "admitted": np.asarray(out["admitted"])[:g],
+        "placed": np.asarray(out["placed"])[:g],
+        "score": np.asarray(out["score"])[:g],
+        "chosen_level": np.asarray(out["chosen_level"])[:g],
+        "free_after": np.asarray(out["free_after"]),
+        "pending": np.asarray(out["pending"])[:g],
+        "waves": int(np.asarray(out["waves"])),
+    }
+
+
 def make_example_batch(
     n_scenarios: int, n_nodes: int = 32, n_gangs: int = 16
 ) -> Tuple[np.ndarray, ...]:

@@ -211,14 +211,16 @@ def solve_waves(
     )
 
 
-def solve_waves_stats(
-    problem: PackingProblem,
-    chunk_size: int = 128,
-    max_waves: int = 16,
-) -> PackingResult:
-    """Device-resident wave solve (ops.packing.solve_waves_device): the whole
-    multi-wave loop runs as one XLA program — the stress-bench path. Returns
-    stats only (no per-pod alloc); use solve_waves/solve for binding."""
+def pad_problem_for_waves(
+    problem: PackingProblem, chunk_size: int
+) -> Tuple[Tuple[np.ndarray, ...], int, bool]:
+    """SINGLE home for the wave solver's input-prep contract: clamp the
+    chunk size, pad the gang axis to a chunk multiple (sentinel -1 for the
+    level/pin fields, 0 elsewhere), and decide the `grouped` compile flag.
+    Returns (args, n_chunks, grouped) where args is the positional tuple of
+    solve_waves_device. Shared by the stats path, the node-sharded
+    multi-chip path, and the parity tests — a padding-contract change lands
+    exactly once."""
     g = problem.num_gangs
     chunk_size = min(chunk_size, max(g, 1))
     n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
@@ -231,20 +233,34 @@ def solve_waves_stats(
         return np.pad(a, width, constant_values=value)
 
     args = (
-        jnp.asarray(problem.capacity),
-        jnp.asarray(problem.topo),
-        jnp.asarray(problem.seg_starts),
-        jnp.asarray(problem.seg_ends),
-        jnp.asarray(pad(problem.demand)),
-        jnp.asarray(pad(problem.count)),
-        jnp.asarray(pad(problem.min_count)),
-        jnp.asarray(pad(problem.req_level, -1)),
-        jnp.asarray(pad(problem.pref_level, -1)),
-        jnp.asarray(pad(problem.group_req, -1)),
-        jnp.asarray(pad(problem.group_pin, -1)),
-        jnp.asarray(pad(problem.gang_pin, -1)),
+        problem.capacity,
+        problem.topo,
+        problem.seg_starts,
+        problem.seg_ends,
+        pad(problem.demand),
+        pad(problem.count),
+        pad(problem.min_count),
+        pad(problem.req_level, -1),
+        pad(problem.pref_level, -1),
+        pad(problem.group_req, -1),
+        pad(problem.group_pin, -1),
+        pad(problem.gang_pin, -1),
     )
     grouped = bool((problem.group_req >= 0).any())
+    return args, n_chunks, grouped
+
+
+def solve_waves_stats(
+    problem: PackingProblem,
+    chunk_size: int = 128,
+    max_waves: int = 16,
+) -> PackingResult:
+    """Device-resident wave solve (ops.packing.solve_waves_device): the whole
+    multi-wave loop runs as one XLA program — the stress-bench path. Returns
+    stats only (no per-pod alloc); use solve_waves/solve for binding."""
+    g = problem.num_gangs
+    raw_args, n_chunks, grouped = pad_problem_for_waves(problem, chunk_size)
+    args = tuple(jnp.asarray(a) for a in raw_args)
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         n_chunks,
         max_waves,

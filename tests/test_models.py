@@ -1,0 +1,69 @@
+"""Scenario model builders: BASELINE acceptance shapes load and admit."""
+
+import numpy as np
+
+from grove_tpu.admission.defaulting import default_podcliqueset
+from grove_tpu.admission.validation import validate_podcliqueset
+from grove_tpu.api.topology import ClusterTopology
+from grove_tpu.models import (
+    BASELINE_SAMPLES,
+    build_stress_problem,
+    load_sample,
+    stress_gang_specs,
+)
+
+
+class TestScenarioModels:
+    def test_all_baseline_samples_load_and_validate(self):
+        for name in BASELINE_SAMPLES:
+            pcs = load_sample(name)
+            default_podcliqueset(pcs)
+            res = validate_podcliqueset(pcs, ClusterTopology())
+            assert res.ok, f"{name}: {res.errors}"
+
+    def test_sample_shapes_match_baseline_families(self):
+        disagg = load_sample("disaggregated")
+        roles = {c.name for c in disagg.spec.template.cliques}
+        assert {"prefill", "decode"} <= roles
+        agentic = load_sample("agentic")
+        assert any(
+            c.spec.starts_after for c in agentic.spec.template.cliques
+        ), "agentic pipeline must carry explicit startup ordering"
+        multi = load_sample("multinode_disaggregated")
+        assert multi.spec.template.pod_clique_scaling_group_configs
+
+    def test_stress_problem_shape_and_mix(self):
+        problem = build_stress_problem(256, 64)
+        assert problem.num_nodes == 256
+        assert problem.num_gangs == 64
+        # every 8th gang is the multi-group constrained tail
+        specs = stress_gang_specs(64)
+        constrained = [s for s in specs if s["required_key"] is not None]
+        assert len(constrained) == 8
+        assert all(len(s["groups"]) >= 2 for s in constrained)
+        assert (problem.req_level >= 0).sum() == 8
+
+    def test_bench_uses_the_shared_generator(self):
+        import bench
+
+        a = bench.build_stress_problem(128, 32)
+        b = build_stress_problem(128, 32)
+        np.testing.assert_array_equal(a.demand, b.demand)
+        np.testing.assert_array_equal(a.capacity, b.capacity)
+
+
+class TestSampleDrift:
+    def test_root_samples_mirror_package_samples(self):
+        """samples/ (user-facing) and grove_tpu/models/samples/ (shipped in
+        the wheel) must stay byte-identical."""
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1] / "samples"
+        from grove_tpu.models.scenarios import SAMPLES_DIR
+
+        root_files = {p.name: p.read_text() for p in root.glob("*.yaml")}
+        pkg_files = {p.name: p.read_text() for p in SAMPLES_DIR.glob("*.yaml")}
+        assert root_files == pkg_files, (
+            "sample manifests drifted between samples/ and"
+            " grove_tpu/models/samples/ — copy the changed file to both"
+        )

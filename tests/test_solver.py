@@ -360,6 +360,48 @@ class TestMultiChip:
             out["admitted"][0], np.asarray(ref["admitted"])
         )
 
+    def test_stress_shape_node_sharded_matches_single_device(self):
+        """Flagship multi-chip proof (round-1 VERDICT item 3): ONE 5120-node
+        stress problem with the node axis sharded across the 8-device mesh —
+        the full device-resident wave loop (lax.while_loop + chunked
+        vmap/commit) under GSPMD — admits IDENTICALLY to the single-device
+        run. Sharding is a throughput choice, never a semantics one."""
+        import jax
+        import jax.numpy as jnp
+
+        from grove_tpu.models import build_stress_problem
+        from grove_tpu.ops.packing import solve_waves_device
+        from grove_tpu.parallel.sharded import (
+            make_solver_mesh,
+            solve_stress_sharded,
+        )
+
+        assert len(jax.devices()) >= 8
+        problem = build_stress_problem(5120, 512)
+        mesh = make_solver_mesh(8)
+        sharded = solve_stress_sharded(mesh, problem, chunk_size=128)
+        assert sharded["admitted"].all(), "stress shape should fully admit"
+
+        from grove_tpu.solver.kernel import pad_problem_for_waves
+
+        g = problem.num_gangs
+        raw_args, n_chunks, grouped = pad_problem_for_waves(problem, 128)
+        out = solve_waves_device(
+            *[jnp.asarray(a) for a in raw_args],
+            n_chunks=n_chunks,
+            max_waves=16,
+            grouped=grouped,
+        )
+        np.testing.assert_array_equal(
+            sharded["admitted"], np.asarray(out["admitted"])[:g]
+        )
+        np.testing.assert_allclose(
+            sharded["score"], np.asarray(out["score"])[:g], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            sharded["free_after"], np.asarray(out["free_after"]), atol=1e-4
+        )
+
 
 class TestEncoder:
     def test_topology_sorted_contiguous(self):

@@ -1,5 +1,5 @@
 """Rolling updates under stress: concurrent breach, scale during update,
-back-to-back updates."""
+back-to-back updates, operator crash/resume mid-update."""
 
 from grove_tpu.api.pod import is_ready
 from grove_tpu.sim.harness import SimHarness
@@ -11,6 +11,49 @@ def with_image(image):
     for clique in pcs.spec.template.cliques:
         clique.spec.pod_spec.containers[0].image = image
     return pcs
+
+
+def restart_operator(harness: SimHarness) -> None:
+    """Kill and recreate the operator mid-flight: the engine, its workqueues,
+    watch subscriptions, and the in-memory expectations store all die; the
+    new instance re-lists every primary object (informer initial sync) and
+    must resume purely from status-persisted progress — the reference's
+    stateless crash/resume model (RollingUpdateProgress structs,
+    podcliqueset.go:93-115, scalinggroup.go:105-129)."""
+    from grove_tpu.controller.common import OperatorContext
+    from grove_tpu.controller.register import register_controllers
+    from grove_tpu.runtime.engine import Engine
+
+    harness.store._watchers.clear()  # the crashed process's watches vanish
+    harness.engine = Engine(harness.store, harness.clock)
+    harness.ctx = OperatorContext(
+        store=harness.store, clock=harness.clock, topology=harness.topology
+    )
+    register_controllers(harness.engine, harness.ctx, harness.config)
+    # informer initial LIST → every existing primary enqueued once
+    for ctrl in harness.engine.controllers:
+        for obj in harness.store.list(ctrl.kind):
+            ctrl.queue.add(
+                (ctrl.kind, obj.metadata.namespace, obj.metadata.name)
+            )
+
+
+class DeletionCounter:
+    """Counts pod deletions across operator restarts (a pod updated twice
+    would be deleted twice)."""
+
+    def __init__(self, harness: SimHarness) -> None:
+        self.harness = harness
+        self.counts = {}
+        self.attach()
+
+    def attach(self) -> None:
+        def on_event(ev):
+            if ev.kind == "Pod" and ev.type == "Deleted":
+                name = ev.obj.metadata.name
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+        self.harness.store.subscribe(on_event)
 
 
 class TestUpdateStress:
@@ -66,6 +109,88 @@ class TestUpdateStress:
         assert {c.image for p in pods for c in p.spec.containers} == {
             "busybox:v2"
         }
+
+    def test_crash_resume_at_three_interruption_points(self):
+        """Kill/recreate the operator at three distinct mid-update states —
+        (1) a PCS replica selected (currentlyUpdating set), (2) a PCSG
+        replica mid-swap (readyReplicaIndicesSelectedToUpdate non-empty),
+        (3) a PCLQ with pods half old / half new template — and require the
+        resumed operator to finish from status-persisted progress without
+        repeating (no pod deleted twice) or skipping (every pod on the new
+        template) replicas."""
+        harness = SimHarness(num_nodes=64)
+        pcs = simple1()
+        pcs.spec.replicas = 2  # replica ordering only matters with >1
+        harness.apply(pcs)
+        harness.converge()
+        counter = DeletionCounter(harness)
+
+        updated = with_image("busybox:v2")
+        updated.spec.replicas = 2
+        harness.apply(updated)
+
+        def pcs_mid_replica() -> bool:
+            p = harness.store.list("PodCliqueSet")[0]
+            prog = p.status.rolling_update_progress
+            return prog is not None and prog.currently_updating is not None
+
+        def pcsg_mid_swap() -> bool:
+            for g in harness.store.list("PodCliqueScalingGroup"):
+                prog = g.status.rolling_update_progress
+                if prog is not None and (
+                    prog.ready_replica_indices_selected_to_update
+                ):
+                    return True
+            return False
+
+        def pclq_half_updated() -> bool:
+            from grove_tpu.api import names as namegen
+
+            by_pclq = {}
+            for pod in harness.store.list("Pod"):
+                pclq = pod.metadata.labels.get(namegen.LABEL_PODCLIQUE)
+                h = pod.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+                by_pclq.setdefault(pclq, set()).add(h)
+            return any(len(hashes) > 1 for hashes in by_pclq.values())
+
+        def run_until(condition, max_rounds=240) -> bool:
+            for _ in range(max_rounds):
+                harness.engine.drain()
+                harness.schedule()
+                harness.cluster.kubelet_tick()
+                harness.engine.drain()
+                if condition():
+                    return True
+                p = harness.store.list("PodCliqueSet")[0]
+                prog = p.status.rolling_update_progress
+                if prog is not None and prog.update_ended_at is not None:
+                    return False  # update finished before the trigger hit
+                harness.advance(2.0)
+            return False
+
+        for trigger in (pcs_mid_replica, pcsg_mid_swap, pclq_half_updated):
+            assert run_until(trigger), (
+                f"interruption point never reached: {trigger.__name__}"
+            )
+            restart_operator(harness)
+            counter.attach()  # the new process watches again
+
+        assert converge_update(harness, max_rounds=360), harness.tree()
+        harness.converge()
+        pods = harness.store.list("Pod")
+        # no skips: every pod rebuilt from the new template and ready
+        assert all(is_ready(p) for p in pods), harness.tree()
+        assert {c.image for p in pods for c in p.spec.containers} == {
+            "busybox:v2"
+        }
+        # no repeats: each original pod was deleted exactly once for its
+        # update (a replayed replica would delete its new pods again)
+        over_deleted = {n: c for n, c in counter.counts.items() if c > 1}
+        assert not over_deleted, f"pods updated more than once: {over_deleted}"
+        # progress bookkeeping closed out
+        prog = harness.store.list("PodCliqueSet")[0].status.rolling_update_progress
+        assert prog.update_ended_at is not None
+        assert prog.currently_updating is None
 
     def test_back_to_back_updates_converge_to_last(self):
         harness = SimHarness(num_nodes=32)
