@@ -449,6 +449,16 @@ class APIServer:
                     doc = server._admit(doc, "UPDATE", username, old_doc)
                     obj = decode_object(doc)
                     with server.lock, server.store.as_user(username):
+                        # spec-endpoint writes never touch status
+                        # (subresource semantics). Re-read UNDER the write
+                        # lock: the admission round trip above runs unlocked,
+                        # and restoring a pre-webhook snapshot would revert
+                        # any status a controller wrote in that window.
+                        fresh = server.store.get(
+                            info.kind, namespace or "", name
+                        )
+                        if fresh is not None and hasattr(fresh, "status"):
+                            obj.status = fresh.status
                         stored = server.store.update(obj)
                         # apiserver rule: removing the last finalizer of a
                         # deleting object completes the deletion

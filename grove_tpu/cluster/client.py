@@ -129,6 +129,14 @@ class HttpStore:
                 payload.get("message", str(e)),
                 operation or method.lower(),
             ) from None
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # transport failure (apiserver restart, connection reset, socket
+            # timeout): typed like any other store error so callers' retry
+            # paths — reconcile requeues, the external scheduler loop —
+            # treat it as transient instead of dying on a raw urllib error
+            raise GroveError(
+                "ERR_TRANSPORT", str(e), operation or method.lower()
+            ) from None
 
     # -- watch ------------------------------------------------------------
 

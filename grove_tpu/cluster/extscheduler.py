@@ -1,0 +1,113 @@
+"""External gang scheduler: a KAI-stand-in consuming the PodGang contract
+over the wire.
+
+The reference delegates placement to the out-of-process KAI scheduler,
+which watches PodGang CRs + ungated pods and binds them all-or-nothing
+(SURVEY §1 'Scheduler contract'; the reference e2e installs the real KAI —
+e2e/setup/kai_scheduler.go:32-69). This module is that consumer for the
+TPU build: a standalone process speaking ONLY the HTTP wire format — no
+imports from the operator's in-process store — so contract drift between
+the operator's PodGang emission and an external scheduler is observable in
+tests instead of hidden behind the in-tree solver.
+
+It reuses the solver-backed GangScheduler over an HttpStore, which is the
+point: the same class binds in-process (sim) or out-of-process (here),
+because the Store interface IS the contract boundary.
+
+    python -m grove_tpu.cluster.extscheduler --apiserver http://...:PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional
+
+# NOTE: no module-level solver/jax imports — `python -m ...extscheduler`
+# must be able to scrub a wedged accelerator link (ensure_healthy_backend)
+# BEFORE anything pulls in jax, or the import itself can hang (the round-1
+# rc=124 failure mode).
+
+
+def run_external_scheduler(
+    apiserver: str,
+    nodes: List,
+    topology=None,
+    priority_map: Optional[Dict[str, int]] = None,
+    poll: float = 0.2,
+    stop=None,
+    kubelet: bool = False,
+) -> None:
+    """Blocking scheduler loop against a remote apiserver. `kubelet=True`
+    additionally runs the kubelet tick (pods become Ready), for e2e setups
+    where this process is the only thing animating the data plane."""
+    from grove_tpu.api.topology import ClusterTopology
+    from grove_tpu.cluster.client import HttpStore
+    from grove_tpu.sim.cluster import SimCluster
+    from grove_tpu.solver.scheduler import GangScheduler
+
+    store = HttpStore(
+        apiserver, watch_kinds=("Pod", "PodGang", "PodClique")
+    ).start()
+    cluster = SimCluster(store=store, nodes=nodes)
+    scheduler = GangScheduler(
+        store, cluster, topology or ClusterTopology(),
+        priority_map=priority_map or {},
+    )
+    from grove_tpu.runtime.errors import GroveError
+
+    try:
+        while stop is None or not stop.is_set():
+            try:
+                bound = scheduler.schedule_pending()
+                started = cluster.kubelet_tick() if kubelet else 0
+            except GroveError as e:
+                # conflicts/races with the concurrently-writing operator are
+                # normal in a live cluster: re-read next round, never die
+                print(f"scheduler round error (retrying): {e}", file=sys.stderr)
+                bound = started = 0
+            if bound == 0 and started == 0:
+                time.sleep(poll)
+    finally:
+        store.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="grove-tpu-scheduler", description=__doc__
+    )
+    parser.add_argument("--apiserver", required=True)
+    parser.add_argument("--nodes", type=int, default=32)
+    parser.add_argument(
+        "--kubelet", action="store_true",
+        help="also run the kubelet tick (sim data plane)",
+    )
+    parser.add_argument("--poll-interval", type=float, default=0.2)
+    args = parser.parse_args(argv)
+
+    # a wedged accelerator link must degrade to CPU, never hang the
+    # scheduler process (same probe as the CLI entry points)
+    from grove_tpu.utils.platform import ensure_healthy_backend
+
+    note = ensure_healthy_backend(timeout_s=45.0)
+    if note != "default":
+        print(f"note: {note}", file=sys.stderr)
+
+    from grove_tpu.sim.cluster import make_nodes
+
+    print(
+        f"external gang scheduler consuming PodGangs from {args.apiserver}",
+        flush=True,
+    )
+    run_external_scheduler(
+        args.apiserver,
+        make_nodes(args.nodes),
+        poll=args.poll_interval,
+        kubelet=args.kubelet,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,6 +130,7 @@ def start_operator(
     with_webhooks: bool = True,
     with_tls: bool = False,
     with_authorizer: bool = False,
+    with_scheduler: bool = True,
     apiserver_url: Optional[str] = None,
     leader_lock_path: Optional[str] = None,
 ) -> OperatorRuntime:
@@ -179,15 +180,20 @@ def start_operator(
     engine = Engine(store, store.clock)
     ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
     register_controllers(engine, ctx, config)
-    cluster = SimCluster(store=store, nodes=nodes or make_nodes(16))
-    scheduler = GangScheduler(
-        store,
-        cluster,
-        topology,
-        priority_map=config.solver.priority_classes,
-        chunk_size=min(config.solver.chunk_size, 64),
-        max_waves=config.solver.max_waves,
-    )
+    # with_scheduler=False leaves binding entirely to an EXTERNAL scheduler
+    # consuming the PodGang contract over the wire (the reference's KAI
+    # deployment shape — grove_tpu.cluster.extscheduler is the stand-in)
+    cluster = scheduler = None
+    if with_scheduler:
+        cluster = SimCluster(store=store, nodes=nodes or make_nodes(16))
+        scheduler = GangScheduler(
+            store,
+            cluster,
+            topology,
+            priority_map=config.solver.priority_classes,
+            chunk_size=min(config.solver.chunk_size, 64),
+            max_waves=config.solver.max_waves,
+        )
     return OperatorRuntime(
         store=store,
         engine=engine,

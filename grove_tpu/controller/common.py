@@ -238,3 +238,59 @@ def resolve_starts_after(
                 fqn = namegen.podclique_name(dep_sg_fqn, r, dep)
                 out.append({"pclq": fqn, "min_available": dep_min_available})
     return out
+
+
+def apply_template_to_pclq(ctx: OperatorContext, pcs, pclq, clique_name: str) -> bool:
+    """Push the PCS template's current spec + pod-template-hash (+ the
+    update-in-progress marker that suspends MinAvailableBreached) onto one
+    PodClique — the single write both rolling-update orchestrators share
+    (PCS replica updater for standalone cliques, PCSG updater for its own
+    replicas). Returns True when a write happened."""
+    import json as _json
+
+    from grove_tpu.api.hashing import compute_pod_template_hash
+    from grove_tpu.api.meta import deep_copy
+    from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
+    from grove_tpu.controller.podclique.status import (
+        UPDATE_IN_PROGRESS_ANNOTATION,
+    )
+
+    tmpl_root = pcs.spec.template
+    tmpl = tmpl_root.clique_template(clique_name)
+    if tmpl is None or pclq.metadata.deletion_timestamp is not None:
+        return False
+    want_hash = compute_pod_template_hash(tmpl, tmpl_root.priority_class_name)
+    changed = False
+    if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want_hash:
+        new_spec = deep_copy(tmpl.spec)
+        # preserve HPA-scaled replica counts on standalone cliques
+        sg = find_scaling_group_config_for_clique(
+            tmpl_root.pod_clique_scaling_group_configs, clique_name
+        )
+        if sg is None and pclq.spec.auto_scaling_config is not None:
+            new_spec.replicas = pclq.spec.replicas
+        pclq.spec = new_spec
+        pclq.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = want_hash
+        pcsg_fqn = pclq.metadata.labels.get(namegen.LABEL_PCSG)
+        pcs_replica = int(
+            pclq.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0")
+        )
+        sg_replica = pclq.metadata.labels.get(namegen.LABEL_PCSG_REPLICA_INDEX)
+        deps = resolve_starts_after(
+            pcs,
+            pcs_replica,
+            clique_name,
+            owner_pcsg_fqn=pcsg_fqn,
+            owner_pcsg_replica=int(sg_replica) if sg_replica is not None else None,
+        )
+        if deps:
+            pclq.metadata.annotations[STARTUP_DEPS_ANNOTATION] = _json.dumps(deps)
+        else:
+            pclq.metadata.annotations.pop(STARTUP_DEPS_ANNOTATION, None)
+        changed = True
+    if UPDATE_IN_PROGRESS_ANNOTATION not in pclq.metadata.annotations:
+        pclq.metadata.annotations[UPDATE_IN_PROGRESS_ANNOTATION] = "true"
+        changed = True
+    if changed:
+        ctx.store.update(pclq)
+    return changed

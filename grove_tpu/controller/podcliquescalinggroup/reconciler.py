@@ -66,13 +66,15 @@ class PodCliqueScalingGroupReconciler:
             if FINALIZER not in pcsg.metadata.finalizers:
                 pcsg.metadata.finalizers.append(FINALIZER)
                 pcsg = self.ctx.store.update(pcsg, bump_generation=False)
+            update_requeue = self._process_rolling_update(pcsg, pcs)
             requeue_in = self._sync_podcliques(pcsg, pcs)
             self._reconcile_status(pcsg, pcs)
         except GroveError as err:
             record_last_error(self.ctx, "PodCliqueScalingGroup", ns, name, err)
             return reconcile_with_errors(f"pcsg {ns}/{name}", err)
-        if requeue_in is not None:
-            return reconcile_after(requeue_in, "scaled-replica breach wait")
+        waits = [w for w in (update_requeue, requeue_in) if w is not None]
+        if waits:
+            return reconcile_after(min(waits), "pcsg update/breach wait")
         return continue_reconcile()
 
     def _owner_pcs(self, pcsg) -> Optional[PodCliqueSet]:
@@ -191,6 +193,191 @@ class PodCliqueScalingGroupReconciler:
             ),
             spec=spec,
         )
+
+    # -- rolling update (components/podclique/rollingupdate.go:55-260) ----
+
+    def _desired_hash(self, pcs: PodCliqueSet, clique_name: str) -> Optional[str]:
+        tmpl = pcs.spec.template.clique_template(clique_name)
+        if tmpl is None:
+            return None
+        return compute_pod_template_hash(
+            tmpl, pcs.spec.template.priority_class_name
+        )
+
+    def _replica_pclqs(self, pcsg, replica: int) -> List[PodClique]:
+        ns = pcsg.metadata.namespace
+        out = []
+        for clique_name in pcsg.spec.clique_names:
+            fqn = namegen.podclique_name(pcsg.metadata.name, replica, clique_name)
+            pclq = self.ctx.store.get("PodClique", ns, fqn)
+            if pclq is not None:
+                out.append((clique_name, pclq))
+        return out
+
+    def _replica_outdated(self, pcsg, pcs, replica: int) -> bool:
+        """PCLQ label hash and the PODS' OWN hash labels both checked — the
+        PCLQ's status.updatedReplicas is recomputed asynchronously, so right
+        after a hash push it still reports the old-hash pod count and the
+        replica would momentarily read as done (letting a second replica get
+        torn down in the same pass)."""
+        from grove_tpu.api.pod import is_terminating
+
+        pairs = self._replica_pclqs(pcsg, replica)
+        if len(pairs) < len(pcsg.spec.clique_names):
+            return False  # not materialized yet; the sync builds it fresh
+        ns = pcsg.metadata.namespace
+        for clique_name, pclq in pairs:
+            want = self._desired_hash(pcs, clique_name)
+            if want is None:
+                continue
+            if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want:
+                return True
+            fresh = [
+                p
+                for p in self.ctx.store.list(
+                    "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
+                )
+                if not is_terminating(p)
+                and p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
+                == want
+            ]
+            if len(fresh) < pclq.spec.replicas:
+                return True
+        return False
+
+    def _replica_available(self, pcsg, replica: int) -> bool:
+        """Every pod of the replica exists and is Ready — a replica the
+        updater must take down CAREFULLY (one at a time); anything else is
+        force-updated first. Checked against PODS directly: the PCLQ
+        conditions lag pod reality and MinAvailableBreached reads Unknown
+        while the update-in-progress marker is set, which would let the
+        updater tear down the next replica while the previous one is still
+        coming back."""
+        from grove_tpu.api.pod import is_ready, is_terminating
+
+        pairs = self._replica_pclqs(pcsg, replica)
+        if len(pairs) < len(pcsg.spec.clique_names):
+            return False
+        ns = pcsg.metadata.namespace
+        for _, pclq in pairs:
+            pods = [
+                p
+                for p in self.ctx.store.list(
+                    "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
+                )
+                if not is_terminating(p)
+            ]
+            if len(pods) < pclq.spec.replicas:
+                return False
+            if not all(is_ready(p) for p in pods):
+                return False
+        return True
+
+    def _push_template_to_replica(self, pcsg, pcs, replica: int) -> None:
+        from grove_tpu.controller.common import apply_template_to_pclq
+
+        for clique_name, pclq in self._replica_pclqs(pcsg, replica):
+            apply_template_to_pclq(self.ctx, pcs, pclq, clique_name)
+
+    def _process_rolling_update(
+        self, pcsg: PodCliqueScalingGroup, pcs: PodCliqueSet
+    ) -> Optional[float]:
+        """Replica-by-replica PCSG rolling update, tracked in THIS
+        controller's status (reference granularity,
+        podcliquescalinggroup/components/podclique/rollingupdate.go:55-260):
+        force-update pending/unavailable replicas immediately, then ONE
+        ready replica at a time recorded in
+        ReadyReplicaIndicesSelectedToUpdate — the rest of the scaling group
+        keeps serving while one replica swaps. The PCS-level updater only
+        gates WHICH PCS replica updates; it no longer touches PCSG-owned
+        cliques."""
+        from grove_tpu.api.types import PCSGRollingUpdateProgress
+
+        progress = pcsg.status.rolling_update_progress
+        outdated = [
+            r
+            for r in range(pcsg.spec.replicas)
+            if self._replica_outdated(pcsg, pcs, r)
+        ]
+        if not outdated:
+            if progress is not None and progress.update_ended_at is None:
+                progress.update_ended_at = self.ctx.clock.now()
+                progress.ready_replica_indices_selected_to_update = []
+                progress.updated_replica_indices = sorted(
+                    set(progress.updated_replica_indices)
+                    | set(range(pcsg.spec.replicas))
+                )
+                self.ctx.store.update_status(pcsg)
+                self.ctx.record_event(
+                    "PodCliqueScalingGroup",
+                    "RollingUpdateCompleted",
+                    pcsg.metadata.name,
+                )
+            return None
+
+        # gate on the PCS-level replica selection: PCSGs of a replica the
+        # PCS updater has not reached yet stay on the old template
+        pcs_prog = pcs.status.rolling_update_progress
+        my_pcs_replica = int(
+            pcsg.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0")
+        )
+        selected = (
+            pcs_prog is not None
+            and pcs_prog.update_ended_at is None
+            and pcs_prog.currently_updating is not None
+            and pcs_prog.currently_updating.replica_index == my_pcs_replica
+        )
+        if not selected and (progress is None or progress.update_ended_at is not None):
+            return None
+
+        if progress is None or progress.update_ended_at is not None:
+            progress = PCSGRollingUpdateProgress(
+                update_started_at=self.ctx.clock.now()
+            )
+            pcsg.status.rolling_update_progress = progress
+
+        # force-update pending/unavailable replicas first (:96-130)
+        ready_outdated = []
+        for r in outdated:
+            if self._replica_available(pcsg, r):
+                ready_outdated.append(r)
+            else:
+                self._push_template_to_replica(pcsg, pcs, r)
+
+        # then one READY replica at a time (:132-260); a freshly-updated
+        # replica counts as done the moment its pods carry the new hash,
+        # so ALSO wait for it to become available again before tearing the
+        # next one down — otherwise two replicas are dark simultaneously
+        in_flight = [
+            r
+            for r in progress.ready_replica_indices_selected_to_update
+            if r in outdated
+        ]
+        settling = [
+            r
+            for r in range(pcsg.spec.replicas)
+            if r not in outdated and not self._replica_available(pcsg, r)
+        ]
+        if in_flight:
+            self._push_template_to_replica(pcsg, pcs, in_flight[0])
+        elif ready_outdated and not settling:
+            pick = ready_outdated[0]
+            progress.ready_replica_indices_selected_to_update.append(pick)
+            self._push_template_to_replica(pcsg, pcs, pick)
+            self.ctx.record_event(
+                "PodCliqueScalingGroup",
+                "RollingUpdateReplicaStarted",
+                f"{pcsg.metadata.name} replica {pick}",
+            )
+
+        # bookkeeping: replicas no longer outdated are done
+        done = [
+            r for r in range(pcsg.spec.replicas) if r not in outdated
+        ]
+        merged = sorted(set(progress.updated_replica_indices) | set(done))
+        progress.updated_replica_indices = merged
+        self.ctx.store.update_status(pcsg)
+        return 2.0
 
     # -- scaled-replica gang termination ---------------------------------
 

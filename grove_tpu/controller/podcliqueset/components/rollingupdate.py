@@ -18,23 +18,17 @@ podcliquesetreplica/rollingupdate.go:39-260:
 
 from __future__ import annotations
 
-import json
 from typing import List, Optional
 
 from grove_tpu.api import names as namegen
 from grove_tpu.api.hashing import compute_pod_template_hash
-from grove_tpu.api.meta import deep_copy, get_condition
+from grove_tpu.api.meta import get_condition
 from grove_tpu.api.types import (
     COND_MIN_AVAILABLE_BREACHED,
     PCSReplicaRollingUpdateProgress,
     PodCliqueSet,
 )
-from grove_tpu.controller.common import (
-    OperatorContext,
-    find_scaling_group_config_for_clique,
-    resolve_starts_after,
-)
-from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
+from grove_tpu.controller.common import OperatorContext
 from grove_tpu.controller.podclique.status import UPDATE_IN_PROGRESS_ANNOTATION
 
 
@@ -147,54 +141,18 @@ def _pick_next_replica(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[int]
 def _push_template_to_replica(
     ctx: OperatorContext, pcs: PodCliqueSet, replica: int
 ) -> None:
-    """Atomically update spec + hash label (+ update-in-progress marker) on
-    every PCLQ of the replica; PCSGs of the replica track their own
-    rolling-update progress (scalinggroup.go:105-129)."""
-    _mark_pcsg_progress(ctx, pcs, replica)
-    tmpl_root = pcs.spec.template
+    """Update spec + hash label (+ update-in-progress marker) on the
+    replica's STANDALONE PodCliques. PCSG-owned cliques are updated by the
+    PCSG controller's own replica-by-replica rolling update (reference
+    granularity — pcsg components/podclique/rollingupdate.go:55-260), gated
+    on this PCS replica being the currently-selected one."""
+    from grove_tpu.controller.common import apply_template_to_pclq
+
     for pclq in _replica_pclqs(ctx, pcs, replica):
-        if pclq.metadata.deletion_timestamp is not None:
-            continue
+        if pclq.metadata.labels.get(namegen.LABEL_PCSG):
+            continue  # PCSG controller's responsibility
         name = _clique_template_name(pcs, pclq)
-        tmpl = tmpl_root.clique_template(name)
-        if tmpl is None:
-            continue
-        want_hash = compute_pod_template_hash(tmpl, tmpl_root.priority_class_name)
-        changed = False
-        if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want_hash:
-            new_spec = deep_copy(tmpl.spec)
-            # preserve HPA-scaled replica counts on standalone cliques
-            sg = find_scaling_group_config_for_clique(
-                tmpl_root.pod_clique_scaling_group_configs, name
-            )
-            if sg is None and pclq.spec.auto_scaling_config is not None:
-                new_spec.replicas = pclq.spec.replicas
-            pclq.spec = new_spec
-            pclq.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = want_hash
-            _refresh_startup_deps(pcs, pclq, name)
-            changed = True
-        if UPDATE_IN_PROGRESS_ANNOTATION not in pclq.metadata.annotations:
-            pclq.metadata.annotations[UPDATE_IN_PROGRESS_ANNOTATION] = "true"
-            changed = True
-        if changed:
-            ctx.store.update(pclq)
-
-
-def _refresh_startup_deps(pcs: PodCliqueSet, pclq, clique_name: str) -> None:
-    pcsg_fqn = pclq.metadata.labels.get(namegen.LABEL_PCSG)
-    pcs_replica = int(pclq.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX, "0"))
-    sg_replica = pclq.metadata.labels.get(namegen.LABEL_PCSG_REPLICA_INDEX)
-    deps = resolve_starts_after(
-        pcs,
-        pcs_replica,
-        clique_name,
-        owner_pcsg_fqn=pcsg_fqn,
-        owner_pcsg_replica=int(sg_replica) if sg_replica is not None else None,
-    )
-    if deps:
-        pclq.metadata.annotations[STARTUP_DEPS_ANNOTATION] = json.dumps(deps)
-    else:
-        pclq.metadata.annotations.pop(STARTUP_DEPS_ANNOTATION, None)
+        apply_template_to_pclq(ctx, pcs, pclq, name)
 
 
 def _replica_update_done(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> bool:
@@ -216,26 +174,6 @@ def _replica_update_done(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) 
         if pclq.status.ready_replicas < (pclq.spec.min_available or 1):
             return False
     return True
-
-
-def _mark_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:
-    from grove_tpu.api.types import PCSGRollingUpdateProgress
-
-    sel = {
-        **namegen.default_labels(pcs.metadata.name),
-        namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
-    }
-    for pcsg in ctx.store.list("PodCliqueScalingGroup", pcs.metadata.namespace, sel):
-        if pcsg.status.rolling_update_progress is None or (
-            pcsg.status.rolling_update_progress.update_ended_at is not None
-        ):
-            pcsg.status.rolling_update_progress = PCSGRollingUpdateProgress(
-                update_started_at=ctx.clock.now(),
-                ready_replica_indices_selected_to_update=list(
-                    range(pcsg.spec.replicas)
-                ),
-            )
-            ctx.store.update_status(pcsg)
 
 
 def _finish_pcsg_progress(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> None:

@@ -25,6 +25,7 @@ from grove_tpu.api.types import (
     PHASE_STARTING,
 )
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
 from grove_tpu.solver.encode import build_problem
@@ -221,6 +222,21 @@ class GangScheduler:
 
     # -- helpers ---------------------------------------------------------
 
+    def _update_status_tolerant(self, obj) -> bool:
+        """Status upsert that tolerates optimistic-concurrency conflicts: in
+        real-cluster mode the operator writes the same objects concurrently,
+        and a 409 simply means the next scheduling round re-reads and
+        re-derives the same condition — never a reason to crash the binder
+        (the reference's scheduler retries conflicts the same way)."""
+        try:
+            self.store.update_status(obj)
+            return True
+        except GroveError as e:
+            if e.code != ERR_CONFLICT:
+                raise
+            METRICS.inc("gang_status_conflicts_total")
+            return False
+
     def _pending_pods(self, namespace: Optional[str]) -> List:
         return [
             p
@@ -389,39 +405,46 @@ class GangScheduler:
     def _mark_scheduled(
         self, namespace: str, gang_name: str, score: Optional[float]
     ) -> None:
-        gang = self.store.get("PodGang", namespace, gang_name)
-        if gang is None:
-            return
-        if gang.status.phase == PHASE_PENDING:
-            gang.status.phase = PHASE_STARTING
-        if score is not None:
-            gang.status.placement_score = score
-        set_condition(
-            gang.status.conditions,
-            Condition(
-                type=COND_PODGANG_SCHEDULED,
-                status="True",
-                reason="AllPodGroupsPlaced",
-                message=f"placement score {gang.status.placement_score}",
-            ),
-            self.store.clock.now(),
-        )
-        # a successfully (re)scheduled gang is no longer a disruption target
-        if (
-            dt := get_condition(
-                gang.status.conditions, COND_PODGANG_DISRUPTION_TARGET
-            )
-        ) is not None and dt.is_true():
+        # retry-with-fresh-read on conflict: the pods are already BOUND, so
+        # skipping this write would strand a placed gang in phase Pending
+        # (unlike the periodic health/phase upserts, which re-derive next
+        # round anyway)
+        for _ in range(4):
+            gang = self.store.get("PodGang", namespace, gang_name)
+            if gang is None:
+                return
+            if gang.status.phase == PHASE_PENDING:
+                gang.status.phase = PHASE_STARTING
+            if score is not None:
+                gang.status.placement_score = score
             set_condition(
                 gang.status.conditions,
                 Condition(
-                    type=COND_PODGANG_DISRUPTION_TARGET,
-                    status="False",
-                    reason="Rescheduled",
+                    type=COND_PODGANG_SCHEDULED,
+                    status="True",
+                    reason="AllPodGroupsPlaced",
+                    message=f"placement score {gang.status.placement_score}",
                 ),
                 self.store.clock.now(),
             )
-        self.store.update_status(gang)
+            # a successfully (re)scheduled gang is no longer a disruption
+            # target
+            if (
+                dt := get_condition(
+                    gang.status.conditions, COND_PODGANG_DISRUPTION_TARGET
+                )
+            ) is not None and dt.is_true():
+                set_condition(
+                    gang.status.conditions,
+                    Condition(
+                        type=COND_PODGANG_DISRUPTION_TARGET,
+                        status="False",
+                        reason="Rescheduled",
+                    ),
+                    self.store.clock.now(),
+                )
+            if self._update_status_tolerant(gang):
+                return
 
     # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----
 
@@ -642,35 +665,48 @@ class GangScheduler:
         return [chosen[i] for i in keep], delta
 
     def _evict_victim(self, gang, preemptor: dict) -> None:
-        now = self.store.clock.now()
-        set_condition(
-            gang.status.conditions,
-            Condition(
-                type=COND_PODGANG_DISRUPTION_TARGET,
-                status="True",
-                reason="PreemptedByHigherPriority",
-                message=f"preempted by {preemptor['name']}",
-            ),
-            now,
-        )
-        set_condition(
-            gang.status.conditions,
-            Condition(
-                type=COND_PODGANG_SCHEDULED,
-                status="False",
-                reason="Preempted",
-                message=f"preempted by {preemptor['name']}",
-            ),
-            now,
-        )
-        gang.status.phase = PHASE_PENDING
-        gang.status.placement_score = None
-        self.store.update_status(gang)
-        # victim pods recreate gated via their PCLQs
+        # retry-with-fresh-read: the Preempted status and the pod deletions
+        # must land together, or a conflicted write would leave evicted pods
+        # with a gang still claiming Scheduled=True
+        ns, name = gang.metadata.namespace, gang.metadata.name
+        for _ in range(4):
+            fresh = self.store.get("PodGang", ns, name)
+            if fresh is None:
+                return
+            now = self.store.clock.now()
+            set_condition(
+                fresh.status.conditions,
+                Condition(
+                    type=COND_PODGANG_DISRUPTION_TARGET,
+                    status="True",
+                    reason="PreemptedByHigherPriority",
+                    message=f"preempted by {preemptor['name']}",
+                ),
+                now,
+            )
+            set_condition(
+                fresh.status.conditions,
+                Condition(
+                    type=COND_PODGANG_SCHEDULED,
+                    status="False",
+                    reason="Preempted",
+                    message=f"preempted by {preemptor['name']}",
+                ),
+                now,
+            )
+            fresh.status.phase = PHASE_PENDING
+            fresh.status.placement_score = None
+            if self._update_status_tolerant(fresh):
+                break
+        # victim pods recreate gated via their PCLQs (concurrent deletion by
+        # the operator is fine — the outcome, pod gone, is what matters)
         for group in gang.spec.pod_groups:
             for ref in group.pod_references:
-                if self.store.get("Pod", ref.namespace, ref.name) is not None:
+                try:
                     self.store.delete("Pod", ref.namespace, ref.name)
+                except GroveError as e:
+                    if e.code != ERR_NOT_FOUND:
+                        raise
         METRICS.inc("gang_preemptions_total")
 
     def update_gang_health(self, namespace: str = "default") -> None:
@@ -704,14 +740,32 @@ class GangScheduler:
                 ),
                 self.store.clock.now(),
             )
-            self.store.update_status(gang)
+            self._update_status_tolerant(gang)
 
     def update_gang_phases(self, namespace: str = "default") -> None:
         """Advance Starting → Running (+ Ready condition) once every pod of
-        the gang is Ready (scheduler podgang.go:139-151 phase semantics)."""
+        the gang is Ready (scheduler podgang.go:139-151 phase semantics).
+        Also level-triggered self-heal: a gang whose pods are ALL bound but
+        whose phase still reads Pending had its _mark_scheduled write lost
+        to conflict exhaustion — re-derive the Scheduled state here rather
+        than stranding it (no other path revisits a fully-bound gang)."""
         from grove_tpu.api.pod import is_ready
 
         for gang in self.store.list("PodGang", namespace):
+            if gang.status.phase == PHASE_PENDING and gang.spec.pod_groups:
+                pods = [
+                    self.store.get("Pod", ref.namespace, ref.name)
+                    for group in gang.spec.pod_groups
+                    for ref in group.pod_references
+                ]
+                if pods and all(
+                    p is not None and is_scheduled(p) and not is_terminating(p)
+                    for p in pods
+                ):
+                    self._mark_scheduled(
+                        namespace, gang.metadata.name, None
+                    )
+                continue
             if gang.status.phase != PHASE_STARTING:
                 continue
             all_ready = True
@@ -734,4 +788,4 @@ class GangScheduler:
                     ),
                     self.store.clock.now(),
                 )
-                self.store.update_status(gang)
+                self._update_status_tolerant(gang)

@@ -41,14 +41,15 @@ def _get(url: str) -> dict:
         return json.loads(resp.read())
 
 
-def _converge(rt, predicate, timeout: float = 60.0):
+def _converge(rt, predicate, timeout: float = 60.0, dump=None):
     deadline = time.time() + timeout
     while time.time() < deadline:
         rt.converge_once()
         if predicate():
             return
         time.sleep(0.05)
-    raise AssertionError(f"did not converge within {timeout}s")
+    detail = f": {dump()}" if dump is not None else ""
+    raise AssertionError(f"did not converge within {timeout}s{detail}")
 
 
 @pytest.fixture
@@ -192,6 +193,189 @@ class TestClusterModeE2E:
             return not sets and not pods
 
         _converge(rt, gone, timeout=60)
+
+
+class TestExternalSchedulerInterop:
+    def test_out_of_process_scheduler_consumes_the_podgang_contract(self):
+        """The reference e2e installs the real KAI scheduler and tests the
+        contract against it (e2e/setup/kai_scheduler.go:32-69). Here the
+        operator runs with its in-tree binder DISABLED and a separate OS
+        process consumes PodGangs + ungated pods purely over the HTTP wire
+        format and binds them — contract drift between emission and an
+        external consumer is observable, not hidden behind the in-tree
+        solver."""
+        import subprocess
+        import sys
+
+        from grove_tpu.utils.platform import cpu_subprocess_env
+
+        rt = start_operator(with_scheduler=False)
+        assert rt.scheduler is None and rt.cluster is None
+        base = rt.apiserver.address
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "grove_tpu.cluster.extscheduler",
+                "--apiserver",
+                base,
+                "--nodes",
+                "16",
+                "--kubelet",
+                "--poll-interval",
+                "0.05",
+            ],
+            cwd=REPO,
+            # scrubbed CPU env: pytest's inherited env carries the axon
+            # link config, and a wedged link would cost the subprocess its
+            # 45s health-probe timeout before falling back
+            env=cpu_subprocess_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            doc = yaml.safe_load((REPO / "samples" / "simple1.yaml").read_text())
+            _post(
+                f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets",
+                doc,
+            )
+
+            def gang_running():
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"external scheduler died: {proc.stdout.read()}"
+                    )
+                gangs = _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+                return any(
+                    g.get("status", {}).get("phase") == "Running"
+                    and g.get("status", {}).get("placementScore") is not None
+                    for g in gangs
+                )
+
+            def dump():
+                gangs = _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+                pods = _get(f"{base}/api/v1/namespaces/default/pods")["items"]
+                return {
+                    "gangs": [
+                        (g["metadata"]["name"], g.get("status", {}).get("phase"))
+                        for g in gangs
+                    ],
+                    "pods": [
+                        (
+                            p["metadata"]["name"],
+                            p.get("spec", {}).get("schedulingGates"),
+                            p.get("status", {}).get("nodeName"),
+                        )
+                        for p in pods[:6]
+                    ],
+                    "sched_alive": proc.poll() is None,
+                }
+
+            # generous budget: the scheduler subprocess cold-imports jax and
+            # compiles the wave kernel on first solve
+            _converge(rt, gang_running, timeout=120, dump=dump)
+            pods = _get(f"{base}/api/v1/namespaces/default/pods")["items"]
+            assert len(pods) >= 9
+            assert all(p["status"].get("nodeName") for p in pods), (
+                "external scheduler left pods unbound"
+            )
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+            rt.shutdown()
+
+
+class TestWireRollingUpdate:
+    def test_spec_put_preserves_status_and_update_completes(self):
+        """A kubectl-style spec PUT (no status in the body) must not wipe
+        controller-owned status — the subresource rule; a clobbered
+        currentGenerationHash silently suppresses the rolling update. Also
+        regression-covers the external scheduler surviving optimistic-
+        concurrency conflicts with the concurrently-writing operator
+        (it previously crashed on the first 409)."""
+        import subprocess
+        import sys
+
+        from grove_tpu.utils.platform import cpu_subprocess_env
+
+        rt = start_operator(with_scheduler=False)
+        base = rt.apiserver.address
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "grove_tpu.cluster.extscheduler",
+                "--apiserver", base, "--nodes", "32",
+                "--kubelet", "--poll-interval", "0.05",
+            ],
+            cwd=REPO,
+            env=cpu_subprocess_env(),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            doc = yaml.safe_load((REPO / "samples" / "simple1.yaml").read_text())
+            _post(
+                f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets",
+                doc,
+            )
+
+            def running():
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"external scheduler died: {proc.stdout.read()}"
+                    )
+                gangs = _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+                return any(
+                    g.get("status", {}).get("phase") == "Running" for g in gangs
+                )
+
+            _converge(rt, running, timeout=240)
+
+            # kubectl-style update: fresh manifest + new image, NO status
+            doc2 = yaml.safe_load((REPO / "samples" / "simple1.yaml").read_text())
+            for c in doc2["spec"]["template"]["cliques"]:
+                c["spec"]["podSpec"]["containers"][0]["image"] = "busybox:v2"
+            cur = _get(
+                f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets/simple1"
+            )
+            doc2["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            doc2["metadata"]["finalizers"] = cur["metadata"].get("finalizers", [])
+            req = urllib.request.Request(
+                f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets/simple1",
+                data=json.dumps(doc2).encode(),
+                headers={"Content-Type": "application/json"},
+                method="PUT",
+            )
+            urllib.request.urlopen(req, timeout=10)
+
+            def update_done():
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"external scheduler died: {proc.stdout.read()}"
+                    )
+                pcs = _get(
+                    f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets/simple1"
+                )
+                prog = pcs.get("status", {}).get("rollingUpdateProgress")
+                return bool(prog and prog.get("updateEndedAt"))
+
+            _converge(rt, update_done, timeout=240)
+            pods = _get(f"{base}/api/v1/namespaces/default/pods")["items"]
+            imgs = {
+                c["image"] for p in pods for c in p["spec"]["containers"]
+            }
+            assert imgs == {"busybox:v2"}, imgs
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+            rt.shutdown()
 
 
 class TestCRDManifests:

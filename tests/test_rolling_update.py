@@ -84,7 +84,13 @@ class TestRollingUpdate:
         assert converge_update(harness, max_rounds=240), harness.tree()
 
         # event order proves sequencing: replica N completed before N+1 started
-        events = [e for e in harness.ctx.events if "RollingUpdateReplica" in e]
+        # (the PCSG controller emits its own RollingUpdateReplica events for
+        # its internal replica-by-replica swap — filter to the PCS kind)
+        events = [
+            e
+            for e in harness.ctx.events
+            if e.startswith("PodCliqueSet RollingUpdateReplica")
+        ]
         started = [e for e in events if "Started" in e]
         completed = [e for e in events if "Completed" in e]
         assert len(started) == 2 and len(completed) == 2
@@ -131,6 +137,78 @@ class TestRollingUpdate:
                 break
             harness.advance(2.0)
         assert min_ready_seen >= 2, min_ready_seen
+
+    def test_pcsg_updates_one_ready_replica_at_a_time(self):
+        """Reference granularity (pcsg components/podclique/rollingupdate.go:
+        55-260): the PCSG controller tracks ReadyReplicaIndicesSelectedToUpdate
+        itself and tears down at most ONE ready scaling-group replica at a
+        time — the rest of the group keeps serving through the update."""
+        harness = SimHarness(num_nodes=64)
+        pcs = simple1()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
+        harness.apply(pcs)
+        harness.converge()
+
+        updated = simple1()
+        updated.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+
+        max_down = 0
+        saw_selection = False
+        for _ in range(240):
+            harness.engine.drain()
+            harness.schedule()
+            harness.cluster.kubelet_tick()
+            harness.engine.drain()
+            # how many PCSG replicas currently lack full readiness
+            down = 0
+            for r in range(3):
+                pods = [
+                    p
+                    for p in harness.store.list("Pod")
+                    if p.metadata.labels.get(namegen.LABEL_PCSG)
+                    == "simple1-0-workers"
+                    and p.metadata.labels.get("grove.io/podcliquescalinggroup-replica-index")
+                    == str(r)
+                ]
+                if len(pods) < 4 or not all(is_ready(p) for p in pods):
+                    down += 1
+            max_down = max(max_down, down)
+            pcsg = harness.store.get(
+                "PodCliqueScalingGroup", "default", "simple1-0-workers"
+            )
+            prog = pcsg.status.rolling_update_progress
+            if prog is not None and prog.ready_replica_indices_selected_to_update:
+                saw_selection = True
+            pcs_now = harness.store.get("PodCliqueSet", "default", "simple1")
+            p = pcs_now.status.rolling_update_progress
+            if p is not None and p.update_ended_at is not None:
+                break
+            harness.advance(2.0)
+        assert saw_selection, "PCSG never recorded its own replica selection"
+        assert max_down <= 1, (
+            f"{max_down} PCSG replicas were down simultaneously — the"
+            f" scaling group must keep serving through its update"
+        )
+        harness.converge()
+        pcsg = harness.store.get(
+            "PodCliqueScalingGroup", "default", "simple1-0-workers"
+        )
+        prog = pcsg.status.rolling_update_progress
+        assert prog.update_ended_at is not None
+        assert prog.updated_replica_indices == [0, 1, 2]
+        assert prog.ready_replica_indices_selected_to_update == []
+        pods = [
+            p
+            for p in harness.store.list("Pod")
+            if p.metadata.labels.get(namegen.LABEL_PCSG) == "simple1-0-workers"
+        ]
+        assert len(pods) == 12 and all(is_ready(p) for p in pods)
+        assert {c.image for p in pods for c in p.spec.containers} == {
+            "busybox:new"
+        }
 
     def test_reuse_reservation_hint_set_and_honored(self):
         harness = SimHarness(num_nodes=32)

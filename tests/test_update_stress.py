@@ -121,12 +121,16 @@ class TestUpdateStress:
         harness = SimHarness(num_nodes=64)
         pcs = simple1()
         pcs.spec.replicas = 2  # replica ordering only matters with >1
+        # 3 PCSG replicas: the PCSG's own one-ready-replica-at-a-time swap
+        # then spans several control rounds (an observable mid-swap window)
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
         harness.apply(pcs)
         harness.converge()
         counter = DeletionCounter(harness)
 
         updated = with_image("busybox:v2")
         updated.spec.replicas = 2
+        updated.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
         harness.apply(updated)
 
         def pcs_mid_replica() -> bool:
