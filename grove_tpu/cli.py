@@ -165,6 +165,7 @@ def _cmd_run(args) -> int:
         config=config,
         with_tls=args.tls,
         with_authorizer=args.authorizer,
+        threaded=args.threaded,
         apiserver_url=args.apiserver,
         leader_lock_path=args.leader_lock,
     )
@@ -261,6 +262,11 @@ def main(argv: List[str] | None = None) -> int:
         "--authorizer", action="store_true", help="enable the authorizer webhook"
     )
     p.add_argument("--leader-lock", help="leader-election lock file path")
+    p.add_argument(
+        "--threaded",
+        action="store_true",
+        help="run concurrent reconciles in real threads (concurrentSyncs)",
+    )
     p.set_defaults(fn=_cmd_run)
 
     args = parser.parse_args(argv)

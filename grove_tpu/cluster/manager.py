@@ -90,15 +90,23 @@ class OperatorRuntime:
     apiserver: Optional[APIServer]
     webhooks: Optional[WebhookServer]
     leader_lock: Optional[FileLeaderLock] = None
+    # real threaded reconciles (MaxConcurrentReconciles equivalent) — safe
+    # here because the HttpStore/apiserver boundary is thread-safe
+    threaded: bool = False
+
+    def _drain(self) -> int:
+        if self.threaded:
+            return self.engine.drain_concurrent()
+        return self.engine.drain()
 
     def converge_once(self) -> int:
         """One control round: reconcile, schedule, kubelet."""
-        work = self.engine.drain()
+        work = self._drain()
         if self.scheduler is not None:
             work += self.scheduler.schedule_pending()
         if self.cluster is not None:
             work += self.cluster.kubelet_tick()
-        work += self.engine.drain()
+        work += self._drain()
         if self.leader_lock is not None:
             self.leader_lock.heartbeat()
         return work
@@ -114,6 +122,7 @@ class OperatorRuntime:
                 self.leader_lock.release()
 
     def shutdown(self) -> None:
+        self.engine.close()
         self.store.stop()
         if self.webhooks is not None:
             self.webhooks.stop()
@@ -131,6 +140,7 @@ def start_operator(
     with_tls: bool = False,
     with_authorizer: bool = False,
     with_scheduler: bool = True,
+    threaded: bool = False,
     apiserver_url: Optional[str] = None,
     leader_lock_path: Optional[str] = None,
 ) -> OperatorRuntime:
@@ -202,4 +212,5 @@ def start_operator(
         apiserver=apiserver,
         webhooks=webhooks,
         leader_lock=leader_lock,
+        threaded=threaded,
     )

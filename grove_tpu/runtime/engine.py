@@ -40,18 +40,28 @@ class Controller:
     reconcile: ReconcileFn
     watches: List[Tuple[str, MapFn]] = field(default_factory=list)
     queue: WorkQueue = field(default_factory=WorkQueue)
-    # ConcurrentSyncs equivalent: keys processed per engine round (the
-    # engine is single-threaded, so this is batching, not parallelism)
+    # ConcurrentSyncs equivalent: keys processed per engine round. In the
+    # default single-threaded drain this is batching; drain_concurrent runs
+    # this many reconciles of the controller in REAL parallel threads
+    # (same-key never concurrent — client-go workqueue semantics).
     concurrent_syncs: int = 1
+    # keys currently being reconciled by a worker thread (drain_concurrent)
+    busy: set = field(default_factory=set)
 
 
 class Engine:
     def __init__(self, store: Store, clock: Optional[Clock] = None) -> None:
+        from collections import deque
+
         self.store = store
         self.clock = clock or store.clock
         self.controllers: List[Controller] = []
-        self._event_backlog: List[WatchEvent] = []
+        # deque + popleft-drain: watch THREADS append concurrently in
+        # cluster mode, and a list snapshot-then-clear would silently drop
+        # events appended in between (deque.append/popleft are atomic)
+        self._event_backlog = deque()
         self.held_kinds: set = set()
+        self._pool = None  # lazy engine-lifetime reconcile thread pool
         store.subscribe(self._event_backlog.append)
 
     def register(self, controller: Controller) -> None:
@@ -68,11 +78,15 @@ class Engine:
         self.held_kinds.discard(kind)
 
     def _route_events(self) -> None:
-        # Drain in place: reconciles emit new events while we iterate.
+        # Drain via popleft until empty: reconciles (and concurrent watch
+        # threads) emit new events while we iterate; popping one at a time
+        # can never lose a concurrent append.
         remaining: List[WatchEvent] = []
-        events = list(self._event_backlog)
-        self._event_backlog.clear()
-        for ev in events:
+        while True:
+            try:
+                ev = self._event_backlog.popleft()
+            except IndexError:
+                break
             if ev.kind in self.held_kinds:
                 remaining.append(ev)
                 continue
@@ -93,6 +107,24 @@ class Engine:
 
     # -- run loop --------------------------------------------------------
 
+    def _complete(self, ctrl: Controller, key, result, error, now) -> None:
+        """Shared workqueue bookkeeping for a finished reconcile — single
+        home for the requeue/backoff/forget semantics so the deterministic
+        and threaded drains can never drift."""
+        if error is not None:
+            METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
+            # RecoverPanic equivalent (manager.go:99-101): requeue
+            ctrl.queue.add_rate_limited(key, now)
+            return
+        if result.result == "requeue":
+            METRICS.inc(f"reconcile_errors_total/{ctrl.name}")
+            ctrl.queue.add_rate_limited(key, now)
+        elif result.result == "requeue_after":
+            ctrl.queue.forget(key)
+            ctrl.queue.add_after(key, result.requeue_after or 0.0, now)
+        else:
+            ctrl.queue.forget(key)
+
     def drain(self, max_rounds: int = 10_000) -> int:
         """Process until no controller has a ready item at the current time.
         Returns the number of reconciles executed."""
@@ -109,23 +141,12 @@ class Engine:
                     progressed = True
                     executed += 1
                     METRICS.inc(f"reconcile_total/{ctrl.name}")
+                    result = error = None
                     try:
                         result = ctrl.reconcile(key)
-                    except Exception:
-                        METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
-                        # RecoverPanic equivalent (manager.go:99-101): requeue
-                        ctrl.queue.add_rate_limited(key, now)
-                        continue
-                    if result.result == "requeue":
-                        METRICS.inc(f"reconcile_errors_total/{ctrl.name}")
-                        ctrl.queue.add_rate_limited(key, now)
-                    elif result.result == "requeue_after":
-                        ctrl.queue.forget(key)
-                        ctrl.queue.add_after(
-                            key, result.requeue_after or 0.0, now
-                        )
-                    else:
-                        ctrl.queue.forget(key)
+                    except Exception as e:
+                        error = e
+                    self._complete(ctrl, key, result, error, now)
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()
@@ -133,6 +154,87 @@ class Engine:
                     return executed
         raise RuntimeError(
             f"engine did not quiesce within {max_rounds} rounds "
+            "(reconcile livelock?)"
+        )
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            size = max(
+                sum(max(c.concurrent_syncs, 1) for c in self.controllers), 1
+            )
+            self._pool = ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix="reconcile"
+            )
+        return self._pool
+
+    def close(self) -> None:
+        """Release the reconcile thread pool (no-op if never threaded)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def drain_concurrent(self, max_iterations: int = 100_000) -> int:
+        """Threaded drain: each controller runs up to `concurrent_syncs`
+        reconciles in REAL parallel threads — the reference's goroutine
+        concurrency model (MaxConcurrentReconciles) rather than the
+        deterministic single-threaded batching of `drain`.
+
+        Completion-driven: slots refill AS reconciles finish (no per-round
+        join barrier), so the busy set genuinely carries the same-key
+        exclusion guarantee — a key whose reconcile is in flight is popped,
+        seen busy, and re-queued to run after the in-flight one completes
+        (client-go workqueue semantics). The pool is an engine-lifetime
+        resource (`close()` releases it).
+
+        Intended for real-cluster mode over a thread-safe store (HttpStore /
+        the locked apiserver). The sim keeps the deterministic drain."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        pool = self._ensure_pool()
+        executed = 0
+        futures = {}  # future -> (controller, key)
+        for _ in range(max_iterations):
+            now = self.clock.now()
+            self._route_events()
+            for ctrl in self.controllers:
+                slots = max(ctrl.concurrent_syncs, 1) - sum(
+                    1 for (c, _k) in futures.values() if c is ctrl
+                )
+                for _slot in range(slots):
+                    key = ctrl.queue.pop(now)
+                    if key is None:
+                        break
+                    if key in ctrl.busy:
+                        # in flight on another thread: run it AFTER that
+                        # reconcile completes, never concurrently
+                        ctrl.queue.add(key)  # no backoff: not a failure
+                        break
+                    ctrl.busy.add(key)
+                    executed += 1
+                    METRICS.inc(f"reconcile_total/{ctrl.name}")
+                    futures[pool.submit(ctrl.reconcile, key)] = (ctrl, key)
+            if not futures:
+                self._route_events()
+                if all(
+                    c.queue.empty(self.clock.now()) for c in self.controllers
+                ):
+                    return executed
+                continue
+            done, _pending = wait(futures, return_when=FIRST_COMPLETED)
+            now = self.clock.now()
+            for fut in done:
+                ctrl, key = futures.pop(fut)
+                result = error = None
+                try:
+                    result = fut.result()
+                except Exception as e:
+                    error = e
+                self._complete(ctrl, key, result, error, now)
+                ctrl.busy.discard(key)
+        raise RuntimeError(
+            f"engine did not quiesce within {max_iterations} iterations "
             "(reconcile livelock?)"
         )
 

@@ -1,0 +1,183 @@
+"""Threaded reconcile engine: real parallelism with workqueue semantics.
+
+The reference runs MaxConcurrentReconciles goroutines per controller against
+a live apiserver (manager.go concurrency model); the round-1 engine only
+batched. drain_concurrent runs reconciles in actual threads — these tests
+prove (1) different keys DO overlap in time, (2) the same key NEVER does
+(client-go workqueue exclusion), and (3) the full operator converges over
+the live HTTP apiserver with threading on.
+"""
+
+import threading
+import time
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import GenericObject
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.engine import Controller, Engine
+from grove_tpu.runtime.flow import continue_reconcile
+from grove_tpu.runtime.store import Store
+
+
+class Tracker:
+    """Records (key, start, end) intervals; thread-safe."""
+
+    def __init__(self, work_seconds: float = 0.03) -> None:
+        self.lock = threading.Lock()
+        self.intervals = []
+        self.work_seconds = work_seconds
+
+    def reconcile(self, key):
+        start = time.monotonic()
+        time.sleep(self.work_seconds)
+        end = time.monotonic()
+        with self.lock:
+            self.intervals.append((key, start, end))
+        return continue_reconcile()
+
+
+def overlaps(a, b) -> bool:
+    return a[1] < b[2] and b[1] < a[2]
+
+
+class TestConcurrentEngine:
+    def _run(self, n_keys: int, repeats: int, concurrent_syncs: int):
+        store = Store(Clock())
+        engine = Engine(store, store.clock)
+        tracker = Tracker()
+        engine.register(
+            Controller(
+                name="test",
+                kind="Service",
+                reconcile=tracker.reconcile,
+                concurrent_syncs=concurrent_syncs,
+            )
+        )
+        for rep in range(repeats):
+            for i in range(n_keys):
+                if rep == 0:
+                    store.create(
+                        GenericObject(
+                            kind="Service",
+                            metadata=ObjectMeta(
+                                name=f"svc-{i}", namespace="default"
+                            ),
+                            spec={"rep": rep},
+                        )
+                    )
+                else:
+                    store.update(_bump(store, f"svc-{i}", rep))
+            engine.drain_concurrent()
+        return tracker.intervals
+
+    def test_different_keys_reconcile_in_parallel(self):
+        intervals = self._run(n_keys=4, repeats=1, concurrent_syncs=4)
+        assert len(intervals) == 4
+        cross = sum(
+            1
+            for i in range(len(intervals))
+            for j in range(i + 1, len(intervals))
+            if intervals[i][0] != intervals[j][0]
+            and overlaps(intervals[i], intervals[j])
+        )
+        assert cross > 0, "no two distinct keys ever ran concurrently"
+
+    def test_same_key_never_overlaps(self):
+        """Exercises the busy-set exclusion for real: each reconcile BUMPS
+        its own object mid-flight, so the key re-enqueues while its own
+        reconcile is still running (the completion-driven loop pops it,
+        sees it busy, and defers) — same-key intervals must never overlap
+        even though distinct keys run in parallel."""
+        store = Store(Clock())
+        store_lock = threading.Lock()
+        engine = Engine(store, store.clock)
+        intervals = []
+        ivl_lock = threading.Lock()
+        bumps = 4
+
+        def reconcile(key):
+            start = time.monotonic()
+            _kind, ns, name = key
+            with store_lock:  # in-memory store is not thread-safe
+                obj = store.get("Service", ns, name)
+                if obj is not None and obj.spec.get("rep", 0) < bumps:
+                    obj.spec = {"rep": obj.spec.get("rep", 0) + 1}
+                    store.update(obj)  # re-enqueues THIS key while running
+            time.sleep(0.02)
+            end = time.monotonic()
+            with ivl_lock:
+                intervals.append((key, start, end))
+            return continue_reconcile()
+
+        engine.register(
+            Controller(
+                name="test",
+                kind="Service",
+                reconcile=reconcile,
+                concurrent_syncs=4,
+            )
+        )
+        with store_lock:
+            for i in range(3):
+                store.create(
+                    GenericObject(
+                        kind="Service",
+                        metadata=ObjectMeta(name=f"svc-{i}", namespace="default"),
+                        spec={"rep": 0},
+                    )
+                )
+        engine.drain_concurrent()
+        engine.close()
+        by_key = {}
+        for key, s, e in intervals:
+            by_key.setdefault(key, []).append((key, s, e))
+        assert all(len(v) >= bumps for v in by_key.values()), {
+            k: len(v) for k, v in by_key.items()
+        }
+        for key, ivs in by_key.items():
+            ivs.sort(key=lambda x: x[1])
+            for a, b in zip(ivs, ivs[1:]):
+                assert not overlaps(a, b), (
+                    f"key {key} reconciled concurrently: {a} vs {b}"
+                )
+
+    def test_threaded_operator_converges_over_http(self):
+        import json
+        import urllib.request
+
+        import yaml
+
+        from grove_tpu.cluster.manager import start_operator
+        from tests.test_cluster_mode import REPO, _converge, _get, _post
+
+        rt = start_operator(threaded=True)
+        try:
+            base = rt.apiserver.address
+            doc = yaml.safe_load(
+                (REPO / "samples" / "simple1.yaml").read_text()
+            )
+            _post(
+                f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets",
+                doc,
+            )
+
+            def running():
+                gangs = _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+                return any(
+                    g.get("status", {}).get("phase") == "Running"
+                    for g in gangs
+                )
+
+            _converge(rt, running, timeout=120)
+            pods = _get(f"{base}/api/v1/namespaces/default/pods")["items"]
+            assert len(pods) >= 9
+        finally:
+            rt.shutdown()
+
+
+def _bump(store, name: str, rep: int):
+    obj = store.get("Service", "default", name)
+    obj.spec = {"rep": rep}
+    return obj
