@@ -93,6 +93,10 @@ class OperatorRuntime:
     # real threaded reconciles (MaxConcurrentReconciles equivalent) — safe
     # here because the HttpStore/apiserver boundary is thread-safe
     threaded: bool = False
+    # multi-level autoscaling (HPA controller equivalent, reference
+    # components/hpa) — evaluated each control round like the kube HPA sync
+    autoscaler: Optional[object] = None
+    metrics_provider: Optional[object] = None
 
     def _drain(self) -> int:
         if self.threaded:
@@ -100,8 +104,18 @@ class OperatorRuntime:
         return self.engine.drain()
 
     def converge_once(self) -> int:
-        """One control round: reconcile, schedule, kubelet."""
+        """One control round: reconcile, autoscale, schedule, kubelet.
+        Store conflicts in the autoscale/schedule passes are routine under
+        concurrent writers (the HPA's read-modify-write isn't atomic over
+        the wire) — they re-derive next round; the run loop must survive."""
+        from grove_tpu.runtime.errors import GroveError
+
         work = self._drain()
+        if self.autoscaler is not None:
+            try:
+                work += self.autoscaler.tick()
+            except GroveError:
+                pass  # conflicting writer; next tick re-reads
         if self.scheduler is not None:
             work += self.scheduler.schedule_pending()
         if self.cluster is not None:
@@ -143,6 +157,7 @@ def start_operator(
     threaded: bool = False,
     apiserver_url: Optional[str] = None,
     leader_lock_path: Optional[str] = None,
+    metrics_provider=None,
 ) -> OperatorRuntime:
     """Boot the full real-cluster operator (embedded apiserver unless
     `apiserver_url` points at an external one), mirroring main.go startup:
@@ -204,6 +219,16 @@ def start_operator(
             chunk_size=min(config.solver.chunk_size, 64),
             max_waves=config.solver.max_waves,
         )
+    from grove_tpu.autoscale.hpa import (
+        HorizontalAutoscaler,
+        StaticMetricsProvider,
+    )
+
+    # real deployments inject a provider backed by their metrics pipeline
+    # (HPAs are inert without one — StaticMetricsProvider only serves what
+    # tests/sims poke into it)
+    metrics_provider = metrics_provider or StaticMetricsProvider()
+    autoscaler = HorizontalAutoscaler(store, metrics_provider)
     return OperatorRuntime(
         store=store,
         engine=engine,
@@ -213,4 +238,6 @@ def start_operator(
         webhooks=webhooks,
         leader_lock=leader_lock,
         threaded=threaded,
+        autoscaler=autoscaler,
+        metrics_provider=metrics_provider,
     )

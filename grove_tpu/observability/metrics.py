@@ -17,6 +17,10 @@ class Metrics:
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, List[float]] = defaultdict(list)
+        # cumulative across window trims — the exported _count/_sum series
+        # must be monotonic or scrapers read every trim as a counter reset
+        self.hist_count: Dict[str, float] = defaultdict(float)
+        self.hist_sum: Dict[str, float] = defaultdict(float)
 
     def inc(self, name: str, value: float = 1.0) -> None:
         self.counters[name] += value
@@ -24,8 +28,17 @@ class Metrics:
     def set(self, name: str, value: float) -> None:
         self.gauges[name] = value
 
+    # long-running operators observe forever: percentiles come from a
+    # bounded recent window; _count/_sum stay cumulative across trims
+    MAX_SAMPLES = 4096
+
     def observe(self, name: str, value: float) -> None:
-        self.histograms[name].append(value)
+        values = self.histograms[name]
+        values.append(value)
+        self.hist_count[name] += 1
+        self.hist_sum[name] += value
+        if len(values) > self.MAX_SAMPLES:
+            del values[: self.MAX_SAMPLES // 2]
 
     def percentile(self, name: str, q: float) -> float:
         values = sorted(self.histograms.get(name, []))
@@ -38,6 +51,8 @@ class Metrics:
         self.counters.clear()
         self.gauges.clear()
         self.histograms.clear()
+        self.hist_count.clear()
+        self.hist_sum.clear()
 
     def prometheus_text(self) -> str:
         lines = []
@@ -47,8 +62,13 @@ class Metrics:
             lines.append(f"{_promname(name)} {v}")
         for name, values in sorted(self.histograms.items()):
             base, label = _prom_parts(name)
-            lines.append(f"{base}_count{label and '{' + label + '}'} {len(values)}")
-            lines.append(f"{base}_sum{label and '{' + label + '}'} {sum(values)}")
+            lines.append(
+                f"{base}_count{label and '{' + label + '}'} "
+                f"{self.hist_count[name]}"
+            )
+            lines.append(
+                f"{base}_sum{label and '{' + label + '}'} {self.hist_sum[name]}"
+            )
             for q in (0.5, 0.9, 0.99):
                 qlabel = f'quantile="{q}"' + (f",{label}" if label else "")
                 lines.append(f"{base}{{{qlabel}}} {self.percentile(name, q)}")

@@ -20,6 +20,7 @@ semantics in common/flow.go.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
@@ -143,10 +144,12 @@ class Engine:
                     METRICS.inc(f"reconcile_total/{ctrl.name}")
                     result = error = None
                     try:
-                        result = ctrl.reconcile(key)
+                        result = self._timed(ctrl, key)
                     except Exception as e:
                         error = e
                     self._complete(ctrl, key, result, error, now)
+            for ctrl in self.controllers:
+                METRICS.set(f"workqueue_depth/{ctrl.name}", len(ctrl.queue))
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()
@@ -156,6 +159,15 @@ class Engine:
             f"engine did not quiesce within {max_rounds} rounds "
             "(reconcile livelock?)"
         )
+
+    def _timed(self, ctrl: Controller, key):
+        t0 = time.perf_counter()
+        try:
+            return ctrl.reconcile(key)
+        finally:
+            METRICS.observe(
+                f"reconcile_seconds/{ctrl.name}", time.perf_counter() - t0
+            )
 
     def _ensure_pool(self):
         if self._pool is None:
@@ -214,7 +226,7 @@ class Engine:
                     ctrl.busy.add(key)
                     executed += 1
                     METRICS.inc(f"reconcile_total/{ctrl.name}")
-                    futures[pool.submit(ctrl.reconcile, key)] = (ctrl, key)
+                    futures[pool.submit(self._timed, ctrl, key)] = (ctrl, key)
             if not futures:
                 self._route_events()
                 if all(
@@ -233,6 +245,8 @@ class Engine:
                     error = e
                 self._complete(ctrl, key, result, error, now)
                 ctrl.busy.discard(key)
+            for ctrl in self.controllers:
+                METRICS.set(f"workqueue_depth/{ctrl.name}", len(ctrl.queue))
         raise RuntimeError(
             f"engine did not quiesce within {max_iterations} iterations "
             "(reconcile livelock?)"

@@ -378,6 +378,50 @@ class TestWireRollingUpdate:
             rt.shutdown()
 
 
+class TestAutoscaleOverWire:
+    def test_hpa_scales_group_and_new_gang_materializes(self, runtime):
+        """Multi-level autoscaling runs in cluster mode too: high observed
+        utilization on the workers scaling group drives its HPA, the PCSG
+        scales out, and a SCALED PodGang materializes over the wire."""
+        rt = runtime
+        base = rt.apiserver.address
+        doc = yaml.safe_load((REPO / "samples" / "simple1.yaml").read_text())
+        _post(
+            f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets",
+            doc,
+        )
+        _converge(
+            rt,
+            lambda: any(
+                g.get("status", {}).get("phase") == "Running"
+                for g in _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+            ),
+            timeout=90,
+        )
+        # pressure: simple1's workers scaleConfig targets 80% utilization,
+        # so observed 300% drives ceil(1 * 300/80) = 4 replicas (max 6)
+        rt.metrics_provider.set(
+            "PodCliqueScalingGroup", "default", "simple1-0-workers", 300.0
+        )
+
+        def scaled_gang_exists():
+            gangs = _get(
+                f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+            )["items"]
+            return any(
+                g["metadata"]["name"].startswith("simple1-0-workers-")
+                for g in gangs
+            )
+
+        _converge(rt, scaled_gang_exists, timeout=90)
+        pcsg = _get(
+            f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquescalinggroups/simple1-0-workers"
+        )
+        assert pcsg["spec"]["replicas"] > 1
+
+
 class TestCRDManifests:
     def test_committed_crds_match_generated(self):
         """deploy/crds/ must never drift from the typed model (the reference
