@@ -8,12 +8,19 @@ deployment; in the sim it feeds assertions and the bench report.
 from __future__ import annotations
 
 import math
+import threading
 from collections import defaultdict
 from typing import Dict, List
 
 
 class Metrics:
+    """Thread-safe: reconcile worker threads (Engine.drain_concurrent) and
+    watch threads observe concurrently; unsynchronized += would silently
+    lose increments and break the monotonic-counter contract scrapers rely
+    on."""
+
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, List[float]] = defaultdict(list)
@@ -23,55 +30,78 @@ class Metrics:
         self.hist_sum: Dict[str, float] = defaultdict(float)
 
     def inc(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     def set(self, name: str, value: float) -> None:
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
 
     # long-running operators observe forever: percentiles come from a
     # bounded recent window; _count/_sum stay cumulative across trims
     MAX_SAMPLES = 4096
 
     def observe(self, name: str, value: float) -> None:
-        values = self.histograms[name]
-        values.append(value)
-        self.hist_count[name] += 1
-        self.hist_sum[name] += value
-        if len(values) > self.MAX_SAMPLES:
-            del values[: self.MAX_SAMPLES // 2]
+        with self._lock:
+            values = self.histograms[name]
+            values.append(value)
+            self.hist_count[name] += 1
+            self.hist_sum[name] += value
+            if len(values) > self.MAX_SAMPLES:
+                del values[: self.MAX_SAMPLES // 2]
 
     def percentile(self, name: str, q: float) -> float:
-        values = sorted(self.histograms.get(name, []))
+        with self._lock:
+            values = sorted(self.histograms.get(name, []))
         if not values:
             return math.nan
         idx = min(len(values) - 1, max(0, math.ceil(q * len(values)) - 1))
         return values[idx]
 
     def reset(self) -> None:
-        self.counters.clear()
-        self.gauges.clear()
-        self.histograms.clear()
-        self.hist_count.clear()
-        self.hist_sum.clear()
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+            self.hist_count.clear()
+            self.hist_sum.clear()
 
     def prometheus_text(self) -> str:
+        # snapshot under the lock: a scrape during concurrent writes must
+        # not hit "dict changed size during iteration"
+        with self._lock:
+            self_counters = dict(self.counters)
+            self_gauges = dict(self.gauges)
+            self_hists = {k: list(v) for k, v in self.histograms.items()}
+            hist_count = dict(self.hist_count)
+            hist_sum = dict(self.hist_sum)
         lines = []
-        for name, v in sorted(self.counters.items()):
+        for name, v in sorted(self_counters.items()):
             lines.append(f"{_promname(name)} {v}")
-        for name, v in sorted(self.gauges.items()):
+        for name, v in sorted(self_gauges.items()):
             lines.append(f"{_promname(name)} {v}")
-        for name, values in sorted(self.histograms.items()):
+        for name, values in sorted(self_hists.items()):
             base, label = _prom_parts(name)
             lines.append(
                 f"{base}_count{label and '{' + label + '}'} "
-                f"{self.hist_count[name]}"
+                f"{hist_count.get(name, 0.0)}"
             )
             lines.append(
-                f"{base}_sum{label and '{' + label + '}'} {self.hist_sum[name]}"
+                f"{base}_sum{label and '{' + label + '}'} "
+                f"{hist_sum.get(name, 0.0)}"
             )
+            window = sorted(values)
             for q in (0.5, 0.9, 0.99):
+                if window:
+                    idx = min(
+                        len(window) - 1,
+                        max(0, math.ceil(q * len(window)) - 1),
+                    )
+                    qv = window[idx]
+                else:
+                    qv = math.nan
                 qlabel = f'quantile="{q}"' + (f",{label}" if label else "")
-                lines.append(f"{base}{{{qlabel}}} {self.percentile(name, q)}")
+                lines.append(f"{base}{{{qlabel}}} {qv}")
         return "\n".join(lines) + "\n"
 
 

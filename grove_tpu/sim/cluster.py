@@ -152,6 +152,29 @@ class SimCluster:
             progressed += 1
         return progressed
 
+    def fail_node(self, node_name: str) -> int:
+        """Node loss: cordon the node and evict (delete) every pod bound to
+        it — the node-controller behavior after a node goes NotReady. The
+        PCLQ controllers recreate the pods gated; the scheduler's recovery
+        delta-solve places them on surviving nodes (honoring gang/group
+        recovery pins). Returns the number of pods evicted."""
+        node = next((n for n in self.nodes if n.name == node_name), None)
+        if node is None:
+            return 0
+        node.cordoned = True
+        self._gc_bindings()  # stale entries must not count as evictions
+        victims = [
+            (ns, pod_name)
+            for (ns, pod_name), bound in self.bindings.items()
+            if bound == node_name
+        ]
+        evicted = 0
+        for ns, pod_name in victims:
+            if self.store.get("Pod", ns, pod_name) is not None:
+                self.store.delete("Pod", ns, pod_name)
+                evicted += 1
+        return evicted
+
     def fail_pod(self, namespace: str, name: str, exit_code: int = 1) -> None:
         """Crash a pod's containers (fault injection for breach tests)."""
         pod = self.store.get("Pod", namespace, name)

@@ -86,3 +86,45 @@ class TestFaultInjection:
         harness.converge(max_ticks=120)
         assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
         assert failures["budget"] == 0
+
+
+class TestNodeFailure:
+    def test_node_loss_evicts_and_recovers_on_surviving_nodes(self):
+        """Node goes NotReady: its pods are evicted (node-controller
+        semantics), the PCLQs recreate them gated, and the recovery
+        delta-solve re-places them on surviving nodes — elastic recovery
+        without tearing down the whole gang."""
+        from grove_tpu.api import names as namegen
+        from grove_tpu.api.load import load_podcliqueset_file
+        from grove_tpu.api.pod import is_ready
+        from grove_tpu.sim.harness import SimHarness
+
+        import pathlib
+
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        h = SimHarness(num_nodes=8)
+        h.apply(load_podcliqueset_file(str(repo / "samples" / "simple1.yaml")))
+        h.converge()
+        pods = h.store.list("Pod")
+        assert pods and all(is_ready(p) for p in pods)
+        n_pods = len(pods)
+
+        # kill the node hosting the most pods
+        by_node = {}
+        for (ns, name), node in h.cluster.bindings.items():
+            by_node.setdefault(node, []).append(name)
+        victim_node = max(by_node, key=lambda n: len(by_node[n]))
+        evicted = h.cluster.fail_node(victim_node)
+        assert evicted == len(by_node[victim_node])
+
+        h.converge()
+        pods = h.store.list("Pod")
+        assert len(pods) == n_pods, h.tree()
+        assert all(is_ready(p) for p in pods), h.tree()
+        # nothing landed back on the dead node
+        for p in pods:
+            node = h.cluster.bindings.get(("default", p.metadata.name))
+            assert node is not None and node != victim_node
+        # the gang recovered (Running) rather than gang-terminating
+        gang = h.store.get("PodGang", "default", "simple1-0")
+        assert gang.status.phase == "Running"
