@@ -85,6 +85,35 @@ class _WatchSub:
     selector: Optional[Dict[str, str]]
 
 
+def _sample_profile(seconds: float, hz: float = 100.0) -> str:
+    """In-process sampling profiler: aggregate (file:line function) frame
+    counts across ALL threads for `seconds` — the whole-process view a
+    pprof endpoint gives, without a tracing profiler's overhead."""
+    import sys
+    import time as _time
+
+    counts: Dict[str, int] = {}
+    own = threading.get_ident()
+    deadline = _time.monotonic() + seconds
+    interval = 1.0 / hz
+    samples = 0
+    while _time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == own:
+                continue
+            while frame is not None:
+                code = frame.f_code
+                key = f"{code.co_filename}:{frame.f_lineno} {code.co_name}"
+                counts[key] = counts.get(key, 0) + 1
+                frame = frame.f_back
+        samples += 1
+        _time.sleep(interval)
+    lines = [f"# {samples} samples over {seconds}s at ~{hz:.0f}Hz"]
+    for key, n in sorted(counts.items(), key=lambda kv: -kv[1])[:80]:
+        lines.append(f"{n:8d} {key}")
+    return "\n".join(lines) + "\n"
+
+
 def parse_label_selector(raw: Optional[str]) -> Optional[Dict[str, str]]:
     if not raw:
         return None
@@ -106,9 +135,15 @@ class APIServer:
         host: str = "127.0.0.1",
         port: int = 0,
         webhooks: Optional[List[WebhookRegistration]] = None,
+        enable_profiling: bool = False,
     ) -> None:
         self.store = store or Store(Clock())
         self.lock = threading.RLock()
+        # config-gated like the reference pprof listener (manager.go:108-113)
+        # and serialized: concurrent samplers would degrade the whole
+        # control plane (every 100Hz stack walk contends on the GIL)
+        self.enable_profiling = enable_profiling
+        self._profile_lock = threading.Lock()
         self.webhooks = webhooks or []
         self._subs: List[_WatchSub] = []
         self._subs_lock = threading.Lock()
@@ -307,6 +342,39 @@ class APIServer:
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
                     )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/debug/profile":
+                    # pprof-server equivalent: sample every thread's stack
+                    # for ?seconds=N and return aggregated frame counts
+                    # (whole-process view, py-spy style — cProfile would
+                    # only see this handler thread)
+                    if not server.enable_profiling:
+                        return self._error(
+                            404,
+                            "profiling disabled (server.profilingEnabled)",
+                        )
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query
+                    )
+                    try:
+                        seconds = min(
+                            float((query.get("seconds") or ["2"])[0]), 30.0
+                        )
+                    except ValueError:
+                        return self._error(400, "seconds must be a number")
+                    if not server._profile_lock.acquire(blocking=False):
+                        return self._error(
+                            429, "a profile is already in progress"
+                        )
+                    try:
+                        body = _sample_profile(seconds).encode()
+                    finally:
+                        server._profile_lock.release()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -193,7 +193,10 @@ def start_operator(
 
     apiserver = None
     if apiserver_url is None:
-        apiserver = APIServer(webhooks=registrations).start()
+        apiserver = APIServer(
+            webhooks=registrations,
+            enable_profiling=config.server.profiling_enabled,
+        ).start()
         apiserver_url = apiserver.address
 
     leader_lock = None

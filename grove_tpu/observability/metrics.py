@@ -53,10 +53,7 @@ class Metrics:
     def percentile(self, name: str, q: float) -> float:
         with self._lock:
             values = sorted(self.histograms.get(name, []))
-        if not values:
-            return math.nan
-        idx = min(len(values) - 1, max(0, math.ceil(q * len(values)) - 1))
-        return values[idx]
+        return _quantile(values, q)
 
     def reset(self) -> None:
         with self._lock:
@@ -92,17 +89,20 @@ class Metrics:
             )
             window = sorted(values)
             for q in (0.5, 0.9, 0.99):
-                if window:
-                    idx = min(
-                        len(window) - 1,
-                        max(0, math.ceil(q * len(window)) - 1),
-                    )
-                    qv = window[idx]
-                else:
-                    qv = math.nan
                 qlabel = f'quantile="{q}"' + (f",{label}" if label else "")
-                lines.append(f"{base}{{{qlabel}}} {qv}")
+                lines.append(f"{base}{{{qlabel}}} {_quantile(window, q)}")
         return "\n".join(lines) + "\n"
+
+
+def _quantile(sorted_values: List[float], q: float) -> float:
+    """Single home for the quantile index arithmetic (Metrics.percentile and
+    the Prometheus exposition must never diverge)."""
+    if not sorted_values:
+        return math.nan
+    idx = min(
+        len(sorted_values) - 1, max(0, math.ceil(q * len(sorted_values)) - 1)
+    )
+    return sorted_values[idx]
 
 
 def _prom_parts(name: str):

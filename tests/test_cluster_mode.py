@@ -378,6 +378,95 @@ class TestWireRollingUpdate:
             rt.shutdown()
 
 
+class TestBaselineSamplesOverWire:
+    def test_all_baseline_samples_converge_over_http(self):
+        """Every BASELINE acceptance shape (simple, single-node
+        disaggregated, multinode disaggregated with slice packing, agentic
+        pipeline with explicit ordering) admits, schedules, and runs
+        through the real wire tier — not just the sim harness."""
+        from grove_tpu.models import BASELINE_SAMPLES
+
+        rt = start_operator()
+        try:
+            base = rt.apiserver.address
+            for name, filename in BASELINE_SAMPLES.items():
+                doc = yaml.safe_load((REPO / "samples" / filename).read_text())
+                _post(
+                    f"{base}/apis/grove.io/v1alpha1/namespaces/default/podcliquesets",
+                    doc,
+                )
+
+            def all_running():
+                gangs = _get(
+                    f"{base}/apis/scheduler.grove.io/v1alpha1/namespaces/default/podgangs"
+                )["items"]
+                if len(gangs) < len(BASELINE_SAMPLES):
+                    return False
+                # every base gang Running (one per applied set)
+                base_names = {
+                    yaml.safe_load((REPO / "samples" / f).read_text())[
+                        "metadata"
+                    ]["name"]
+                    + "-0"
+                    for f in BASELINE_SAMPLES.values()
+                }
+                running = {
+                    g["metadata"]["name"]
+                    for g in gangs
+                    if g.get("status", {}).get("phase") == "Running"
+                }
+                return base_names <= running
+
+            _converge(rt, all_running, timeout=180)
+            pods = _get(f"{base}/api/v1/namespaces/default/pods")["items"]
+            assert all(
+                any(
+                    c["type"] == "Ready" and c["status"] == "True"
+                    for c in (p.get("status", {}).get("conditions") or [])
+                )
+                for p in pods
+            )
+        finally:
+            rt.shutdown()
+
+
+class TestDebugProfile:
+    def test_profile_endpoint_samples_all_threads(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        server = APIServer(enable_profiling=True).start()
+        try:
+            out = (
+                urllib.request.urlopen(
+                    server.address + "/debug/profile?seconds=0.2", timeout=10
+                )
+                .read()
+                .decode()
+            )
+            assert out.startswith("#") and "samples over" in out
+            # malformed input is a 400, not a dropped connection
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    server.address + "/debug/profile?seconds=abc", timeout=10
+                )
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_profile_endpoint_gated_by_config(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        server = APIServer().start()  # profiling disabled by default
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    server.address + "/debug/profile?seconds=0.1", timeout=10
+                )
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+
 class TestAutoscaleOverWire:
     def test_hpa_scales_group_and_new_gang_materializes(self, runtime):
         """Multi-level autoscaling runs in cluster mode too: high observed
