@@ -5,7 +5,7 @@ capacity pressure instead of cordons where noted."""
 import pathlib
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.load import load_podcliqueset_file, load_podcliquesets
 from grove_tpu.api.pod import is_ready, is_scheduled
 from grove_tpu.api.types import TopologyConstraint
 from grove_tpu.sim.harness import SimHarness
@@ -320,3 +320,104 @@ class TestPlacementScore:
         assert pcs.status.pod_gang_statuses
         gang = harness.store.get("PodGang", "default", "simple1-0")
         assert gang.status.placement_score is not None
+
+
+class TestStagedCapacityRelease:
+    def test_progressive_uncordon_admits_base_then_scaled(self):
+        """GS-12 analogue (reference e2e gang_scheduling_test.go:1174-1188):
+        two PCS replicas with a scaling group scaled to 3, everything
+        pending under cordons; capacity released in stages must admit the
+        BASE gangs of both replicas first (min-available), then the scaled
+        gangs, each stage all-or-nothing."""
+        text = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata: {name: wl}
+spec:
+  replicas: 2
+  template:
+    cliques:
+      - name: router
+        spec:
+          roleName: router
+          replicas: 1
+          podSpec:
+            containers:
+              - name: c
+                image: busybox:stable
+                resources: {requests: {cpu: "1"}}
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 2
+          podSpec:
+            containers:
+              - name: c
+                image: busybox:stable
+                resources: {requests: {cpu: "1"}}
+    podCliqueScalingGroups:
+      - name: sg
+        cliqueNames: [worker]
+        replicas: 3
+        minAvailable: 1
+"""
+        h = SimHarness(num_nodes=28)
+        for n in h.cluster.nodes:
+            n.capacity = {"cpu": 1.0}  # exactly one pod per node (e2e trick)
+            n.cordoned = True
+
+        h.apply(load_podcliquesets(text)[0])
+        h.converge()
+        pods = h.store.list("Pod")
+        # 2 replicas x (1 router + 3 sg replicas x 2 workers) = 14 pods
+        assert len(pods) == 14
+        assert not any(is_scheduled(p) for p in pods), h.tree()
+
+        def uncordon(n):
+            for node in h.cluster.nodes:
+                if node.cordoned and n > 0:
+                    node.cordoned = False
+                    n -= 1
+
+        # stage 1: capacity for both BASE gangs only (router + minAvailable
+        # sg replica = 3 pods each)
+        uncordon(6)
+        h.converge()
+        scheduled = [p for p in h.store.list("Pod") if is_scheduled(p)]
+        assert len(scheduled) == 6, h.tree()
+        for p in scheduled:
+            idx = p.metadata.labels[namegen.LABEL_PCSG_REPLICA_INDEX] if (
+                namegen.LABEL_PCSG_REPLICA_INDEX in p.metadata.labels
+            ) else "0"
+            assert idx == "0", (
+                "a scaled replica scheduled before capacity allowed"
+            )
+        assert all(is_ready(p) for p in scheduled)
+
+        def assert_admitted_gangs_complete():
+            """All-or-nothing at every stage: any gang with a scheduled pod
+            must have ALL its pods scheduled (no partial gang admission)."""
+            by_gang = {}
+            for p in h.store.list("Pod"):
+                by_gang.setdefault(
+                    p.metadata.labels[namegen.LABEL_PODGANG], []
+                ).append(is_scheduled(p))
+            for gang, states in by_gang.items():
+                if any(states):
+                    assert all(states), f"gang {gang} partially admitted"
+
+        assert_admitted_gangs_complete()
+
+        # stage 2: room for half the scaled gangs (2 pods each, 4 gangs)
+        uncordon(4)
+        h.converge()
+        scheduled = [p for p in h.store.list("Pod") if is_scheduled(p)]
+        assert len(scheduled) == 10, h.tree()
+        assert_admitted_gangs_complete()
+
+        # stage 3: everything fits
+        uncordon(4)
+        h.converge()
+        pods = h.store.list("Pod")
+        assert all(is_scheduled(p) and is_ready(p) for p in pods), h.tree()
+        assert len(pods) == 14
