@@ -102,18 +102,48 @@ def _cmd_tree(args) -> int:
 
 
 def _cmd_get(args) -> int:
-    _ensure_backend()
     import yaml
 
     from grove_tpu.api.serialize import export_object
-    from grove_tpu.sim.harness import SimHarness
 
-    harness = SimHarness(num_nodes=args.nodes)
-    for path in args.manifests:
-        with open(path) as f:
-            harness.apply_yaml(f.read())
-    harness.converge()
-    objs = harness.store.list(args.kind)
+    if args.apiserver and args.manifests:
+        print(
+            "get: --apiserver reads live objects; manifests are not applied"
+            " (POST them to the apiserver instead)",
+            file=sys.stderr,
+        )
+        return 2
+    if not args.apiserver and not args.manifests:
+        print(
+            "get: provide manifests to simulate, or --apiserver URL to read"
+            " a live cluster",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.apiserver:
+        # kubectl-style read against a LIVE apiserver (no sim, no jax)
+        from grove_tpu.cluster.client import HttpStore
+        from grove_tpu.runtime.errors import GroveError
+
+        try:
+            objs = HttpStore(args.apiserver).list(
+                args.kind, args.namespace or None
+            )
+        except GroveError as e:
+            print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
+            return 1
+    else:
+        _ensure_backend()
+        from grove_tpu.sim.harness import SimHarness
+
+        harness = SimHarness(num_nodes=args.nodes)
+        for path in args.manifests:
+            with open(path) as f:
+                harness.apply_yaml(f.read())
+        harness.converge()
+        objs = harness.store.list(args.kind, args.namespace or None)
+
     if not objs:
         print(f"no {args.kind} objects", file=sys.stderr)
         return 1
@@ -230,11 +260,17 @@ def main(argv: List[str] | None = None) -> int:
     p.set_defaults(fn=_cmd_tree)
 
     p = sub.add_parser(
-        "get", help="apply manifests, then export live objects as YAML"
+        "get",
+        help=(
+            "export live objects as YAML — from a real apiserver"
+            " (--apiserver URL) or after applying manifests to a sim"
+        ),
     )
-    p.add_argument("manifests", nargs="+")
+    p.add_argument("manifests", nargs="*")
     p.add_argument("--kind", default="PodGang")
     p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="read from a live apiserver instead")
+    p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_get)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
