@@ -121,17 +121,28 @@ def _cmd_get(args) -> int:
         )
         return 2
 
+    from grove_tpu.api.wire import KIND_REGISTRY
+
+    if args.kind not in KIND_REGISTRY:
+        print(
+            f"get: unknown kind {args.kind!r} (known:"
+            f" {', '.join(sorted(KIND_REGISTRY))})",
+            file=sys.stderr,
+        )
+        return 2
+
     if args.apiserver:
         # kubectl-style read against a LIVE apiserver (no sim, no jax)
         from grove_tpu.cluster.client import HttpStore
         from grove_tpu.runtime.errors import GroveError
 
+        url = args.apiserver
+        if "://" not in url:
+            url = f"http://{url}"  # kubectl-style bare host:port
         try:
-            objs = HttpStore(args.apiserver).list(
-                args.kind, args.namespace or None
-            )
+            objs = HttpStore(url).list(args.kind, args.namespace)
         except GroveError as e:
-            print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
+            print(f"get: {url}: {e.message}", file=sys.stderr)
             return 1
     else:
         _ensure_backend()
@@ -142,7 +153,7 @@ def _cmd_get(args) -> int:
             with open(path) as f:
                 harness.apply_yaml(f.read())
         harness.converge()
-        objs = harness.store.list(args.kind, args.namespace or None)
+        objs = harness.store.list(args.kind, args.namespace)
 
     if not objs:
         print(f"no {args.kind} objects", file=sys.stderr)
@@ -270,7 +281,11 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--kind", default="PodGang")
     p.add_argument("--nodes", type=int, default=32)
     p.add_argument("--apiserver", help="read from a live apiserver instead")
-    p.add_argument("--namespace", default="default")
+    p.add_argument(
+        "--namespace",
+        default=None,
+        help="filter to one namespace (default: all namespaces)",
+    )
     p.set_defaults(fn=_cmd_get)
 
     p = sub.add_parser("bench", help="run the stress benchmark")

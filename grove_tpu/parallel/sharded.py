@@ -125,7 +125,9 @@ def solve_stress_sharded(
     from grove_tpu.solver.kernel import pad_problem_for_waves
 
     g = problem.num_gangs
-    raw_args, n_chunks, grouped = pad_problem_for_waves(problem, chunk_size)
+    raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(
+        problem, chunk_size
+    )
     node_sh = NamedSharding(mesh, P("tp", None))
     rep = NamedSharding(mesh, P())
     # capacity and topo carry the node axis (sharded); everything else
@@ -137,7 +139,11 @@ def solve_stress_sharded(
     ]
     with mesh:
         out = solve_waves_device(
-            *placed, n_chunks=n_chunks, max_waves=max_waves, grouped=grouped
+            *placed,
+            n_chunks=n_chunks,
+            max_waves=max_waves,
+            grouped=grouped,
+            pinned=pinned,
         )
     return {
         "admitted": np.asarray(out["admitted"])[:g],

@@ -25,13 +25,17 @@ from grove_tpu.solver.types import PackingProblem, PackingResult
 _compiled_cache: Dict[Tuple, object] = {}
 
 
-def _get_compiled(args, with_alloc: bool, grouped: bool):
-    sig = tuple((a.shape, str(a.dtype)) for a in args) + (with_alloc, grouped)
+def _get_compiled(args, with_alloc: bool, grouped: bool, pinned: bool):
+    sig = tuple((a.shape, str(a.dtype)) for a in args) + (
+        with_alloc,
+        grouped,
+        pinned,
+    )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         t0 = time.perf_counter()
         compiled = solve_packing.lower(
-            *args, with_alloc=with_alloc, grouped=grouped
+            *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
@@ -54,7 +58,8 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         jnp.asarray(problem.gang_pin),
     )
     grouped = bool((problem.group_req >= 0).any())
-    compiled = _get_compiled(args, with_alloc, grouped)
+    pinned = bool((problem.gang_pin >= 0).any())
+    compiled = _get_compiled(args, with_alloc, grouped, pinned)
     t0 = time.perf_counter()
     out = compiled(*args)
     admitted = np.asarray(out["admitted"])  # device sync
@@ -126,6 +131,7 @@ def solve_waves(
     )
 
     grouped = bool((problem.group_req >= 0).any())
+    pinned = bool((problem.gang_pin >= 0).any())
     # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
     # change between waves; re-uploading per wave would pay the remote-link
     # latency this path exists to avoid)
@@ -175,6 +181,7 @@ def solve_waves(
                 group_pin=gpin_c,
                 gang_pin=gangpin_c,
                 grouped=grouped,
+                pinned=pinned,
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -213,14 +220,14 @@ def solve_waves(
 
 def pad_problem_for_waves(
     problem: PackingProblem, chunk_size: int
-) -> Tuple[Tuple[np.ndarray, ...], int, bool]:
+) -> Tuple[Tuple[np.ndarray, ...], int, bool, bool]:
     """SINGLE home for the wave solver's input-prep contract: clamp the
     chunk size, pad the gang axis to a chunk multiple (sentinel -1 for the
-    level/pin fields, 0 elsewhere), and decide the `grouped` compile flag.
-    Returns (args, n_chunks, grouped) where args is the positional tuple of
-    solve_waves_device. Shared by the stats path, the node-sharded
-    multi-chip path, and the parity tests — a padding-contract change lands
-    exactly once."""
+    level/pin fields, 0 elsewhere), and decide the `grouped`/`pinned`
+    compile flags. Returns (args, n_chunks, grouped, pinned) where args is
+    the positional tuple of solve_waves_device. Shared by the stats path,
+    the node-sharded multi-chip path, and the parity tests — a
+    padding-contract change lands exactly once."""
     g = problem.num_gangs
     chunk_size = min(chunk_size, max(g, 1))
     n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
@@ -247,7 +254,8 @@ def pad_problem_for_waves(
         pad(problem.gang_pin, -1),
     )
     grouped = bool((problem.group_req >= 0).any())
-    return args, n_chunks, grouped
+    pinned = bool((problem.gang_pin >= 0).any())
+    return args, n_chunks, grouped, pinned
 
 
 def solve_waves_stats(
@@ -259,18 +267,25 @@ def solve_waves_stats(
     multi-wave loop runs as one XLA program — the stress-bench path. Returns
     stats only (no per-pod alloc); use solve_waves/solve for binding."""
     g = problem.num_gangs
-    raw_args, n_chunks, grouped = pad_problem_for_waves(problem, chunk_size)
+    raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(
+        problem, chunk_size
+    )
     args = tuple(jnp.asarray(a) for a in raw_args)
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         n_chunks,
         max_waves,
         grouped,
+        pinned,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         t0 = time.perf_counter()
         compiled = solve_waves_device.lower(
-            *args, n_chunks=n_chunks, max_waves=max_waves, grouped=grouped
+            *args,
+            n_chunks=n_chunks,
+            max_waves=max_waves,
+            grouped=grouped,
+            pinned=pinned,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled

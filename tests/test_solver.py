@@ -385,12 +385,13 @@ class TestMultiChip:
         from grove_tpu.solver.kernel import pad_problem_for_waves
 
         g = problem.num_gangs
-        raw_args, n_chunks, grouped = pad_problem_for_waves(problem, 128)
+        raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(problem, 128)
         out = solve_waves_device(
             *[jnp.asarray(a) for a in raw_args],
             n_chunks=n_chunks,
             max_waves=16,
             grouped=grouped,
+            pinned=pinned,
         )
         np.testing.assert_array_equal(
             sharded["admitted"], np.asarray(out["admitted"])[:g]
