@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Dev-only perf probe: timing distribution of the full-size stress solve.
+
+Prints one line per run (unbuffered) so a killed process still shows the
+distribution so far. Not part of the driver contract (bench.py is).
+
+Usage: python -u scripts/perf_probe.py [--runs N] [--chunk C] [--waves W]
+       [--nodes N] [--gangs G]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=15)
+    ap.add_argument("--chunk", type=int, default=128)
+    ap.add_argument("--waves", type=int, default=16)
+    ap.add_argument("--nodes", type=int, default=5120)
+    ap.add_argument("--gangs", type=int, default=10240)
+    args = ap.parse_args()
+
+    from grove_tpu.models import build_stress_problem
+    from grove_tpu.observability.metrics import METRICS
+    from grove_tpu.solver.kernel import solve_waves_stats
+
+    import jax
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}", flush=True)
+    problem = build_stress_problem(args.nodes, args.gangs)
+
+    t0 = time.perf_counter()
+    r = solve_waves_stats(problem, chunk_size=args.chunk, max_waves=args.waves)
+    print(f"warmup(total incl compile): {time.perf_counter() - t0:.1f}s", flush=True)
+
+    times = []
+    for i in range(args.runs):
+        r = solve_waves_stats(problem, chunk_size=args.chunk, max_waves=args.waves)
+        times.append(r.solve_seconds)
+        print(
+            f"run {i}: {r.solve_seconds:.4f}s waves={METRICS.gauges.get('gang_solve_waves')}"
+            f" tail={METRICS.gauges.get('gang_solve_tail', 0)}"
+            f" admitted={int(r.admitted.sum())} score={float(r.score.sum()):.1f}",
+            flush=True,
+        )
+    ts = np.sort(np.array(times))
+    print(
+        f"min={ts[0]:.4f} median={np.median(ts):.4f} mean={ts.mean():.4f}"
+        f" max={ts[-1]:.4f} p99~max over {len(ts)} runs",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

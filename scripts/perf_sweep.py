@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Dev-only: sweep wave-solver configs at full stress size on the live chip.
+
+For each (chunk_size, max_waves) config: timed runs + quality vs the exact
+oracle. Prints one line per run (unbuffered) and a summary per config.
+
+Usage: python -u scripts/perf_sweep.py [--runs N] [--configs 128:16,256:16,...]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=12)
+    ap.add_argument("--configs", default="128:16,256:16,512:16,64:24")
+    ap.add_argument("--nodes", type=int, default=5120)
+    ap.add_argument("--gangs", type=int, default=10240)
+    args = ap.parse_args()
+
+    from grove_tpu.models import build_stress_problem
+    from grove_tpu.observability.metrics import METRICS
+    from grove_tpu.solver.kernel import solve, solve_waves_stats
+
+    import jax
+
+    print(f"backend={jax.default_backend()}", flush=True)
+    problem = build_stress_problem(args.nodes, args.gangs)
+
+    t0 = time.perf_counter()
+    exact = solve(problem, with_alloc=False)
+    print(f"exact oracle: {time.perf_counter() - t0:.1f}s incl compile,"
+          f" score={float(exact.score.sum()):.1f}", flush=True)
+    exact_score = float(exact.score.sum())
+
+    for cfg in args.configs.split(","):
+        chunk, waves = (int(x) for x in cfg.split(":"))
+        t0 = time.perf_counter()
+        r = solve_waves_stats(problem, chunk_size=chunk, max_waves=waves)
+        r = solve_waves_stats(problem, chunk_size=chunk, max_waves=waves)
+        print(f"[{cfg}] warmup x2: {time.perf_counter() - t0:.1f}s", flush=True)
+        times = []
+        for i in range(args.runs):
+            r = solve_waves_stats(problem, chunk_size=chunk, max_waves=waves)
+            times.append(r.solve_seconds)
+            print(
+                f"[{cfg}] run {i}: {r.solve_seconds:.4f}s"
+                f" waves={METRICS.gauges.get('gang_solve_waves')}"
+                f" tail={METRICS.gauges.get('gang_solve_tail', 0)}",
+                flush=True,
+            )
+        ts = np.sort(np.array(times))
+        q = float(r.score.sum()) / exact_score if exact_score else 1.0
+        print(
+            f"[{cfg}] SUMMARY min={ts[0]:.4f} med={np.median(ts):.4f}"
+            f" max={ts[-1]:.4f} admitted={int(r.admitted.sum())}"
+            f" quality={q:.4f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Dev-only: poll TPU liveness every 3 minutes, append to /tmp/tpu_watch.log
+while true; do
+  if timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))
+jax.block_until_ready(x)
+assert jax.default_backend() != "cpu"
+EOF
+  then
+    echo "$(date +%H:%M:%S) UP" >> /tmp/tpu_watch.log
+  else
+    echo "$(date +%H:%M:%S) DOWN" >> /tmp/tpu_watch.log
+  fi
+  sleep 180
+done
