@@ -58,6 +58,7 @@ def global_solver_mesh():
 
 _WORKER_SNIPPET = """
 import os
+import numpy as np
 import jax
 from grove_tpu.parallel import multihost
 multihost.initialize(
@@ -73,7 +74,26 @@ from jax.experimental import multihost_utils
 x = jnp.ones((4,)) * (int(os.environ["PID_IDX"]) + 1)
 gathered = multihost_utils.process_allgather(x)
 assert gathered.shape[0] == int(os.environ["NPROC"]), gathered.shape
-print("MULTIHOST_OK", mesh.axis_names, tuple(mesh.devices.shape))
+
+# the flagship path across PROCESS boundaries: one placement problem whose
+# node axis is sharded over every process's devices (every process feeds
+# the same global arrays; XLA partitions the wave loop over the mesh) —
+# admissions must be bit-identical to this process's local single-device
+# solve, proving sharding never changes semantics across hosts either
+from jax.sharding import Mesh
+from grove_tpu.models import build_stress_problem
+from grove_tpu.parallel.sharded import solve_stress_sharded
+problem = build_stress_problem(16 * mesh.devices.size, 32)
+sharded = solve_stress_sharded(mesh, problem, chunk_size=16, max_waves=8)
+local_mesh = Mesh(
+    np.array(jax.local_devices()[:1]).reshape(1, 1), ("dp", "tp")
+)
+local = solve_stress_sharded(local_mesh, problem, chunk_size=16, max_waves=8)
+assert sharded["admitted"].any(), "cross-process solve placed nothing"
+np.testing.assert_array_equal(sharded["admitted"], local["admitted"])
+np.testing.assert_array_equal(sharded["placed"], local["placed"])
+print("MULTIHOST_OK", mesh.axis_names, tuple(mesh.devices.shape),
+      int(sharded["admitted"].sum()), "/", len(sharded["admitted"]))
 """
 
 

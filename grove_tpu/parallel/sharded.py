@@ -145,14 +145,26 @@ def solve_stress_sharded(
             grouped=grouped,
             pinned=pinned,
         )
+
+    if jax.process_count() > 1:
+        # outputs may span devices owned by OTHER processes (multi-host
+        # mesh): reshard the whole output pytree to fully-replicated in ONE
+        # program, then read the local replica of each leaf
+        replicated = jax.jit(
+            lambda t: t, out_shardings=NamedSharding(mesh, P())
+        )(out)
+        fetch = lambda x: np.asarray(x.addressable_data(0))
+        out = {k: replicated[k] for k in out}
+    else:
+        fetch = np.asarray
     return {
-        "admitted": np.asarray(out["admitted"])[:g],
-        "placed": np.asarray(out["placed"])[:g],
-        "score": np.asarray(out["score"])[:g],
-        "chosen_level": np.asarray(out["chosen_level"])[:g],
-        "free_after": np.asarray(out["free_after"]),
-        "pending": np.asarray(out["pending"])[:g],
-        "waves": int(np.asarray(out["waves"])),
+        "admitted": fetch(out["admitted"])[:g],
+        "placed": fetch(out["placed"])[:g],
+        "score": fetch(out["score"])[:g],
+        "chosen_level": fetch(out["chosen_level"])[:g],
+        "free_after": fetch(out["free_after"]),
+        "pending": fetch(out["pending"])[:g],
+        "waves": int(fetch(out["waves"])),
     }
 
 
