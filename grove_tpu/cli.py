@@ -45,6 +45,8 @@ def _cmd_validate(args) -> int:
 
 
 def _cmd_apply(args) -> int:
+    if args.apiserver:
+        return _wire_apply(args)
     _ensure_backend()
     from grove_tpu.sim.harness import SimHarness
 
@@ -57,6 +59,159 @@ def _cmd_apply(args) -> int:
     print(f"converged in {ticks} virtual ticks (t={harness.clock.now():.0f}s)\n")
     print(harness.tree(), end="")
     return 0
+
+
+def _wire_client(url: str):
+    from grove_tpu.cluster.client import HttpStore
+
+    if "://" not in url:
+        url = f"http://{url}"  # kubectl-style bare host:port
+    return HttpStore(url)
+
+
+def _wire_apply(args) -> int:
+    """kubectl-style create-or-update against a LIVE apiserver: POST each
+    manifest document; on 409 re-read the live object, carry its
+    resourceVersion + finalizers, and PUT the new spec (the server's
+    mutating/validating webhooks run on both paths)."""
+    import yaml
+
+    from grove_tpu.api.wire import decode_object
+    from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
+
+    store = _wire_client(args.apiserver)
+    failed = 0
+    for path in args.manifests:
+        try:
+            with open(path) as f:
+                docs = [d for d in yaml.safe_load_all(f.read()) if d]
+        except (OSError, yaml.YAMLError) as exc:
+            print(f"{path}: LOAD ERROR: {exc}", file=sys.stderr)
+            failed += 1
+            continue
+        for doc in docs:
+            # kubectl -n semantics: the flag names the namespace for
+            # manifests that don't carry one (decode_object would otherwise
+            # default it before the CLI could tell the difference); tolerate
+            # an explicit `metadata:` null the way decode_object does
+            if isinstance(doc, dict):
+                meta = doc.get("metadata") or {}
+                meta.setdefault("namespace", args.namespace)
+                doc["metadata"] = meta
+            try:
+                obj = decode_object(doc)
+            except Exception as exc:
+                print(f"{path}: DECODE ERROR: {exc}", file=sys.stderr)
+                failed += 1
+                continue
+            try:
+                created = store.create(obj)
+                print(f"{obj.kind.lower()}/{created.metadata.name} created")
+            except GroveError as e:
+                if e.code != ERR_CONFLICT:
+                    print(
+                        f"{path}: {obj.metadata.name}: {e.message}",
+                        file=sys.stderr,
+                    )
+                    failed += 1
+                    continue
+
+                # create-or-update: graft the manifest's desired state onto
+                # whatever is live NOW, re-applied per conflict retry so a
+                # racing writer is never clobbered
+                def configure(live, manifest=obj):
+                    live.spec = manifest.spec
+                    live.metadata.labels = manifest.metadata.labels
+                    live.metadata.annotations = manifest.metadata.annotations
+
+                try:
+                    updated = store.read_modify_write(
+                        obj.kind,
+                        obj.metadata.namespace,
+                        obj.metadata.name,
+                        configure,
+                    )
+                except GroveError as e2:
+                    print(
+                        f"{path}: {obj.metadata.name}: {e2.message}",
+                        file=sys.stderr,
+                    )
+                    failed += 1
+                    continue
+                if updated is None:
+                    print(
+                        f"{path}: {obj.metadata.name}: conflict but object"
+                        " not found",
+                        file=sys.stderr,
+                    )
+                    failed += 1
+                else:
+                    print(
+                        f"{obj.kind.lower()}/{obj.metadata.name} configured"
+                    )
+    return 1 if failed else 0
+
+
+def _cmd_delete(args) -> int:
+    """kubectl-style delete against a live apiserver (finalizers drain
+    server-side; the controllers' delete flows run as in-cluster)."""
+    from grove_tpu.runtime.errors import GroveError
+
+    store = _wire_client(args.apiserver)
+    failed = 0
+    for name in args.names:
+        try:
+            store.delete(args.kind, args.namespace, name)
+            print(f"{args.kind.lower()}/{name} deleted")
+        except GroveError as e:
+            print(f"delete {name}: {e.message}", file=sys.stderr)
+            failed += 1
+    return 1 if failed else 0
+
+
+def _cmd_scale(args) -> int:
+    """kubectl-style scale for PodCliqueSet / PodCliqueScalingGroup /
+    PodClique replicas via read-modify-write on the live apiserver (the
+    validation webhook enforces minAvailable and immutability rules; the
+    mutation is re-applied per conflict retry so racing writers are never
+    clobbered)."""
+    from grove_tpu.runtime.errors import GroveError
+
+    store = _wire_client(args.apiserver)
+    seen = {}
+
+    def set_replicas(live):
+        spec = getattr(live, "spec", None)
+        if spec is None or not hasattr(spec, "replicas"):
+            raise _NotScalable(args.kind)
+        seen["old"] = spec.replicas
+        spec.replicas = args.replicas
+
+    try:
+        updated = store.read_modify_write(
+            args.kind, args.namespace, args.name, set_replicas
+        )
+    except _NotScalable:
+        print(f"scale: kind {args.kind} is not scalable", file=sys.stderr)
+        return 1
+    except GroveError as e:
+        print(f"scale {args.name}: {e.message}", file=sys.stderr)
+        return 1
+    if updated is None:
+        print(
+            f"scale: {args.kind.lower()}/{args.name} not found",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"{args.kind.lower()}/{args.name} scaled: replicas {seen['old']} ->"
+        f" {args.replicas}"
+    )
+    return 0
+
+
+class _NotScalable(Exception):
+    pass
 
 
 def _cmd_tree(args) -> int:
@@ -133,16 +288,12 @@ def _cmd_get(args) -> int:
 
     if args.apiserver:
         # kubectl-style read against a LIVE apiserver (no sim, no jax)
-        from grove_tpu.cluster.client import HttpStore
         from grove_tpu.runtime.errors import GroveError
 
-        url = args.apiserver
-        if "://" not in url:
-            url = f"http://{url}"  # kubectl-style bare host:port
         try:
-            objs = HttpStore(url).list(args.kind, args.namespace)
+            objs = _wire_client(args.apiserver).list(args.kind, args.namespace)
         except GroveError as e:
-            print(f"get: {url}: {e.message}", file=sys.stderr)
+            print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
             return 1
     else:
         _ensure_backend()
@@ -259,10 +410,35 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("manifests", nargs="+")
     p.set_defaults(fn=_cmd_validate)
 
-    p = sub.add_parser("apply", help="apply to the simulated control plane")
+    p = sub.add_parser(
+        "apply",
+        help=(
+            "apply manifests — to the simulated control plane, or to a live"
+            " apiserver with --apiserver URL (create-or-update)"
+        ),
+    )
     p.add_argument("manifests", nargs="+")
     p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="apply to a live apiserver instead")
+    p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_apply)
+
+    p = sub.add_parser("delete", help="delete objects on a live apiserver")
+    p.add_argument("names", nargs="+")
+    p.add_argument("--apiserver", required=True)
+    p.add_argument("--kind", default="PodCliqueSet")
+    p.add_argument("--namespace", default="default")
+    p.set_defaults(fn=_cmd_delete)
+
+    p = sub.add_parser(
+        "scale", help="set replicas on a live apiserver (read-modify-write)"
+    )
+    p.add_argument("name")
+    p.add_argument("--replicas", type=int, required=True)
+    p.add_argument("--apiserver", required=True)
+    p.add_argument("--kind", default="PodCliqueSet")
+    p.add_argument("--namespace", default="default")
+    p.set_defaults(fn=_cmd_scale)
 
     p = sub.add_parser("tree", help="apply + optional scale + dump tree")
     p.add_argument("manifests", nargs="+")

@@ -250,31 +250,47 @@ class HttpStore:
             "DELETE", self._path(kind, namespace, name), operation="delete"
         )
 
-    def remove_finalizer(
-        self, kind: str, namespace: str, name: str, finalizer: str
-    ) -> None:
-        """Client-side finalizer drain: read-modify-write with conflict
-        retry; the server completes the deletion when the list empties."""
-        for _ in range(8):
+    def read_modify_write(
+        self, kind: str, namespace: str, name: str, mutate, attempts: int = 8
+    ):
+        """Optimistic-concurrency write loop: GET the LIVE object, apply
+        `mutate(obj)` (edit in place; return False to skip the write), PUT,
+        and retry from a fresh read on 409 — so a racing writer's changes
+        are never clobbered (the mutation is re-applied to their version,
+        kubectl-style). Returns the updated object, or None if the object
+        does not exist / disappeared mid-loop."""
+        for _ in range(attempts):
             obj = self.get(kind, namespace, name)
             if obj is None:
-                return
-            if finalizer not in obj.metadata.finalizers:
-                return
-            obj.metadata.finalizers = [
-                f for f in obj.metadata.finalizers if f != finalizer
-            ]
+                return None
+            if mutate(obj) is False:
+                return obj
             try:
-                self.update(obj)
-                return
+                return self.update(obj)
             except GroveError as e:
                 if e.code != ERR_CONFLICT:
                     raise
         raise GroveError(
             ERR_CONFLICT,
-            f"{kind} {namespace}/{name}: finalizer drain kept conflicting",
-            "remove_finalizer",
+            f"{kind} {namespace}/{name}: write kept conflicting after"
+            f" {attempts} attempts",
+            "read_modify_write",
         )
+
+    def remove_finalizer(
+        self, kind: str, namespace: str, name: str, finalizer: str
+    ) -> None:
+        """Client-side finalizer drain: the server completes the deletion
+        when the list empties."""
+
+        def drop(obj):
+            if finalizer not in obj.metadata.finalizers:
+                return False
+            obj.metadata.finalizers = [
+                f for f in obj.metadata.finalizers if f != finalizer
+            ]
+
+        self.read_modify_write(kind, namespace, name, drop)
 
     def delete_collection(
         self,
