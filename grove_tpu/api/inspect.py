@@ -1,0 +1,47 @@
+"""kubectl-tree-style resource rendering, store-agnostic.
+
+Works over any store exposing ``list(kind, namespace, label_selector)`` —
+the in-memory sim store and the live-apiserver HTTP client alike — so the
+same tree the quickstart shows (pcs > pclq/pcsg > pg > pod; reference
+README.md:26) renders for both tiers.
+"""
+
+from __future__ import annotations
+
+import io
+
+from grove_tpu.api import names as namegen
+
+
+def render_tree(store, namespace: str = "default") -> str:
+    out = io.StringIO()
+    for pcs in store.list("PodCliqueSet", namespace):
+        out.write(f"pcs/{pcs.metadata.name}\n")
+        sel = namegen.default_labels(pcs.metadata.name)
+        for pcsg in store.list("PodCliqueScalingGroup", namespace, sel):
+            st = pcsg.status
+            out.write(
+                f"  pcsg/{pcsg.metadata.name} replicas={pcsg.spec.replicas}"
+                f" scheduled={st.scheduled_replicas}"
+                f" available={st.available_replicas}\n"
+            )
+        for pclq in store.list("PodClique", namespace, sel):
+            st = pclq.status
+            out.write(
+                f"  pclq/{pclq.metadata.name} replicas={st.replicas}"
+                f" ready={st.ready_replicas} scheduled={st.scheduled_replicas}\n"
+            )
+        for pg in store.list("PodGang", namespace, sel):
+            groups = ", ".join(
+                f"{g.name}(min={g.min_replicas},pods={len(g.pod_references)})"
+                for g in pg.spec.pod_groups
+            )
+            out.write(f"  pg/{pg.metadata.name} [{groups}]\n")
+        for pod in store.list("Pod", namespace, sel):
+            gates = "gated" if pod.spec.scheduling_gates else "ungated"
+            node = pod.status.node_name or "-"
+            out.write(
+                f"    pod/{pod.metadata.name} {pod.status.phase} {gates}"
+                f" node={node}\n"
+            )
+    return out.getvalue()

@@ -215,6 +215,34 @@ class _NotScalable(Exception):
 
 
 def _cmd_tree(args) -> int:
+    if args.apiserver:
+        # live-cluster tree: pure reads, no sim, no jax
+        from grove_tpu.api.inspect import render_tree
+        from grove_tpu.runtime.errors import GroveError
+
+        if args.manifests or args.scale:
+            print(
+                "tree: --apiserver renders live objects; manifests/--scale"
+                " do not apply (use apply/scale verbs instead)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            print(
+                render_tree(_wire_client(args.apiserver), args.namespace),
+                end="",
+            )
+        except GroveError as e:
+            print(f"tree: {args.apiserver}: {e.message}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.manifests:
+        print(
+            "tree: provide manifests to simulate, or --apiserver URL to"
+            " render a live cluster",
+            file=sys.stderr,
+        )
+        return 2
     _ensure_backend()
     from grove_tpu.sim.harness import SimHarness
 
@@ -252,7 +280,7 @@ def _cmd_tree(args) -> int:
             pcsg.spec.replicas = replicas
             harness.store.update(pcsg)
     harness.converge()
-    print(harness.tree(), end="")
+    print(harness.tree(args.namespace), end="")
     return 0
 
 
@@ -440,10 +468,18 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_scale)
 
-    p = sub.add_parser("tree", help="apply + optional scale + dump tree")
-    p.add_argument("manifests", nargs="+")
+    p = sub.add_parser(
+        "tree",
+        help=(
+            "dump the pcs>pclq/pcsg>pg>pod tree — simulated (apply"
+            " manifests first) or live with --apiserver URL"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
     p.add_argument("--nodes", type=int, default=32)
     p.add_argument("--scale", action="append", metavar="GROUP=REPLICAS")
+    p.add_argument("--apiserver", help="render a live apiserver instead")
+    p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_tree)
 
     p = sub.add_parser(

@@ -6,7 +6,6 @@ The end-to-end driver mirroring the reference quickstart flow
 
 from __future__ import annotations
 
-import io
 from typing import List, Optional
 
 from grove_tpu.admission.defaulting import default_podcliqueset
@@ -15,7 +14,6 @@ from grove_tpu.admission.validation import (
     validate_or_raise,
     validate_podcliqueset_update,
 )
-from grove_tpu.api import names as namegen
 from grove_tpu.api.load import load_podcliquesets
 from grove_tpu.api.topology import ClusterTopology
 from grove_tpu.api.types import PodCliqueSet
@@ -158,32 +156,6 @@ class SimHarness:
 
     def tree(self, namespace: str = "default") -> str:
         """kubectl-tree-style dump: pcs > pclq/pcsg > pg > pod."""
-        out = io.StringIO()
-        for pcs in self.store.list("PodCliqueSet", namespace):
-            out.write(f"pcs/{pcs.metadata.name}\n")
-            sel = namegen.default_labels(pcs.metadata.name)
-            for pcsg in self.store.list("PodCliqueScalingGroup", namespace, sel):
-                st = pcsg.status
-                out.write(
-                    f"  pcsg/{pcsg.metadata.name} replicas={pcsg.spec.replicas}"
-                    f" scheduled={st.scheduled_replicas} available={st.available_replicas}\n"
-                )
-            for pclq in self.store.list("PodClique", namespace, sel):
-                st = pclq.status
-                out.write(
-                    f"  pclq/{pclq.metadata.name} replicas={st.replicas}"
-                    f" ready={st.ready_replicas} scheduled={st.scheduled_replicas}\n"
-                )
-            for pg in self.store.list("PodGang", namespace, sel):
-                groups = ", ".join(
-                    f"{g.name}(min={g.min_replicas},pods={len(g.pod_references)})"
-                    for g in pg.spec.pod_groups
-                )
-                out.write(f"  pg/{pg.metadata.name} [{groups}]\n")
-            for pod in self.store.list("Pod", namespace, sel):
-                gates = "gated" if pod.spec.scheduling_gates else "ungated"
-                node = pod.status.node_name or "-"
-                out.write(
-                    f"    pod/{pod.metadata.name} {pod.status.phase} {gates} node={node}\n"
-                )
-        return out.getvalue()
+        from grove_tpu.api.inspect import render_tree
+
+        return render_tree(self.store, namespace)

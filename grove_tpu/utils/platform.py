@@ -117,14 +117,34 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
     """
     import jax
 
-    cache = path or os.environ.get(
-        "GROVE_TPU_COMPILE_CACHE",
-        os.path.join(
-            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-            "grove_tpu",
-            "jax_cache",
-        ),
-    )
+    if path is None:
+        # partition by (platform pin, XLA flags): executables AOT-compiled
+        # under one host config can load under another with alarming
+        # machine-feature warnings (e.g. the virtual-8-device test config vs
+        # a plain CPU process) — never share cache entries across configs
+        import hashlib
+
+        config_token = hashlib.md5(
+            (
+                os.environ.get("JAX_PLATFORMS", "auto")
+                + "|"
+                + os.environ.get("XLA_FLAGS", "")
+            ).encode()
+        ).hexdigest()[:8]
+        # GROVE_TPU_COMPILE_CACHE names the cache ROOT; the per-config
+        # partition applies underneath it too, so a shared CI cache dir can
+        # still never mix configs
+        root = os.environ.get(
+            "GROVE_TPU_COMPILE_CACHE",
+            os.path.join(
+                os.environ.get(
+                    "XDG_CACHE_HOME", os.path.expanduser("~/.cache")
+                ),
+                "grove_tpu",
+            ),
+        )
+        path = os.path.join(root, f"jax_cache-{config_token}")
+    cache = path
     os.makedirs(cache, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache)
     # default min compile time is 1s; the wave program is minutes, but cache

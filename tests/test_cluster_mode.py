@@ -646,6 +646,12 @@ class TestKubectlVerbs:
                 g["metadata"]["name"] == "simple1-1" for g in gangs()
             ))
 
+            # live tree renders the whole hierarchy over the wire
+            assert cli_main(["tree", "--apiserver", base]) == 0
+            tree_out = capsys.readouterr().out
+            assert "pcs/simple1" in tree_out
+            assert "pg/simple1-0" in tree_out
+
             # scale validation runs server-side: negative replicas rejected
             assert (
                 cli_main(

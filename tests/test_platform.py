@@ -94,7 +94,9 @@ class TestEnableCompileCache:
         finally:
             jax.config.update("jax_compilation_cache_dir", before)
 
-    def test_env_override(self, tmp_path, monkeypatch):
+    def test_env_override_is_partitioned_root(self, tmp_path, monkeypatch):
+        # the env var names the cache ROOT; the per-config partition still
+        # applies underneath (a shared CI dir must never mix configs)
         import jax
 
         target = tmp_path / "env-cc"
@@ -102,8 +104,24 @@ class TestEnableCompileCache:
         before = jax.config.jax_compilation_cache_dir
         try:
             got = plat.enable_compile_cache()
-            assert got == str(target)
-            assert target.is_dir()
+            assert got.startswith(str(target) + "/jax_cache-")
+            assert (target / got.rsplit("/", 1)[-1]).is_dir()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before)
+
+    def test_partition_differs_by_config(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GROVE_TPU_COMPILE_CACHE", str(tmp_path))
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        try:
+            monkeypatch.setenv("XLA_FLAGS", "")
+            a = plat.enable_compile_cache()
+            monkeypatch.setenv(
+                "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+            )
+            b = plat.enable_compile_cache()
+            assert a != b
         finally:
             jax.config.update("jax_compilation_cache_dir", before)
 
