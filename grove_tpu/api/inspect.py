@@ -13,6 +13,94 @@ import io
 from grove_tpu.api import names as namegen
 
 
+def render_describe(store, kind: str, namespace: str, name: str) -> str:
+    """kubectl-describe-style single-object view: metadata, spec highlights,
+    status counters + conditions + typed lastErrors, and the Events whose
+    message names the object (events are materialized as store objects —
+    controller/common.py record_event)."""
+    obj = store.get(kind, namespace, name)
+    if obj is None:
+        return ""
+    out = io.StringIO()
+    out.write(f"Name:       {obj.metadata.name}\n")
+    out.write(f"Namespace:  {obj.metadata.namespace}\n")
+    out.write(f"Kind:       {obj.kind}\n")
+    if obj.metadata.labels:
+        labels = ", ".join(
+            f"{k}={v}" for k, v in sorted(obj.metadata.labels.items())
+        )
+        out.write(f"Labels:     {labels}\n")
+    out.write(f"Generation: {obj.metadata.generation}\n")
+    spec = getattr(obj, "spec", None)
+    if spec is not None and hasattr(spec, "replicas"):
+        out.write(f"Replicas:   {spec.replicas}\n")
+    status = getattr(obj, "status", None)
+    if status is not None:
+        for field in (
+            "phase",
+            "replicas",
+            "ready_replicas",
+            "scheduled_replicas",
+            "available_replicas",
+            "updated_replicas",
+            "placement_score",
+        ):
+            val = getattr(status, field, None)
+            if val is not None:
+                label = field.replace("_", " ").title().replace(" ", "")
+                out.write(f"Status.{label}: {val}\n")
+        conds = getattr(status, "conditions", None) or []
+        if conds:
+            out.write("Conditions:\n")
+            for c in conds:
+                out.write(
+                    f"  {c.type}={c.status}"
+                    f" reason={getattr(c, 'reason', '') or '-'}"
+                    f" message={getattr(c, 'message', '') or '-'}\n"
+                )
+        last_errors = getattr(status, "last_errors", None) or []
+        if last_errors:
+            out.write("LastErrors:\n")
+            for err in last_errors:
+                out.write(
+                    f"  {getattr(err, 'code', '?')}"
+                    f" op={getattr(err, 'operation', '-')}"
+                    f" {getattr(err, 'description', '')}\n"
+                )
+    # events live in the default namespace regardless of the object's (the
+    # ring buffer is cluster-scoped); match the message on a word boundary so
+    # `simple1` never inherits `simple10`'s events (children like
+    # `simple1-0-...` still match their own names when described directly)
+    import re
+
+    word = re.compile(rf"\b{re.escape(name)}\b")
+    events = [
+        e
+        for e in store.list("Event", None)
+        if word.search(str(e.spec.get("message", "")))
+    ]
+    # store listing is lexicographic by name (evt-10 < evt-2): order
+    # chronologically before truncating to the newest 20 (the numeric name
+    # suffix breaks ties within one virtual-clock instant)
+    def _event_order(e):
+        suffix = e.metadata.name.rsplit("-", 1)[-1]
+        return (
+            e.spec.get("timestamp", 0),
+            int(suffix) if suffix.isdigit() else 0,
+        )
+
+    events.sort(key=_event_order)
+    if events:
+        out.write("Events:\n")
+        for e in events[-20:]:
+            out.write(
+                f"  t={e.spec.get('timestamp', 0):.0f}s"
+                f" {e.spec.get('involvedKind', '?')}"
+                f" {e.spec.get('reason', '?')}: {e.spec.get('message', '')}\n"
+            )
+    return out.getvalue()
+
+
 def render_tree(store, namespace: str = "default") -> str:
     out = io.StringIO()
     for pcs in store.list("PodCliqueSet", namespace):

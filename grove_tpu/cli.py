@@ -69,6 +69,33 @@ def _wire_client(url: str):
     return HttpStore(url)
 
 
+def _check_kind(kind: str, verb: str) -> bool:
+    from grove_tpu.api.wire import KIND_REGISTRY
+
+    if kind in KIND_REGISTRY:
+        return True
+    print(
+        f"{verb}: unknown kind {kind!r} (known:"
+        f" {', '.join(sorted(KIND_REGISTRY))})",
+        file=sys.stderr,
+    )
+    return False
+
+
+def _sim_from_manifests(args):
+    """Converged sim harness from the command's manifest args (shared sim
+    bootstrap of tree/get/describe)."""
+    _ensure_backend()
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            harness.apply_yaml(f.read())
+    harness.converge()
+    return harness
+
+
 def _wire_apply(args) -> int:
     """kubectl-style create-or-update against a LIVE apiserver: POST each
     manifest document; on 409 re-read the live object, carry its
@@ -243,14 +270,7 @@ def _cmd_tree(args) -> int:
             file=sys.stderr,
         )
         return 2
-    _ensure_backend()
-    from grove_tpu.sim.harness import SimHarness
-
-    harness = SimHarness(num_nodes=args.nodes)
-    for path in args.manifests:
-        with open(path) as f:
-            harness.apply_yaml(f.read())
-    harness.converge()
+    harness = _sim_from_manifests(args)
     for spec in args.scale or []:
         name, sep, replicas_str = spec.partition("=")
         if not sep or not replicas_str.isdigit():
@@ -304,14 +324,7 @@ def _cmd_get(args) -> int:
         )
         return 2
 
-    from grove_tpu.api.wire import KIND_REGISTRY
-
-    if args.kind not in KIND_REGISTRY:
-        print(
-            f"get: unknown kind {args.kind!r} (known:"
-            f" {', '.join(sorted(KIND_REGISTRY))})",
-            file=sys.stderr,
-        )
+    if not _check_kind(args.kind, "get"):
         return 2
 
     if args.apiserver:
@@ -324,14 +337,7 @@ def _cmd_get(args) -> int:
             print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
             return 1
     else:
-        _ensure_backend()
-        from grove_tpu.sim.harness import SimHarness
-
-        harness = SimHarness(num_nodes=args.nodes)
-        for path in args.manifests:
-            with open(path) as f:
-                harness.apply_yaml(f.read())
-        harness.converge()
+        harness = _sim_from_manifests(args)
         objs = harness.store.list(args.kind, args.namespace)
 
     if not objs:
@@ -343,6 +349,55 @@ def _cmd_get(args) -> int:
         ),
         end="",
     )
+    return 0
+
+
+def _cmd_describe(args) -> int:
+    """kubectl-describe-style view: metadata, status counters, conditions,
+    typed lastErrors, and the object's Events — live (--apiserver) or after
+    simulating manifests."""
+    from grove_tpu.api.inspect import render_describe
+
+    if not _check_kind(args.kind, "describe"):
+        return 2
+    if args.apiserver:
+        if args.manifests:
+            print(
+                "describe: --apiserver reads live objects; manifests are"
+                " not applied (use the apply verb instead)",
+                file=sys.stderr,
+            )
+            return 2
+        from grove_tpu.runtime.errors import GroveError
+
+        try:
+            out = render_describe(
+                _wire_client(args.apiserver),
+                args.kind,
+                args.namespace,
+                args.name,
+            )
+        except GroveError as e:
+            print(f"describe: {args.apiserver}: {e.message}", file=sys.stderr)
+            return 1
+    else:
+        if not args.manifests:
+            print(
+                "describe: provide manifests to simulate, or --apiserver URL",
+                file=sys.stderr,
+            )
+            return 2
+        harness = _sim_from_manifests(args)
+        out = render_describe(
+            harness.store, args.kind, args.namespace, args.name
+        )
+    if not out:
+        print(
+            f"describe: {args.kind.lower()}/{args.name} not found",
+            file=sys.stderr,
+        )
+        return 1
+    print(out, end="")
     return 0
 
 
@@ -499,6 +554,21 @@ def main(argv: List[str] | None = None) -> int:
         help="filter to one namespace (default: all namespaces)",
     )
     p.set_defaults(fn=_cmd_get)
+
+    p = sub.add_parser(
+        "describe",
+        help=(
+            "kubectl-describe one object (conditions, lastErrors, events)"
+            " — live with --apiserver or after simulating manifests"
+        ),
+    )
+    p.add_argument("name")
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--kind", default="PodCliqueSet")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="read from a live apiserver instead")
+    p.add_argument("--namespace", default="default")
+    p.set_defaults(fn=_cmd_describe)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")

@@ -304,3 +304,29 @@ class TestRemainingSamples:
         )
         assert pcsg.spec.replicas == 4
         assert all(is_ready(p) for p in harness.store.list("Pod")), harness.tree()
+
+
+class TestDescribe:
+    def test_describe_pcs_and_gang(self, capsys):
+        from grove_tpu.cli import main as cli_main
+
+        rc = cli_main(["describe", "simple1", "samples/simple1.yaml"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Kind:       PodCliqueSet" in out
+        assert "PodGangCreateSuccessful: simple1-0" in out
+
+        rc = cli_main(
+            ["describe", "simple1-0", "samples/simple1.yaml", "--kind", "PodGang"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Scheduled=True" in out
+        assert "Status.PlacementScore: 1.0" in out
+
+    def test_describe_missing_object(self, capsys):
+        from grove_tpu.cli import main as cli_main
+
+        rc = cli_main(["describe", "nope", "samples/simple1.yaml"])
+        assert rc == 1
+        assert "not found" in capsys.readouterr().err
