@@ -421,3 +421,51 @@ spec:
         pods = h.store.list("Pod")
         assert all(is_scheduled(p) and is_ready(p) for p in pods), h.tree()
         assert len(pods) == 14
+
+
+class TestMultinodeSampleSpread:
+    def test_each_instance_packs_one_block_spread_emerges(self):
+        """The BASELINE DeepSeek-analogue sample: every PCSG replica
+        (leader+workers instance) must land inside ONE ici-block (the
+        NVLink-domain analogue, samples/multinode-disaggregated.yaml
+        topologyConstraint); distinct replicas spread across blocks when one
+        block can't hold them both — packing is per-instance, never
+        cross-instance."""
+        harness = SimHarness(num_nodes=32)  # 8 blocks x 4 hosts
+        # shrink capacity so one block (4 nodes x 8 cpu = 32) cannot hold two
+        # prefill instances (5 pods x 4 cpu = 20 each): spread must emerge
+        for n in harness.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        pcs = load_podcliqueset_file(
+            str(REPO / "samples" / "multinode-disaggregated.yaml")
+        )
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.containers[0].requests = {"cpu": 4.0}
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        node_by_name = {n.name: n for n in harness.cluster.nodes}
+
+        def block_of(pod):
+            return node_by_name[pod.status.node_name].labels[
+                "cloud.google.com/gke-tpu-ici-block"
+            ]
+
+        by_instance = {}
+        for p in pods:
+            # instance identity = (scaling group, pcsg replica index) labels
+            # (the supported mechanism, inherited by every constituent pod)
+            inst = (
+                p.metadata.labels[namegen.LABEL_PCSG],
+                p.metadata.labels[namegen.LABEL_PCSG_REPLICA_INDEX],
+            )
+            by_instance.setdefault(inst, set()).add(block_of(p))
+        for inst, blocks in by_instance.items():
+            assert len(blocks) == 1, (inst, blocks)
+        prefill_blocks = {
+            next(iter(b))
+            for (pcsg, _), b in by_instance.items()
+            if pcsg.endswith("-prefill")
+        }
+        assert len(prefill_blocks) == 2, prefill_blocks
