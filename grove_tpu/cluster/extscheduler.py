@@ -38,6 +38,7 @@ def run_external_scheduler(
     poll: float = 0.2,
     stop=None,
     kubelet: bool = False,
+    solver_sidecar: Optional[str] = None,
 ) -> None:
     """Blocking scheduler loop against a remote apiserver. `kubelet=True`
     additionally runs the kubelet tick (pods become Ready), for e2e setups
@@ -54,6 +55,7 @@ def run_external_scheduler(
     scheduler = GangScheduler(
         store, cluster, topology or ClusterTopology(),
         priority_map=priority_map or {},
+        solver_sidecar=solver_sidecar,
     )
     from grove_tpu.runtime.errors import GroveError
 
@@ -84,6 +86,11 @@ def main(argv=None) -> int:
         help="also run the kubelet tick (sim data plane)",
     )
     parser.add_argument("--poll-interval", type=float, default=0.2)
+    parser.add_argument(
+        "--solver-sidecar",
+        help="route packing solves through a gRPC gang-solver sidecar"
+        " (host:port; see grove-tpu-solver)",
+    )
     args = parser.parse_args(argv)
 
     # a wedged accelerator link must degrade to CPU, never hang the
@@ -105,6 +112,7 @@ def main(argv=None) -> int:
         make_nodes(args.nodes),
         poll=args.poll_interval,
         kubelet=args.kubelet,
+        solver_sidecar=args.solver_sidecar,
     )
     return 0
 

@@ -1,0 +1,288 @@
+"""gRPC gang-solver sidecar: the BASELINE north-star boundary.
+
+The reference delegates placement to the external KAI scheduler; the
+north star (BASELINE.json) puts the all-or-nothing packing behind a gRPC
+sidecar the scheduler plugin calls. This module is that sidecar:
+``GangSolver.Solve`` takes the full pending-gang batch + cluster snapshot
+(protos/solver.proto) and returns per-gang placements + PlacementScores,
+solved by the device-resident wave kernel.
+
+grpcio-tools is not available in this image, so the message classes are
+protoc-generated and committed (protos/solver_pb2.py) while the
+service/stub layer is written against grpc-python's generic handler API —
+wire-compatible with any standard gRPC client/server of this proto.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import List, Optional
+
+import numpy as np
+
+try:  # grpcio ships in the dev image; declared as the [grpc] extra in
+    # pyproject — fail with an actionable message, not a bare ImportError
+    import grpc
+except ImportError as _exc:  # pragma: no cover
+    grpc = None
+    _GRPC_IMPORT_ERROR = _exc
+else:
+    _GRPC_IMPORT_ERROR = None
+
+from grove_tpu.cluster.protos import solver_pb2 as pb
+
+_SERVICE = "grove.solver.v1.GangSolver"
+
+
+def _require_grpc() -> None:
+    if grpc is None:  # pragma: no cover
+        raise RuntimeError(
+            "the gang-solver sidecar needs grpcio (pip install"
+            " 'grove-tpu[grpc]')"
+        ) from _GRPC_IMPORT_ERROR
+
+
+def _topology_from_keys(level_keys: List[str]):
+    from grove_tpu.api.topology import (
+        ClusterTopology,
+        ClusterTopologySpec,
+        TopologyLevel,
+    )
+
+    if not level_keys:
+        return ClusterTopology()
+    return ClusterTopology(
+        spec=ClusterTopologySpec(
+            levels=[
+                TopologyLevel(domain=f"level-{i}", key=key)
+                for i, key in enumerate(level_keys)
+            ]
+        )
+    )
+
+
+def _decode_request(request: pb.SolveRequest):
+    from grove_tpu.sim.cluster import Node
+
+    nodes = [
+        Node(
+            name=n.name,
+            capacity={q.resource: q.value for q in n.capacity},
+            labels=dict(n.labels),
+        )
+        for n in request.nodes
+    ]
+    gang_specs = []
+    for gang in request.gangs:
+        gang_specs.append(
+            {
+                "name": gang.name,
+                "groups": [
+                    {
+                        "name": grp.name,
+                        "demand": {q.resource: q.value for q in grp.demand},
+                        "count": grp.count,
+                        "min_count": grp.min_count,
+                        "required_key": grp.pack_level_key or None,
+                        "pinned_node": grp.pinned_node or None,
+                    }
+                    for grp in gang.groups
+                ],
+                "required_key": gang.required_level_key or None,
+                "preferred_key": gang.preferred_level_key or None,
+                "priority": gang.priority,
+                "gang_pinned_node": gang.pinned_node or None,
+            }
+        )
+    topology = _topology_from_keys(list(request.topology_level_keys))
+    return nodes, gang_specs, topology
+
+
+class RequestDecodeError(ValueError):
+    """Malformed/undecodable request — maps to INVALID_ARGUMENT."""
+
+
+def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
+    """Pure request → response solve (shared by the gRPC handler and
+    in-process callers/tests)."""
+    from grove_tpu.solver.encode import build_problem
+    from grove_tpu.solver.kernel import solve_waves
+
+    try:
+        nodes, gang_specs, topology = _decode_request(request)
+    except Exception as exc:
+        raise RequestDecodeError(str(exc)) from exc
+    problem = build_problem(nodes, gang_specs, topology)
+    solve_kwargs = {"with_alloc": not request.options.stats_only}
+    if request.options.chunk_size:
+        solve_kwargs["chunk_size"] = request.options.chunk_size
+    if request.options.max_waves:
+        solve_kwargs["max_waves"] = request.options.max_waves
+    result = solve_waves(problem, **solve_kwargs)
+
+    level_keys = [lvl.key for lvl in topology.spec.levels]
+    response = pb.SolveResponse(solve_seconds=result.solve_seconds)
+    for gi, spec in enumerate(gang_specs):
+        placement = response.placements.add()
+        placement.gang = spec["name"]
+        placement.admitted = bool(result.admitted[gi])
+        placement.placement_score = float(result.score[gi])
+        chosen = int(result.chosen_level[gi])
+        placement.chosen_level_key = (
+            level_keys[chosen] if 0 <= chosen < len(level_keys) else ""
+        )
+        if result.alloc is not None and placement.admitted:
+            alloc = result.alloc[gi]  # [P, N] pod counts
+            for pi, grp in enumerate(spec["groups"]):
+                for ni in np.nonzero(alloc[pi])[0]:
+                    assignment = placement.assignments.add()
+                    assignment.group = grp["name"]
+                    assignment.node = problem.node_names[int(ni)]
+                    assignment.count = int(alloc[pi][ni])
+    return response
+
+
+class SolverServer:
+    """Standalone gRPC server for the sidecar. ``start()`` binds (port 0 →
+    ephemeral) and returns self; ``address`` is host:port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, workers: int = 4):
+        _require_grpc()
+        self._requested = (host, port)
+        self._workers = workers
+        self._server = None
+        self.address: Optional[str] = None
+
+    def start(self) -> "SolverServer":
+        def solve_handler(request: pb.SolveRequest, context) -> pb.SolveResponse:
+            try:
+                return solve_request(request)
+            except RequestDecodeError as exc:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"bad request: {exc}"
+                )
+            except Exception as exc:
+                # solver/backend failures are SERVER-side and retryable —
+                # never INVALID_ARGUMENT (clients treat that as permanent)
+                context.abort(
+                    grpc.StatusCode.INTERNAL, f"solve failed: {exc}"
+                )
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                "Solve": grpc.unary_unary_rpc_method_handler(
+                    solve_handler,
+                    request_deserializer=pb.SolveRequest.FromString,
+                    response_serializer=pb.SolveResponse.SerializeToString,
+                )
+            },
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._workers)
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        host, port = self._requested
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        self.address = f"{host}:{bound}"
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+
+class SolverClient:
+    """Thin stub for GangSolver (hand-written; wire-compatible with any
+    generated stub of protos/solver.proto)."""
+
+    def __init__(self, address: str):
+        _require_grpc()
+        self._channel = grpc.insecure_channel(address)
+        self._solve = self._channel.unary_unary(
+            f"/{_SERVICE}/Solve",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
+
+    def solve(
+        self, request: pb.SolveRequest, timeout: float = 120.0
+    ) -> pb.SolveResponse:
+        return self._solve(request, timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def build_request(
+    nodes, gang_specs: List[dict], topology=None
+) -> pb.SolveRequest:
+    """Encode the scheduler-side domain objects into the wire request (the
+    inverse of _decode_request; used by in-process callers and tests)."""
+    request = pb.SolveRequest()
+    for node in nodes:
+        n = request.nodes.add()
+        n.name = node.name
+        for resource, value in sorted(node.capacity.items()):
+            q = n.capacity.add()
+            q.resource = resource
+            q.value = value
+        for k, v in node.labels.items():
+            n.labels[k] = v
+    for spec in gang_specs:
+        gang = request.gangs.add()
+        gang.name = spec["name"]
+        gang.required_level_key = spec.get("required_key") or ""
+        gang.preferred_level_key = spec.get("preferred_key") or ""
+        gang.priority = int(spec.get("priority", 0))
+        gang.pinned_node = spec.get("gang_pinned_node") or ""
+        for grp in spec["groups"]:
+            group = gang.groups.add()
+            group.name = grp["name"]
+            group.count = int(grp["count"])
+            group.min_count = int(grp["min_count"])
+            group.pack_level_key = grp.get("required_key") or ""
+            group.pinned_node = grp.get("pinned_node") or ""
+            for resource, value in sorted(grp["demand"].items()):
+                q = group.demand.add()
+                q.resource = resource
+                q.value = value
+    if topology is not None:
+        request.topology_level_keys.extend(
+            lvl.key for lvl in topology.spec.levels
+        )
+    return request
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Console entry: run the sidecar until interrupted."""
+    import argparse
+    import sys
+    import time
+
+    parser = argparse.ArgumentParser(prog="grove-tpu-solver")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=50061)
+    args = parser.parse_args(argv)
+
+    from grove_tpu.utils.platform import ensure_healthy_backend
+
+    note = ensure_healthy_backend(timeout_s=45.0)
+    if note != "default":
+        print(f"note: {note}", file=sys.stderr)
+    server = SolverServer(args.host, args.port).start()
+    print(f"gang-solver sidecar listening on {server.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -117,7 +117,10 @@ class OperatorRuntime:
             except GroveError:
                 pass  # conflicting writer; next tick re-reads
         if self.scheduler is not None:
-            work += self.scheduler.schedule_pending()
+            try:
+                work += self.scheduler.schedule_pending()
+            except GroveError:
+                pass  # conflict or sidecar outage; next round retries
         if self.cluster is not None:
             work += self.cluster.kubelet_tick()
         work += self._drain()
@@ -221,6 +224,7 @@ def start_operator(
             priority_map=config.solver.priority_classes,
             chunk_size=min(config.solver.chunk_size, 64),
             max_waves=config.solver.max_waves,
+            solver_sidecar=config.solver.sidecar_address or None,
         )
     from grove_tpu.autoscale.hpa import (
         HorizontalAutoscaler,

@@ -74,6 +74,9 @@ class SolverConfig:
     chunk_size: int = 128
     max_waves: int = 16
     priority_classes: Dict[str, int] = field(default_factory=dict)
+    # route packing solves through a gRPC gang-solver sidecar (host:port;
+    # empty -> solve in-process). BASELINE north-star boundary.
+    sidecar_address: str = ""
 
 
 @dataclass
@@ -142,6 +145,7 @@ def load_operator_configuration(text: str) -> OperatorConfiguration:
         chunk_size=int(solver.get("chunkSize", 128)),
         max_waves=int(solver.get("maxWaves", 16)),
         priority_classes=dict(solver.get("priorityClasses") or {}),
+        sidecar_address=str(solver.get("sidecarAddress", "")),
     )
     validate_operator_configuration(cfg)
     return cfg

@@ -77,6 +77,7 @@ class SimHarness:
             priority_map=self.config.solver.priority_classes,
             chunk_size=min(self.config.solver.chunk_size, 64),
             max_waves=self.config.solver.max_waves,
+            solver_sidecar=self.config.solver.sidecar_address or None,
         )
         # HPA controller equivalent (multi-level autoscaling)
         from grove_tpu.autoscale.hpa import (

@@ -29,7 +29,7 @@ from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
 from grove_tpu.solver.encode import build_problem
-from grove_tpu.solver.kernel import solve, solve_waves
+from grove_tpu.solver.kernel import solve_waves
 
 
 class GangScheduler:
@@ -43,6 +43,7 @@ class GangScheduler:
         priority_map: Optional[Dict[str, int]] = None,
         chunk_size: int = 32,
         max_waves: int = 16,
+        solver_sidecar: Optional[str] = None,
     ) -> None:
         self.store = store
         self.cluster = cluster
@@ -51,6 +52,118 @@ class GangScheduler:
         self.priority_map = priority_map or {}
         self.chunk_size = chunk_size
         self.max_waves = max_waves
+        # BASELINE north star: the scheduling loop can call the packing
+        # solve through a gRPC sidecar (host:port) instead of in-process —
+        # the same boundary the reference's scheduler plugin puts KAI behind
+        self.solver_sidecar = solver_sidecar
+        self._sidecar_client = None
+
+    def _solve_batch(
+        self,
+        nodes: List,
+        gang_specs: List[dict],
+        free_capacity: Dict[str, Dict[str, float]],
+        with_alloc: bool = True,
+    ):
+        """One batch solve against a free-capacity snapshot. In-process by
+        default; with ``solver_sidecar`` set, the identical request goes
+        over gRPC (cluster/grpcsolver.py) and the response is mapped back
+        onto the locally-encoded problem's index space, so every downstream
+        consumer (binding, preemption trials, recovery pins) is agnostic to
+        where the kernel ran. Returns (PackingResult, PackingProblem)."""
+        problem = build_problem(
+            nodes, gang_specs, self.topology, free_capacity=free_capacity
+        )
+        if self.solver_sidecar is None:
+            result = solve_waves(
+                problem,
+                chunk_size=self.chunk_size,
+                max_waves=self.max_waves,
+                with_alloc=with_alloc,
+            )
+            return result, problem
+        return self._solve_remote(
+            problem, nodes, gang_specs, free_capacity, with_alloc
+        )
+
+    def _solve_remote(
+        self, problem, nodes, gang_specs, free_capacity, with_alloc: bool
+    ):
+        # The local build_problem still runs on this path: its
+        # name/level/group index maps AND the problem object itself are what
+        # every downstream consumer needs (assignments(), trial usage,
+        # recovery pins) — and the encode is pure numpy, no device work, so
+        # the duplicate cost vs the sidecar's own encode is tens of
+        # microseconds per trial-sized request.
+        import grpc
+        import numpy as np
+
+        from grove_tpu.cluster.grpcsolver import SolverClient, build_request
+        from grove_tpu.runtime.errors import GroveError
+        from grove_tpu.sim.cluster import Node
+        from grove_tpu.solver.types import PackingResult
+
+        snapshot = [
+            Node(
+                name=n.name,
+                capacity=dict(free_capacity.get(n.name, n.capacity)),
+                labels=dict(n.labels),
+            )
+            for n in nodes
+        ]
+        request = build_request(snapshot, gang_specs, self.topology)
+        request.options.chunk_size = self.chunk_size
+        request.options.max_waves = self.max_waves
+        request.options.stats_only = not with_alloc
+        if self._sidecar_client is None:
+            self._sidecar_client = SolverClient(self.solver_sidecar)
+        try:
+            response = self._sidecar_client.solve(request)
+        except grpc.RpcError as e:
+            # a restarting/unreachable sidecar must never kill the control
+            # loop — surface as the retryable store-error type every caller
+            # (extscheduler round guard, operator engine) already handles
+            self._sidecar_client = None  # reconnect next round
+            raise GroveError(
+                "ERR_SOLVER_SIDECAR",
+                f"solver sidecar {self.solver_sidecar}: {e.code()}",
+                "solve_remote",
+            ) from e
+
+        g = problem.num_gangs
+        p_max = problem.max_groups
+        n_nodes = problem.num_nodes
+        node_index = {name: i for i, name in enumerate(problem.node_names)}
+        admitted = np.zeros((g,), dtype=bool)
+        score = np.zeros((g,), dtype=np.float32)
+        chosen_level = np.full((g,), -1, dtype=np.int32)
+        placed = np.zeros((g, p_max), dtype=np.int32)
+        alloc = np.zeros((g, p_max, n_nodes), dtype=np.int32)
+        level_index = {key: i for i, key in enumerate(problem.level_keys)}
+        for gi, placement in enumerate(response.placements[:g]):
+            admitted[gi] = placement.admitted
+            score[gi] = placement.placement_score
+            chosen_level[gi] = level_index.get(placement.chosen_level_key, -1)
+            group_index = {
+                name: pi for pi, name in enumerate(problem.group_names[gi])
+            }
+            for asg in placement.assignments:
+                pi = group_index.get(asg.group)
+                ni = node_index.get(asg.node)
+                if pi is None or ni is None:
+                    continue
+                alloc[gi, pi, ni] += asg.count
+                placed[gi, pi] += asg.count
+        result = PackingResult(
+            admitted=admitted,
+            placed=placed,
+            score=score,
+            chosen_level=chosen_level,
+            alloc=alloc,
+            free_after=problem.capacity,  # not consumed on this path
+            solve_seconds=response.solve_seconds,
+        )
+        return result, problem
 
     # -- main loop -------------------------------------------------------
 
@@ -105,17 +218,10 @@ class GangScheduler:
             }
             nodes = [n for n in self.cluster.nodes if not n.cordoned]
             if nodes:
-                problem = build_problem(
-                    nodes, gang_specs, self.topology, free_capacity=free
-                )
                 # wave solver with allocations: cheap-to-compile vmapped
                 # decisions (the exact scan kernel stays on the parity/bench
                 # paths; unadmitted gangs retry on the next control round)
-                result = solve_waves(
-                    problem,
-                    chunk_size=self.chunk_size,
-                    max_waves=self.max_waves,
-                )
+                result, problem = self._solve_batch(nodes, gang_specs, free)
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
                 preempted = self._maybe_preempt(gang_specs, result)
                 assignments = result.assignments(problem)
@@ -551,10 +657,7 @@ class GangScheduler:
         # capacity on its own, it will simply be placed next round — never
         # evict for it (but DO reserve its planned placement against later
         # preemptors' trials).
-        solo_problem = build_problem(
-            nodes, [preemptor], self.topology, free_capacity=base_free
-        )
-        solo = solve_waves(solo_problem, with_alloc=True)
+        solo, solo_problem = self._solve_batch(nodes, [preemptor], base_free)
         if solo.admitted[0]:
             return [], self._placement_usage(solo, solo_problem, preemptor)
 
@@ -632,10 +735,9 @@ class GangScheduler:
                 for r, q in add.get(node.name, {}).items():
                     caps[r] = caps.get(r, 0.0) + q
                 trial_free[node.name] = caps
-            trial_problem = build_problem(
-                nodes, [preemptor], self.topology, free_capacity=trial_free
+            return self._solve_batch(
+                nodes, [preemptor], trial_free, with_alloc=with_alloc
             )
-            return solve_waves(trial_problem, with_alloc=with_alloc), trial_problem
 
         keep = list(range(len(chosen)))
         result, _ = run_trial(keep)
