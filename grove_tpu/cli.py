@@ -61,12 +61,12 @@ def _cmd_apply(args) -> int:
     return 0
 
 
-def _wire_client(url: str):
+def _wire_client(url: str, watch_kinds=()):
     from grove_tpu.cluster.client import HttpStore
 
     if "://" not in url:
         url = f"http://{url}"  # kubectl-style bare host:port
-    return HttpStore(url)
+    return HttpStore(url, watch_kinds=watch_kinds)
 
 
 def _check_kind(kind: str, verb: str) -> bool:
@@ -323,6 +323,9 @@ def _cmd_get(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.watch and not args.apiserver:
+        print("get: --watch requires --apiserver", file=sys.stderr)
+        return 2
 
     if not _check_kind(args.kind, "get"):
         return 2
@@ -331,6 +334,8 @@ def _cmd_get(args) -> int:
         # kubectl-style read against a LIVE apiserver (no sim, no jax)
         from grove_tpu.runtime.errors import GroveError
 
+        if args.watch:
+            return _watch_kind(args)
         try:
             objs = _wire_client(args.apiserver).list(args.kind, args.namespace)
         except GroveError as e:
@@ -349,6 +354,55 @@ def _cmd_get(args) -> int:
         ),
         end="",
     )
+    return 0
+
+
+def _watch_kind(args) -> int:
+    """kubectl get --watch: stream Added/Modified/Deleted events for one
+    kind from the live apiserver until interrupted."""
+    import threading
+
+    from grove_tpu.runtime.errors import GroveError
+
+    store = _wire_client(args.apiserver, watch_kinds=(args.kind,))
+    try:
+        # preflight: the watch loop retries connection errors silently by
+        # design (informer semantics) — an unreachable/wrong server must
+        # fail the command up front like the non-watch path does
+        store.list(args.kind, args.namespace)
+    except GroveError as e:
+        print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
+        return 1
+
+    def on_event(ev):
+        obj = ev.obj
+        if args.namespace and obj.metadata.namespace != args.namespace:
+            return
+        status = getattr(obj, "status", None)
+        phase = getattr(status, "phase", "") or ""
+        print(
+            f"{ev.type:<9} {obj.kind.lower()}/{obj.metadata.name}"
+            f" rv={obj.metadata.resource_version}"
+            + (f" phase={phase}" if phase else ""),
+            flush=True,
+        )
+
+    store.subscribe(on_event)
+    store.start()
+    print(
+        f"watching {args.kind} on {store.base_url} (Ctrl-C to stop)",
+        flush=True,
+    )
+    idle = threading.Event()
+    try:
+        while True:
+            # short slices keep Ctrl-C responsive on every platform (a long
+            # main-thread Event.wait is not SIGINT-interruptible on Windows)
+            idle.wait(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        store.stop()
     return 0
 
 
@@ -552,6 +606,11 @@ def main(argv: List[str] | None = None) -> int:
         "--namespace",
         default=None,
         help="filter to one namespace (default: all namespaces)",
+    )
+    p.add_argument(
+        "--watch",
+        action="store_true",
+        help="stream Added/Modified/Deleted events (requires --apiserver)",
     )
     p.set_defaults(fn=_cmd_get)
 

@@ -711,3 +711,50 @@ class TestReadModifyWrite:
             )
         finally:
             server.stop()
+
+
+class TestGetWatch:
+    def test_watch_streams_gang_lifecycle(self):
+        """grove-tpu get --watch streams Added/Modified events as the gang
+        progresses Pending -> Running (kubectl -w parity over the wire)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        rt = start_operator()
+        try:
+            base = rt.apiserver.address
+            env = dict(os.environ, PYTHONPATH=str(REPO))
+            watcher = subprocess.Popen(
+                [sys.executable, "-u", "-m", "grove_tpu.cli", "get",
+                 "--kind", "PodGang", "--apiserver", base, "--watch"],
+                env=env, cwd=str(REPO),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            try:
+                time.sleep(1.0)
+                doc = yaml.safe_load(
+                    (REPO / "samples" / "simple1.yaml").read_text()
+                )
+                _post(
+                    f"{base}/apis/grove.io/v1alpha1/namespaces/default/"
+                    "podcliquesets",
+                    doc,
+                )
+                _converge(rt, lambda: any(
+                    g["metadata"]["name"] == "simple1-0"
+                    and g.get("status", {}).get("phase") == "Running"
+                    for g in _get(
+                        f"{base}/apis/scheduler.grove.io/v1alpha1/"
+                        "namespaces/default/podgangs"
+                    )["items"]
+                ), timeout=90)
+                time.sleep(1.0)
+            finally:
+                watcher.send_signal(signal.SIGINT)
+                out, _ = watcher.communicate(timeout=20)
+            assert "Added     podgang/simple1-0" in out, out
+            assert "phase=Running" in out, out
+        finally:
+            rt.shutdown()
