@@ -374,31 +374,41 @@ def _watch_kind(args) -> int:
         print(f"get: {args.apiserver}: {e.message}", file=sys.stderr)
         return 1
 
+    done = threading.Event()
+
     def on_event(ev):
         obj = ev.obj
         if args.namespace and obj.metadata.namespace != args.namespace:
             return
         status = getattr(obj, "status", None)
         phase = getattr(status, "phase", "") or ""
-        print(
-            f"{ev.type:<9} {obj.kind.lower()}/{obj.metadata.name}"
-            f" rv={obj.metadata.resource_version}"
-            + (f" phase={phase}" if phase else ""),
-            flush=True,
-        )
+        try:
+            print(
+                f"{ev.type:<9} {obj.kind.lower()}/{obj.metadata.name}"
+                f" rv={obj.metadata.resource_version}"
+                + (f" phase={phase}" if phase else ""),
+                flush=True,
+            )
+        except (BrokenPipeError, OSError):
+            # stdout is gone (e.g. `... --watch | head`): end the watch
+            # instead of letting the client's reconnect loop re-list the
+            # snapshot against the apiserver forever
+            done.set()
 
     store.subscribe(on_event)
     store.start()
-    print(
-        f"watching {args.kind} on {store.base_url} (Ctrl-C to stop)",
-        flush=True,
-    )
-    idle = threading.Event()
     try:
-        while True:
+        print(
+            f"watching {args.kind} on {store.base_url} (Ctrl-C to stop)",
+            flush=True,
+        )
+    except (BrokenPipeError, OSError):
+        done.set()
+    try:
+        while not done.is_set():
             # short slices keep Ctrl-C responsive on every platform (a long
             # main-thread Event.wait is not SIGINT-interruptible on Windows)
-            idle.wait(1.0)
+            done.wait(1.0)
     except KeyboardInterrupt:
         pass
     finally:
@@ -472,6 +482,16 @@ def _cmd_crds(args) -> int:
             print(path)
         return 0
     print(render_crds(), end="")
+    return 0
+
+
+def _cmd_api_docs(args) -> int:
+    from grove_tpu.cluster.apidocs import render_api_reference, write_api_reference
+
+    if args.write:
+        print(write_api_reference(args.write))
+        return 0
+    print(render_api_reference(), end="")
     return 0
 
 
@@ -640,6 +660,12 @@ def main(argv: List[str] | None = None) -> int:
     p = sub.add_parser("crds", help="print or write the CRD manifests")
     p.add_argument("--output-dir", metavar="DIR")
     p.set_defaults(fn=_cmd_crds)
+
+    p = sub.add_parser(
+        "api-docs", help="render the API reference from the typed model"
+    )
+    p.add_argument("--write", metavar="PATH", help="write to PATH instead of stdout")
+    p.set_defaults(fn=_cmd_api_docs)
 
     p = sub.add_parser(
         "run", help="run the operator against a real (HTTP) apiserver"

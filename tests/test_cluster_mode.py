@@ -527,6 +527,34 @@ class TestCRDManifests:
                 f" `python -m grove_tpu.cli crds --output-dir deploy/crds`"
             )
 
+    def test_committed_api_reference_matches_generated(self):
+        """docs/api-reference.md must never drift from the typed model (the
+        reference's generated API docs carry the same guarantee via codegen)."""
+        from grove_tpu.cluster.apidocs import render_api_reference
+
+        path = REPO / "docs" / "api-reference.md"
+        assert path.exists(), "missing committed docs/api-reference.md"
+        assert path.read_text() == render_api_reference(), (
+            "docs/api-reference.md drifted from the typed model — regenerate"
+            " with `python -m grove_tpu.cli api-docs --write"
+            " docs/api-reference.md`"
+        )
+
+    def test_api_reference_covers_all_wire_kinds(self):
+        """Every kind a user can put on the wire is documented."""
+        from grove_tpu.cluster.apidocs import render_api_reference
+
+        doc = render_api_reference()
+        for kind in (
+            "PodCliqueSet",
+            "PodClique",
+            "PodCliqueScalingGroup",
+            "ClusterTopology",
+            "PodGang",
+            "OperatorConfiguration",
+        ):
+            assert f"### {kind}" in doc, f"{kind} missing from API reference"
+
     def test_crd_schema_covers_sample_manifest(self):
         """Smoke-check the generated schema names the sample's spec keys."""
         from grove_tpu.cluster.crdgen import generate_crd
