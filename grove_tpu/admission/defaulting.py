@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from grove_tpu.api.types import (
     DEFAULT_TERMINATION_DELAY_SECONDS,
+    SPREAD_DO_NOT_SCHEDULE,
     STARTUP_ANY_ORDER,
     HeadlessServiceConfig,
     PodCliqueSet,
@@ -52,6 +53,15 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
         pod_spec.extra.setdefault(
             "terminationGracePeriodSeconds", DEFAULT_TERMINATION_GRACE_PERIOD
         )
+
+    # spread constraint defaults (grove-tpu extension — see
+    # api/types.py TopologyConstraint)
+    tc = tmpl.topology_constraint
+    if tc is not None and tc.spread_domain is not None:
+        if tc.spread_min_domains is None:
+            tc.spread_min_domains = 2
+        if tc.spread_when_unsatisfiable is None:
+            tc.spread_when_unsatisfiable = SPREAD_DO_NOT_SCHEDULE
 
     for sg in tmpl.pod_clique_scaling_group_configs:
         # kubebuilder defaults — podcliqueset.go:211, :224

@@ -371,8 +371,34 @@ def _validate_spec(
     # --- PCS-level topology constraint ---------------------------------
     if tmpl.topology_constraint is not None:
         _validate_topology_constraint(
-            tmpl.topology_constraint, None, "spec.template.topologyConstraint", topology, res
+            tmpl.topology_constraint,
+            None,
+            "spec.template.topologyConstraint",
+            topology,
+            res,
+            allow_spread=True,
         )
+        # gang-level spread and per-group (clique/PCSG) packs are mutually
+        # exclusive: the balanced spread fill places the whole gang, so a
+        # narrower per-group pack could not be honored at the same time
+        if tmpl.topology_constraint.spread_domain is not None:
+            offenders = [
+                f"clique {c.name!r}"
+                for c in tmpl.cliques
+                if c.topology_constraint is not None
+                and c.topology_constraint.pack_domain is not None
+            ] + [
+                f"scalingGroup {sg.name!r}"
+                for sg in tmpl.pod_clique_scaling_group_configs
+                if sg.topology_constraint is not None
+                and sg.topology_constraint.pack_domain is not None
+            ]
+            if offenders:
+                res.error(
+                    "spec.template.topologyConstraint.spreadDomain",
+                    "cannot be combined with per-clique or per-scaling-group"
+                    f" packDomain constraints ({', '.join(offenders)})",
+                )
 
     # --- generated-name budget ------------------------------------------
     worst, worst_name = _worst_case_pod_name_len(pcs)
@@ -416,8 +442,14 @@ def _validate_pod_spec(
 
 
 def _validate_topology_constraint(
-    tc, parent_tc, path: str, topology: Optional[ClusterTopology], res: ValidationResult
+    tc,
+    parent_tc,
+    path: str,
+    topology: Optional[ClusterTopology],
+    res: ValidationResult,
+    allow_spread: bool = False,
 ) -> None:
+    _validate_spread_constraint(tc, path, topology, res, allow_spread)
     if tc.pack_domain is None:
         return
     if tc.pack_domain not in TOPOLOGY_DOMAIN_ORDER:
@@ -446,6 +478,73 @@ def _validate_topology_constraint(
                 f"must be equal to or stricter than the parent constraint"
                 f" {parent_tc.pack_domain!r}",
             )
+
+
+def _validate_spread_constraint(
+    tc, path: str, topology, res: ValidationResult, allow_spread: bool
+) -> None:
+    """Topology SPREAD rules (grove-tpu extension; no reference analogue):
+    gang-level only, known domain, strictly narrower than a packDomain it
+    composes with, minDomains >= 2, whenUnsatisfiable enum."""
+    from grove_tpu.api.types import SPREAD_UNSATISFIABLE_MODES
+
+    has_spread_fields = (
+        tc.spread_domain is not None
+        or tc.spread_min_domains is not None
+        or tc.spread_when_unsatisfiable is not None
+    )
+    if not has_spread_fields:
+        return
+    if not allow_spread:
+        res.error(
+            f"{path}.spreadDomain",
+            "spread constraints are only supported on the template-level"
+            " topologyConstraint (the whole gang), not per clique or"
+            " scaling group",
+        )
+        return
+    if tc.spread_domain is None:
+        res.error(
+            f"{path}.spreadDomain",
+            "spreadMinDomains/spreadWhenUnsatisfiable require spreadDomain",
+        )
+        return
+    if tc.spread_domain not in TOPOLOGY_DOMAIN_ORDER:
+        res.error(
+            f"{path}.spreadDomain",
+            f"unknown topology domain {tc.spread_domain!r}; must be one of"
+            f" {sorted(TOPOLOGY_DOMAIN_ORDER)}",
+        )
+        return
+    if topology is not None and topology.level_index(tc.spread_domain) is None:
+        res.error(
+            f"{path}.spreadDomain",
+            f"domain {tc.spread_domain!r} is not a level of the cluster"
+            " topology",
+        )
+    if (
+        tc.pack_domain is not None
+        and tc.pack_domain in TOPOLOGY_DOMAIN_ORDER
+        and not broader_than(tc.pack_domain, tc.spread_domain)
+    ):
+        res.error(
+            f"{path}.spreadDomain",
+            f"must be strictly narrower than packDomain {tc.pack_domain!r}"
+            " (pack into one broad domain, spread across the narrower"
+            " domains inside it)",
+        )
+    if tc.spread_min_domains is not None and tc.spread_min_domains < 2:
+        res.error(
+            f"{path}.spreadMinDomains", "must be at least 2 when set"
+        )
+    if (
+        tc.spread_when_unsatisfiable is not None
+        and tc.spread_when_unsatisfiable not in SPREAD_UNSATISFIABLE_MODES
+    ):
+        res.error(
+            f"{path}.spreadWhenUnsatisfiable",
+            f"must be one of {list(SPREAD_UNSATISFIABLE_MODES)}",
+        )
 
 
 def _unique(items: List[str], path: str, msg: str, res: ValidationResult) -> None:

@@ -186,19 +186,42 @@ class AutoScalingConfig:
 # ---------------------------------------------------------------------------
 
 
+SPREAD_DO_NOT_SCHEDULE = "DoNotSchedule"
+SPREAD_SCHEDULE_ANYWAY = "ScheduleAnyway"
+SPREAD_UNSATISFIABLE_MODES = (SPREAD_DO_NOT_SCHEDULE, SPREAD_SCHEDULE_ANYWAY)
+
+
 @dataclass
 class TopologyConstraint:
     """podcliqueset.go:186-199 — packDomain holds a topology *level name*
     (e.g. 'ici-block'); the operator translates it into node-label topology
-    keys on the PodGang (docs/designs/topology.md:541-616)."""
+    keys on the PodGang (docs/designs/topology.md:541-616).
+
+    spreadDomain extends the contract with topology SPREAD (the reference's
+    2026 roadmap item, README.md "Topology Spread Constraints", unshipped
+    there): balance the unit's pods across the domains of that level —
+    fault-tolerance counterpart of packing. Composes with packDomain when
+    spreadDomain is strictly narrower (pack the gang into one slice, spread
+    its pods across the hosts inside it)."""
 
     pack_domain: Optional[str] = None
+    spread_domain: Optional[str] = None
+    # minimum distinct domains a placement must span (defaulted to 2)
+    spread_min_domains: Optional[int] = None
+    # DoNotSchedule (hard — reject placements below the floor) or
+    # ScheduleAnyway (soft — spread shapes the PlacementScore only)
+    spread_when_unsatisfiable: Optional[str] = None
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> Optional["TopologyConstraint"]:
         if not d:
             return None
-        return TopologyConstraint(pack_domain=d.get("packDomain"))
+        return TopologyConstraint(
+            pack_domain=d.get("packDomain"),
+            spread_domain=d.get("spreadDomain"),
+            spread_min_domains=d.get("spreadMinDomains"),
+            spread_when_unsatisfiable=d.get("spreadWhenUnsatisfiable"),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -518,10 +541,24 @@ class TopologyPackConstraint:
 
 
 @dataclass
+class TopologySpreadConstraint:
+    """grove-tpu extension of the PodGang contract (no reference analogue —
+    'Topology Spread Constraints' is an unshipped roadmap item there):
+    balance the gang's pods across the domains of `topology_key`, spanning
+    at least `min_domains` distinct domains when `when_unsatisfiable` is
+    DoNotSchedule."""
+
+    topology_key: str = ""
+    min_domains: int = 2
+    when_unsatisfiable: str = SPREAD_DO_NOT_SCHEDULE
+
+
+@dataclass
 class SchedTopologyConstraint:
-    """scheduler podgang.go:95-99."""
+    """scheduler podgang.go:95-99 (+ the spread extension)."""
 
     pack_constraint: Optional[TopologyPackConstraint] = None
+    spread_constraint: Optional[TopologySpreadConstraint] = None
 
 
 @dataclass

@@ -90,6 +90,9 @@ def _decode_request(request: pb.SolveRequest):
                 ],
                 "required_key": gang.required_level_key or None,
                 "preferred_key": gang.preferred_level_key or None,
+                "spread_key": gang.spread_level_key or None,
+                "spread_min_domains": gang.spread_min_domains or 2,
+                "spread_required": gang.spread_required,
                 "priority": gang.priority,
                 "gang_pinned_node": gang.pinned_node or None,
             }
@@ -108,11 +111,19 @@ def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
     from grove_tpu.solver.encode import build_problem
     from grove_tpu.solver.kernel import solve_waves
 
+    from grove_tpu.solver.encode import ConstraintError
+
     try:
         nodes, gang_specs, topology = _decode_request(request)
     except Exception as exc:
         raise RequestDecodeError(str(exc)) from exc
-    problem = build_problem(nodes, gang_specs, topology)
+    try:
+        problem = build_problem(nodes, gang_specs, topology)
+    except ConstraintError as exc:
+        # declared-constraint contradictions (unknown hard keys, spread +
+        # per-group pack) are the caller's fault → INVALID_ARGUMENT; any
+        # other encoder failure stays a server-side INTERNAL error
+        raise RequestDecodeError(str(exc)) from exc
     solve_kwargs = {"with_alloc": not request.options.stats_only}
     if request.options.chunk_size:
         solve_kwargs["chunk_size"] = request.options.chunk_size
@@ -236,6 +247,9 @@ def build_request(
         gang.name = spec["name"]
         gang.required_level_key = spec.get("required_key") or ""
         gang.preferred_level_key = spec.get("preferred_key") or ""
+        gang.spread_level_key = spec.get("spread_key") or ""
+        gang.spread_min_domains = int(spec.get("spread_min_domains") or 0)
+        gang.spread_required = bool(spec.get("spread_required", False))
         gang.priority = int(spec.get("priority", 0))
         gang.pinned_node = spec.get("gang_pinned_node") or ""
         for grp in spec["groups"]:

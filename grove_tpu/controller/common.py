@@ -163,15 +163,31 @@ def translate_topology_constraint(
     """Operator-side level *name* → scheduler-side topology *key* translation
     (docs/designs/topology.md:541-616): the user's packDomain becomes the
     `required` key; the topology's narrowest level becomes the auto-generated
-    `preferred` key."""
-    if tc is None or tc.pack_domain is None or topology is None:
+    `preferred` key; spreadDomain becomes a TopologySpreadConstraint."""
+    if tc is None or topology is None:
         return None
-    return SchedTopologyConstraint(
-        pack_constraint=TopologyPackConstraint(
+    pack = spread = None
+    if tc.pack_domain is not None:
+        pack = TopologyPackConstraint(
             required=topology.translate_pack_domain(tc.pack_domain),
             preferred=topology.narrowest_key(),
         )
-    )
+    if tc.spread_domain is not None:
+        from grove_tpu.api.types import (
+            SPREAD_DO_NOT_SCHEDULE,
+            TopologySpreadConstraint,
+        )
+
+        spread = TopologySpreadConstraint(
+            topology_key=topology.translate_pack_domain(tc.spread_domain),
+            min_domains=tc.spread_min_domains or 2,
+            when_unsatisfiable=(
+                tc.spread_when_unsatisfiable or SPREAD_DO_NOT_SCHEDULE
+            ),
+        )
+    if pack is None and spread is None:
+        return None
+    return SchedTopologyConstraint(pack_constraint=pack, spread_constraint=spread)
 
 
 def pcs_child_selector(pcs_name: str) -> Dict[str, str]:

@@ -63,6 +63,18 @@ class GangInputs(NamedTuple):
     # recovery delta-solve could split the live gang across two domains in
     # violation of TopologyPackConstraint.Required
     gang_pin: jnp.ndarray = None  # scalar
+    # topology SPREAD constraint (TopologySpreadConstraint): level whose
+    # domains the gang's pods must be distributed across (-1 none). Composes
+    # with packing: req_level packs the gang into one broad domain while
+    # spread_level balances its pods across the narrower domains inside it
+    # (e.g. pack within a slice, spread across hosts for fault tolerance).
+    spread_level: jnp.ndarray = None  # scalar
+    # minimum distinct domains the placement must span (effective floor is
+    # min(spread_min, pods placed)); <=1 → balance only
+    spread_min: jnp.ndarray = None  # scalar
+    # hard vs soft: required spread rejects placements spanning fewer than
+    # spread_min domains (DoNotSchedule); soft spread only shapes the score
+    spread_required: jnp.ndarray = None  # scalar bool
 
 
 def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
@@ -194,6 +206,172 @@ def _fill(free, mask, demand, count):
     return alloc, placed, free_after
 
 
+def _spread_defaults(g_shape, spread_level, spread_min, spread_required):
+    """Fill unset spread tensors with their sentinels (no constraint)."""
+    if spread_level is None:
+        spread_level = jnp.full(g_shape, -1, dtype=jnp.int32)
+    if spread_min is None:
+        spread_min = jnp.zeros(g_shape, dtype=jnp.int32)
+    if spread_required is None:
+        spread_required = jnp.zeros(g_shape, dtype=bool)
+    return spread_level, spread_min, spread_required
+
+
+def _spread_quota(
+    K: jnp.ndarray, cnt: jnp.ndarray, load: jnp.ndarray
+) -> jnp.ndarray:
+    """Balanced (water-filling) per-domain quota: q[d] <= K[d],
+    sum(q) = min(cnt, sum(K)), and max(q) minimized — the most even
+    distribution of `cnt` pods over domains with capacities K.
+
+    The water level t = smallest integer with sum(min(K, t)) >= cnt is found
+    by a fixed 22-step bisection (counts are capped at _INT_CAP), then the
+    overshoot sum(min(K, t)) - cnt is shaved off — f(t) - f(t-1) =
+    #{K >= t} guarantees the overshoot is strictly smaller than the
+    water-level set, so every quota stays >= t-1 >= 0."""
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        ge = jnp.sum(jnp.minimum(K, mid)) >= cnt
+        return (jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi))
+
+    _, t = jax.lax.fori_loop(
+        0, 22, body, (jnp.int32(0), jnp.int32(2 * _INT_CAP))
+    )
+    q0 = jnp.minimum(K, t)
+    excess = jnp.sum(q0) - jnp.minimum(cnt, jnp.sum(K))
+    at_level = K >= t
+    # The overshoot is shaved off the MOST-LOADED water-level domains
+    # (load = pods this gang already placed per domain by earlier groups).
+    # Shaving a fixed domain order instead would skip the same domains for
+    # every group, and a multi-group gang could systematically miss them —
+    # load-aware shaving is what makes the per-group fills jointly span the
+    # most domains. Ties break toward shaving the highest index, keeping
+    # early domains occupied. Non-candidates sort last (load -1).
+    d = K.shape[0]
+    load_eff = jnp.where(at_level, load, -1)
+    perm = jnp.lexsort((-jnp.arange(d), -load_eff))
+    rank = jnp.argsort(perm)
+    shave = at_level & (rank < excess)
+    return q0 - shave.astype(jnp.int32)
+
+
+def _fill_spread(
+    free, mask, demand, count, topo_col, starts_l, ends_l, load0=None
+):
+    """Sequentially fill each group inside `mask`, BALANCING pods across the
+    contiguous domains of one level instead of packing: per-group
+    water-filled domain quotas (_spread_quota, load-aware so the groups
+    jointly span the most domains), then an in-domain exclusive prefix take
+    against each domain's quota. Same prefix-sum/gather-only structure as
+    _fill — no scatters.
+    Returns (alloc [P,N], placed [P], free_after, load [D])."""
+
+    def group_step(carry, inputs):
+        free_c, load = carry
+        demand_p, count_p = inputs
+        k = _pods_fit_per_node(free_c, demand_p)
+        k = jnp.minimum(jnp.where(mask, k, 0), count_p)
+        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), jnp.cumsum(k)])
+        K = cs[ends_l] - cs[starts_l]  # [D] per-domain fit counts
+        q = _spread_quota(K, count_p, load)
+        # in-domain exclusive prefix: node n's fill position inside its slab
+        in_dom = cs[:-1] - cs[starts_l[topo_col]]
+        take = jnp.clip(q[topo_col] - in_dom, 0, k)
+        free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
+        cs_t = jnp.concatenate([jnp.zeros((1,), take.dtype), jnp.cumsum(take)])
+        load = load + (cs_t[ends_l] - cs_t[starts_l])
+        return (free_c, load), (take, take.sum())
+
+    if load0 is None:
+        load0 = jnp.zeros(starts_l.shape, dtype=jnp.int32)
+    (free_after, load), (alloc, placed) = jax.lax.scan(
+        group_step, (free, load0), (demand, count)
+    )
+    return alloc, placed, free_after, load
+
+
+def _fill_spread_floors_first(
+    free, mask, demand, count, min_count, topo_col, starts_l, ends_l
+):
+    """Floors-first two-phase spread fill (same contract as
+    _fill_floors_first) plus the count of distinct domains the final
+    placement spans at the spread level.
+    Returns (alloc [P,N], placed [P], placed_min [P], free_after, used)."""
+    floors = jnp.minimum(min_count, count)
+    extras = jnp.maximum(count - min_count, 0)
+    alloc_min, placed_min, free1, load1 = _fill_spread(
+        free, mask, demand, floors, topo_col, starts_l, ends_l
+    )
+    alloc_ext, placed_ext, free2, load2 = _fill_spread(
+        free1, mask, demand, extras, topo_col, starts_l, ends_l, load1
+    )
+    alloc = alloc_min + alloc_ext
+    used = jnp.sum((load2 > 0).astype(jnp.int32))
+    return alloc, placed_min + placed_ext, placed_min, free2, used
+
+
+def _spread_select(gang: GangInputs, seg_starts, seg_ends, topo):
+    """Per-gang spread-level segment views (safe index when unset)."""
+    sl = jnp.maximum(gang.spread_level, 0)
+    return (
+        gang.spread_level >= 0,
+        jnp.take(topo, sl, axis=1),
+        seg_starts[sl],
+        seg_ends[sl],
+    )
+
+
+def _dispatch_with_spread(
+    spread, grouped, free, mask, gang: GangInputs,
+    topo, seg_starts, seg_ends, seed,
+):
+    """Fill dispatch for problems that may mix spread and non-spread gangs:
+    with the static `spread` flag off, exactly the plain dispatch; with it
+    on, both variants are computed and selected per gang (spread problems
+    pay the double fill, everyone else compiles it away).
+    Returns (alloc, placed, placed_min, free_after, used, spread_on)."""
+    if not spread:
+        a, p, pm, f = _fill_dispatch(
+            grouped, free, mask, gang.demand, gang.count, gang.min_count,
+            gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+        )
+        return a, p, pm, f, jnp.int32(0), jnp.asarray(False)
+    spread_on, topo_col, starts_l, ends_l = _spread_select(
+        gang, seg_starts, seg_ends, topo
+    )
+    a_s, p_s, pm_s, f_s, used = _fill_spread_floors_first(
+        free, mask, gang.demand, gang.count, gang.min_count,
+        topo_col, starts_l, ends_l,
+    )
+    a_n, p_n, pm_n, f_n = _fill_dispatch(
+        grouped, free, mask, gang.demand, gang.count, gang.min_count,
+        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+    )
+    alloc = jnp.where(spread_on, a_s, a_n)
+    placed = jnp.where(spread_on, p_s, p_n)
+    placed_min = jnp.where(spread_on, pm_s, pm_n)
+    free_after = jnp.where(spread_on, f_s, f_n)
+    return alloc, placed, placed_min, free_after, used, spread_on
+
+
+def _spread_admit(gang: GangInputs, spread_on, used, placed_total):
+    """Hard-spread admission: a required spread rejects placements spanning
+    fewer than min(spread_min, pods placed) distinct domains."""
+    eff = jnp.minimum(jnp.maximum(gang.spread_min, 1), placed_total)
+    return jnp.where(spread_on & gang.spread_required, used >= eff, True)
+
+
+def _spread_score(gang: GangInputs, spread_on, used, placed_total, coloc):
+    """Score select: a spread gang's PlacementScore is its domain coverage
+    toward the spread target (1.0 = target met) — replacing the co-location
+    score, whose objective points the other way."""
+    eff = jnp.minimum(jnp.maximum(gang.spread_min, 1), placed_total)
+    cover = used.astype(jnp.float32) / jnp.maximum(eff, 1).astype(jnp.float32)
+    return jnp.where(spread_on, jnp.clip(cover, 0.0, 1.0), coloc)
+
+
 def _level_weights(num_levels: int) -> jnp.ndarray:
     w = jnp.arange(1, num_levels + 1, dtype=jnp.float32)
     return w / w.sum()
@@ -276,6 +454,7 @@ def gang_select_and_fill(
     gang: GangInputs,
     grouped: bool = False,
     pinned: bool = False,
+    spread: bool = False,
 ):
     """One gang's placement decision against `free`.
 
@@ -335,44 +514,61 @@ def gang_select_and_fill(
     lv = jnp.arange(n_levels)
     min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
 
-    cand_alloc, cand_placed, cand_free, cand_ok = [], [], [], []
+    cand_alloc, cand_placed, cand_free, cand_ok, cand_used = [], [], [], [], []
+    spread_on = jnp.asarray(False)
     for l in range(n_levels):
         ok_l, best_l = level_candidate(l)
         mask_l = jnp.where(ok_l, (topo[:, l] == best_l) & pin_mask, no_nodes)
-        alloc_l, placed_l, placed_min_l, free_l = _fill_dispatch(
-            grouped, free, mask_l, gang.demand, gang.count, gang.min_count,
-            gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
-            jnp.int32(0),
+        alloc_l, placed_l, placed_min_l, free_l, used_l, spread_on = (
+            _dispatch_with_spread(
+                spread, grouped, free, mask_l, gang,
+                topo, seg_starts, seg_ends, jnp.int32(0),
+            )
         )
         fill_ok = (
             ok_l
             & (lv[l] >= min_allowed)
             & jnp.all(jnp.where(active, placed_min_l >= gang.min_count, True))
+            & _spread_admit(gang, spread_on, used_l, placed_l.sum())
         )
         cand_alloc.append(alloc_l)
         cand_placed.append(placed_l)
         cand_free.append(free_l)
         cand_ok.append(fill_ok)
+        cand_used.append(used_l)
     # cluster-wide fallback (only when no required pack level)
-    alloc_c, placed_c, placed_min_c, free_c = _fill_dispatch(
-        grouped, free, all_nodes, gang.demand, gang.count, gang.min_count,
-        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends,
-        jnp.int32(0),
+    alloc_c, placed_c, placed_min_c, free_c, used_c, spread_on = (
+        _dispatch_with_spread(
+            spread, grouped, free, all_nodes, gang,
+            topo, seg_starts, seg_ends, jnp.int32(0),
+        )
     )
     cluster_ok = (
         (gang.req_level < 0)
         & any_active
         & jnp.all(jnp.where(active, placed_min_c >= gang.min_count, True))
+        & _spread_admit(gang, spread_on, used_c, placed_c.sum())
     )
     cand_alloc.append(alloc_c)
     cand_placed.append(placed_c)
     cand_free.append(free_c)
     cand_ok.append(cluster_ok)
+    cand_used.append(used_c)
 
     oks = jnp.stack(cand_ok)  # [L+1]
     # Preference order (TopologyPackConstraint.Preferred): preferred level
     # first, then closest levels (narrower wins ties), cluster-wide last.
     pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    if spread:
+        # spread gangs prefer the BROADEST allowed mask (their required pack
+        # level, else the broadest level): a narrow mask holds few
+        # spread-level domains, and narrow-first preference would leave a
+        # SOFT (ScheduleAnyway) spread gang packed into one domain even
+        # with the whole cluster free — the wave kernel (gang_select_single)
+        # applies the same override, keeping the two kernels consistent
+        pref_eff = jnp.where(
+            spread_on, jnp.maximum(gang.req_level, 0), pref_eff
+        )
     level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
     pref_rank = jnp.concatenate(
         [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
@@ -388,12 +584,16 @@ def gang_select_and_fill(
         one_hot[i] * cand_placed[i].astype(free.dtype) for i in range(n_levels + 1)
     ).astype(jnp.int32)
     free_after = sum(one_hot[i] * cand_free[i] for i in range(n_levels + 1))
+    used = sum(
+        one_hot[i] * cand_used[i].astype(free.dtype) for i in range(n_levels + 1)
+    ).astype(jnp.int32)
 
     # best-effort extras: pods beyond the packed domain scatter cluster-wide
-    # (no gang-level required constraint, and never for group-constrained
-    # groups — their extras must stay inside their chosen domain)
+    # (no gang-level required constraint, never for group-constrained groups
+    # — their extras must stay inside their chosen domain — and never for
+    # spread gangs, whose whole allocation comes from the balanced fill)
     chose_packed_level = ok_min & (chosen < n_levels)
-    spill = (gang.req_level < 0) & chose_packed_level
+    spill = (gang.req_level < 0) & chose_packed_level & ~spread_on
     remaining = jnp.where(
         spill & (gang.group_req < 0), gang.count - placed, 0
     )
@@ -410,11 +610,16 @@ def gang_select_and_fill(
     chosen_l = jnp.where(any_level, chosen, -1)
 
     score = _coloc_score(alloc, placed_total, seg_starts, seg_ends, weights, ok_min)
+    score = jnp.where(
+        ok_min,
+        _spread_score(gang, spread_on, used, placed_total.sum(), score),
+        0.0,
+    )
 
     return free_new, alloc, placed_total, ok_min, chosen_l, score
 
 
-@partial(jax.jit, static_argnames=("with_alloc", "grouped", "pinned"))
+@partial(jax.jit, static_argnames=("with_alloc", "grouped", "pinned", "spread"))
 def solve_packing(
     capacity: jnp.ndarray,  # [N, R] float32
     topo: jnp.ndarray,  # [N, L] int32, dense ids per level
@@ -428,9 +633,13 @@ def solve_packing(
     group_req: jnp.ndarray = None,  # [G, P] int32 (-1 none)
     group_pin: jnp.ndarray = None,  # [G, P] int32 (-1 none)
     gang_pin: jnp.ndarray = None,  # [G] int32 (-1 none)
+    spread_level: jnp.ndarray = None,  # [G] int32 (-1 none)
+    spread_min: jnp.ndarray = None,  # [G] int32
+    spread_required: jnp.ndarray = None,  # [G] bool
     with_alloc: bool = True,
     grouped: bool = False,
     pinned: bool = False,
+    spread: bool = False,
 ):
     """Exact sequential greedy (oracle-parity kernel)."""
     if group_req is None:
@@ -439,11 +648,14 @@ def solve_packing(
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
+    spread_level, spread_min, spread_required = _spread_defaults(
+        count.shape[:1], spread_level, spread_min, spread_required
+    )
 
     def gang_step(free, gang: GangInputs):
         free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
             free, topo, seg_starts, seg_ends, gang, grouped=grouped,
-            pinned=pinned,
+            pinned=pinned, spread=spread,
         )
         ys = (ok_min, placed, score, chosen_l)
         if with_alloc:
@@ -459,6 +671,9 @@ def solve_packing(
         group_req=group_req,
         group_pin=group_pin,
         gang_pin=gang_pin,
+        spread_level=spread_level,
+        spread_min=spread_min,
+        spread_required=spread_required,
     )
     free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
     if with_alloc:
@@ -476,7 +691,7 @@ def solve_packing(
     }
 
 
-@partial(jax.jit, static_argnames=("commit_iters", "grouped", "pinned"))
+@partial(jax.jit, static_argnames=("commit_iters", "grouped", "pinned", "spread"))
 def solve_wave_chunk(
     free: jnp.ndarray,  # [N, R]
     topo: jnp.ndarray,  # [N, L]
@@ -493,9 +708,13 @@ def solve_wave_chunk(
     group_req: jnp.ndarray = None,  # [C, P]
     group_pin: jnp.ndarray = None,  # [C, P]
     gang_pin: jnp.ndarray = None,  # [C]
+    spread_level: jnp.ndarray = None,  # [C]
+    spread_min: jnp.ndarray = None,  # [C]
+    spread_required: jnp.ndarray = None,  # [C]
     commit_iters: int = 2,
     grouped: bool = False,
     pinned: bool = False,
+    spread: bool = False,
 ):
     """One wave over one chunk, with per-pod allocations materialized (the
     binding path). Same core as the device-resident stats solver."""
@@ -505,6 +724,9 @@ def solve_wave_chunk(
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
+    spread_level, spread_min, spread_required = _spread_defaults(
+        count.shape[:1], spread_level, spread_min, spread_required
+    )
     free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
         wave_chunk_core(
             free,
@@ -522,9 +744,13 @@ def solve_wave_chunk(
             group_req,
             group_pin,
             gang_pin,
+            spread_level,
+            spread_min,
+            spread_required,
             commit_iters,
             grouped,
             pinned,
+            spread,
         )
     )
     n_levels = topo.shape[1]
@@ -550,8 +776,9 @@ def solve_wave_chunk(
 
 def wave_chunk_core(
     free, topo, seg_starts, seg_ends,
-    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin, commit_iters,
-    grouped=False, pinned=False,
+    dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
+    spreadlvl, spreadmin, spreadreq, commit_iters,
+    grouped=False, pinned=False, spread=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
@@ -560,9 +787,14 @@ def wave_chunk_core(
     Returns (free, accept, placed, score, chosen, retry, new_cap,
     fill_failed, alloc)."""
     cnt = cnt * pend[:, None]
-    inputs = GangInputs(dem, cnt, mn, rq, pf, grq, gpin, gangpin)
+    inputs = GangInputs(
+        dem, cnt, mn, rq, pf, grq, gpin, gangpin,
+        spreadlvl, spreadmin, spreadreq,
+    )
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
-        lambda *xs: gang_select_single(*xs, grouped=grouped, pinned=pinned),
+        lambda *xs: gang_select_single(
+            *xs, grouped=grouped, pinned=pinned, spread=spread
+        ),
         in_axes=(None, None, None, None, 0, 0, 0),
     )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
 
@@ -600,7 +832,7 @@ def wave_chunk_core(
 
 def gang_select_single(
     free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
-    grouped: bool = False, pinned: bool = False,
+    grouped: bool = False, pinned: bool = False, spread: bool = False,
 ):
     """Single-fill variant of gang_select_and_fill for the wave solver.
 
@@ -668,6 +900,15 @@ def gang_select_single(
     min_allowed = jnp.where(gang.req_level >= 0, gang.req_level, 0)
     allowed = oks & (lv >= min_allowed) & (lv <= narrow_cap)
     pref_eff = jnp.where(gang.pref_level >= 0, gang.pref_level, n_levels - 1)
+    if spread:
+        # a spread gang gets ONE fill attempt per wave: aim at the broadest
+        # allowed mask (its required pack level, else the broadest level) —
+        # a narrow mask holds few spread-level domains, and walking broader
+        # via fill-failure retries would burn a wave per level
+        s_on = gang.spread_level >= 0 if gang.spread_level is not None else (
+            jnp.asarray(False)
+        )
+        pref_eff = jnp.where(s_on, jnp.maximum(gang.req_level, 0), pref_eff)
     level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
     has_level = jnp.any(allowed)
     chosen_level = jnp.argmax(jnp.where(allowed, level_rank + 1, 0))
@@ -681,14 +922,17 @@ def gang_select_single(
         has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
     )
 
-    alloc, placed, placed_min, free_after = _fill_dispatch(
-        grouped, free, mask, gang.demand, gang.count, gang.min_count,
-        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+    alloc, placed, placed_min, free_after, used, spread_on = (
+        _dispatch_with_spread(
+            spread, grouped, free, mask, gang,
+            topo, seg_starts, seg_ends, seed,
+        )
     )
     level_fill_ok = (
         had_candidate
         & any_active
         & jnp.all(jnp.where(active, placed_min >= gang.min_count, True))
+        & _spread_admit(gang, spread_on, used, placed.sum())
     )
 
     # when the level fill fails, the retry cap jumps straight to the next
@@ -709,7 +953,10 @@ def gang_select_single(
         & (fallback_cap < 0)
         & any_active
     )
-    spill = level_fill_ok & has_level & (gang.req_level < 0)
+    # spread gangs never spill: their whole allocation comes from the
+    # balanced fill (rescue still applies — it re-runs the spread fill
+    # cluster-wide, where more domains are visible)
+    spill = level_fill_ok & has_level & (gang.req_level < 0) & ~spread_on
     base_free = jnp.where(cluster_rescue, free, free_after)
     # extras of group-constrained groups must stay inside their chosen
     # domain — only unconstrained groups may spill cluster-wide
@@ -720,12 +967,15 @@ def gang_select_single(
         jnp.where(spill & spillable, gang.count - placed, 0),
     )
     rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
-    alloc2, placed2, placed2_min, _ = _fill_dispatch(
-        grouped, base_free, all_nodes, gang.demand, remaining, rescue_min,
-        gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+    alloc2, placed2, placed2_min, _, used2, _ = _dispatch_with_spread(
+        spread, grouped, base_free, all_nodes,
+        gang._replace(count=remaining, min_count=rescue_min),
+        topo, seg_starts, seg_ends, seed,
     )
-    rescue_ok = cluster_rescue & jnp.all(
-        jnp.where(active, placed2_min >= gang.min_count, True)
+    rescue_ok = (
+        cluster_rescue
+        & jnp.all(jnp.where(active, placed2_min >= gang.min_count, True))
+        & _spread_admit(gang, spread_on, used2, placed2.sum())
     )
     alloc = jnp.where(
         rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)
@@ -733,6 +983,7 @@ def gang_select_single(
     placed = jnp.where(
         rescue_ok, placed2, jnp.where(spill, placed + placed2, placed)
     )
+    used = jnp.where(rescue_ok, used2, used)
     fill_ok = level_fill_ok | rescue_ok
     chosen_level = jnp.where(rescue_ok, n_levels, chosen_level)
     has_level = has_level & ~rescue_ok
@@ -742,6 +993,9 @@ def gang_select_single(
     placed = jnp.where(fill_ok, placed, 0)
 
     score = _coloc_score(alloc, placed, seg_starts, seg_ends, weights, fill_ok)
+    score = jnp.where(
+        fill_ok, _spread_score(gang, spread_on, used, placed.sum(), score), 0.0
+    )
 
     chosen = jnp.where(
         has_level, chosen_level, jnp.where(use_cluster, n_levels, -1)
@@ -751,7 +1005,9 @@ def gang_select_single(
 
 @partial(
     jax.jit,
-    static_argnames=("n_chunks", "max_waves", "commit_iters", "grouped", "pinned"),
+    static_argnames=(
+        "n_chunks", "max_waves", "commit_iters", "grouped", "pinned", "spread"
+    ),
 )
 def solve_waves_device(
     capacity,  # [N, R]
@@ -766,11 +1022,15 @@ def solve_waves_device(
     group_req=None,  # [G, P]
     group_pin=None,  # [G, P]
     gang_pin=None,  # [G]
+    spread_level=None,  # [G]
+    spread_min=None,  # [G]
+    spread_required=None,  # [G]
     n_chunks: int = 20,
     max_waves: int = 8,
     commit_iters: int = 2,
     grouped: bool = False,
     pinned: bool = False,
+    spread: bool = False,
 ):
     """Whole multi-wave wave-parallel solve in ONE device program — zero
     host↔device round trips until the final results (critical when the chip
@@ -793,6 +1053,9 @@ def solve_waves_device(
         group_pin = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full((g_total,), -1, dtype=jnp.int32)
+    spread_level, spread_min, spread_required = _spread_defaults(
+        (g_total,), spread_level, spread_min, spread_required
+    )
     c = g_total // n_chunks
 
     def reshape_chunks(a):
@@ -814,7 +1077,10 @@ def solve_waves_device(
     def chunk_step(free, xs):
         # settled chunks skip the whole decision+commit (lax.cond executes
         # one branch): waves after the first mostly touch a few chunks
-        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
+        (
+            dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
+            slvl, smin, sreq,
+        ) = xs
         c_gangs = dem.shape[0]
 
         def passthrough(free):
@@ -833,12 +1099,16 @@ def solve_waves_device(
         )
 
     def _active_chunk_step(free, xs):
-        dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin = xs
+        (
+            dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
+            slvl, smin, sreq,
+        ) = xs
         free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
             wave_chunk_core(
                 free, topo, seg_starts, seg_ends,
                 dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-                commit_iters, grouped, pinned,
+                slvl, smin, sreq,
+                commit_iters, grouped, pinned, spread,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)
@@ -866,6 +1136,9 @@ def solve_waves_device(
                 reshape_chunks(group_req),
                 reshape_chunks(group_pin),
                 reshape_chunks(gang_pin),
+                reshape_chunks(spread_level),
+                reshape_chunks(spread_min),
+                reshape_chunks(spread_required),
             ),
         )
         accept, placed, score, chosen, retry, new_cap, fill_failed = (

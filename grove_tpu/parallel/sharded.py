@@ -125,7 +125,7 @@ def solve_stress_sharded(
     from grove_tpu.solver.kernel import pad_problem_for_waves
 
     g = problem.num_gangs
-    raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(
+    raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
         problem, chunk_size
     )
     node_sh = NamedSharding(mesh, P("tp", None))
@@ -144,6 +144,7 @@ def solve_stress_sharded(
             max_waves=max_waves,
             grouped=grouped,
             pinned=pinned,
+            spread=spread,
         )
 
     if jax.process_count() > 1:

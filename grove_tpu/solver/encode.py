@@ -17,6 +17,13 @@ from grove_tpu.api.topology import ClusterTopology
 from grove_tpu.solver.types import PackingProblem
 
 
+class ConstraintError(ValueError):
+    """A gang carries an unsatisfiable/contradictory constraint DECLARATION
+    (unknown hard topology key, spread combined with per-group packs) — the
+    caller's input is at fault, distinct from solver-side failures. The gRPC
+    sidecar maps this to INVALID_ARGUMENT."""
+
+
 def _next_pow2(x: int) -> int:
     n = 1
     while n < x:
@@ -120,7 +127,7 @@ def level_index_for_key(
         if required:
             # A HARD pack constraint must never silently degrade to
             # cluster-wide scatter (TopologyPackConstraint.Required).
-            raise ValueError(
+            raise ConstraintError(
                 f"required topology key {key!r} is not a level of the cluster"
                 f" topology {level_keys}"
             )
@@ -148,6 +155,9 @@ def encode_gangs(
     group_req = np.full((gp, pp), -1, dtype=np.int32)
     req_level = np.full((gp,), -1, dtype=np.int32)
     pref_level = np.full((gp,), -1, dtype=np.int32)
+    spread_level = np.full((gp,), -1, dtype=np.int32)
+    spread_min = np.zeros((gp,), dtype=np.int32)
+    spread_required = np.zeros((gp,), dtype=bool)
     priority = np.zeros((gp,), dtype=np.int32)
     gang_names: List[str] = []
     group_names: List[List[str]] = []
@@ -169,6 +179,24 @@ def encode_gangs(
             level_keys, spec.get("required_key"), required=True
         )
         pref_level[gi] = level_index_for_key(level_keys, spec.get("preferred_key"))
+        # spread: a hard (required) spread key must resolve, like a hard pack
+        spread_required[gi] = bool(spec.get("spread_required", False))
+        spread_level[gi] = level_index_for_key(
+            level_keys, spec.get("spread_key"), required=spread_required[gi]
+        )
+        if spread_level[gi] < 0:
+            spread_required[gi] = False
+        elif (group_req[gi] >= 0).any():
+            # the balanced spread fill places the whole gang and cannot
+            # honor per-group hard packs at the same time — reject at the
+            # solver boundary (operator admission enforces the same rule,
+            # but external gRPC clients reach the encoder directly and a
+            # silent group-pack violation must never look admitted)
+            raise ConstraintError(
+                f"gang {spec['name']!r}: spread_key cannot be combined with"
+                " per-group required pack constraints"
+            )
+        spread_min[gi] = int(spec.get("spread_min_domains", 2) or 2)
         priority[gi] = spec.get("priority", 0)
 
     return (
@@ -179,6 +207,9 @@ def encode_gangs(
         pref_level,
         priority,
         group_req,
+        spread_level,
+        spread_min,
+        spread_required,
         gang_names,
         group_names,
     )
@@ -239,6 +270,9 @@ def build_problem(
         pref_level,
         priority,
         group_req,
+        spread_level,
+        spread_min,
+        spread_required,
         gang_names,
         group_names,
     ) = encode_gangs(gang_specs, resource_names, level_keys, pad_gangs, pad_groups)
@@ -277,6 +311,9 @@ def build_problem(
         min_count=min_count,
         req_level=req_level,
         pref_level=pref_level,
+        spread_level=spread_level,
+        spread_min=spread_min,
+        spread_required=spread_required,
         priority=priority,
         node_names=node_names,
         gang_names=gang_names,

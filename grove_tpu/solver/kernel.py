@@ -46,25 +46,50 @@ def _maybe_enable_disk_cache() -> None:
         pass
 
 
-def _get_compiled(args, with_alloc: bool, grouped: bool, pinned: bool):
+def _get_compiled(args, with_alloc: bool, grouped: bool, pinned: bool, spread: bool):
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         with_alloc,
         grouped,
         pinned,
+        spread,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         _maybe_enable_disk_cache()
         t0 = time.perf_counter()
         compiled = solve_packing.lower(
-            *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned
+            *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned,
+            spread=spread,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
     return compiled
 
 
+def _spread_arrays(problem: PackingProblem):
+    """Spread tensors with sentinel defaults (problems built before the
+    spread feature, or by hand in tests, may leave them None)."""
+    g = problem.num_gangs
+    sl = (
+        problem.spread_level
+        if problem.spread_level is not None
+        else np.full((g,), -1, dtype=np.int32)
+    )
+    sm = (
+        problem.spread_min
+        if problem.spread_min is not None
+        else np.zeros((g,), dtype=np.int32)
+    )
+    sr = (
+        problem.spread_required
+        if problem.spread_required is not None
+        else np.zeros((g,), dtype=bool)
+    )
+    return sl, sm, sr
+
+
 def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
+    spread_level, spread_min, spread_required = _spread_arrays(problem)
     args = (
         jnp.asarray(problem.capacity),
         jnp.asarray(problem.topo),
@@ -78,10 +103,14 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         jnp.asarray(problem.group_req),
         jnp.asarray(problem.group_pin),
         jnp.asarray(problem.gang_pin),
+        jnp.asarray(spread_level),
+        jnp.asarray(spread_min),
+        jnp.asarray(spread_required),
     )
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
-    compiled = _get_compiled(args, with_alloc, grouped, pinned)
+    spread = bool((spread_level >= 0).any())
+    compiled = _get_compiled(args, with_alloc, grouped, pinned, spread)
     t0 = time.perf_counter()
     out = compiled(*args)
     admitted = np.asarray(out["admitted"])  # device sync
@@ -124,6 +153,7 @@ def solve_waves(
         width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, width, constant_values=value)
 
+    spread_level_a, spread_min_a, spread_required_a = _spread_arrays(problem)
     demand = pad(problem.demand)
     count = pad(problem.count)
     min_count = pad(problem.min_count)
@@ -132,6 +162,9 @@ def solve_waves(
     group_req = pad(problem.group_req, -1)
     group_pin = pad(problem.group_pin, -1)
     gang_pin = pad(problem.gang_pin, -1)
+    spread_level = pad(spread_level_a, -1)
+    spread_min = pad(spread_min_a)
+    spread_required = pad(spread_required_a)
 
     _maybe_enable_disk_cache()  # solve_wave_chunk compiles via plain jit
     free = jnp.asarray(problem.capacity)
@@ -155,6 +188,7 @@ def solve_waves(
 
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
+    spread = bool((spread_level >= 0).any())
     # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
     # change between waves; re-uploading per wave would pay the remote-link
     # latency this path exists to avoid)
@@ -163,10 +197,12 @@ def solve_waves(
             jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
             for a in (demand, count, min_count, req_level, pref_level)
         )
-        + (
-            jnp.asarray(group_req[c * chunk_size : (c + 1) * chunk_size]),
-            jnp.asarray(group_pin[c * chunk_size : (c + 1) * chunk_size]),
-            jnp.asarray(gang_pin[c * chunk_size : (c + 1) * chunk_size]),
+        + tuple(
+            jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
+            for a in (
+                group_req, group_pin, gang_pin,
+                spread_level, spread_min, spread_required,
+            )
         )
         for c in range(n_chunks)
     ]
@@ -184,9 +220,10 @@ def solve_waves(
             mask = pending[sl]
             if not mask.any():
                 continue
-            dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c = (
-                chunk_const[c]
-            )
+            (
+                dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c,
+                slvl_c, smin_c, sreq_c,
+            ) = chunk_const[c]
             out = solve_wave_chunk(
                 free,
                 topo,
@@ -203,8 +240,12 @@ def solve_waves(
                 group_req=grq_c,
                 group_pin=gpin_c,
                 gang_pin=gangpin_c,
+                spread_level=slvl_c,
+                spread_min=smin_c,
+                spread_required=sreq_c,
                 grouped=grouped,
                 pinned=pinned,
+                spread=spread,
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -243,14 +284,14 @@ def solve_waves(
 
 def pad_problem_for_waves(
     problem: PackingProblem, chunk_size: int
-) -> Tuple[Tuple[np.ndarray, ...], int, bool, bool]:
+) -> Tuple[Tuple[np.ndarray, ...], int, bool, bool, bool]:
     """SINGLE home for the wave solver's input-prep contract: clamp the
     chunk size, pad the gang axis to a chunk multiple (sentinel -1 for the
-    level/pin fields, 0 elsewhere), and decide the `grouped`/`pinned`
-    compile flags. Returns (args, n_chunks, grouped, pinned) where args is
-    the positional tuple of solve_waves_device. Shared by the stats path,
-    the node-sharded multi-chip path, and the parity tests — a
-    padding-contract change lands exactly once."""
+    level/pin fields, 0 elsewhere), and decide the `grouped`/`pinned`/
+    `spread` compile flags. Returns (args, n_chunks, grouped, pinned,
+    spread) where args is the positional tuple of solve_waves_device.
+    Shared by the stats path, the node-sharded multi-chip path, and the
+    parity tests — a padding-contract change lands exactly once."""
     g = problem.num_gangs
     chunk_size = min(chunk_size, max(g, 1))
     n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
@@ -262,6 +303,7 @@ def pad_problem_for_waves(
         width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, width, constant_values=value)
 
+    spread_level, spread_min, spread_required = _spread_arrays(problem)
     args = (
         problem.capacity,
         problem.topo,
@@ -275,10 +317,14 @@ def pad_problem_for_waves(
         pad(problem.group_req, -1),
         pad(problem.group_pin, -1),
         pad(problem.gang_pin, -1),
+        pad(spread_level, -1),
+        pad(spread_min),
+        pad(spread_required),
     )
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
-    return args, n_chunks, grouped, pinned
+    spread = bool((spread_level >= 0).any())
+    return args, n_chunks, grouped, pinned, spread
 
 
 def solve_waves_stats(
@@ -290,7 +336,7 @@ def solve_waves_stats(
     multi-wave loop runs as one XLA program — the stress-bench path. Returns
     stats only (no per-pod alloc); use solve_waves/solve for binding."""
     g = problem.num_gangs
-    raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(
+    raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
         problem, chunk_size
     )
     args = tuple(jnp.asarray(a) for a in raw_args)
@@ -299,6 +345,7 @@ def solve_waves_stats(
         max_waves,
         grouped,
         pinned,
+        spread,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
@@ -310,6 +357,7 @@ def solve_waves_stats(
             max_waves=max_waves,
             grouped=grouped,
             pinned=pinned,
+            spread=spread,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
@@ -340,6 +388,7 @@ def solve_waves_stats(
             width = [(0, t_pad - n_pending)] + [(0, 0)] * (a.ndim - 1)
             return np.pad(a[idx], width, constant_values=value)
 
+        sl_a, sm_a, sr_a = _spread_arrays(problem)
         tail = PackingProblem(
             capacity=free_after,
             topo=problem.topo,
@@ -351,6 +400,9 @@ def solve_waves_stats(
             group_req=tpad(problem.group_req, -1),
             group_pin=tpad(problem.group_pin, -1),
             gang_pin=tpad(problem.gang_pin, -1),
+            spread_level=tpad(sl_a, -1),
+            spread_min=tpad(sm_a),
+            spread_required=tpad(sr_a),
             priority=tpad(problem.priority),
             seg_starts=problem.seg_starts,
             seg_ends=problem.seg_ends,

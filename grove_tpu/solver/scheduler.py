@@ -23,6 +23,7 @@ from grove_tpu.api.types import (
     PHASE_PENDING,
     PHASE_RUNNING,
     PHASE_STARTING,
+    SPREAD_SCHEDULE_ANYWAY,
 )
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
@@ -429,10 +430,20 @@ class GangScheduler:
                     }
                 )
             required_key = preferred_key = None
+            spread_key = None
+            spread_min = 2
+            spread_required = False
             tc = gang_cr.spec.topology_constraint
             if tc is not None and tc.pack_constraint is not None:
                 required_key = tc.pack_constraint.required
                 preferred_key = tc.pack_constraint.preferred
+            if tc is not None and tc.spread_constraint is not None:
+                sc = tc.spread_constraint
+                spread_key = sc.topology_key
+                spread_min = sc.min_domains
+                spread_required = (
+                    sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
+                )
             required_key = self._narrower_key(required_key, collective_req)
             # gang-level recovery pin: a gang-level required pack (template
             # constraint or collective PCSG fold) with surviving pods must
@@ -463,6 +474,9 @@ class GangScheduler:
                     "groups": groups,
                     "required_key": required_key,
                     "preferred_key": preferred_key,
+                    "spread_key": spread_key,
+                    "spread_min_domains": spread_min,
+                    "spread_required": spread_required,
                     "gang_pinned_node": gang_pinned_node,
                     "priority": self.priority_map.get(
                         gang_cr.spec.priority_class_name, 0

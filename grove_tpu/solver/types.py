@@ -49,6 +49,12 @@ class PackingProblem:
     # replacements of a gang-level-constrained gang rejoin the survivors'
     # domain (never split a live gang across required domains)
     gang_pin: np.ndarray = None  # [G] int32
+    # topology SPREAD constraint (TopologySpreadConstraint): level whose
+    # domains the gang's pods are balanced across (-1 none); minimum distinct
+    # domains required; hard (reject) vs soft (score-only)
+    spread_level: np.ndarray = None  # [G] int32
+    spread_min: np.ndarray = None  # [G] int32
+    spread_required: np.ndarray = None  # [G] bool
 
     # bookkeeping (host side, not shipped to device)
     node_names: List[str] = field(default_factory=list)

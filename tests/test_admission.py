@@ -204,6 +204,70 @@ class TestValidationCreate:
         res = validate_podcliqueset(pcs, topology=ClusterTopology())
         assert any("stricter" in e for e in res.errors)
 
+    def test_spread_constraint_validation(self):
+        # valid: template-level spread, defaulted knobs
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="host"
+        )
+        default_podcliqueset(pcs)
+        tc = pcs.spec.template.topology_constraint
+        assert tc.spread_min_domains == 2
+        assert tc.spread_when_unsatisfiable == "DoNotSchedule"
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert res.ok, res.errors
+        # pack + spread composes when spread is strictly narrower
+        tc.pack_domain = "slice"
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert res.ok, res.errors
+
+    def test_spread_rejections(self):
+        # spread on a clique → gang-level only
+        pcs = defaulted_pcs()
+        pcs.spec.template.cliques[0].topology_constraint = TopologyConstraint(
+            spread_domain="host"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("template-level" in e for e in res.errors)
+        # spread not narrower than pack
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="host", spread_domain="slice"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("strictly narrower" in e for e in res.errors)
+        # minDomains < 2
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="host", spread_min_domains=1
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("at least 2" in e for e in res.errors)
+        # bad whenUnsatisfiable
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="host", spread_when_unsatisfiable="Sometimes"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("spreadWhenUnsatisfiable" in e for e in res.errors)
+        # unknown domain
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="bogus"
+        )
+        res = validate_podcliqueset(pcs)
+        assert any("unknown topology domain" in e for e in res.errors)
+        # gang spread + per-clique pack → mutually exclusive
+        pcs = defaulted_pcs()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="host"
+        )
+        pcs.spec.template.cliques[0].topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        res = validate_podcliqueset(pcs, topology=ClusterTopology())
+        assert any("cannot be combined" in e for e in res.errors)
+
     def test_forbidden_podspec_fields(self):
         pcs = defaulted_pcs()
         pcs.spec.template.cliques[0].spec.pod_spec.extra["nodeName"] = "n1"

@@ -99,6 +99,42 @@ class TestGrpcSolver:
             blocks = {node_block[a.node] for a in p.assignments}
             assert len(blocks) == 1, (p.gang, blocks)
 
+    def test_spread_constraint_over_the_wire(self):
+        """TopologySpreadConstraint survives the proto round trip and the
+        sidecar's placements span the required domains."""
+        nodes = make_nodes(16, capacity={"tpu": 4.0})
+        topology = ClusterTopology()
+        specs = _gang_specs(2)
+        for s in specs:
+            s["spread_key"] = "cloud.google.com/gke-tpu-ici-block"
+            s["spread_min_domains"] = 4
+            s["spread_required"] = True
+        request = build_request(nodes, specs, topology)
+        gang0 = request.gangs[0]
+        assert gang0.spread_level_key == "cloud.google.com/gke-tpu-ici-block"
+        assert gang0.spread_min_domains == 4
+        assert gang0.spread_required
+        server = SolverServer().start()
+        try:
+            client = SolverClient(server.address)
+            response = client.solve(request)
+            client.close()
+        finally:
+            server.stop()
+        node_block = {
+            n.name: n.labels["cloud.google.com/gke-tpu-ici-block"]
+            for n in nodes
+        }
+        admitted = [p for p in response.placements if p.admitted]
+        assert admitted
+        for p in admitted:
+            blocks = {node_block[a.node] for a in p.assignments}
+            pods = sum(a.count for a in p.assignments)
+            # effective floor is min(minDomains, pods placed): 3 pods can
+            # span at most 3 domains
+            assert len(blocks) >= min(4, pods), (p.gang, blocks)
+            assert len(blocks) == 3  # one pod per block for the 3-pod gangs
+
     def test_bad_request_is_invalid_argument(self):
         import grpc
         import pytest

@@ -327,6 +327,154 @@ class TestWaveSolver:
         np.testing.assert_array_equal(waves.placed, exact.placed)
 
 
+class TestSpreadConstraints:
+    """Topology spread (grove-tpu extension; the reference lists 'Topology
+    Spread Constraints' as an unshipped roadmap item)."""
+
+    def _spread_gang(self, name, cpu, count, spread_key=HOST_KEY,
+                     spread_min=2, required=True, **kw):
+        g = gang(name, [group(f"{name}-a", cpu=cpu, count=count)], **kw)
+        g["spread_key"] = spread_key
+        g["spread_min_domains"] = spread_min
+        g["spread_required"] = required
+        return g
+
+    def test_balanced_spread_across_blocks(self):
+        """8 pods spread over the 4 ici-blocks land 2 per block."""
+        nodes = make_nodes(16, capacity={"cpu": 4.0})
+        gangs = [
+            self._spread_gang("g0", cpu=1.0, count=8, spread_key=BLOCK_KEY,
+                              spread_min=4)
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        res = solve(problem)
+        assert res.admitted[0]
+        assert res.score[0] == pytest.approx(1.0)
+        lvl = problem.level_keys.index(BLOCK_KEY)
+        per_block = {}
+        for n in np.nonzero(res.alloc[0].sum(axis=0))[0]:
+            d = int(problem.topo[n, lvl])
+            per_block[d] = per_block.get(d, 0) + int(res.alloc[0, :, n].sum())
+        assert sorted(per_block.values()) == [2, 2, 2, 2], per_block
+
+    def test_required_spread_rejects_single_domain(self):
+        """Capacity confined to one block + required spread_min=4 → pending;
+        the same placement with ScheduleAnyway admits with a reduced score."""
+        nodes = make_nodes(16, capacity={"cpu": 0.0})
+        for n in nodes[:4]:  # only block-0 has capacity
+            n.capacity = {"cpu": 4.0}
+        hard = build_problem(
+            nodes,
+            [self._spread_gang("g0", 1.0, 8, spread_key=BLOCK_KEY,
+                               spread_min=4, required=True)],
+            TOPO,
+        )
+        res = solve(hard)
+        assert not res.admitted[0]
+        soft = build_problem(
+            nodes,
+            [self._spread_gang("g1", 1.0, 8, spread_key=BLOCK_KEY,
+                               spread_min=4, required=False)],
+            TOPO,
+        )
+        res2 = solve(soft)
+        assert res2.admitted[0]
+        assert res2.score[0] == pytest.approx(0.25)  # 1 of 4 target domains
+
+    def test_pack_and_spread_compose(self):
+        """Pack the gang into ONE slice, spread its pods across the hosts
+        inside it: all pods share a slice, >= 4 distinct hosts."""
+        nodes = make_nodes(32, capacity={"cpu": 4.0})  # 2 slices
+        g = self._spread_gang(
+            "g0", cpu=1.0, count=8, spread_key=HOST_KEY, spread_min=4,
+            required_key=SLICE_KEY,
+        )
+        problem = build_problem(nodes, [g], TOPO)
+        res = solve(problem)
+        assert res.admitted[0]
+        slice_lvl = problem.level_keys.index(SLICE_KEY)
+        host_lvl = problem.level_keys.index(HOST_KEY)
+        used = np.nonzero(res.alloc[0].sum(axis=0))[0]
+        assert len({int(problem.topo[n, slice_lvl]) for n in used}) == 1
+        assert len({int(problem.topo[n, host_lvl]) for n in used}) >= 4
+
+    def test_wave_solver_honors_spread(self):
+        """The device-resident wave path admits spread gangs with the same
+        floors/validity guarantees and spans the required domains."""
+        from grove_tpu.solver.kernel import solve_waves
+
+        nodes = make_nodes(16, capacity={"cpu": 4.0})
+        gangs = [
+            self._spread_gang(f"g{i}", cpu=1.0, count=4, spread_key=BLOCK_KEY,
+                              spread_min=4)
+            for i in range(4)
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        waves = solve_waves(problem, chunk_size=2)
+        assert waves.admitted[:4].all()
+        usage = np.einsum("gpn,gpr->nr", waves.alloc, problem.demand)
+        assert (usage <= problem.capacity + 1e-5).all()
+        lvl = problem.level_keys.index(BLOCK_KEY)
+        for g_i in range(4):
+            used = np.nonzero(waves.alloc[g_i].sum(axis=0))[0]
+            assert len({int(problem.topo[n, lvl]) for n in used}) >= 4
+
+    def test_mixed_spread_and_pack_gangs_in_one_problem(self):
+        """Spread and plain pack gangs coexist in one solve; pack gangs keep
+        exact-greedy co-location, spread gangs span their domains."""
+        nodes = make_nodes(16, capacity={"cpu": 8.0})
+        gangs = [
+            self._spread_gang("spread", cpu=1.0, count=4, spread_key=BLOCK_KEY,
+                              spread_min=4),
+            gang("packed", [group("packed-a", cpu=1.0, count=4)],
+                 required_key=BLOCK_KEY),
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        res = solve(problem)
+        assert res.admitted[:2].all()
+        lvl = problem.level_keys.index(BLOCK_KEY)
+        used_s = np.nonzero(res.alloc[0].sum(axis=0))[0]
+        used_p = np.nonzero(res.alloc[1].sum(axis=0))[0]
+        assert len({int(problem.topo[n, lvl]) for n in used_s}) == 4
+        assert len({int(problem.topo[n, lvl]) for n in used_p}) == 1
+
+    def test_encoder_spread_fields(self):
+        nodes = make_nodes(8)
+        g = self._spread_gang("g0", 1.0, 4, spread_key=HOST_KEY, spread_min=3)
+        problem = build_problem(nodes, [g], TOPO)
+        assert problem.spread_level[0] == problem.level_keys.index(HOST_KEY)
+        assert problem.spread_min[0] == 3
+        assert problem.spread_required[0]
+        # hard spread with an unknown key must refuse to encode
+        bad = self._spread_gang("g1", 1.0, 4, spread_key="not-a-level")
+        with pytest.raises(ValueError):
+            build_problem(nodes, [bad], TOPO)
+        # spread + per-GROUP hard pack is rejected at the solver boundary
+        # too (external gRPC clients bypass operator admission)
+        combo = self._spread_gang("g2", 1.0, 4, spread_key=HOST_KEY)
+        combo["groups"][0]["required_key"] = BLOCK_KEY
+        with pytest.raises(ValueError, match="cannot be combined"):
+            build_problem(nodes, [combo], TOPO)
+
+    def test_soft_spread_spreads_when_capacity_allows(self):
+        """ScheduleAnyway must still spread on a free cluster — the exact
+        kernel's level preference must not pack a soft-spread gang into one
+        narrow domain (regression: exact kernel lacked the broadest-level
+        override the wave kernel had)."""
+        from grove_tpu.solver.kernel import solve_waves
+
+        nodes = make_nodes(16, capacity={"cpu": 8.0})
+        g = self._spread_gang("g0", cpu=1.0, count=8, spread_key=BLOCK_KEY,
+                              spread_min=4, required=False)
+        problem = build_problem(nodes, [g], TOPO)
+        lvl = problem.level_keys.index(BLOCK_KEY)
+        for res in (solve(problem), solve_waves(problem, chunk_size=4)):
+            assert res.admitted[0]
+            assert res.score[0] == pytest.approx(1.0)
+            used = np.nonzero(res.alloc[0].sum(axis=0))[0]
+            assert len({int(problem.topo[n, lvl]) for n in used}) == 4
+
+
 class TestMultiChip:
     def test_sharded_batch_solve_on_mesh(self):
         """Scenario-dp × node-tp sharded solve over the 8-device CPU mesh."""
@@ -385,13 +533,16 @@ class TestMultiChip:
         from grove_tpu.solver.kernel import pad_problem_for_waves
 
         g = problem.num_gangs
-        raw_args, n_chunks, grouped, pinned = pad_problem_for_waves(problem, 128)
+        raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
+            problem, 128
+        )
         out = solve_waves_device(
             *[jnp.asarray(a) for a in raw_args],
             n_chunks=n_chunks,
             max_waves=16,
             grouped=grouped,
             pinned=pinned,
+            spread=spread,
         )
         np.testing.assert_array_equal(
             sharded["admitted"], np.asarray(out["admitted"])[:g]
