@@ -485,6 +485,38 @@ def _cmd_crds(args) -> int:
     return 0
 
 
+def _cmd_detect_topology(args) -> int:
+    """Automatic topology detection (reference roadmap item, shipped here):
+    infer the ClusterTopology CR from node labels and print it."""
+    import yaml
+
+    from grove_tpu.admission.validation import validate_cluster_topology
+    from grove_tpu.api.serialize import export_object
+    from grove_tpu.cluster.autotopo import (
+        TopologyDetectionError,
+        detect_topology,
+        load_nodes_file,
+    )
+
+    if args.file:
+        nodes = load_nodes_file(args.file)
+    else:
+        from grove_tpu.sim.cluster import make_nodes
+
+        nodes = make_nodes(args.sim_nodes)
+    try:
+        topo = detect_topology(nodes, name=args.name)
+    except TopologyDetectionError as e:
+        print(f"detect-topology: {e}", file=sys.stderr)
+        return 1
+    res = validate_cluster_topology(topo)
+    if not res.ok:  # defensive: detection guarantees a valid CR
+        print(f"detect-topology: invalid result: {res.errors}", file=sys.stderr)
+        return 1
+    print(yaml.safe_dump(export_object(topo), sort_keys=False), end="")
+    return 0
+
+
 def _cmd_api_docs(args) -> int:
     from grove_tpu.cluster.apidocs import render_api_reference, write_api_reference
 
@@ -509,8 +541,19 @@ def _cmd_run(args) -> int:
     config = (
         load_operator_configuration_file(args.config) if args.config else None
     )
+    nodes = make_nodes(args.nodes)
+    topology = None
+    if args.auto_detect_topology:
+        from grove_tpu.cluster.autotopo import detect_topology
+
+        topology = detect_topology(nodes)
+        print(
+            "detected topology: "
+            + " > ".join(lvl.domain for lvl in topology.spec.levels)
+        )
     rt = start_operator(
-        nodes=make_nodes(args.nodes),
+        nodes=nodes,
+        topology=topology,
         config=config,
         with_tls=args.tls,
         with_authorizer=args.authorizer,
@@ -668,6 +711,24 @@ def main(argv: List[str] | None = None) -> int:
     p.set_defaults(fn=_cmd_api_docs)
 
     p = sub.add_parser(
+        "detect-topology",
+        help="infer the ClusterTopology CR from node labels",
+    )
+    p.add_argument(
+        "--file",
+        metavar="NODES_YAML",
+        help="node list (k8s NodeList, Node manifests, or [{name, labels}])",
+    )
+    p.add_argument(
+        "--sim-nodes",
+        type=int,
+        default=16,
+        help="detect from a synthetic sim cluster of N nodes (demo)",
+    )
+    p.add_argument("--name", default="default", help="CR name")
+    p.set_defaults(fn=_cmd_detect_topology)
+
+    p = sub.add_parser(
         "run", help="run the operator against a real (HTTP) apiserver"
     )
     p.add_argument("--config", help="operator configuration file")
@@ -684,6 +745,11 @@ def main(argv: List[str] | None = None) -> int:
         "--threaded",
         action="store_true",
         help="run concurrent reconciles in real threads (concurrentSyncs)",
+    )
+    p.add_argument(
+        "--auto-detect-topology",
+        action="store_true",
+        help="infer the ClusterTopology from node labels at startup",
     )
     p.set_defaults(fn=_cmd_run)
 

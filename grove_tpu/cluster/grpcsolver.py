@@ -93,6 +93,7 @@ def _decode_request(request: pb.SolveRequest):
                 "spread_key": gang.spread_level_key or None,
                 "spread_min_domains": gang.spread_min_domains or 2,
                 "spread_required": gang.spread_required,
+                "spread_survivor_nodes": list(gang.spread_survivor_nodes),
                 "priority": gang.priority,
                 "gang_pinned_node": gang.pinned_node or None,
             }
@@ -108,10 +109,8 @@ class RequestDecodeError(ValueError):
 def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
     """Pure request → response solve (shared by the gRPC handler and
     in-process callers/tests)."""
-    from grove_tpu.solver.encode import build_problem
+    from grove_tpu.solver.encode import ConstraintError, build_problem
     from grove_tpu.solver.kernel import solve_waves
-
-    from grove_tpu.solver.encode import ConstraintError
 
     try:
         nodes, gang_specs, topology = _decode_request(request)
@@ -250,6 +249,9 @@ def build_request(
         gang.spread_level_key = spec.get("spread_key") or ""
         gang.spread_min_domains = int(spec.get("spread_min_domains") or 0)
         gang.spread_required = bool(spec.get("spread_required", False))
+        gang.spread_survivor_nodes.extend(
+            spec.get("spread_survivor_nodes") or []
+        )
         gang.priority = int(spec.get("priority", 0))
         gang.pinned_node = spec.get("gang_pinned_node") or ""
         for grp in spec["groups"]:

@@ -208,6 +208,31 @@ def start_operator(
         leader_lock.acquire_blocking()
 
     store = HttpStore(apiserver_url).start()
+    # materialize the hierarchy as a CR so wire clients can inspect what the
+    # operator schedules against (the reference crashes when the configured
+    # CR is missing, cmd/main.go validateClusterTopology; here the operator
+    # OWNS the CR — incl. an auto-detected one — and publishes it)
+    from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
+
+    if not topology.metadata.name:
+        topology.metadata.name = "default"
+    try:
+        store.create(topology)
+    except GroveError as exc:
+        if exc.code != ERR_CONFLICT:
+            raise
+        # restart / external apiserver: the stored CR must match what the
+        # operator actually schedules against — a stale hierarchy (e.g.
+        # nodes relabeled before an --auto-detect-topology restart) would
+        # make the published contract silently wrong
+        stored = store.get(
+            "ClusterTopology", "", topology.metadata.name
+        )
+        if [(l.domain, l.key) for l in stored.spec.levels] != [
+            (l.domain, l.key) for l in topology.spec.levels
+        ]:
+            stored.spec = topology.spec
+            store.update(stored)
     engine = Engine(store, store.clock)
     ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
     register_controllers(engine, ctx, config)

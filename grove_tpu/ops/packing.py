@@ -75,6 +75,12 @@ class GangInputs(NamedTuple):
     # hard vs soft: required spread rejects placements spanning fewer than
     # spread_min domains (DoNotSchedule); soft spread only shapes the score
     spread_required: jnp.ndarray = None  # scalar bool
+    # recovery seed: SURVIVOR pod counts per spread-level domain ([D]) — a
+    # delta-solve replacing failed pods must judge the spread of the LIVE
+    # gang (survivors + replacements), and the balanced fill must steer
+    # replacements away from already-loaded survivor domains (the spread
+    # analogue of the pack path's gang_pin)
+    spread_seed: jnp.ndarray = None  # [D]
 
 
 def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
@@ -206,7 +212,9 @@ def _fill(free, mask, demand, count):
     return alloc, placed, free_after
 
 
-def _spread_defaults(g_shape, spread_level, spread_min, spread_required):
+def _spread_defaults(
+    g_shape, d_dim, spread_level, spread_min, spread_required, spread_seed
+):
     """Fill unset spread tensors with their sentinels (no constraint)."""
     if spread_level is None:
         spread_level = jnp.full(g_shape, -1, dtype=jnp.int32)
@@ -214,7 +222,9 @@ def _spread_defaults(g_shape, spread_level, spread_min, spread_required):
         spread_min = jnp.zeros(g_shape, dtype=jnp.int32)
     if spread_required is None:
         spread_required = jnp.zeros(g_shape, dtype=bool)
-    return spread_level, spread_min, spread_required
+    if spread_seed is None:
+        spread_seed = jnp.zeros(tuple(g_shape) + (d_dim,), dtype=jnp.int32)
+    return spread_level, spread_min, spread_required, spread_seed
 
 
 def _spread_quota(
@@ -293,16 +303,18 @@ def _fill_spread(
 
 
 def _fill_spread_floors_first(
-    free, mask, demand, count, min_count, topo_col, starts_l, ends_l
+    free, mask, demand, count, min_count, topo_col, starts_l, ends_l,
+    load0=None,
 ):
     """Floors-first two-phase spread fill (same contract as
     _fill_floors_first) plus the count of distinct domains the final
-    placement spans at the spread level.
+    placement spans at the spread level — including `load0` survivor
+    domains on a recovery delta-solve.
     Returns (alloc [P,N], placed [P], placed_min [P], free_after, used)."""
     floors = jnp.minimum(min_count, count)
     extras = jnp.maximum(count - min_count, 0)
     alloc_min, placed_min, free1, load1 = _fill_spread(
-        free, mask, demand, floors, topo_col, starts_l, ends_l
+        free, mask, demand, floors, topo_col, starts_l, ends_l, load0
     )
     alloc_ext, placed_ext, free2, load2 = _fill_spread(
         free1, mask, demand, extras, topo_col, starts_l, ends_l, load1
@@ -343,7 +355,7 @@ def _dispatch_with_spread(
     )
     a_s, p_s, pm_s, f_s, used = _fill_spread_floors_first(
         free, mask, gang.demand, gang.count, gang.min_count,
-        topo_col, starts_l, ends_l,
+        topo_col, starts_l, ends_l, gang.spread_seed,
     )
     a_n, p_n, pm_n, f_n = _fill_dispatch(
         grouped, free, mask, gang.demand, gang.count, gang.min_count,
@@ -356,10 +368,21 @@ def _dispatch_with_spread(
     return alloc, placed, placed_min, free_after, used, spread_on
 
 
+def _live_total(gang: GangInputs, placed_total):
+    """Pods of the LIVE gang: this solve's placements plus recovery
+    survivors (the seed) — the spread target is judged against both."""
+    if gang.spread_seed is None:
+        return placed_total
+    return placed_total + jnp.sum(gang.spread_seed)
+
+
 def _spread_admit(gang: GangInputs, spread_on, used, placed_total):
     """Hard-spread admission: a required spread rejects placements spanning
-    fewer than min(spread_min, pods placed) distinct domains."""
-    eff = jnp.minimum(jnp.maximum(gang.spread_min, 1), placed_total)
+    fewer than min(spread_min, live pods) distinct domains (`used` already
+    counts survivor domains via the seed load)."""
+    eff = jnp.minimum(
+        jnp.maximum(gang.spread_min, 1), _live_total(gang, placed_total)
+    )
     return jnp.where(spread_on & gang.spread_required, used >= eff, True)
 
 
@@ -367,7 +390,9 @@ def _spread_score(gang: GangInputs, spread_on, used, placed_total, coloc):
     """Score select: a spread gang's PlacementScore is its domain coverage
     toward the spread target (1.0 = target met) — replacing the co-location
     score, whose objective points the other way."""
-    eff = jnp.minimum(jnp.maximum(gang.spread_min, 1), placed_total)
+    eff = jnp.minimum(
+        jnp.maximum(gang.spread_min, 1), _live_total(gang, placed_total)
+    )
     cover = used.astype(jnp.float32) / jnp.maximum(eff, 1).astype(jnp.float32)
     return jnp.where(spread_on, jnp.clip(cover, 0.0, 1.0), coloc)
 
@@ -636,6 +661,7 @@ def solve_packing(
     spread_level: jnp.ndarray = None,  # [G] int32 (-1 none)
     spread_min: jnp.ndarray = None,  # [G] int32
     spread_required: jnp.ndarray = None,  # [G] bool
+    spread_seed: jnp.ndarray = None,  # [G, D] int32
     with_alloc: bool = True,
     grouped: bool = False,
     pinned: bool = False,
@@ -648,8 +674,9 @@ def solve_packing(
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
-    spread_level, spread_min, spread_required = _spread_defaults(
-        count.shape[:1], spread_level, spread_min, spread_required
+    spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
+        count.shape[:1], seg_starts.shape[1],
+        spread_level, spread_min, spread_required, spread_seed,
     )
 
     def gang_step(free, gang: GangInputs):
@@ -674,6 +701,7 @@ def solve_packing(
         spread_level=spread_level,
         spread_min=spread_min,
         spread_required=spread_required,
+        spread_seed=spread_seed,
     )
     free_after, ys = jax.lax.scan(gang_step, capacity, inputs)
     if with_alloc:
@@ -711,6 +739,7 @@ def solve_wave_chunk(
     spread_level: jnp.ndarray = None,  # [C]
     spread_min: jnp.ndarray = None,  # [C]
     spread_required: jnp.ndarray = None,  # [C]
+    spread_seed: jnp.ndarray = None,  # [C, D]
     commit_iters: int = 2,
     grouped: bool = False,
     pinned: bool = False,
@@ -724,8 +753,9 @@ def solve_wave_chunk(
         group_pin = jnp.full(count.shape, -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
-    spread_level, spread_min, spread_required = _spread_defaults(
-        count.shape[:1], spread_level, spread_min, spread_required
+    spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
+        count.shape[:1], seg_starts.shape[1],
+        spread_level, spread_min, spread_required, spread_seed,
     )
     free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
         wave_chunk_core(
@@ -747,6 +777,7 @@ def solve_wave_chunk(
             spread_level,
             spread_min,
             spread_required,
+            spread_seed,
             commit_iters,
             grouped,
             pinned,
@@ -777,7 +808,7 @@ def solve_wave_chunk(
 def wave_chunk_core(
     free, topo, seg_starts, seg_ends,
     dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-    spreadlvl, spreadmin, spreadreq, commit_iters,
+    spreadlvl, spreadmin, spreadreq, spreadseed, commit_iters,
     grouped=False, pinned=False, spread=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
@@ -789,7 +820,7 @@ def wave_chunk_core(
     cnt = cnt * pend[:, None]
     inputs = GangInputs(
         dem, cnt, mn, rq, pf, grq, gpin, gangpin,
-        spreadlvl, spreadmin, spreadreq,
+        spreadlvl, spreadmin, spreadreq, spreadseed,
     )
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
         lambda *xs: gang_select_single(
@@ -1025,6 +1056,7 @@ def solve_waves_device(
     spread_level=None,  # [G]
     spread_min=None,  # [G]
     spread_required=None,  # [G]
+    spread_seed=None,  # [G, D]
     n_chunks: int = 20,
     max_waves: int = 8,
     commit_iters: int = 2,
@@ -1053,8 +1085,9 @@ def solve_waves_device(
         group_pin = jnp.full((g_total, p_max), -1, dtype=jnp.int32)
     if gang_pin is None:
         gang_pin = jnp.full((g_total,), -1, dtype=jnp.int32)
-    spread_level, spread_min, spread_required = _spread_defaults(
-        (g_total,), spread_level, spread_min, spread_required
+    spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
+        (g_total,), seg_starts.shape[1],
+        spread_level, spread_min, spread_required, spread_seed,
     )
     c = g_total // n_chunks
 
@@ -1079,7 +1112,7 @@ def solve_waves_device(
         # one branch): waves after the first mostly touch a few chunks
         (
             dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-            slvl, smin, sreq,
+            slvl, smin, sreq, sseed,
         ) = xs
         c_gangs = dem.shape[0]
 
@@ -1101,13 +1134,13 @@ def solve_waves_device(
     def _active_chunk_step(free, xs):
         (
             dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-            slvl, smin, sreq,
+            slvl, smin, sreq, sseed,
         ) = xs
         free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
             wave_chunk_core(
                 free, topo, seg_starts, seg_ends,
                 dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-                slvl, smin, sreq,
+                slvl, smin, sreq, sseed,
                 commit_iters, grouped, pinned, spread,
             )
         )
@@ -1139,6 +1172,7 @@ def solve_waves_device(
                 reshape_chunks(spread_level),
                 reshape_chunks(spread_min),
                 reshape_chunks(spread_required),
+                reshape_chunks(spread_seed),
             ),
         )
         accept, placed, score, chosen, retry, new_cap, fill_failed = (

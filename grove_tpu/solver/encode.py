@@ -298,6 +298,20 @@ def build_problem(
         if gpin_node is not None and glvl >= 0 and gpin_node in node_index:
             gang_pin[gi] = topo[node_index[gpin_node], glvl]
 
+    # spread recovery seed: survivor pods per spread-level domain, so a
+    # delta-solve judges the live gang's spread and the balanced fill
+    # steers replacements into un-covered domains
+    spread_seed = np.zeros(
+        (spread_level.shape[0], seg_starts.shape[1]), dtype=np.int32
+    )
+    for gi, spec in enumerate(gang_specs):
+        slvl = spread_level[gi]
+        if slvl < 0:
+            continue
+        for node in spec.get("spread_survivor_nodes") or []:
+            if node in node_index:
+                spread_seed[gi, topo[node_index[node], slvl]] += 1
+
     return PackingProblem(
         capacity=capacity,
         topo=topo,
@@ -314,6 +328,7 @@ def build_problem(
         spread_level=spread_level,
         spread_min=spread_min,
         spread_required=spread_required,
+        spread_seed=spread_seed,
         priority=priority,
         node_names=node_names,
         gang_names=gang_names,

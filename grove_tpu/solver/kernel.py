@@ -85,11 +85,18 @@ def _spread_arrays(problem: PackingProblem):
         if problem.spread_required is not None
         else np.zeros((g,), dtype=bool)
     )
-    return sl, sm, sr
+    ss = (
+        problem.spread_seed
+        if problem.spread_seed is not None
+        else np.zeros((g, problem.seg_starts.shape[1]), dtype=np.int32)
+    )
+    return sl, sm, sr, ss
 
 
 def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
-    spread_level, spread_min, spread_required = _spread_arrays(problem)
+    spread_level, spread_min, spread_required, spread_seed = _spread_arrays(
+        problem
+    )
     args = (
         jnp.asarray(problem.capacity),
         jnp.asarray(problem.topo),
@@ -106,6 +113,7 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         jnp.asarray(spread_level),
         jnp.asarray(spread_min),
         jnp.asarray(spread_required),
+        jnp.asarray(spread_seed),
     )
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
@@ -153,7 +161,9 @@ def solve_waves(
         width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, width, constant_values=value)
 
-    spread_level_a, spread_min_a, spread_required_a = _spread_arrays(problem)
+    spread_level_a, spread_min_a, spread_required_a, spread_seed_a = (
+        _spread_arrays(problem)
+    )
     demand = pad(problem.demand)
     count = pad(problem.count)
     min_count = pad(problem.min_count)
@@ -165,6 +175,7 @@ def solve_waves(
     spread_level = pad(spread_level_a, -1)
     spread_min = pad(spread_min_a)
     spread_required = pad(spread_required_a)
+    spread_seed = pad(spread_seed_a)
 
     _maybe_enable_disk_cache()  # solve_wave_chunk compiles via plain jit
     free = jnp.asarray(problem.capacity)
@@ -201,7 +212,7 @@ def solve_waves(
             jnp.asarray(a[c * chunk_size : (c + 1) * chunk_size])
             for a in (
                 group_req, group_pin, gang_pin,
-                spread_level, spread_min, spread_required,
+                spread_level, spread_min, spread_required, spread_seed,
             )
         )
         for c in range(n_chunks)
@@ -222,7 +233,7 @@ def solve_waves(
                 continue
             (
                 dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c,
-                slvl_c, smin_c, sreq_c,
+                slvl_c, smin_c, sreq_c, sseed_c,
             ) = chunk_const[c]
             out = solve_wave_chunk(
                 free,
@@ -243,6 +254,7 @@ def solve_waves(
                 spread_level=slvl_c,
                 spread_min=smin_c,
                 spread_required=sreq_c,
+                spread_seed=sseed_c,
                 grouped=grouped,
                 pinned=pinned,
                 spread=spread,
@@ -303,7 +315,9 @@ def pad_problem_for_waves(
         width = [(0, g_pad - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
         return np.pad(a, width, constant_values=value)
 
-    spread_level, spread_min, spread_required = _spread_arrays(problem)
+    spread_level, spread_min, spread_required, spread_seed = _spread_arrays(
+        problem
+    )
     args = (
         problem.capacity,
         problem.topo,
@@ -320,6 +334,7 @@ def pad_problem_for_waves(
         pad(spread_level, -1),
         pad(spread_min),
         pad(spread_required),
+        pad(spread_seed),
     )
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
@@ -388,7 +403,7 @@ def solve_waves_stats(
             width = [(0, t_pad - n_pending)] + [(0, 0)] * (a.ndim - 1)
             return np.pad(a[idx], width, constant_values=value)
 
-        sl_a, sm_a, sr_a = _spread_arrays(problem)
+        sl_a, sm_a, sr_a, ss_a = _spread_arrays(problem)
         tail = PackingProblem(
             capacity=free_after,
             topo=problem.topo,
@@ -403,6 +418,7 @@ def solve_waves_stats(
             spread_level=tpad(sl_a, -1),
             spread_min=tpad(sm_a),
             spread_required=tpad(sr_a),
+            spread_seed=tpad(ss_a),
             priority=tpad(problem.priority),
             seg_starts=problem.seg_starts,
             seg_ends=problem.seg_ends,

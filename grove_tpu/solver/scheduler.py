@@ -437,6 +437,7 @@ class GangScheduler:
             if tc is not None and tc.pack_constraint is not None:
                 required_key = tc.pack_constraint.required
                 preferred_key = tc.pack_constraint.preferred
+            spread_survivor_nodes: List[str] = []
             if tc is not None and tc.spread_constraint is not None:
                 sc = tc.spread_constraint
                 spread_key = sc.topology_key
@@ -444,6 +445,15 @@ class GangScheduler:
                 spread_required = (
                     sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
                 )
+                # spread recovery: a delta-solve must judge the LIVE gang's
+                # spread — survivors' nodes seed the balanced fill so
+                # replacements land in un-covered domains (spread analogue
+                # of the pack path's gang_pinned_node below)
+                if any(g["partial"] for g in groups):
+                    for grp in groups:
+                        spread_survivor_nodes.extend(
+                            self._bound_nodes(namespace, grp["name"])
+                        )
             required_key = self._narrower_key(required_key, collective_req)
             # gang-level recovery pin: a gang-level required pack (template
             # constraint or collective PCSG fold) with surviving pods must
@@ -477,6 +487,7 @@ class GangScheduler:
                     "spread_key": spread_key,
                     "spread_min_domains": spread_min,
                     "spread_required": spread_required,
+                    "spread_survivor_nodes": spread_survivor_nodes,
                     "gang_pinned_node": gang_pinned_node,
                     "priority": self.priority_map.get(
                         gang_cr.spec.priority_class_name, 0
@@ -512,6 +523,18 @@ class GangScheduler:
                 return node
             fallback = fallback or node
         return fallback
+
+    def _bound_nodes(self, namespace: str, pclq_fqn: str) -> List[str]:
+        """Every node hosting a bound pod of the clique (with multiplicity)
+        — the spread-recovery seed."""
+        out: List[str] = []
+        for p in self.store.list(
+            "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
+        ):
+            node = self.cluster.bindings.get((namespace, p.metadata.name))
+            if node is not None:
+                out.append(node)
+        return out
 
     def _scheduled_count(self, namespace: str, pclq_fqn: str) -> int:
         return sum(

@@ -55,6 +55,10 @@ class PackingProblem:
     spread_level: np.ndarray = None  # [G] int32
     spread_min: np.ndarray = None  # [G] int32
     spread_required: np.ndarray = None  # [G] bool
+    # recovery seed: survivor pod counts per spread-level domain — a
+    # delta-solve judges the LIVE gang's spread (survivors + replacements)
+    # and steers replacements away from survivor domains
+    spread_seed: np.ndarray = None  # [G, D] int32
 
     # bookkeeping (host side, not shipped to device)
     node_names: List[str] = field(default_factory=list)

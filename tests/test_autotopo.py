@@ -1,0 +1,152 @@
+"""Automatic topology detection (grove-tpu extension; the reference lists
+'Automatic Topology Detection' as an unshipped roadmap item)."""
+
+import pytest
+
+from grove_tpu.admission.validation import validate_cluster_topology
+from grove_tpu.cluster.autotopo import (
+    TopologyDetectionError,
+    detect_topology,
+    detect_topology_levels,
+    load_nodes_file,
+)
+from grove_tpu.sim.cluster import make_nodes
+
+
+def _node(name, **labels):
+    return (name, labels)
+
+
+class TestDetection:
+    def test_synthetic_cluster_detects_full_hierarchy(self):
+        topo = detect_topology(make_nodes(32))
+        domains = [lvl.domain for lvl in topo.spec.levels]
+        keys = [lvl.key for lvl in topo.spec.levels]
+        assert domains == ["cluster", "slice", "ici-block", "host"]
+        assert keys[-1] == "kubernetes.io/hostname"
+        assert validate_cluster_topology(topo).ok
+
+    def test_cross_cutting_labels_are_dropped(self):
+        """App/team labels partition nodes orthogonally to the topology and
+        must not become levels."""
+        nodes = []
+        for i in range(8):
+            nodes.append(
+                _node(
+                    f"n{i}",
+                    **{
+                        "topology.kubernetes.io/zone": f"z{i // 4}",
+                        "kubernetes.io/hostname": f"n{i}",
+                        "team": f"team-{i % 3}",  # cross-cuts zones
+                    },
+                )
+            )
+        chain = detect_topology_levels(nodes)
+        assert chain == [
+            "topology.kubernetes.io/zone",
+            "kubernetes.io/hostname",
+        ]
+
+    def test_constant_labels_dropped_unless_canonical(self):
+        nodes = [
+            _node(
+                f"n{i}",
+                **{
+                    "kubernetes.io/os": "linux",  # constant, not topology
+                    "kubernetes.io/hostname": f"n{i}",
+                },
+            )
+            for i in range(4)
+        ]
+        chain = detect_topology_levels(nodes)
+        assert chain == ["kubernetes.io/hostname"]
+
+    def test_equivalent_partitions_deduplicate(self):
+        """Two keys with identical structure (hostname + a uid) keep only
+        the canonical one."""
+        nodes = [
+            _node(
+                f"n{i}",
+                **{
+                    "kubernetes.io/hostname": f"n{i}",
+                    "node-uid": f"uid-{i}",
+                    "topology.kubernetes.io/zone": f"z{i // 2}",
+                },
+            )
+            for i in range(4)
+        ]
+        topo = detect_topology(nodes)
+        keys = [lvl.key for lvl in topo.spec.levels]
+        assert "node-uid" not in keys
+        assert "kubernetes.io/hostname" in keys
+
+    def test_unknown_keys_get_free_domain_slots(self):
+        """A rack-style custom label between zone and host lands on a valid
+        unused domain and the result still validates."""
+        nodes = [
+            _node(
+                f"n{i}",
+                **{
+                    "topology.kubernetes.io/zone": f"z{i // 8}",
+                    "example.com/rack": f"r{i // 2}",
+                    "kubernetes.io/hostname": f"n{i}",
+                },
+            )
+            for i in range(16)
+        ]
+        topo = detect_topology(nodes)
+        assert validate_cluster_topology(topo).ok, topo
+        by_key = {lvl.key: lvl.domain for lvl in topo.spec.levels}
+        assert by_key["topology.kubernetes.io/zone"] == "zone"
+        assert by_key["kubernetes.io/hostname"] == "host"
+        assert "example.com/rack" in by_key
+
+    def test_no_nodes_raises(self):
+        with pytest.raises(TopologyDetectionError):
+            detect_topology([])
+
+    def test_no_hierarchy_raises(self):
+        # labels exist but none are on every node
+        nodes = [_node("a", x="1"), _node("b", y="2")]
+        with pytest.raises(TopologyDetectionError):
+            detect_topology(nodes)
+
+    def test_nodes_file_formats(self, tmp_path):
+        bare = tmp_path / "bare.yaml"
+        bare.write_text(
+            "- name: a\n  labels: {k: v}\n- name: b\n  labels: {k: v}\n"
+        )
+        assert load_nodes_file(str(bare)) == [
+            ("a", {"k": "v"}),
+            ("b", {"k": "v"}),
+        ]
+        nodelist = tmp_path / "list.yaml"
+        nodelist.write_text(
+            "kind: NodeList\nitems:\n"
+            "  - metadata: {name: a, labels: {k: v}}\n"
+        )
+        assert load_nodes_file(str(nodelist)) == [("a", {"k": "v"})]
+
+
+class TestOperatorIntegration:
+    def test_detected_topology_drives_placement(self):
+        """The detected hierarchy is accepted by the full control loop: a
+        packDomain constraint expressed against a DETECTED level places
+        correctly."""
+        from grove_tpu.api.types import TopologyConstraint
+        from grove_tpu.models import load_sample
+        from grove_tpu.sim.harness import SimHarness
+
+        nodes = make_nodes(16)
+        topo = detect_topology(nodes)
+        harness = SimHarness(num_nodes=16, topology=topo)
+        pcs = load_sample("simple")
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain="ici-block"
+        )
+        harness.apply(pcs)
+        harness.converge()
+        from grove_tpu.api.pod import is_ready
+
+        pods = harness.store.list("Pod")
+        assert pods and all(is_ready(p) for p in pods), harness.tree()

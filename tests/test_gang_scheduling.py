@@ -261,6 +261,46 @@ class TestGroupLevelConstraints:
         pods = harness.store.list("Pod")
         assert all(is_ready(p) for p in pods), harness.tree()
 
+    def test_spread_recovery_rejoins_uncovered_domain(self):
+        """A spread gang's replacement pods must keep the LIVE gang at its
+        spread floor: the delta-solve sees the survivors' domains (seed) and
+        steers replacements into un-covered blocks."""
+        harness = SimHarness(num_nodes=16)  # 4 blocks x 4 hosts
+        pcs = simple1()
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            spread_domain="ici-block", spread_min_domains=4
+        )
+        harness.apply(pcs)
+        harness.converge()
+        node_by_name = {n.name: n for n in harness.cluster.nodes}
+
+        def blocks():
+            return {
+                node_by_name[p.status.node_name].labels[
+                    "cloud.google.com/gke-tpu-ici-block"
+                ]
+                for p in harness.store.list("Pod")
+                if p.status.node_name
+            }
+
+        assert len(blocks()) >= 4
+        # kill every pod in ONE block; disable sticky reuse so the solver
+        # must re-decide placement for the replacements
+        victim_block = sorted(blocks())[0]
+        harness.cluster.last_node.clear()
+        for p in list(harness.store.list("Pod")):
+            if not p.status.node_name:
+                continue
+            node = node_by_name[p.status.node_name]
+            if node.labels["cloud.google.com/gke-tpu-ici-block"] == victim_block:
+                harness.store.delete("Pod", "default", p.metadata.name)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert all(is_ready(p) for p in pods), harness.tree()
+        # the live gang must span >= 4 blocks again (not stack replacements
+        # into the surviving 3)
+        assert len(blocks()) >= 4, blocks()
+
     def test_clique_pack_domain_confines_each_group(self):
         """PodClique-level packDomain: every clique's pods land inside ONE
         ici-block, but different cliques may use different blocks."""

@@ -456,6 +456,36 @@ class TestSpreadConstraints:
         with pytest.raises(ValueError, match="cannot be combined"):
             build_problem(nodes, [combo], TOPO)
 
+    def test_recovery_seed_steers_replacements(self):
+        """A delta-solve with survivor seed load places replacements in
+        UN-covered domains and judges the spread floor against the live
+        gang (survivors + replacements)."""
+        nodes = make_nodes(16, capacity={"cpu": 4.0})
+        # replacements: 2 pods; survivors: 4 pods in blocks 1 and 2
+        g = self._spread_gang("g0", cpu=1.0, count=2, spread_key=BLOCK_KEY,
+                              spread_min=4)
+        g["spread_survivor_nodes"] = ["node-4", "node-5", "node-8", "node-9"]
+        problem = build_problem(nodes, [g], TOPO)
+        lvl = problem.level_keys.index(BLOCK_KEY)
+        assert problem.spread_seed[0].sum() == 4
+        res = solve(problem)
+        assert res.admitted[0], "live gang (4 survivors + 2 new) spans 4 blocks"
+        used = np.nonzero(res.alloc[0].sum(axis=0))[0]
+        new_blocks = {int(problem.topo[n, lvl]) for n in used}
+        assert new_blocks == {0, 3}, new_blocks  # the two un-covered blocks
+        assert res.score[0] == pytest.approx(1.0)
+        # without the seed the same delta-solve must REJECT: 2 replacement
+        # pods alone can never span min(4, live=2)=2... they can — so tighten:
+        # replacements of 1 pod with min 4 and 3 survivor domains covered
+        g2 = self._spread_gang("g1", cpu=1.0, count=1, spread_key=BLOCK_KEY,
+                               spread_min=4)
+        g2["spread_survivor_nodes"] = ["node-4", "node-8", "node-12"]
+        p2 = build_problem(nodes, [g2], TOPO)
+        r2 = solve(p2)
+        assert r2.admitted[0]
+        used2 = np.nonzero(r2.alloc[0].sum(axis=0))[0]
+        assert {int(p2.topo[n, lvl]) for n in used2} == {0}
+
     def test_soft_spread_spreads_when_capacity_allows(self):
         """ScheduleAnyway must still spread on a free cluster — the exact
         kernel's level preference must not pack a soft-spread gang into one
