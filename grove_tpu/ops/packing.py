@@ -213,9 +213,14 @@ def _fill(free, mask, demand, count):
 
 
 def _spread_defaults(
-    g_shape, d_dim, spread_level, spread_min, spread_required, spread_seed
+    g_shape, spread_level, spread_min, spread_required, spread_seed
 ):
-    """Fill unset spread tensors with their sentinels (no constraint)."""
+    """Fill unset spread tensors with their sentinels (no constraint).
+
+    The seed defaults to a ZERO-WIDTH [G, 0] placeholder, not [G, D]: a
+    full-width zeros tensor is ~200MB at stress scale (G=10k, D=5k node-
+    level domains) and would be shipped to the device on every solve that
+    carries no recovery seeds — i.e. almost all of them."""
     if spread_level is None:
         spread_level = jnp.full(g_shape, -1, dtype=jnp.int32)
     if spread_min is None:
@@ -223,8 +228,15 @@ def _spread_defaults(
     if spread_required is None:
         spread_required = jnp.zeros(g_shape, dtype=bool)
     if spread_seed is None:
-        spread_seed = jnp.zeros(tuple(g_shape) + (d_dim,), dtype=jnp.int32)
+        spread_seed = jnp.zeros(tuple(g_shape) + (0,), dtype=jnp.int32)
     return spread_level, spread_min, spread_required, spread_seed
+
+
+def _seed_or_none(spread_seed):
+    """Treat the [.., 0] placeholder as 'no seed' (static shape check)."""
+    if spread_seed is None or spread_seed.shape[-1] == 0:
+        return None
+    return spread_seed
 
 
 def _spread_quota(
@@ -355,7 +367,7 @@ def _dispatch_with_spread(
     )
     a_s, p_s, pm_s, f_s, used = _fill_spread_floors_first(
         free, mask, gang.demand, gang.count, gang.min_count,
-        topo_col, starts_l, ends_l, gang.spread_seed,
+        topo_col, starts_l, ends_l, _seed_or_none(gang.spread_seed),
     )
     a_n, p_n, pm_n, f_n = _fill_dispatch(
         grouped, free, mask, gang.demand, gang.count, gang.min_count,
@@ -371,9 +383,10 @@ def _dispatch_with_spread(
 def _live_total(gang: GangInputs, placed_total):
     """Pods of the LIVE gang: this solve's placements plus recovery
     survivors (the seed) — the spread target is judged against both."""
-    if gang.spread_seed is None:
+    seed = _seed_or_none(gang.spread_seed)
+    if seed is None:
         return placed_total
-    return placed_total + jnp.sum(gang.spread_seed)
+    return placed_total + jnp.sum(seed)
 
 
 def _spread_admit(gang: GangInputs, spread_on, used, placed_total):
@@ -675,8 +688,7 @@ def solve_packing(
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
     spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
-        count.shape[:1], seg_starts.shape[1],
-        spread_level, spread_min, spread_required, spread_seed,
+        count.shape[:1], spread_level, spread_min, spread_required, spread_seed
     )
 
     def gang_step(free, gang: GangInputs):
@@ -754,8 +766,7 @@ def solve_wave_chunk(
     if gang_pin is None:
         gang_pin = jnp.full(count.shape[:1], -1, dtype=jnp.int32)
     spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
-        count.shape[:1], seg_starts.shape[1],
-        spread_level, spread_min, spread_required, spread_seed,
+        count.shape[:1], spread_level, spread_min, spread_required, spread_seed
     )
     free_after, accept, placed, score, chosen, retry, new_cap, fill_failed, alloc = (
         wave_chunk_core(
@@ -1086,8 +1097,7 @@ def solve_waves_device(
     if gang_pin is None:
         gang_pin = jnp.full((g_total,), -1, dtype=jnp.int32)
     spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
-        (g_total,), seg_starts.shape[1],
-        spread_level, spread_min, spread_required, spread_seed,
+        (g_total,), spread_level, spread_min, spread_required, spread_seed
     )
     c = g_total // n_chunks
 

@@ -311,6 +311,11 @@ def build_problem(
         for node in spec.get("spread_survivor_nodes") or []:
             if node in node_index:
                 spread_seed[gi, topo[node_index[node], slvl]] += 1
+    if not spread_seed.any():
+        # zero-width placeholder: a full [G, D] zeros tensor would be
+        # shipped to the device on every seedless solve (~200MB at stress
+        # scale) only for XLA to ignore it
+        spread_seed = np.zeros((spread_level.shape[0], 0), dtype=np.int32)
 
     return PackingProblem(
         capacity=capacity,

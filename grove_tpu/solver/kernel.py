@@ -85,10 +85,12 @@ def _spread_arrays(problem: PackingProblem):
         if problem.spread_required is not None
         else np.zeros((g,), dtype=bool)
     )
+    # zero-width = no seeds; the encoder already collapses all-zero seed
+    # tensors to [G, 0], so no per-solve O(G*D) rescan here
     ss = (
         problem.spread_seed
         if problem.spread_seed is not None
-        else np.zeros((g, problem.seg_starts.shape[1]), dtype=np.int32)
+        else np.zeros((g, 0), dtype=np.int32)
     )
     return sl, sm, sr, ss
 

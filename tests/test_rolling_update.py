@@ -210,6 +210,49 @@ class TestRollingUpdate:
             "busybox:new"
         }
 
+    def test_update_completes_with_zero_spare_capacity(self):
+        """Resource-optimized rolling update (reference roadmap item): on a
+        cluster with NO spare capacity the update still completes — the
+        surge-less pod-by-pod replacement fits each new pod exactly into the
+        capacity its predecessor released, and reservation reuse keeps every
+        placement, so the update consumes zero extra resources."""
+        harness = SimHarness(num_nodes=4)
+        pcs = simple1()
+        harness.apply(pcs)
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert pods and all(is_ready(p) for p in pods), harness.tree()
+        # shrink every node to EXACTLY its current usage (as the scheduler
+        # sees it — PodSpec.total_requests): zero headroom
+        usage = {n.name: {} for n in harness.cluster.nodes}
+        for p in pods:
+            node_usage = usage[p.status.node_name]
+            for r, q in p.spec.total_requests().items():
+                node_usage[r] = node_usage.get(r, 0.0) + q
+        for n in harness.cluster.nodes:
+            n.capacity = dict(usage[n.name]) or {"cpu": 0.0}
+        node_before = {
+            p.metadata.name: p.status.node_name for p in pods
+        }
+
+        updated = simple1()
+        for clique in updated.spec.template.cliques:
+            clique.spec.pod_spec.containers[0].image = "busybox:new"
+        harness.apply(updated)
+        assert converge_update(harness), harness.tree()
+        harness.converge()
+        after = harness.store.list("Pod")
+        assert all(is_ready(p) for p in after), harness.tree()
+        assert {c.image for p in after for c in p.spec.containers} == {
+            "busybox:new"
+        }
+        # zero surge AND zero churn: every replacement landed exactly where
+        # its predecessor ran
+        node_after = {
+            p.metadata.name: p.status.node_name for p in after
+        }
+        assert node_after == node_before
+
     def test_reuse_reservation_hint_set_and_honored(self):
         harness = SimHarness(num_nodes=32)
         harness.apply(simple1())
