@@ -544,9 +544,13 @@ def _cmd_run(args) -> int:
     nodes = make_nodes(args.nodes)
     topology = None
     if args.auto_detect_topology:
-        from grove_tpu.cluster.autotopo import detect_topology
+        from grove_tpu.cluster.autotopo import TopologyDetectionError, detect_topology
 
-        topology = detect_topology(nodes)
+        try:
+            topology = detect_topology(nodes)
+        except TopologyDetectionError as exc:
+            print(f"error: topology detection failed: {exc}", file=sys.stderr)
+            return 1
         print(
             "detected topology: "
             + " > ".join(lvl.domain for lvl in topology.spec.levels)

@@ -133,7 +133,18 @@ def detect_topology(
     ]
     chain = detect_topology_levels(pairs)
     if len(chain) > 7:
-        chain = chain[-7:]  # keep the narrowest levels (placement-relevant)
+        # keep the narrowest levels (placement-relevant); name the dropped
+        # broad keys so a packDomain/spreadDomain referencing one of them
+        # fails validation with a visible cause rather than silently
+        dropped = chain[:-7]
+        import warnings
+
+        warnings.warn(
+            "topology detection found more than 7 containment levels;"
+            f" dropping broadest label keys: {', '.join(dropped)}",
+            stacklevel=2,
+        )
+        chain = chain[-7:]
 
     # assign domain names: known keys pin their slot; unknown keys take the
     # next free slot that keeps the broad→narrow order strict

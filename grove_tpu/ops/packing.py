@@ -608,9 +608,18 @@ def gang_select_and_fill(
             spread_on, jnp.maximum(gang.req_level, 0), pref_eff
         )
     level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
-    pref_rank = jnp.concatenate(
-        [level_rank, jnp.zeros((1,), dtype=level_rank.dtype)]
-    )  # cluster rank 0
+    # cluster rank 0 — EXCEPT for spread gangs with no required pack: the
+    # cluster-wide mask holds every spread-level domain, while even the
+    # broadest level candidate is a single domain of that level. Packing a
+    # soft (ScheduleAnyway) spread gang into one broadest-level domain on a
+    # free multi-root-domain cluster would defeat the spread; rank
+    # cluster-wide ABOVE all level candidates for such gangs.
+    cluster_rank = jnp.where(
+        spread_on & (gang.req_level < 0),
+        jnp.asarray(2 * (n_levels + 1), dtype=level_rank.dtype),
+        jnp.asarray(0, dtype=level_rank.dtype),
+    )
+    pref_rank = jnp.concatenate([level_rank, cluster_rank[None]])
     chosen = jnp.argmax(jnp.where(oks, pref_rank + 1, 0))
     ok_min = jnp.any(oks)
 
@@ -951,6 +960,12 @@ def gang_select_single(
             jnp.asarray(False)
         )
         pref_eff = jnp.where(s_on, jnp.maximum(gang.req_level, 0), pref_eff)
+        # spread gangs with no required pack go straight to the cluster-wide
+        # fill: it sees every spread-level domain, whereas any level
+        # candidate is a single domain — packing there would leave a soft
+        # spread gang un-spread on a free multi-root-domain cluster (the
+        # exact kernel applies the same cluster-over-levels override)
+        allowed = allowed & ~(s_on & (gang.req_level < 0))
     level_rank = 2 * (n_levels - jnp.abs(lv - pref_eff)) + (lv > pref_eff)
     has_level = jnp.any(allowed)
     chosen_level = jnp.argmax(jnp.where(allowed, level_rank + 1, 0))

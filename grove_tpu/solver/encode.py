@@ -196,6 +196,16 @@ def encode_gangs(
                 f"gang {spec['name']!r}: spread_key cannot be combined with"
                 " per-group required pack constraints"
             )
+        elif 0 <= spread_level[gi] <= req_level[gi]:
+            # operator admission enforces "spread domain strictly narrower
+            # than pack domain"; mirror it at the solver boundary — a direct
+            # gRPC client sending spread_key >= pack breadth would otherwise
+            # get a gang that can never span >1 spread domain inside one
+            # pack domain and silently stays pending forever
+            raise ConstraintError(
+                f"gang {spec['name']!r}: spread_key must be strictly"
+                " narrower than required_key"
+            )
         spread_min[gi] = int(spec.get("spread_min_domains", 2) or 2)
         priority[gi] = spec.get("priority", 0)
 

@@ -106,6 +106,35 @@ def ensure_healthy_backend(
     return _backend_note
 
 
+def host_machine_fingerprint() -> str:
+    """Stable fingerprint of the host's CPU feature set.
+
+    XLA:CPU bakes the compiling machine's features into the executable; the
+    persistent compile cache will happily hand that executable to a host with
+    a *different* feature set ("Compile machine features ... vs host machine
+    features ... could lead to execution errors such as SIGILL"). Partitioning
+    the cache by this fingerprint makes such cross-host reuse impossible.
+    """
+    import hashlib
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags") or line.startswith("Features"):
+                    # one physical CPU model per host: the first flags line
+                    # is the whole feature story
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    if not flags:
+        import platform as _platform
+
+        flags = f"{_platform.machine()}|{_platform.processor()}"
+    return hashlib.md5(flags.encode()).hexdigest()[:8]
+
+
 def enable_compile_cache(path: Optional[str] = None) -> str:
     """Point JAX's persistent compilation cache at a writable directory so
     repeat processes skip the multi-minute XLA compile of the full-size wave
@@ -118,10 +147,12 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
     import jax
 
     if path is None:
-        # partition by (platform pin, XLA flags): executables AOT-compiled
-        # under one host config can load under another with alarming
-        # machine-feature warnings (e.g. the virtual-8-device test config vs
-        # a plain CPU process) — never share cache entries across configs
+        # partition by (platform pin, XLA flags, host machine features):
+        # executables AOT-compiled under one config can load under another
+        # with machine-feature warnings and a SIGILL risk (e.g. the
+        # virtual-8-device test config vs a plain CPU process, or two hosts
+        # with different AVX/AMX sets sharing a cache volume) — never share
+        # cache entries across configs or machine types
         import hashlib
 
         config_token = hashlib.md5(
@@ -129,6 +160,8 @@ def enable_compile_cache(path: Optional[str] = None) -> str:
                 os.environ.get("JAX_PLATFORMS", "auto")
                 + "|"
                 + os.environ.get("XLA_FLAGS", "")
+                + "|"
+                + host_machine_fingerprint()
             ).encode()
         ).hexdigest()[:8]
         # GROVE_TPU_COMPILE_CACHE names the cache ROOT; the per-config

@@ -101,6 +101,25 @@ class TestDetection:
         assert by_key["kubernetes.io/hostname"] == "host"
         assert "example.com/rack" in by_key
 
+    def test_truncation_beyond_seven_levels_warns_with_dropped_keys(self):
+        """More than 7 containment levels: the broadest are dropped, and the
+        warning NAMES them so a packDomain referencing one has a visible
+        cause (advisor r2)."""
+        nodes = [
+            _node(
+                f"n{i}",
+                **{f"example.com/l{d}": f"v{i // (2 ** (8 - d))}"
+                   for d in range(9)},
+            )
+            for i in range(512)
+        ]
+        with pytest.warns(UserWarning, match="example.com/l0"):
+            topo = detect_topology(nodes)
+        assert len(topo.spec.levels) == 7
+        kept = {lvl.key for lvl in topo.spec.levels}
+        assert "example.com/l0" not in kept  # broadest dropped
+        assert "example.com/l8" in kept  # narrowest kept
+
     def test_no_nodes_raises(self):
         with pytest.raises(TopologyDetectionError):
             detect_topology([])

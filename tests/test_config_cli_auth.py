@@ -256,6 +256,23 @@ spec:
         rc = main(["config-check", str(cfg)])
         assert rc == 0 and "OK" in capsys.readouterr().out
 
+    def test_run_auto_detect_topology_error_is_clean(self, monkeypatch, capsys):
+        """`run --auto-detect-topology` on undetectable labels prints a
+        clean error + exit 1 like detect-topology, not a raw traceback
+        (advisor r2)."""
+        from grove_tpu.cli import main
+        from grove_tpu.cluster import autotopo
+
+        def boom(nodes):
+            raise autotopo.TopologyDetectionError("no containment hierarchy")
+
+        monkeypatch.setattr(autotopo, "detect_topology", boom)
+        rc = main(["run", "--auto-detect-topology", "--nodes", "4"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "topology detection failed" in err
+        assert "no containment hierarchy" in err
+
 
 class TestRemainingSamples:
     def test_agentic_pipeline_ordering(self):

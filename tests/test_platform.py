@@ -126,6 +126,31 @@ class TestEnableCompileCache:
             jax.config.update("jax_compilation_cache_dir", before)
 
 
+class TestHostMachineFingerprint:
+    def test_stable_within_process(self):
+        assert plat.host_machine_fingerprint() == plat.host_machine_fingerprint()
+        assert len(plat.host_machine_fingerprint()) == 8
+
+    def test_partitions_cache_by_machine_features(self, tmp_path, monkeypatch):
+        # two hosts with different CPU feature sets must land in different
+        # cache partitions (the r02 SIGILL-warning hazard: an executable
+        # compiled with +amx-avx512 loaded on a host without it)
+        monkeypatch.setenv("GROVE_TPU_COMPILE_CACHE", str(tmp_path))
+        monkeypatch.setenv("XLA_FLAGS", "")
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        try:
+            a = plat.enable_compile_cache()
+            monkeypatch.setattr(
+                plat, "host_machine_fingerprint", lambda: "deadbeef"
+            )
+            b = plat.enable_compile_cache()
+            assert a != b
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before)
+
+
 class TestCpuSubprocessEnv:
     def test_scrubs_axon_and_pins_cpu(self, monkeypatch):
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")

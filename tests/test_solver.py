@@ -438,6 +438,92 @@ class TestSpreadConstraints:
         assert len({int(problem.topo[n, lvl]) for n in used_s}) == 4
         assert len({int(problem.topo[n, lvl]) for n in used_p}) == 1
 
+    def _two_zone_nodes(self, per_zone=4, cpu=4.0):
+        """Multi-root topology: 2 zones (the broadest level has >1 domain),
+        each zone its own cluster/slice so containment stays strict."""
+        nodes = make_nodes(2 * per_zone, capacity={"cpu": cpu},
+                           hosts_per_ici_block=2, blocks_per_slice=2)
+        for i, n in enumerate(nodes):
+            z = i // per_zone
+            n.labels["topology.kubernetes.io/zone"] = f"zone-{z}"
+            n.labels["cloud.google.com/gke-cluster"] = f"cluster-{z}"
+        return nodes
+
+    def test_soft_spread_spans_zones_on_multi_root_cluster(self):
+        """A soft (ScheduleAnyway) zone-spread gang with no required pack
+        must spread cluster-wide across BOTH zones on a free two-zone
+        cluster — not pack into the single best broadest-level domain
+        (advisor r2: cluster-wide candidate outranks level candidates for
+        spread gangs with req_level < 0)."""
+        zone_key = "topology.kubernetes.io/zone"
+        nodes = self._two_zone_nodes()
+        g = self._spread_gang("g0", cpu=1.0, count=8, spread_key=zone_key,
+                              spread_min=2, required=False)
+        problem = build_problem(nodes, [g], TOPO)
+        res = solve(problem)
+        assert res.admitted[0]
+        lvl = problem.level_keys.index(zone_key)
+        used = np.nonzero(res.alloc[0].sum(axis=0))[0]
+        assert len({int(problem.topo[n, lvl]) for n in used}) == 2
+        assert res.score[0] == pytest.approx(1.0)  # 2 of 2 target domains
+
+    def test_wave_soft_spread_spans_zones_on_multi_root_cluster(self):
+        """Same cluster-over-levels override in the wave kernel."""
+        from grove_tpu.solver.kernel import solve_waves
+
+        zone_key = "topology.kubernetes.io/zone"
+        nodes = self._two_zone_nodes()
+        gangs = [
+            self._spread_gang(f"g{i}", cpu=1.0, count=4, spread_key=zone_key,
+                              spread_min=2, required=False)
+            for i in range(2)
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        waves = solve_waves(problem, chunk_size=2)
+        assert waves.admitted[:2].all()
+        lvl = problem.level_keys.index(zone_key)
+        for g_i in range(2):
+            used = np.nonzero(waves.alloc[g_i].sum(axis=0))[0]
+            assert len({int(problem.topo[n, lvl]) for n in used}) == 2
+        # a hard zone-spread gang admits in ONE attempt too (previously it
+        # walked every level candidate before reaching cluster-wide)
+        hard = build_problem(
+            nodes,
+            [self._spread_gang("h0", cpu=1.0, count=4, spread_key=zone_key,
+                               spread_min=2, required=True)],
+            TOPO,
+        )
+        hres = solve_waves(hard, chunk_size=1)
+        assert hres.admitted[0]
+
+    def test_packed_spread_still_respects_required_level(self):
+        """The override only applies when there is NO required pack: a gang
+        packed into one slice with host-spread inside it stays packed."""
+        nodes = self._two_zone_nodes()
+        g = self._spread_gang("g0", cpu=1.0, count=4, spread_key=HOST_KEY,
+                              spread_min=2, required_key=SLICE_KEY)
+        problem = build_problem(nodes, [g], TOPO)
+        res = solve(problem)
+        assert res.admitted[0]
+        slice_lvl = problem.level_keys.index(SLICE_KEY)
+        used = np.nonzero(res.alloc[0].sum(axis=0))[0]
+        assert len({int(problem.topo[n, slice_lvl]) for n in used}) == 1
+
+    def test_encoder_rejects_spread_not_narrower_than_pack(self):
+        """Admission enforces spread strictly narrower than pack; the solver
+        boundary must too (advisor r2: a direct gRPC client sending
+        spread_key >= pack breadth got a forever-pending gang instead of
+        INVALID_ARGUMENT)."""
+        nodes = make_nodes(8)
+        equal = self._spread_gang("g0", 1.0, 4, spread_key=BLOCK_KEY)
+        equal["required_key"] = BLOCK_KEY
+        with pytest.raises(ValueError, match="strictly narrower"):
+            build_problem(nodes, [equal], TOPO)
+        broader = self._spread_gang("g1", 1.0, 4, spread_key=SLICE_KEY)
+        broader["required_key"] = BLOCK_KEY
+        with pytest.raises(ValueError, match="strictly narrower"):
+            build_problem(nodes, [broader], TOPO)
+
     def test_encoder_spread_fields(self):
         nodes = make_nodes(8)
         g = self._spread_gang("g0", 1.0, 4, spread_key=HOST_KEY, spread_min=3)
