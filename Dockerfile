@@ -1,0 +1,35 @@
+# grove-tpu container image: operator + initc waiter + solver sidecar in one
+# image (three console scripts), the analogue of the reference's
+# operator/initc images built by /root/reference/operator/Makefile
+# docker-build + hack/docker-build.sh.
+#
+# Build:    docker build -t grove-tpu:0.2.0 .
+# TPU pods: pass the TPU-enabled jax wheel spec, e.g.
+#           docker build --build-arg JAX_SPEC="jax[tpu]" -t grove-tpu:0.2.0-tpu .
+# Run:      docker run -p 8080:8080 grove-tpu:0.2.0  (operator with embedded
+#           apiserver; see deploy/docker-compose.yaml for the full topology)
+FROM python:3.12-slim AS runtime
+
+ARG JAX_SPEC="jax"
+
+WORKDIR /opt/grove-tpu
+COPY pyproject.toml README.md ./
+COPY grove_tpu ./grove_tpu
+COPY deploy/crds ./deploy/crds
+COPY samples ./samples
+
+RUN pip install --no-cache-dir "${JAX_SPEC}" && \
+    pip install --no-cache-dir ".[grpc]" && \
+    grove-tpu validate samples/simple1.yaml
+
+# operator runtime state (leader lock, serving certs)
+RUN mkdir -p /var/run/grove /etc/grove
+ENV JAX_PLATFORMS=""
+EXPOSE 8080 9443 50051
+
+# default: the deployable operator (embedded apiserver + webhooks +
+# controllers + solver-backed scheduler); other entry points:
+#   grove-tpu-initc  — pod init waiter (startup ordering)
+#   grove-tpu-solver — gRPC solver sidecar
+ENTRYPOINT ["grove-tpu"]
+CMD ["run"]

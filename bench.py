@@ -20,9 +20,80 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
+
+# XLA:CPU logs a spurious machine-feature ERROR on every persistent-cache
+# AOT load: the compiler records synthetic tuning features
+# (+prefer-no-gather/+prefer-no-scatter) that the loader's host-feature
+# detector never reports — even on the very host that compiled the
+# executable (verified with a fresh cache, same env, same machine; see
+# docs/benchmarks.md "Persistent-cache AOT warnings"). Silence C++ log
+# chatter for the bench; real backend failures surface as Python
+# exceptions regardless of the log level.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+_T_START = time.time()
+
+
+class ProbeLog:
+    """Self-diagnosing record of every accelerator health probe this run:
+    when it ran (seconds into the bench), its timeout, and its verdict.
+    Embedded in the BENCH JSON so a CPU-fallback artifact carries
+    machine-readable proof of whether the chip ever answered."""
+
+    def __init__(self):
+        self.attempts = []
+        self._lock = threading.Lock()
+        self.healthy = threading.Event()
+
+    def probe(self, timeout_s: float, where: str) -> bool:
+        from grove_tpu.utils.platform import probe_device_health
+
+        t0 = time.time()
+        ok = probe_device_health(timeout_s)
+        with self._lock:
+            self.attempts.append(
+                {
+                    "at_s": round(t0 - _T_START, 1),
+                    "took_s": round(time.time() - t0, 1),
+                    "timeout_s": timeout_s,
+                    "where": where,
+                    "ok": ok,
+                }
+            )
+        if ok:
+            self.healthy.set()
+        return ok
+
+    def as_json(self) -> dict:
+        with self._lock:
+            attempts = list(self.attempts)
+        return {
+            "attempts": attempts,
+            "env": {
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+                "axon_pool": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+            },
+        }
+
+    def background_prober(self, stop: threading.Event, interval_s: float = 20.0):
+        """Keep probing while the CPU-fallback bench runs on the main thread —
+        a chip that wakes mid-bench is caught and exploited at the end."""
+
+        def loop():
+            while not stop.is_set() and not self.healthy.is_set():
+                self.probe(60.0, "background")
+                stop.wait(interval_s)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+
+PROBE_LOG = ProbeLog()
 
 
 def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
@@ -111,23 +182,33 @@ def main() -> None:
         return
 
     backend_note = "default"
+    prober_stop = None
     if os.environ.get("_GROVE_BENCH_CPU_CHILD"):
         # re-exec child after a mid-bench backend death: already CPU-pinned
         # by the parent's env; report honestly and keep the trimmed profile
         backend_note = "cpu-fallback (backend died mid-run)"
+    elif os.environ.get("_GROVE_BENCH_TPU_LATE"):
+        # late-retry child: the parent saw a healthy probe after finishing
+        # its CPU-fallback run; re-verify once and bail silently on a blip
+        # (the parent's CPU artifact then stands as the last JSON line)
+        if not PROBE_LOG.probe(60.0, "late-child"):
+            sys.exit(3)
     elif not args.skip_health_probe:
-        from grove_tpu.utils.platform import ensure_healthy_backend
+        from grove_tpu.utils.platform import force_cpu_platform
 
-        # the chip sits behind a tunnel that can be transiently unavailable:
-        # probe up to 3 times (~7 min worst case) before settling for CPU
-        backend_note = ensure_healthy_backend(
-            timeout_s=120.0, retries=3, retry_wait_s=30.0
-        )
-        if backend_note != "default":
+        # ONE up-front probe; the rest of the retry budget is spread ACROSS
+        # the bench window by a background prober instead of burning minutes
+        # before any measurement starts. A chip that wakes at ANY point is
+        # exploited at the end via a full TPU re-run (late-retry child).
+        if not PROBE_LOG.probe(90.0, "start"):
+            force_cpu_platform()
+            backend_note = "cpu-fallback (accelerator probe failed)"
             print(
                 "WARNING: accelerator health probe failed; benchmarking on CPU",
                 file=sys.stderr,
             )
+            prober_stop = threading.Event()
+            PROBE_LOG.background_prober(prober_stop)
 
     import jax
 
@@ -224,6 +305,7 @@ def main() -> None:
                 "median_s": round(times[len(times) // 2], 4),
                 "runs": len(times),
                 "backend": f"{jax.default_backend()} ({backend_note})",
+                "probe": PROBE_LOG.as_json(),
             }
         )
     )
@@ -232,6 +314,41 @@ def main() -> None:
             f"WARNING: quality_vs_exact {quality:.4f} below the 0.995 gate",
             file=sys.stderr,
         )
+    if prober_stop is not None:
+        prober_stop.set()
+        # the chip answered during the CPU run (or answers right now):
+        # immediately capture the real TPU artifact — its JSON line prints
+        # last, so the driver records the TPU number, with the CPU line
+        # above kept as history
+        if PROBE_LOG.healthy.is_set() or PROBE_LOG.probe(45.0, "end"):
+            sys.exit(_retry_on_tpu())
+
+
+_ORIG_ENV = dict(os.environ)
+
+
+def _retry_on_tpu() -> int:
+    """The chip answered after the CPU-fallback measurement completed:
+    re-exec a child with the ORIGINAL (un-scrubbed) environment so it runs
+    on the accelerator and prints the real artifact. Failures and hangs are
+    contained — the parent's CPU JSON line already went out, so the driver
+    always has an artifact."""
+    import subprocess
+
+    env = dict(_ORIG_ENV)
+    env["_GROVE_BENCH_TPU_LATE"] = "1"
+    try:
+        subprocess.run(
+            [sys.executable, __file__, *sys.argv[1:]],
+            env=env,
+            timeout=1200,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            "WARNING: late TPU retry timed out; CPU artifact stands",
+            file=sys.stderr,
+        )
+    return 0
 
 
 def _rerun_on_cpu() -> int:

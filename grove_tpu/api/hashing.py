@@ -48,16 +48,64 @@ def _clique_template_payload(clique_template, priority_class_name: str = ""):
     }
 
 
+# Hash memoization. A CR's spec is immutable per (uid, generation) — the
+# store bumps generation on every spec write — so template hashes can be
+# cached on that key instead of re-normalizing the whole template tree on
+# every reconcile (profiling: _normalize was a top-3 control-plane cost).
+# Unsaved objects (no uid / generation 0, e.g. webhook-time) are never
+# cached. Bounded by wholesale clear — entries are tiny and regeneration
+# is cheap relative to the steady-state savings.
+_HASH_CACHE: dict = {}
+_HASH_CACHE_MAX = 8192
+
+
+def _cached(key, compute):
+    if key is None:
+        return compute()
+    h = _HASH_CACHE.get(key)
+    if h is None:
+        if len(_HASH_CACHE) >= _HASH_CACHE_MAX:
+            _HASH_CACHE.clear()
+        h = _HASH_CACHE[key] = compute()
+    return h
+
+
+def _gen_key(owner, scope: str):
+    meta = owner.metadata
+    if meta.uid and meta.generation:
+        return (meta.uid, meta.generation, scope)
+    return None
+
+
 def compute_pcs_generation_hash(pcs) -> str:
     """Hash of every clique's pod template (not replica counts — scaling is
     not an update); changing it starts the rolling update flow
     (reconcilespec.go:72-123)."""
-    pcn = pcs.spec.template.priority_class_name
-    parts = [
-        _clique_template_payload(c, pcn) for c in pcs.spec.template.cliques
-    ]
-    return compute_hash({"cliques": parts})
+
+    def compute():
+        pcn = pcs.spec.template.priority_class_name
+        parts = [
+            _clique_template_payload(c, pcn) for c in pcs.spec.template.cliques
+        ]
+        return compute_hash({"cliques": parts})
+
+    return _cached(_gen_key(pcs, "pcs-generation"), compute)
 
 
 def compute_pod_template_hash(clique_template, priority_class_name: str = "") -> str:
     return compute_hash(_clique_template_payload(clique_template, priority_class_name))
+
+
+def pod_template_hash_for(pcs, clique_name: str):
+    """Cached per-(uid, generation, clique) pod-template hash; None when the
+    PCS template has no such clique."""
+
+    def compute():
+        tmpl = pcs.spec.template.clique_template(clique_name)
+        if tmpl is None:
+            return None
+        return compute_pod_template_hash(
+            tmpl, pcs.spec.template.priority_class_name
+        )
+
+    return _cached(_gen_key(pcs, f"clique:{clique_name}"), compute)

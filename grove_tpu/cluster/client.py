@@ -195,7 +195,16 @@ class HttpStore:
         )
         return decode_object(out)
 
-    def get(self, kind: str, namespace: str, name: str, cached: bool = False):
+    def get(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        cached: bool = False,
+        readonly: bool = False,
+    ):
+        # `readonly` is a Store-interface contract marker: over HTTP every
+        # response is already a private decode, so it changes nothing here
         try:
             out = self._request(
                 "GET", self._path(kind, namespace, name), operation="get"
@@ -205,6 +214,17 @@ class HttpStore:
                 return None
             raise
         return decode_object(out)
+
+    def scan(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        cached: bool = False,
+    ):
+        """Store.scan parity: over HTTP a list response is already private
+        decoded objects, so scan == iterate the list."""
+        return iter(self.list(kind, namespace, label_selector, cached))
 
     def list(
         self,

@@ -90,18 +90,21 @@ def record_last_error(
     LastErrors). Skips the write when the same code+description is already
     recorded — a timestamp-only rewrite would emit a self-watch event and
     defeat the workqueue's backoff with an immediate re-reconcile."""
-    fresh = ctx.store.get(kind, namespace, name)
-    if fresh is None:
+    view = ctx.store.get(kind, namespace, name, readonly=True)
+    if view is None:
         return
     entry = {
         "code": err.code,
         "description": str(err),
         "observedAt": ctx.clock.now(),
     }
-    existing = fresh.status.last_errors
+    existing = view.status.last_errors
     if existing and all(
         existing[0].get(k) == entry[k] for k in ("code", "description")
     ):
+        return
+    fresh = ctx.store.get(kind, namespace, name)  # mutable copy for the write
+    if fresh is None:
         return
     fresh.status.last_errors = [entry]
     try:
@@ -120,7 +123,9 @@ def create_or_adopt(ctx: OperatorContext, desired) -> None:
     against the old spec.
     """
     ns = desired.metadata.namespace
-    current = ctx.store.get(desired.kind, ns, desired.metadata.name)
+    # readonly view for the steady-state no-drift comparison; re-get a
+    # mutable copy only when adoption actually writes
+    current = ctx.store.get(desired.kind, ns, desired.metadata.name, readonly=True)
     if current is None:
         ctx.store.create(desired)
         return
@@ -142,6 +147,7 @@ def create_or_adopt(ctx: OperatorContext, desired) -> None:
         current.metadata.labels != want_labels
         or current.metadata.annotations != want_annotations
     ):
+        current = ctx.store.get(desired.kind, ns, desired.metadata.name)
         current.metadata.labels = want_labels
         current.metadata.annotations = want_annotations
         ctx.store.update(current, bump_generation=False)
@@ -264,7 +270,7 @@ def apply_template_to_pclq(ctx: OperatorContext, pcs, pclq, clique_name: str) ->
     replicas). Returns True when a write happened."""
     import json as _json
 
-    from grove_tpu.api.hashing import compute_pod_template_hash
+    from grove_tpu.api.hashing import pod_template_hash_for
     from grove_tpu.api.meta import deep_copy
     from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
     from grove_tpu.controller.podclique.status import (
@@ -275,7 +281,7 @@ def apply_template_to_pclq(ctx: OperatorContext, pcs, pclq, clique_name: str) ->
     tmpl = tmpl_root.clique_template(clique_name)
     if tmpl is None or pclq.metadata.deletion_timestamp is not None:
         return False
-    want_hash = compute_pod_template_hash(tmpl, tmpl_root.priority_class_name)
+    want_hash = pod_template_hash_for(pcs, clique_name)
     changed = False
     if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want_hash:
         new_spec = deep_copy(tmpl.spec)

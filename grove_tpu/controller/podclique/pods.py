@@ -44,7 +44,7 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique) -> int:
     ns = pclq.metadata.namespace
     sel = {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
     cached_pods = [
-        p for p in ctx.store.list("Pod", ns, sel, cached=True) if not is_terminating(p)
+        p for p in ctx.store.scan("Pod", ns, sel, cached=True) if not is_terminating(p)
     ]
     observed_uids = [p.metadata.uid for p in cached_pods]
     key = f"{ns}/{pclq.metadata.name}"
@@ -251,7 +251,7 @@ def _remove_scheduling_gates(ctx: OperatorContext, pclq: PodClique) -> int:
     podgang_name = pclq.metadata.labels.get(namegen.LABEL_PODGANG, "")
     pods = [
         p
-        for p in ctx.store.list(
+        for p in ctx.store.scan(
             "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
         )
         if not is_terminating(p)
@@ -261,7 +261,9 @@ def _remove_scheduling_gates(ctx: OperatorContext, pclq: PodClique) -> int:
         return 0
 
     podgang: Optional[PodGang] = (
-        ctx.store.get("PodGang", ns, podgang_name, cached=True) if podgang_name else None
+        ctx.store.get("PodGang", ns, podgang_name, cached=True, readonly=True)
+        if podgang_name
+        else None
     )
     names_in_gang = set()
     if podgang is not None:
@@ -299,11 +301,13 @@ def _base_podgang_scheduled(ctx: OperatorContext, pclq: PodClique) -> bool:
     if not base_name:
         return True
     ns = pclq.metadata.namespace
-    base = ctx.store.get("PodGang", ns, base_name, cached=True)
+    base = ctx.store.get("PodGang", ns, base_name, cached=True, readonly=True)
     if base is None:
         return False
     for group in base.spec.pod_groups:
-        member = ctx.store.get("PodClique", ns, group.name, cached=True)
+        member = ctx.store.get(
+            "PodClique", ns, group.name, cached=True, readonly=True
+        )
         if member is None:
             return False
         if member.status.scheduled_replicas < group.min_replicas:

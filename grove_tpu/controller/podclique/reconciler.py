@@ -34,13 +34,16 @@ class PodCliqueReconciler:
 
     def reconcile(self, key: Key) -> ReconcileStepResult:
         _, ns, name = key
-        pclq = self.ctx.store.get("PodClique", ns, name)
+        # readonly view: sync_pods only reads the PCLQ; the one-time
+        # finalizer write re-gets a mutable copy
+        pclq = self.ctx.store.get("PodClique", ns, name, readonly=True)
         if pclq is None:
             return do_not_requeue()
         if pclq.metadata.deletion_timestamp is not None:
             return self._reconcile_delete(pclq)
         try:
             if FINALIZER not in pclq.metadata.finalizers:
+                pclq = self.ctx.store.get("PodClique", ns, name)
                 pclq.metadata.finalizers.append(FINALIZER)
                 pclq = self.ctx.store.update(pclq, bump_generation=False)
             skipped_gated = pod_component.sync_pods(self.ctx, pclq)

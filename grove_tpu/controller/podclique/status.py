@@ -35,7 +35,7 @@ def reconcile_status(ctx: OperatorContext, pclq: PodClique) -> PodClique:
     ns = pclq.metadata.namespace
     pods = [
         p
-        for p in ctx.store.list(
+        for p in ctx.store.scan(
             "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
         )
         if not is_terminating(p)

@@ -18,7 +18,7 @@ import json
 from typing import Dict, List, Optional
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.hashing import pod_template_hash_for
 from grove_tpu.api.meta import Condition, ObjectMeta, get_condition, set_condition
 from grove_tpu.api.types import (
     COND_MIN_AVAILABLE_BREACHED,
@@ -79,7 +79,11 @@ class PodCliqueScalingGroupReconciler:
 
     def _owner_pcs(self, pcsg) -> Optional[PodCliqueSet]:
         pcs_name = pcsg.metadata.labels.get(namegen.LABEL_PART_OF, "")
-        return self.ctx.store.get("PodCliqueSet", pcsg.metadata.namespace, pcs_name)
+        # readonly: PCSG flows only read the owner PCS (template, configs);
+        # writes always target PCSG/PodClique objects fetched mutably
+        return self.ctx.store.get(
+            "PodCliqueSet", pcsg.metadata.namespace, pcs_name, readonly=True
+        )
 
     def _reconcile_delete(self, pcsg) -> ReconcileStepResult:
         ns = pcsg.metadata.namespace
@@ -161,8 +165,8 @@ class PodCliqueScalingGroupReconciler:
         labels[namegen.LABEL_PCSG] = pcsg.metadata.name
         labels[namegen.LABEL_PCSG_REPLICA_INDEX] = str(replica)
         labels[namegen.LABEL_PODGANG] = gang
-        labels[namegen.LABEL_POD_TEMPLATE_HASH] = compute_pod_template_hash(
-            tmpl, pcs.spec.template.priority_class_name
+        labels[namegen.LABEL_POD_TEMPLATE_HASH] = pod_template_hash_for(
+            pcs, clique_name
         )
         if replica >= min_available:
             # scaled replica: points back at its base gang (podclique.go:423-449)
@@ -197,19 +201,16 @@ class PodCliqueScalingGroupReconciler:
     # -- rolling update (components/podclique/rollingupdate.go:55-260) ----
 
     def _desired_hash(self, pcs: PodCliqueSet, clique_name: str) -> Optional[str]:
-        tmpl = pcs.spec.template.clique_template(clique_name)
-        if tmpl is None:
-            return None
-        return compute_pod_template_hash(
-            tmpl, pcs.spec.template.priority_class_name
-        )
+        return pod_template_hash_for(pcs, clique_name)
 
-    def _replica_pclqs(self, pcsg, replica: int) -> List[PodClique]:
+    def _replica_pclqs(
+        self, pcsg, replica: int, readonly: bool = False
+    ) -> List[PodClique]:
         ns = pcsg.metadata.namespace
         out = []
         for clique_name in pcsg.spec.clique_names:
             fqn = namegen.podclique_name(pcsg.metadata.name, replica, clique_name)
-            pclq = self.ctx.store.get("PodClique", ns, fqn)
+            pclq = self.ctx.store.get("PodClique", ns, fqn, readonly=readonly)
             if pclq is not None:
                 out.append((clique_name, pclq))
         return out
@@ -222,7 +223,7 @@ class PodCliqueScalingGroupReconciler:
         torn down in the same pass)."""
         from grove_tpu.api.pod import is_terminating
 
-        pairs = self._replica_pclqs(pcsg, replica)
+        pairs = self._replica_pclqs(pcsg, replica, readonly=True)
         if len(pairs) < len(pcsg.spec.clique_names):
             return False  # not materialized yet; the sync builds it fresh
         ns = pcsg.metadata.namespace
@@ -234,7 +235,7 @@ class PodCliqueScalingGroupReconciler:
                 return True
             fresh = [
                 p
-                for p in self.ctx.store.list(
+                for p in self.ctx.store.scan(
                     "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
                 )
                 if not is_terminating(p)
@@ -255,14 +256,14 @@ class PodCliqueScalingGroupReconciler:
         coming back."""
         from grove_tpu.api.pod import is_ready, is_terminating
 
-        pairs = self._replica_pclqs(pcsg, replica)
+        pairs = self._replica_pclqs(pcsg, replica, readonly=True)
         if len(pairs) < len(pcsg.spec.clique_names):
             return False
         ns = pcsg.metadata.namespace
         for _, pclq in pairs:
             pods = [
                 p
-                for p in self.ctx.store.list(
+                for p in self.ctx.store.scan(
                     "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}
                 )
                 if not is_terminating(p)
@@ -446,7 +447,9 @@ class PodCliqueScalingGroupReconciler:
             pclqs: List[PodClique] = []
             for clique_name in fresh.spec.clique_names:
                 fqn = namegen.podclique_name(fresh.metadata.name, replica, clique_name)
-                pclq = self.ctx.store.get("PodClique", ns, fqn, cached=True)
+                pclq = self.ctx.store.get(
+                    "PodClique", ns, fqn, cached=True, readonly=True
+                )
                 if pclq is not None:
                     pclqs.append(pclq)
             if len(pclqs) < len(fresh.spec.clique_names):

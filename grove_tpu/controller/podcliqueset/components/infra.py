@@ -18,7 +18,12 @@ from grove_tpu.controller.common import OperatorContext
 
 
 def _ensure(ctx: OperatorContext, obj: GenericObject) -> None:
-    if ctx.store.get(obj.kind, obj.metadata.namespace, obj.metadata.name) is None:
+    if (
+        ctx.store.get(
+            obj.kind, obj.metadata.namespace, obj.metadata.name, readonly=True
+        )
+        is None
+    ):
         ctx.store.create(obj)
 
 
@@ -29,7 +34,7 @@ def _reap(
     selector: Dict[str, str],
     keep: List[str],
 ) -> None:
-    for obj in ctx.store.list(kind, namespace, selector):
+    for obj in ctx.store.scan(kind, namespace, selector):
         if obj.metadata.name not in keep:
             ctx.store.delete(kind, namespace, obj.metadata.name)
 

@@ -12,7 +12,7 @@ import json
 from typing import Dict
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.hashing import pod_template_hash_for
 from grove_tpu.api.meta import ObjectMeta, deep_copy
 from grove_tpu.api.types import PodClique, PodCliqueSet
 from grove_tpu.controller.common import (
@@ -57,8 +57,8 @@ def build_pclq(pcs: PodCliqueSet, replica: int, clique) -> PodClique:
     labels[namegen.LABEL_PODGANG] = namegen.base_podgang_name(
         pcs.metadata.name, replica
     )
-    labels[namegen.LABEL_POD_TEMPLATE_HASH] = compute_pod_template_hash(
-        clique, pcs.spec.template.priority_class_name
+    labels[namegen.LABEL_POD_TEMPLATE_HASH] = pod_template_hash_for(
+        pcs, clique.name
     )
     annotations = dict(clique.annotations)
     deps = resolve_starts_after(pcs, replica, clique.name)

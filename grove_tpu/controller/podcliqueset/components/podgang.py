@@ -64,13 +64,13 @@ def compute_expected_podgangs(
     ns = pcs.metadata.namespace
     live_pclqs = {
         p.metadata.name: p
-        for p in ctx.store.list(
+        for p in ctx.store.scan(
             "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
         )
     }
     live_pcsgs = {
         g.metadata.name: g
-        for g in ctx.store.list(
+        for g in ctx.store.scan(
             "PodCliqueScalingGroup",
             ns,
             namegen.default_labels(pcs.metadata.name),
@@ -176,7 +176,7 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
         **namegen.default_labels(pcs.metadata.name),
         namegen.LABEL_COMPONENT: namegen.COMPONENT_PODGANG,
     }
-    existing = {g.metadata.name for g in ctx.store.list("PodGang", ns, selector)}
+    existing = {g.metadata.name for g in ctx.store.scan("PodGang", ns, selector)}
 
     # delete excess (:368-386)
     for name in existing - expected_names:
@@ -185,7 +185,7 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
 
     live_pclqs = {
         p.metadata.name: p
-        for p in ctx.store.list(
+        for p in ctx.store.scan(
             "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
         )
     }
@@ -214,7 +214,7 @@ def _pods_pending_creation_or_association(
         if live is None:
             pending += pclq.replicas
             continue
-        pods = ctx.store.list(
+        pods = ctx.store.scan(
             "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.fqn}, cached=True
         )
         pods = [p for p in pods if p.metadata.deletion_timestamp is None]
@@ -308,7 +308,7 @@ def _create_or_update_podgang(
         reuse_reservation_ref=reuse_ref,
     )
 
-    current = ctx.store.get("PodGang", ns, gang.fqn)
+    current = ctx.store.get("PodGang", ns, gang.fqn, readonly=True)
     if current is None:
         labels = dict(namegen.default_labels(pcs.metadata.name))
         labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PODGANG
@@ -322,6 +322,7 @@ def _create_or_update_podgang(
         )
         ctx.record_event("PodGang", "PodGangCreateSuccessful", gang.fqn)
     elif current.spec != spec:
+        current = ctx.store.get("PodGang", ns, gang.fqn)
         current.spec = spec
         ctx.store.update(current, bump_generation=False)
 

@@ -43,7 +43,7 @@ def _replica_breach_since(
     PCSGs (gangterminate.go:67-105; PCSG aggregation covers base replicas)."""
     ns = pcs.metadata.namespace
     breach_times: List[float] = []
-    standalone = ctx.store.list(
+    standalone = ctx.store.scan(
         "PodClique",
         ns,
         {
@@ -57,7 +57,7 @@ def _replica_breach_since(
         cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
         if cond is not None and cond.is_true():
             breach_times.append(cond.last_transition_time)
-    pcsgs = ctx.store.list(
+    pcsgs = ctx.store.scan(
         "PodCliqueScalingGroup",
         ns,
         {

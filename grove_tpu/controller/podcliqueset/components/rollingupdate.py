@@ -21,7 +21,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.hashing import compute_pod_template_hash
+from grove_tpu.api.hashing import pod_template_hash_for
 from grove_tpu.api.meta import get_condition
 from grove_tpu.api.types import (
     COND_MIN_AVAILABLE_BREACHED,
@@ -87,12 +87,9 @@ def _replica_pclqs(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> Lis
 def _replica_needs_update(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> bool:
     for pclq in _replica_pclqs(ctx, pcs, replica):
         tmpl_name = _clique_template_name(pcs, pclq)
-        tmpl = pcs.spec.template.clique_template(tmpl_name)
-        if tmpl is None:
+        want = pod_template_hash_for(pcs, tmpl_name)
+        if want is None:
             continue
-        want = compute_pod_template_hash(
-            tmpl, pcs.spec.template.priority_class_name
-        )
         if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want:
             return True
         if pclq.status.updated_replicas < pclq.spec.replicas:
@@ -161,12 +158,9 @@ def _replica_update_done(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) 
         return True
     for pclq in pclqs:
         name = _clique_template_name(pcs, pclq)
-        tmpl = pcs.spec.template.clique_template(name)
-        if tmpl is None:
+        want = pod_template_hash_for(pcs, name)
+        if want is None:
             continue
-        want = compute_pod_template_hash(
-            tmpl, pcs.spec.template.priority_class_name
-        )
         if pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) != want:
             return False
         if pclq.status.updated_replicas < pclq.spec.replicas:

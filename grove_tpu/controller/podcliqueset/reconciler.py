@@ -153,7 +153,7 @@ class PodCliqueSetReconciler:
         pcs = self.ctx.store.get("PodCliqueSet", ns, name)
         if pcs is None or pcs.metadata.deletion_timestamp is not None:
             return
-        gangs = self.ctx.store.list(
+        gangs = self.ctx.store.scan(
             "PodGang",
             ns,
             {
@@ -180,7 +180,7 @@ class PodCliqueSetReconciler:
     def _count_updated_replicas(self, pcs: PodCliqueSet) -> int:
         """Replicas whose every PCLQ carries the current template hash with
         all pods updated (podcliqueset.go:68-70 UpdatedReplicas)."""
-        from grove_tpu.api.hashing import compute_pod_template_hash
+        from grove_tpu.api.hashing import pod_template_hash_for
         from grove_tpu.controller.podcliqueset.components.rollingupdate import (
             _clique_template_name,
         )
@@ -189,9 +189,7 @@ class PodCliqueSetReconciler:
         tmpl = pcs.spec.template
         # hash depends only on the template — compute once per clique
         want_hash = {
-            clique.name: compute_pod_template_hash(
-                clique, tmpl.priority_class_name
-            )
+            clique.name: pod_template_hash_for(pcs, clique.name)
             for clique in tmpl.cliques
         }
         count = 0
@@ -200,7 +198,7 @@ class PodCliqueSetReconciler:
                 **namegen.default_labels(pcs.metadata.name),
                 namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
             }
-            pclqs = self.ctx.store.list("PodClique", ns, sel, cached=True)
+            pclqs = list(self.ctx.store.scan("PodClique", ns, sel, cached=True))
             if not pclqs:
                 continue
             updated = True
@@ -234,13 +232,13 @@ class PodCliqueSetReconciler:
             }
             pclqs = [
                 p
-                for p in self.ctx.store.list("PodClique", ns, sel, cached=True)
+                for p in self.ctx.store.scan("PodClique", ns, sel, cached=True)
                 if p.metadata.labels.get(namegen.LABEL_COMPONENT)
                 == namegen.COMPONENT_PCS_PODCLIQUE
             ]
-            pcsgs = self.ctx.store.list(
+            pcsgs = list(self.ctx.store.scan(
                 "PodCliqueScalingGroup", ns, sel, cached=True
-            )
+            ))
             entities = pclqs + pcsgs
             if not entities:
                 continue

@@ -45,7 +45,7 @@ def _map_pcs_to_children_of_kind(ctx: OperatorContext, kind: str):
         sel = namegen.default_labels(ev.obj.metadata.name)
         return [
             (o.metadata.namespace, o.metadata.name)
-            for o in ctx.store.list(kind, ev.obj.metadata.namespace, sel)
+            for o in ctx.store.scan(kind, ev.obj.metadata.namespace, sel)
         ]
 
     return map_fn

@@ -135,7 +135,13 @@ class Engine:
             self._route_events()
             progressed = False
             for ctrl in self.controllers:
-                for _slot in range(max(ctrl.concurrent_syncs, 1)):
+                # Drain the controller's whole ready set this round: events
+                # emitted by these reconciles are routed only at the next
+                # round's start, so sibling updates COALESCE into one owner
+                # requeue (dedup) instead of one owner reconcile per child
+                # event. Terminates: reconciles can only add to the backlog
+                # (routed next round) or the delayed heap (>= backoff).
+                while True:
                     key = ctrl.queue.pop(now)
                     if key is None:
                         break

@@ -18,9 +18,10 @@ controllers rely on:
 
 from __future__ import annotations
 
+import pickle
 from contextlib import contextmanager
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional
 
 from grove_tpu.api.meta import deep_copy, next_uid
 from grove_tpu.runtime.clock import Clock
@@ -46,11 +47,33 @@ INDEXED_LABELS = (
 )
 
 
+def _dumps(obj) -> Optional[bytes]:
+    """Canonical pickled form of a committed object. Computed ONCE per
+    write; every read materializes with a single pickle.loads — half the
+    cost of a dumps+loads round trip, which profiling shows dominates
+    control-plane time. None when the object doesn't pickle (then reads
+    fall back to deep_copy)."""
+    try:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        return None
+
+
+def _materialize(obj, blob: Optional[bytes]):
+    return pickle.loads(blob) if blob is not None else deep_copy(obj)
+
+
 @dataclass
 class WatchEvent:
     type: str
     kind: str
-    obj: object  # deep copy at emit time
+    obj: object  # READ-ONLY view shared by all subscribers — never mutate;
+    # call materialize() for a private copy
+    blob: Optional[bytes] = field(default=None, repr=False, compare=False)
+
+    def materialize(self):
+        """Private deep copy of the event payload (cheap: pre-pickled)."""
+        return _materialize(self.obj, self.blob)
 
 
 def obj_key(obj) -> str:
@@ -100,6 +123,13 @@ class Store:
         self.cache_lag = cache_lag
         self._committed: Dict[str, Dict[str, object]] = {}
         self._cache: Dict[str, Dict[str, object]] = {}
+        # canonical pickled form per committed/cached object, computed once
+        # per write: reads materialize with ONE pickle.loads instead of a
+        # dumps+loads round trip (the control plane's hottest path).
+        # Committed objects are IMMUTABLE once stored — every write commits
+        # a fresh object — so blobs never go stale.
+        self._blob: Dict[str, Dict[str, bytes]] = {}
+        self._cache_blob: Dict[str, Dict[str, bytes]] = {}
         # kind -> (label_key, label_value) -> set of object keys
         self._index: Dict[str, Dict[tuple, set]] = {}
         self._cache_index: Dict[str, Dict[tuple, set]] = {}
@@ -147,8 +177,11 @@ class Store:
     def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
         self._watchers.append(fn)
 
-    def _emit(self, type_: str, obj) -> None:
-        ev = WatchEvent(type=type_, kind=obj.kind, obj=deep_copy(obj))
+    def _emit(self, type_: str, obj, blob: Optional[bytes]) -> None:
+        # zero-copy fanout: committed objects are immutable once stored, so
+        # every subscriber may share the payload; WatchEvent.materialize()
+        # (pre-pickled) is the escape hatch for watchers that must mutate
+        ev = WatchEvent(type=type_, kind=obj.kind, obj=obj, blob=blob)
         for w in self._watchers:
             w(ev)
 
@@ -162,10 +195,10 @@ class Store:
     def sync_cache_kind(self, kind: str) -> None:
         """Advance one kind's cache — models that kind's informer receiving
         its watch events (each informer syncs independently; cross-kind
-        staleness is exactly the race expectations absorb)."""
-        self._cache[kind] = {
-            k: deep_copy(v) for k, v in self._committed.get(kind, {}).items()
-        }
+        staleness is exactly the race expectations absorb). Committed
+        objects are immutable, so the cache shares them (no copies)."""
+        self._cache[kind] = dict(self._committed.get(kind, {}))
+        self._cache_blob[kind] = dict(self._blob.get(kind, {}))
         index: Dict[tuple, set] = {}
         for obj in self._cache[kind].values():
             _index_insert(index, obj)
@@ -173,9 +206,11 @@ class Store:
 
     def apply_event_to_cache(self, ev: "WatchEvent") -> None:
         """Incrementally apply one delivered watch event to the read cache —
-        O(1) informer semantics (sync_cache_kind re-copies a whole kind and
-        is kept for explicit full resyncs)."""
+        O(1) informer semantics (sync_cache_kind re-syncs a whole kind and
+        is kept for explicit full resyncs). Event payloads are immutable
+        (read-only watcher contract), so the cache shares them."""
         kind_cache = self._cache.setdefault(ev.kind, {})
+        kind_blob = self._cache_blob.setdefault(ev.kind, {})
         kind_index = self._cache_index.setdefault(ev.kind, {})
         key = obj_key(ev.obj)
         old = kind_cache.get(key)
@@ -183,12 +218,14 @@ class Store:
             _index_delete(kind_index, old)
         if ev.type == DELETED:
             kind_cache.pop(key, None)
+            kind_blob.pop(key, None)
             return
-        # copy on insert: the event payload is shared by every subscriber, so
-        # a mutating watcher must not be able to corrupt the informer cache
-        stored = deep_copy(ev.obj)
-        kind_cache[key] = stored
-        _index_insert(kind_index, stored)
+        kind_cache[key] = ev.obj
+        if ev.blob is not None:
+            kind_blob[key] = ev.blob
+        else:
+            kind_blob.pop(key, None)
+        _index_insert(kind_index, ev.obj)
 
     # -- label index ------------------------------------------------------
 
@@ -216,8 +253,10 @@ class Store:
                         if best is None or len(entries) < len(best):
                             best = entries
                 if best is not None:
-                    return [view[k] for k in best if k in view]
-        return view.values()
+                    return [view[k] for k in list(best) if k in view]
+        # snapshot of the reference list (not the objects): callers may
+        # create/delete while iterating a scan
+        return list(view.values())
 
     def _read_view(self, cached: bool) -> Dict[str, Dict[str, object]]:
         if cached and self.cache_lag:
@@ -225,6 +264,29 @@ class Store:
         return self._committed
 
     # -- CRUD -----------------------------------------------------------
+
+    def _commit(self, stored, blob: Optional[bytes] = None) -> Optional[bytes]:
+        """Commit `stored` as the new immutable committed state + canonical
+        blob. `stored` must never be mutated after this call."""
+        if blob is None:
+            blob = _dumps(stored)
+        self._committed.setdefault(stored.kind, {})[obj_key(stored)] = stored
+        if blob is not None:
+            self._blob.setdefault(stored.kind, {})[obj_key(stored)] = blob
+        else:
+            self._blob.get(stored.kind, {}).pop(obj_key(stored), None)
+        self._index_add(stored)
+        return blob
+
+    def _uncommit(self, obj) -> Optional[bytes]:
+        key = obj_key(obj)
+        self._committed.get(obj.kind, {}).pop(key, None)
+        blob = self._blob.get(obj.kind, {}).pop(key, None)
+        self._index_remove(obj)
+        return blob
+
+    def _blob_view(self, use_cache: bool, kind: str) -> Dict[str, bytes]:
+        return (self._cache_blob if use_cache else self._blob).get(kind, {})
 
     def create(self, obj) -> object:
         self._authorize("create", obj)
@@ -235,20 +297,35 @@ class Store:
             raise GroveError(
                 ERR_CONFLICT, f"{obj.kind} {key} already exists", "create"
             )
-        stored = deep_copy(obj)
+        stored = deep_copy(obj)  # caller keeps ownership of its argument
         self._rv += 1
         stored.metadata.uid = stored.metadata.uid or next_uid()
         stored.metadata.resource_version = self._rv
         stored.metadata.generation = 1
         stored.metadata.creation_timestamp = self.clock.now()
-        kind_objs[key] = stored
-        self._index_add(stored)
-        self._emit(ADDED, stored)
-        return deep_copy(stored)
+        blob = self._commit(stored)
+        self._emit(ADDED, stored, blob)
+        return _materialize(stored, blob)
 
-    def get(self, kind: str, namespace: str, name: str, cached: bool = False):
-        obj = self._read_view(cached).get(kind, {}).get(f"{namespace}/{name}")
-        return deep_copy(obj) if obj is not None else None
+    def get(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        cached: bool = False,
+        readonly: bool = False,
+    ):
+        """Fetch one object. `readonly=True` returns the store's committed
+        object WITHOUT a copy — the caller MUST NOT mutate it (same contract
+        as scan(); re-get mutably before building an update)."""
+        use_cache = cached and self.cache_lag
+        key = f"{namespace}/{name}"
+        obj = self._read_view(cached).get(kind, {}).get(key)
+        if obj is None:
+            return None
+        if readonly:
+            return obj
+        return _materialize(obj, self._blob_view(use_cache, kind).get(key))
 
     def list(
         self,
@@ -258,15 +335,35 @@ class Store:
         cached: bool = False,
     ) -> List[object]:
         use_cache = cached and self.cache_lag
+        blobs = self._blob_view(use_cache, kind)
+        out = [
+            _materialize(obj, blobs.get(obj_key(obj)))
+            for obj in self.scan(kind, namespace, label_selector, cached)
+        ]
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def scan(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        cached: bool = False,
+    ) -> Iterator[object]:
+        """Zero-copy read-only iteration over matching objects (unsorted).
+
+        The yielded objects ARE the store's committed state — callers MUST
+        NOT mutate them (deep_copy first to build an update). This is the
+        informer-cache contract from client-go, and it is what makes the
+        hot status/compute scans O(matched) with no serialization cost.
+        """
+        use_cache = cached and self.cache_lag
         view = self._read_view(cached).get(kind, {})
-        out = []
         for obj in self._candidates(kind, label_selector, use_cache, view):
             if namespace is not None and obj.metadata.namespace != namespace:
                 continue
             if matches_labels(obj, label_selector):
-                out.append(deep_copy(obj))
-        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
-        return out
+                yield obj
 
     def _require(self, obj):
         kind_objs = self._committed.get(obj.kind, {})
@@ -299,24 +396,55 @@ class Store:
                 f"{current.metadata.resource_version}",
                 "update",
             )
-        stored = deep_copy(obj)
-        stored.metadata.uid = current.metadata.uid
-        stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        # No-op detection, fast path first: pickle `obj` with its metadata
+        # bookkeeping normalized to current's and byte-compare against the
+        # canonical committed blob. Identical bytes prove a no-op with ONE
+        # dumps and no copies. Differing bytes fall back to the structural
+        # comparison (pickle is order-sensitive for dicts, so byte
+        # inequality does not prove semantic inequality). No-op writes get
+        # no version bump and no event — the role the reference's change
+        # predicates (GenerationChanged etc.) play in preventing
+        # self-triggering reconcile livelock.
+        cur_blob = self._blob.get(obj.kind, {}).get(key)
+        meta = obj.metadata
+        saved = (
+            meta.resource_version,
+            meta.generation,
+            meta.uid,
+            meta.creation_timestamp,
+        )
+        try:
+            meta.resource_version = current.metadata.resource_version
+            meta.generation = current.metadata.generation
+            meta.uid = current.metadata.uid
+            meta.creation_timestamp = current.metadata.creation_timestamp
+            blob_norm = _dumps(obj)
+        finally:
+            (
+                meta.resource_version,
+                meta.generation,
+                meta.uid,
+                meta.creation_timestamp,
+            ) = saved
+        if blob_norm is not None and blob_norm == cur_blob:
+            return pickle.loads(blob_norm)
+        if blob_norm is not None:
+            stored = pickle.loads(blob_norm)  # private copy, metadata normalized
+        else:
+            stored = deep_copy(obj)
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
         if _semantically_equal(stored, current):
-            # No-op write: no version bump, no event. Plays the role of the
-            # reference's change predicates (GenerationChanged etc.) in
-            # preventing self-triggering reconcile livelock.
-            return deep_copy(current)
+            return _materialize(current, cur_blob)
         self._rv += 1
         stored.metadata.resource_version = self._rv
         stored.metadata.generation = current.metadata.generation + (
             1 if bump_generation else 0
         )
         self._index_remove(current)
-        kind_objs[key] = stored
-        self._index_add(stored)
-        self._emit(MODIFIED, stored)
-        return deep_copy(stored)
+        blob = self._commit(stored)
+        self._emit(MODIFIED, stored, blob)
+        return _materialize(stored, blob)
 
     def update_status(self, obj) -> object:
         """Status write: no generation bump (status subresource semantics)."""
@@ -332,14 +460,18 @@ class Store:
         self._inject("delete", obj)
         if obj.metadata.finalizers:
             if obj.metadata.deletion_timestamp is None:
-                obj.metadata.deletion_timestamp = self.clock.now()
+                # committed objects are immutable: commit a fresh copy with
+                # the deletion timestamp instead of mutating in place
+                stored = _materialize(obj, self._blob.get(kind, {}).get(key))
+                stored.metadata.deletion_timestamp = self.clock.now()
                 self._rv += 1
-                obj.metadata.resource_version = self._rv
-                self._emit(MODIFIED, obj)
+                stored.metadata.resource_version = self._rv
+                self._index_remove(obj)
+                blob = self._commit(stored)
+                self._emit(MODIFIED, stored, blob)
             return
-        del kind_objs[key]
-        self._index_remove(obj)
-        self._emit(DELETED, obj)
+        blob = self._uncommit(obj)
+        self._emit(DELETED, obj, blob)
 
     def remove_finalizer(self, kind: str, namespace: str, name: str, finalizer: str) -> None:
         kind_objs = self._committed.get(kind, {})
@@ -351,10 +483,13 @@ class Store:
         self._authorize("update", obj)
         self._inject("update", obj)
         if finalizer in obj.metadata.finalizers:
-            obj.metadata.finalizers.remove(finalizer)
+            stored = _materialize(obj, self._blob.get(kind, {}).get(key))
+            stored.metadata.finalizers.remove(finalizer)
             self._rv += 1
-            obj.metadata.resource_version = self._rv
-            self._emit(MODIFIED, obj)
+            stored.metadata.resource_version = self._rv
+            self._index_remove(obj)
+            blob = self._commit(stored)
+            self._emit(MODIFIED, stored, blob)
         self.complete_deletion_if_drained(kind, namespace, name)
 
     def complete_deletion_if_drained(
@@ -372,9 +507,8 @@ class Store:
             and obj.metadata.deletion_timestamp is not None
             and not obj.metadata.finalizers
         ):
-            del kind_objs[key]
-            self._index_remove(obj)
-            self._emit(DELETED, obj)
+            blob = self._uncommit(obj)
+            self._emit(DELETED, obj, blob)
             return True
         return False
 

@@ -52,7 +52,7 @@ class SimCluster:
         (deleted-and-recreated pods reuse stable names)."""
         stale = []
         for (ns, name), _node in self.bindings.items():
-            pod = self.store.get("Pod", ns, name)
+            pod = self.store.get("Pod", ns, name, readonly=True)
             if pod is None or not is_scheduled(pod):
                 stale.append((ns, name))
         for key in stale:
@@ -65,7 +65,7 @@ class SimCluster:
         for (ns, pod_name), node_name in self.bindings.items():
             if node_name != node.name:
                 continue
-            pod = self.store.get("Pod", ns, pod_name)
+            pod = self.store.get("Pod", ns, pod_name, readonly=True)
             if pod is None or is_terminating(pod):
                 continue
             for k, v in pod.spec.total_requests().items():

@@ -180,7 +180,7 @@ class GangScheduler:
             # scheduled (Starting → Running, Unhealthy upkeep)
             namespaces = sorted(
                 {p.metadata.namespace for p in self._pending_pods(None)}
-                | {g.metadata.namespace for g in self.store.list("PodGang")}
+                | {g.metadata.namespace for g in self.store.scan("PodGang")}
             ) or ["default"]
         else:
             namespaces = [namespace]
@@ -273,7 +273,7 @@ class GangScheduler:
             gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
             if gang_name and gang_name not in gang_cache:
                 gang_cache[gang_name] = self.store.get(
-                    "PodGang", namespace, gang_name
+                    "PodGang", namespace, gang_name, readonly=True
                 )
             gang = gang_cache.get(gang_name) if gang_name else None
             prev = self.cluster.last_node.get((namespace, pod.metadata.name))
@@ -345,9 +345,11 @@ class GangScheduler:
             return False
 
     def _pending_pods(self, namespace: Optional[str]) -> List:
+        # read-only scan: pods flow into the encoder; binding always
+        # re-reads fresh copies (SimCluster.bind / store.get)
         return [
             p
-            for p in self.store.list("Pod", namespace)
+            for p in self.store.scan("Pod", namespace)
             if not p.spec.scheduling_gates
             and not is_scheduled(p)
             and not is_terminating(p)
@@ -366,7 +368,9 @@ class GangScheduler:
         gang_specs: List[dict] = []
         gang_pods: Dict[str, Dict[str, List]] = {}
         for gang_name, pods in sorted(by_gang.items()):
-            gang_cr = self.store.get("PodGang", namespace, gang_name)
+            gang_cr = self.store.get(
+                "PodGang", namespace, gang_name, readonly=True
+            )
             if gang_cr is None:
                 loose.extend(pods)
                 continue
@@ -513,7 +517,7 @@ class GangScheduler:
         pin resolved to one would be silently dropped by the encoder)."""
         cordoned = {n.name for n in self.cluster.nodes if n.cordoned}
         fallback = None
-        for p in self.store.list(
+        for p in self.store.scan(
             "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
         ):
             node = self.cluster.bindings.get((namespace, p.metadata.name))
@@ -528,7 +532,7 @@ class GangScheduler:
         """Every node hosting a bound pod of the clique (with multiplicity)
         — the spread-recovery seed."""
         out: List[str] = []
-        for p in self.store.list(
+        for p in self.store.scan(
             "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
         ):
             node = self.cluster.bindings.get((namespace, p.metadata.name))
@@ -539,7 +543,7 @@ class GangScheduler:
     def _scheduled_count(self, namespace: str, pclq_fqn: str) -> int:
         return sum(
             1
-            for p in self.store.list(
+            for p in self.store.scan(
                 "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
             )
             if is_scheduled(p) and not is_terminating(p)
@@ -732,7 +736,9 @@ class GangScheduler:
                     )
                     if node_name is None:
                         continue
-                    pod = self.store.get("Pod", ref.namespace, ref.name)
+                    pod = self.store.get(
+                        "Pod", ref.namespace, ref.name, readonly=True
+                    )
                     if pod is None:
                         continue
                     caps = per_node.setdefault(node_name, {})
@@ -857,7 +863,9 @@ class GangScheduler:
         for gang in self.store.list("PodGang", namespace):
             breached = False
             for group in gang.spec.pod_groups:
-                pclq = self.store.get("PodClique", namespace, group.name)
+                pclq = self.store.get(
+                    "PodClique", namespace, group.name, readonly=True
+                )
                 if pclq is None:
                     continue
                 cond = get_condition(
@@ -893,7 +901,7 @@ class GangScheduler:
         for gang in self.store.list("PodGang", namespace):
             if gang.status.phase == PHASE_PENDING and gang.spec.pod_groups:
                 pods = [
-                    self.store.get("Pod", ref.namespace, ref.name)
+                    self.store.get("Pod", ref.namespace, ref.name, readonly=True)
                     for group in gang.spec.pod_groups
                     for ref in group.pod_references
                 ]
@@ -912,7 +920,9 @@ class GangScheduler:
             for group in gang.spec.pod_groups:
                 for ref in group.pod_references:
                     total += 1
-                    pod = self.store.get("Pod", ref.namespace, ref.name)
+                    pod = self.store.get(
+                        "Pod", ref.namespace, ref.name, readonly=True
+                    )
                     if pod is None or not is_ready(pod):
                         all_ready = False
             if total and all_ready:
