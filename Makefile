@@ -1,0 +1,52 @@
+# Developer entry points, mirroring the reference's make interface
+# (/root/reference/operator/Makefile: test-unit, check, docker-build, …).
+# Pure-Python project: no build step; "check" is the drift-free gate CI runs.
+
+PY ?= python
+CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+IMAGE ?= grove-tpu:0.2.0
+
+.PHONY: test test-fast check crds api-docs bench bench-small \
+        control-plane-bench dryrun docker-build compose-up clean
+
+test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+test-fast:       ## skip the slow e2e tiers
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -x \
+	    --ignore=tests/test_cluster_mode.py \
+	    --ignore=tests/test_update_stress.py
+
+check:           ## drift gates: CRDs, api-docs, wire fixtures, CRD conformance
+	$(CPU_ENV) $(PY) -m pytest -q \
+	    tests/test_cluster_mode.py::TestCRDManifests \
+	    tests/test_config_cli_auth.py \
+	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
+
+crds:            ## regenerate deploy/crds/ from the typed model
+	$(CPU_ENV) $(PY) -m grove_tpu.cli crds --output-dir deploy/crds
+
+api-docs:        ## regenerate docs/api-reference.md
+	$(CPU_ENV) $(PY) -m grove_tpu.cli api-docs > docs/api-reference.md
+
+bench:           ## full stress bench (one JSON line; TPU if the chip answers)
+	$(PY) bench.py
+
+bench-small:
+	$(PY) bench.py --small
+
+control-plane-bench:
+	$(CPU_ENV) $(PY) bench.py --control-plane --sets 256
+
+dryrun:          ## multi-chip sharding dry run on the virtual 8-mesh
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+docker-build:    ## reference `make docker-build` analogue
+	docker build -t $(IMAGE) .
+
+compose-up:      ## operator + solver sidecar + external scheduler
+	docker compose -f deploy/docker-compose.yaml up --build
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -prune -exec rm -rf {} +

@@ -100,6 +100,8 @@ _KINDS = [
         "v2",
         "horizontalpodautoscalers",
     ),
+    # leader-election lease (coordination.k8s.io/v1, manager.go:84-98)
+    KindInfo("Lease", GenericObject, "coordination.k8s.io", "v1", "leases"),
 ]
 
 KIND_REGISTRY: Dict[str, KindInfo] = {k.kind: k for k in _KINDS}

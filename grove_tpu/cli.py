@@ -555,6 +555,16 @@ def _cmd_run(args) -> int:
             "detected topology: "
             + " > ".join(lvl.domain for lvl in topology.spec.levels)
         )
+    if args.leader_election and not args.apiserver:
+        # election on a PRIVATE embedded apiserver is vacuous: each replica
+        # would win its own lease and all of them would lead. HA requires
+        # every replica to elect on ONE shared apiserver.
+        print(
+            "warning: --leader-election without --apiserver elects on this"
+            " process's own embedded apiserver — replicas must share one"
+            " apiserver (--apiserver URL) for the election to exclude them",
+            file=sys.stderr,
+        )
     rt = start_operator(
         nodes=nodes,
         topology=topology,
@@ -564,6 +574,7 @@ def _cmd_run(args) -> int:
         threaded=args.threaded,
         apiserver_url=args.apiserver,
         leader_lock_path=args.leader_lock,
+        leader_election=True if args.leader_election else None,
     )
     if rt.apiserver is not None:
         print(f"apiserver:  {rt.apiserver.address}")
@@ -745,6 +756,12 @@ def main(argv: List[str] | None = None) -> int:
         "--authorizer", action="store_true", help="enable the authorizer webhook"
     )
     p.add_argument("--leader-lock", help="leader-election lock file path")
+    p.add_argument(
+        "--leader-election",
+        action="store_true",
+        help="lease-based leader election over the apiserver "
+        "(coordination.k8s.io/v1 Lease; HA across hosts)",
+    )
     p.add_argument(
         "--threaded",
         action="store_true",

@@ -29,9 +29,23 @@ except ImportError as _exc:  # pragma: no cover
 else:
     _GRPC_IMPORT_ERROR = None
 
+from grove_tpu.cluster.protos import health_pb2
 from grove_tpu.cluster.protos import solver_pb2 as pb
 
 _SERVICE = "grove.solver.v1.GangSolver"
+_HEALTH_SERVICE = "grpc.health.v1.Health"
+
+# explicit wire-size ceiling (both directions, server and client): a 10k-gang
+# × 5k-node stress request with allocations is ~tens of MB; grpc's 4 MB
+# default receive limit would reject it, and UNbounded would let one rogue
+# request exhaust the sidecar
+MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+# request-complexity guard: the dense alloc tensor is gangs × max-groups ×
+# nodes int32s; past this cell count (~1 GB for the one array, before the
+# kernel's working set) reject as RESOURCE_EXHAUSTED rather than OOM-killing
+# the sidecar mid-solve. The BASELINE stress shape (10k gangs × ~4 groups ×
+# 5k nodes = 2.0e8) fits under it.
+MAX_DENSE_CELLS = 250_000_000
 
 
 def _require_grpc() -> None:
@@ -156,17 +170,46 @@ class SolverServer:
     """Standalone gRPC server for the sidecar. ``start()`` binds (port 0 →
     ephemeral) and returns self; ``address`` is host:port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, workers: int = 4):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, workers: int = 8):
         _require_grpc()
         self._requested = (host, port)
         self._workers = workers
         self._server = None
+        self._serving = False
+        # long-lived health Watch streams each occupy one pool thread; cap
+        # them well below the pool so watchers can never starve Solve
+        self._watch_limit = max(workers // 4, 1)
+        self._watchers = 0
+        self._watchers_lock = __import__("threading").Lock()
         self.address: Optional[str] = None
 
     def start(self) -> "SolverServer":
         def solve_handler(request: pb.SolveRequest, context) -> pb.SolveResponse:
+            # deadline guard BEFORE the solve: past (or about to pass) the
+            # client's deadline, the result is garbage to them — don't burn
+            # device time computing it (grpc would only notice at send time)
+            remaining = context.time_remaining()
+            if remaining is not None and remaining < 0.05:
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    "client deadline expired before solve started",
+                )
+            max_groups = max(
+                (len(g.groups) for g in request.gangs), default=0
+            )
+            complexity = (
+                len(request.gangs)
+                * max(max_groups, 1)
+                * max(len(request.nodes), 1)
+            )
+            if complexity > MAX_DENSE_CELLS:
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"request complexity {complexity} gangs x groups x nodes "
+                    f"exceeds {MAX_DENSE_CELLS}",
+                )
             try:
-                return solve_request(request)
+                response = solve_request(request)
             except RequestDecodeError as exc:
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, f"bad request: {exc}"
@@ -177,28 +220,110 @@ class SolverServer:
                 context.abort(
                     grpc.StatusCode.INTERNAL, f"solve failed: {exc}"
                 )
-
-        handler = grpc.method_handlers_generic_handler(
-            _SERVICE,
-            {
-                "Solve": grpc.unary_unary_rpc_method_handler(
-                    solve_handler,
-                    request_deserializer=pb.SolveRequest.FromString,
-                    response_serializer=pb.SolveResponse.SerializeToString,
+            # the solve outran the deadline or the client hung up: skip the
+            # (large) response marshal — nobody is listening
+            if not context.is_active():
+                context.abort(
+                    grpc.StatusCode.CANCELLED,
+                    "client gone before solve completed",
                 )
-            },
-        )
+            return response
+
+        def health_handler(
+            request: health_pb2.HealthCheckRequest, context
+        ) -> health_pb2.HealthCheckResponse:
+            # empty service = server-wide; the solver service by name; any
+            # other name is unknown per the health protocol
+            if request.service not in ("", _SERVICE):
+                return health_pb2.HealthCheckResponse(
+                    status=health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+                )
+            status = (
+                health_pb2.HealthCheckResponse.SERVING
+                if self._serving
+                else health_pb2.HealthCheckResponse.NOT_SERVING
+            )
+            return health_pb2.HealthCheckResponse(status=status)
+
+        def health_watch(request, context):
+            # Watch contract: emit the current status, hold the stream open,
+            # and re-emit on every change (drain flips to NOT_SERVING inside
+            # stop()'s grace window). Each live watcher occupies one
+            # worker-pool thread, so they are capped at a fraction of the
+            # pool — past the cap the stream degrades to one-shot rather
+            # than let watchers starve Solve RPCs.
+            import time as _time
+
+            def status_for():
+                if request.service not in ("", _SERVICE):
+                    return health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+                return (
+                    health_pb2.HealthCheckResponse.SERVING
+                    if self._serving
+                    else health_pb2.HealthCheckResponse.NOT_SERVING
+                )
+
+            last = status_for()
+            yield health_pb2.HealthCheckResponse(status=last)
+            with self._watchers_lock:
+                if self._watchers >= self._watch_limit:
+                    return  # degrade to one-shot; client re-polls
+                self._watchers += 1
+            try:
+                while context.is_active():
+                    current = status_for()
+                    if current != last:
+                        last = current
+                        yield health_pb2.HealthCheckResponse(status=current)
+                    _time.sleep(0.2)
+            finally:
+                with self._watchers_lock:
+                    self._watchers -= 1
+
+        handlers = [
+            grpc.method_handlers_generic_handler(
+                _SERVICE,
+                {
+                    "Solve": grpc.unary_unary_rpc_method_handler(
+                        solve_handler,
+                        request_deserializer=pb.SolveRequest.FromString,
+                        response_serializer=pb.SolveResponse.SerializeToString,
+                    )
+                },
+            ),
+            grpc.method_handlers_generic_handler(
+                _HEALTH_SERVICE,
+                {
+                    "Check": grpc.unary_unary_rpc_method_handler(
+                        health_handler,
+                        request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                        response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+                    ),
+                    "Watch": grpc.unary_stream_rpc_method_handler(
+                        health_watch,
+                        request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                        response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+                    ),
+                },
+            ),
+        ]
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=self._workers)
+            futures.ThreadPoolExecutor(max_workers=self._workers),
+            options=[
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+            ],
         )
-        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_generic_rpc_handlers(tuple(handlers))
         host, port = self._requested
         bound = self._server.add_insecure_port(f"{host}:{port}")
         self.address = f"{host}:{bound}"
+        self._serving = True
         self._server.start()
         return self
 
     def stop(self, grace: float = 1.0) -> None:
+        self._serving = False  # health flips NOT_SERVING during drain
         if self._server is not None:
             self._server.stop(grace).wait()
             self._server = None
@@ -210,17 +335,38 @@ class SolverClient:
 
     def __init__(self, address: str):
         _require_grpc()
-        self._channel = grpc.insecure_channel(address)
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+                ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+            ],
+        )
         self._solve = self._channel.unary_unary(
             f"/{_SERVICE}/Solve",
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=pb.SolveResponse.FromString,
+        )
+        self._health = self._channel.unary_unary(
+            f"/{_HEALTH_SERVICE}/Check",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
         )
 
     def solve(
         self, request: pb.SolveRequest, timeout: float = 120.0
     ) -> pb.SolveResponse:
         return self._solve(request, timeout=timeout)
+
+    def healthy(self, timeout: float = 2.0) -> bool:
+        """Standard grpc.health.v1 Check — what kube gRPC probes would hit."""
+        try:
+            response = self._health(
+                health_pb2.HealthCheckRequest(service=_SERVICE), timeout=timeout
+            )
+        except grpc.RpcError:
+            return False
+        return response.status == health_pb2.HealthCheckResponse.SERVING
 
     def close(self) -> None:
         self._channel.close()

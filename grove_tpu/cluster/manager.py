@@ -8,11 +8,13 @@ served by the embedded apiserver (`/healthz`, `/readyz`, `/metrics`); when
 connecting to an external server the same endpoints are exposed on a small
 sidecar listener.
 
-Leader election (manager.go:84-98) is a config-gated file lock: exactly one
-operator process per lock path runs the controllers; the losers block in
-standby and take over when the leader releases (process exit drops the
-lock) — the same single-writer guarantee lease-based election gives the
-reference, scoped to a shared filesystem instead of an apiserver lease.
+Leader election (manager.go:84-98) comes in two tiers:
+  - **Lease-based** (`leader_election=True`): a coordination.k8s.io/v1
+    Lease object on the apiserver, client-go protocol (cluster/lease.py) —
+    works across hosts, the reference's HA deployment shape.
+  - File lock (`leader_lock_path`): exclusive-create lockfile with
+    mtime-staleness stealing — single shared filesystem only; kept for
+    setups without an apiserver reachable at boot.
 """
 
 from __future__ import annotations
@@ -90,6 +92,15 @@ class OperatorRuntime:
     apiserver: Optional[APIServer]
     webhooks: Optional[WebhookServer]
     leader_lock: Optional[FileLeaderLock] = None
+    # lease-based election (cluster/lease.py): run() campaigns in standby,
+    # a background thread renews while leading (decoupled from reconcile
+    # round length), and run() re-enters standby on leadership loss
+    elector: Optional[object] = None
+    # deferred shared-state publication: with election enabled, only the
+    # LEADER may create/reconcile the ClusterTopology CR — a standby that
+    # booted with a different hierarchy must not overwrite the active
+    # leader's published contract
+    topology_publish: Optional[object] = None
     # real threaded reconciles (MaxConcurrentReconciles equivalent) — safe
     # here because the HttpStore/apiserver boundary is thread-safe
     threaded: bool = False
@@ -110,6 +121,21 @@ class OperatorRuntime:
         the wire) — they re-derive next round; the run loop must survive."""
         from grove_tpu.runtime.errors import GroveError
 
+        if self.elector is not None:
+            # leadership is maintained by the elector's background renewer;
+            # a deposed leader must not act (the standby that stole the
+            # lease is already reconciling)
+            if not self.elector.is_leader:
+                return 0
+        if self.topology_publish is not None:
+            try:
+                self.topology_publish()
+            except GroveError:
+                # apiserver blip at the takeover moment: keep the publish
+                # pending and retry next round — the run loop must survive
+                pass
+            else:
+                self.topology_publish = None
         work = self._drain()
         if self.autoscaler is not None:
             try:
@@ -132,9 +158,26 @@ class OperatorRuntime:
         stop = stop or threading.Event()
         try:
             while not stop.is_set():
+                if self.elector is not None and not self.elector.is_leader:
+                    # standby: campaign until leadership or stop, dropping
+                    # queued watch events nobody will drain meanwhile
+                    if not self.elector.acquire_blocking(
+                        stop, on_wait=self.engine.discard_pending_events
+                    ):
+                        break
+                    # fresh leader: full resync covers the dropped events,
+                    # and the scheduler re-learns bindings made by the old
+                    # leader (else node_free() over-commits occupied nodes)
+                    self.engine.discard_pending_events()
+                    self.engine.requeue_all()
+                    if self.cluster is not None:
+                        self.cluster.rebuild_bindings()
+                    continue
                 if self.converge_once() == 0:
                     stop.wait(poll)
         finally:
+            if self.elector is not None:
+                self.elector.release()
             if self.leader_lock is not None:
                 self.leader_lock.release()
 
@@ -145,6 +188,8 @@ class OperatorRuntime:
             self.webhooks.stop()
         if self.apiserver is not None:
             self.apiserver.stop()
+        if self.elector is not None:
+            self.elector.release()
         if self.leader_lock is not None:
             self.leader_lock.release()
 
@@ -160,6 +205,8 @@ def start_operator(
     threaded: bool = False,
     apiserver_url: Optional[str] = None,
     leader_lock_path: Optional[str] = None,
+    leader_election: Optional[bool] = None,
+    leader_identity: Optional[str] = None,
     metrics_provider=None,
 ) -> OperatorRuntime:
     """Boot the full real-cluster operator (embedded apiserver unless
@@ -208,31 +255,32 @@ def start_operator(
         leader_lock.acquire_blocking()
 
     store = HttpStore(apiserver_url).start()
+
     # materialize the hierarchy as a CR so wire clients can inspect what the
     # operator schedules against (the reference crashes when the configured
     # CR is missing, cmd/main.go validateClusterTopology; here the operator
     # OWNS the CR — incl. an auto-detected one — and publishes it)
-    from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
+    def publish_topology() -> None:
+        from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
+
+        try:
+            store.create(topology)
+        except GroveError as exc:
+            if exc.code != ERR_CONFLICT:
+                raise
+            # restart / external apiserver: the stored CR must match what
+            # the operator actually schedules against — a stale hierarchy
+            # (e.g. nodes relabeled before an --auto-detect-topology
+            # restart) would make the published contract silently wrong
+            stored = store.get("ClusterTopology", "", topology.metadata.name)
+            if [(l.domain, l.key) for l in stored.spec.levels] != [
+                (l.domain, l.key) for l in topology.spec.levels
+            ]:
+                stored.spec = topology.spec
+                store.update(stored)
 
     if not topology.metadata.name:
         topology.metadata.name = "default"
-    try:
-        store.create(topology)
-    except GroveError as exc:
-        if exc.code != ERR_CONFLICT:
-            raise
-        # restart / external apiserver: the stored CR must match what the
-        # operator actually schedules against — a stale hierarchy (e.g.
-        # nodes relabeled before an --auto-detect-topology restart) would
-        # make the published contract silently wrong
-        stored = store.get(
-            "ClusterTopology", "", topology.metadata.name
-        )
-        if [(l.domain, l.key) for l in stored.spec.levels] != [
-            (l.domain, l.key) for l in topology.spec.levels
-        ]:
-            stored.spec = topology.spec
-            store.update(stored)
     engine = Engine(store, store.clock)
     ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
     register_controllers(engine, ctx, config)
@@ -242,6 +290,9 @@ def start_operator(
     cluster = scheduler = None
     if with_scheduler:
         cluster = SimCluster(store=store, nodes=nodes or make_nodes(16))
+        # restart path: account for pods a predecessor already bound (an
+        # external apiserver outlives operator processes)
+        cluster.rebuild_bindings()
         scheduler = GangScheduler(
             store,
             cluster,
@@ -261,6 +312,29 @@ def start_operator(
     # tests/sims poke into it)
     metrics_provider = metrics_provider or StaticMetricsProvider()
     autoscaler = HorizontalAutoscaler(store, metrics_provider)
+    elector = None
+    elect = (
+        leader_election
+        if leader_election is not None
+        else config.leader_election.enabled
+    )
+    if elect:
+        from grove_tpu.cluster.lease import LeaseElector
+
+        le = config.leader_election
+        elector = LeaseElector(
+            store,
+            name=le.resource_name,
+            identity=leader_identity,
+            lease_duration=le.lease_duration,
+            renew_deadline=le.renew_deadline,
+            retry_period=le.retry_period,
+            background_renew=True,
+        )
+    else:
+        # no election: this process is the only writer — publish now, the
+        # startup-crash semantics of the reference's validateClusterTopology
+        publish_topology()
     return OperatorRuntime(
         store=store,
         engine=engine,
@@ -269,6 +343,8 @@ def start_operator(
         apiserver=apiserver,
         webhooks=webhooks,
         leader_lock=leader_lock,
+        elector=elector,
+        topology_publish=publish_topology if elect else None,
         threaded=threaded,
         autoscaler=autoscaler,
         metrics_provider=metrics_provider,

@@ -83,6 +83,45 @@ class Component(Protocol):
     def delete(self, ctx: OperatorContext, owner) -> None: ...
 
 
+def status_shadow(view):
+    """Shadow object over a zero-copy readonly store view: SHARES metadata
+    and spec (read-only by the scan/readonly contract) with a PRIVATE
+    deep-copied status, so a mutating status flow can run against it without
+    touching committed store state. The one sanctioned way to do this —
+    pair with [write_status_if_changed] for the write side."""
+    from grove_tpu.api.meta import deep_copy
+
+    return type(view)(
+        metadata=view.metadata,
+        spec=view.spec,
+        status=deep_copy(view.status),
+    )
+
+
+def write_status_if_changed(
+    ctx: OperatorContext, kind: str, namespace: str, name: str, status
+) -> bool:
+    """Write `status` only when it differs from the live object's status.
+
+    The shared tail of every status flow: reconcilers compute the proposed
+    status on a zero-copy readonly view (no serialization), and this helper
+    owns the compare / mutable re-get / liveness re-check / write — one
+    place to fix, three reconcilers using it. Steady-state (unchanged)
+    reconciles return without touching the store. Returns True on write.
+    """
+    view = ctx.store.get(kind, namespace, name, readonly=True)
+    if view is None or view.metadata.deletion_timestamp is not None:
+        return False
+    if status == view.status:
+        return False
+    fresh = ctx.store.get(kind, namespace, name)
+    if fresh is None or fresh.metadata.deletion_timestamp is not None:
+        return False
+    fresh.status = status
+    ctx.store.update_status(fresh)
+    return True
+
+
 def record_last_error(
     ctx: OperatorContext, kind: str, namespace: str, name: str, err
 ) -> None:

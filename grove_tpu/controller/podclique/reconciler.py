@@ -12,9 +12,10 @@ from grove_tpu.controller.common import (
     FINALIZER,
     OperatorContext,
     record_last_error,
+    write_status_if_changed,
 )
 from grove_tpu.controller.podclique import pods as pod_component
-from grove_tpu.controller.podclique.status import reconcile_status
+from grove_tpu.controller.podclique.status import compute_status
 from grove_tpu.runtime.errors import GroveError
 from grove_tpu.runtime.flow import (
     ReconcileStepResult,
@@ -47,12 +48,16 @@ class PodCliqueReconciler:
                 pclq.metadata.finalizers.append(FINALIZER)
                 pclq = self.ctx.store.update(pclq, bump_generation=False)
             skipped_gated = pod_component.sync_pods(self.ctx, pclq)
-            fresh = self.ctx.store.get("PodClique", ns, name)
-            if fresh is not None and fresh.metadata.deletion_timestamp is None:
-                reconcile_status(self.ctx, fresh)
-                fresh.status.observed_generation = fresh.metadata.generation
-                fresh.status.last_errors = []  # cleared on a clean reconcile
-                self.ctx.store.update_status(fresh)
+            view = self.ctx.store.get("PodClique", ns, name, readonly=True)
+            if view is not None and view.metadata.deletion_timestamp is None:
+                # compute on the zero-copy view; write only on difference
+                # (steady-state reconciles then cost no serialization)
+                proposed = compute_status(self.ctx, view)
+                proposed.observed_generation = view.metadata.generation
+                proposed.last_errors = []  # cleared on a clean reconcile
+                write_status_if_changed(
+                    self.ctx, "PodClique", ns, name, proposed
+                )
         except GroveError as err:
             record_last_error(self.ctx, "PodClique", ns, name, err)
             return reconcile_with_errors(f"podclique {ns}/{name}", err)

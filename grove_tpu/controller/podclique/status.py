@@ -31,6 +31,19 @@ from grove_tpu.controller.common import OperatorContext
 UPDATE_IN_PROGRESS_ANNOTATION = "grove.io/update-in-progress"
 
 
+def compute_status(ctx: OperatorContext, pclq: PodClique):
+    """The status `pclq` SHOULD have, computed WITHOUT mutating it — safe on
+    zero-copy readonly store views. The reconciler compares the result
+    against the live status and writes only on difference, so steady-state
+    reconciles cost no serialization at all (the write-free analogue of the
+    reference's status-patch-if-changed)."""
+    from grove_tpu.controller.common import status_shadow
+
+    shadow = status_shadow(pclq)
+    reconcile_status(ctx, shadow)
+    return shadow.status
+
+
 def reconcile_status(ctx: OperatorContext, pclq: PodClique) -> PodClique:
     ns = pclq.metadata.namespace
     pods = [

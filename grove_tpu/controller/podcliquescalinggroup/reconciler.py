@@ -33,6 +33,7 @@ from grove_tpu.controller.common import (
     create_or_adopt,
     record_last_error,
     resolve_starts_after,
+    write_status_if_changed,
 )
 from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
 from grove_tpu.runtime.errors import GroveError
@@ -439,9 +440,16 @@ class PodCliqueScalingGroupReconciler:
         self, pcsg: PodCliqueScalingGroup, pcs: PodCliqueSet
     ) -> None:
         ns = pcsg.metadata.namespace
-        fresh = self.ctx.store.get("PodCliqueScalingGroup", ns, pcsg.metadata.name)
-        if fresh is None or fresh.metadata.deletion_timestamp is not None:
+        # compute on the zero-copy view; write only on difference (the
+        # steady state then costs no serialization at all)
+        view = self.ctx.store.get(
+            "PodCliqueScalingGroup", ns, pcsg.metadata.name, readonly=True
+        )
+        if view is None or view.metadata.deletion_timestamp is not None:
             return
+        from grove_tpu.controller.common import status_shadow
+
+        fresh = status_shadow(view)
         scheduled = available = updated = 0
         for replica in range(fresh.spec.replicas):
             pclqs: List[PodClique] = []
@@ -482,7 +490,9 @@ class PodCliqueScalingGroupReconciler:
         st.selector = f"{namegen.LABEL_PCSG}={fresh.metadata.name}"
         now = self.ctx.clock.now()
         set_condition(st.conditions, self._breached_condition(fresh), now)
-        self.ctx.store.update_status(fresh)
+        write_status_if_changed(
+            self.ctx, "PodCliqueScalingGroup", ns, pcsg.metadata.name, st
+        )
 
     @staticmethod
     def _breached_condition(pcsg: PodCliqueScalingGroup) -> Condition:

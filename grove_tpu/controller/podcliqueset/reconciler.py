@@ -28,6 +28,7 @@ from grove_tpu.controller.common import (
     FINALIZER,
     OperatorContext,
     record_last_error,
+    write_status_if_changed,
 )
 from grove_tpu.controller.podcliqueset.components import (
     infra,
@@ -150,8 +151,10 @@ class PodCliqueSetReconciler:
     # -- status flow -----------------------------------------------------
 
     def _reconcile_status(self, ns: str, name: str) -> None:
-        pcs = self.ctx.store.get("PodCliqueSet", ns, name)
-        if pcs is None or pcs.metadata.deletion_timestamp is not None:
+        # compute on the zero-copy view; write only on difference (the
+        # steady state then costs no serialization at all)
+        view = self.ctx.store.get("PodCliqueSet", ns, name, readonly=True)
+        if view is None or view.metadata.deletion_timestamp is not None:
             return
         gangs = self.ctx.store.scan(
             "PodGang",
@@ -162,8 +165,11 @@ class PodCliqueSetReconciler:
             },
             cached=True,
         )
-        pcs.status.replicas = pcs.spec.replicas
-        pcs.status.pod_gang_statuses = [
+        from grove_tpu.api.meta import deep_copy
+
+        st = deep_copy(view.status)
+        st.replicas = view.spec.replicas
+        st.pod_gang_statuses = [
             PodGangStatusSummary(
                 name=g.metadata.name,
                 phase=g.status.phase,
@@ -171,11 +177,11 @@ class PodCliqueSetReconciler:
             )
             for g in gangs
         ]
-        pcs.status.available_replicas = self._count_available_replicas(pcs)
-        pcs.status.updated_replicas = self._count_updated_replicas(pcs)
-        pcs.status.selector = f"{namegen.LABEL_PART_OF}={name}"
-        pcs.status.last_errors = []  # cleared on a clean reconcile
-        self.ctx.store.update_status(pcs)
+        st.available_replicas = self._count_available_replicas(view)
+        st.updated_replicas = self._count_updated_replicas(view)
+        st.selector = f"{namegen.LABEL_PART_OF}={name}"
+        st.last_errors = []  # cleared on a clean reconcile
+        write_status_if_changed(self.ctx, "PodCliqueSet", ns, name, st)
 
     def _count_updated_replicas(self, pcs: PodCliqueSet) -> int:
         """Replicas whose every PCLQ carries the current template hash with

@@ -78,6 +78,28 @@ class Engine:
     def release_events(self, kind: str) -> None:
         self.held_kinds.discard(kind)
 
+    def discard_pending_events(self) -> int:
+        """Drop undelivered watch events. A leader-election STANDBY never
+        drains, so its backlog would grow without bound; standbys drop and
+        the fresh leader does a full `requeue_all` resync instead."""
+        n = 0
+        while True:
+            try:
+                self._event_backlog.popleft()
+            except IndexError:
+                return n
+            n += 1
+
+    def requeue_all(self) -> None:
+        """Enqueue every live object of every controller's kind — the
+        informer ListAndWatch-restart equivalent a fresh leader runs to
+        cover whatever events were dropped while it stood by."""
+        for ctrl in self.controllers:
+            for obj in self.store.scan(ctrl.kind):
+                ctrl.queue.add(
+                    (ctrl.kind, obj.metadata.namespace, obj.metadata.name)
+                )
+
     def _route_events(self) -> None:
         # Drain via popleft until empty: reconciles (and concurrent watch
         # threads) emit new events while we iterate; popping one at a time

@@ -47,6 +47,24 @@ class SimCluster:
     last_node: Dict[tuple, str] = field(default_factory=dict)
     start_delay: float = 0.0  # container start latency (virtual seconds)
 
+    def rebuild_bindings(self) -> int:
+        """Reconstruct the in-memory binding map from persisted pod status
+        (`status.node_name`) — the restart/failover path: a fresh scheduler
+        (operator restart against an external apiserver, or a standby that
+        just took the leader lease) must account capacity for pods bound by
+        its predecessor, or node_free() over-commits occupied nodes."""
+        n = 0
+        for pod in self.store.scan("Pod"):
+            if is_terminating(pod) or not is_scheduled(pod):
+                continue
+            node = pod.status.node_name
+            if node:
+                key = (pod.metadata.namespace, pod.metadata.name)
+                self.bindings[key] = node
+                self.last_node.setdefault(key, node)
+                n += 1
+        return n
+
     def _gc_bindings(self) -> None:
         """Drop bindings whose pod is gone or no longer carries the binding
         (deleted-and-recreated pods reuse stable names)."""

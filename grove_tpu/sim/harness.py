@@ -51,6 +51,9 @@ class SimHarness:
                     f"cluster topology invalid: {'; '.join(res.errors)}"
                 )
             self.topology.metadata.name = self.config.cluster_topology.name
+        # cluster-scoped CR: no namespace, matching the wire/CRD scope and
+        # the real-cluster manager's lookup (cluster/manager.py)
+        self.topology.metadata.namespace = ""
         # the stored CR is the source of truth — keep its identity (uid/rv)
         self.topology = self.store.create(self.topology)
         if self.config.authorizer.enabled:

@@ -58,6 +58,18 @@ class GangScheduler:
         # the same boundary the reference's scheduler plugin puts KAI behind
         self.solver_sidecar = solver_sidecar
         self._sidecar_client = None
+        # per-solve gRPC deadline; past it the sidecar aborts the solve
+        # server-side (DEADLINE_EXCEEDED) and we fall back in-process
+        self.sidecar_timeout = 120.0
+        # observability: rounds solved in-process while the sidecar was
+        # down (reattach is automatic — the client is rebuilt per failure)
+        self.sidecar_fallbacks = 0
+        # per-REQUEST failures (deadline blown, request too big/invalid)
+        # are doomed on identical retry: skip the sidecar this long before
+        # re-sending, instead of shipping the multi-MB request to fail
+        # every round. Connectivity failures (restart) retry immediately.
+        self.sidecar_backoff_s = 60.0
+        self._sidecar_skip_until = 0.0
 
     def _solve_batch(
         self,
@@ -75,7 +87,12 @@ class GangScheduler:
         problem = build_problem(
             nodes, gang_specs, self.topology, free_capacity=free_capacity
         )
-        if self.solver_sidecar is None:
+        import time as _time
+
+        if (
+            self.solver_sidecar is None
+            or _time.monotonic() < self._sidecar_skip_until
+        ):
             result = solve_waves(
                 problem,
                 chunk_size=self.chunk_size,
@@ -100,7 +117,6 @@ class GangScheduler:
         import numpy as np
 
         from grove_tpu.cluster.grpcsolver import SolverClient, build_request
-        from grove_tpu.runtime.errors import GroveError
         from grove_tpu.sim.cluster import Node
         from grove_tpu.solver.types import PackingResult
 
@@ -119,17 +135,54 @@ class GangScheduler:
         if self._sidecar_client is None:
             self._sidecar_client = SolverClient(self.solver_sidecar)
         try:
-            response = self._sidecar_client.solve(request)
+            response = self._sidecar_client.solve(
+                request, timeout=self.sidecar_timeout
+            )
         except grpc.RpcError as e:
-            # a restarting/unreachable sidecar must never kill the control
-            # loop — surface as the retryable store-error type every caller
-            # (extscheduler round guard, operator engine) already handles
-            self._sidecar_client = None  # reconnect next round
-            raise GroveError(
-                "ERR_SOLVER_SIDECAR",
-                f"solver sidecar {self.solver_sidecar}: {e.code()}",
-                "solve_remote",
-            ) from e
+            # a crashed/restarting/slow sidecar must never stall gang
+            # admission: solve THIS batch in-process and drop the client so
+            # a later round reattaches to the (possibly restarted) sidecar
+            import logging
+            import time as _time
+
+            self._sidecar_client = None
+            self.sidecar_fallbacks += 1
+            code = e.code()
+            log = logging.getLogger("grove_tpu.solver")
+            if code in (
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                grpc.StatusCode.INVALID_ARGUMENT,
+            ):
+                # per-request failure: the identical retry is doomed —
+                # don't re-ship the multi-MB request every round
+                self._sidecar_skip_until = (
+                    _time.monotonic() + self.sidecar_backoff_s
+                )
+                log.error(
+                    "solver sidecar %s rejected the request (%s); solving "
+                    "in-process and skipping the sidecar for %.0fs "
+                    "(fallback #%d)",
+                    self.solver_sidecar,
+                    code,
+                    self.sidecar_backoff_s,
+                    self.sidecar_fallbacks,
+                )
+            else:
+                log.warning(
+                    "solver sidecar %s unavailable (%s); solved in-process "
+                    "(fallback #%d), will reattach",
+                    self.solver_sidecar,
+                    code,
+                    self.sidecar_fallbacks,
+                )
+            result = solve_waves(
+                problem,
+                chunk_size=self.chunk_size,
+                max_waves=self.max_waves,
+                with_alloc=with_alloc,
+            )
+            return result, problem
 
         g = problem.num_gangs
         p_max = problem.max_groups

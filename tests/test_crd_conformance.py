@@ -1,0 +1,172 @@
+"""CRD conformance: what a real kube-apiserver would enforce, without one.
+
+Two independent checks standing in for `kubectl apply --dry-run=server`
+(no cluster in this environment; documented in docs/installation.md):
+
+1. **Structural-schema rules** on `deploy/crds/*.yaml` — the subset of
+   apiextensions validation that rejects a CRD at apply time
+   (k8s "structural schema" requirements): root `type: object`; every
+   schema node carries a `type` unless it opts out via
+   `x-kubernetes-preserve-unknown-fields`/`x-kubernetes-int-or-string`;
+   `items` present for arrays; `properties` and `additionalProperties`
+   never set together; metadata schemas left unconstrained beyond
+   `type: object` (kube prunes them).
+
+2. **Instance validation**: every golden wire fixture
+   (tests/fixtures/wire/*.json — the exact documents the apiserver serves)
+   validates against its CRD's `openAPIV3Schema` via jsonschema. This pins
+   wire ⇄ CRD consistency: a field added to the serializer but not the CRD
+   (or vice versa) fails here, independently of the shared codebase.
+
+Reference anchor: the embedded CRDs at
+/root/reference/operator/api/core/v1alpha1/crds/ and
+/root/reference/scheduler/api/core/v1alpha1/crds/, applied by a real
+apiserver in the reference's envtest tier (SURVEY §4.2).
+"""
+
+import json
+import pathlib
+
+import pytest
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+CRD_DIR = REPO / "deploy" / "crds"
+FIXTURE_DIR = pathlib.Path(__file__).parent / "fixtures" / "wire"
+
+CRD_FILES = sorted(CRD_DIR.glob("*.yaml"))
+
+# wire fixture -> CRD kind it must validate against
+FIXTURE_KINDS = {
+    "podcliqueset": "PodCliqueSet",
+    "podclique-standalone": "PodClique",
+    "podclique-pcsg-member": "PodClique",
+    "podcliquescalinggroup": "PodCliqueScalingGroup",
+    "podgang-base": "PodGang",
+    "clustertopology": "ClusterTopology",
+}
+
+
+def _load_crds():
+    out = {}
+    for path in CRD_FILES:
+        doc = yaml.safe_load(path.read_text())
+        out[doc["spec"]["names"]["kind"]] = (path.name, doc)
+    return out
+
+
+CRDS = _load_crds()
+
+
+def _walk_schema(node, path, errors):
+    """Enforce the structural-schema rules kube's apiextensions registry
+    applies before accepting a CRD."""
+    if not isinstance(node, dict):
+        errors.append(f"{path}: schema node is not a mapping")
+        return
+    preserve = node.get("x-kubernetes-preserve-unknown-fields")
+    int_or_string = node.get("x-kubernetes-int-or-string")
+    if "type" not in node and not (preserve or int_or_string):
+        errors.append(f"{path}: missing type (and no preserve/int-or-string)")
+    if node.get("type") == "array" and "items" not in node:
+        errors.append(f"{path}: array without items")
+    if "properties" in node and "additionalProperties" in node:
+        errors.append(f"{path}: properties and additionalProperties together")
+    for name, child in (node.get("properties") or {}).items():
+        # kube prunes object metadata: CRDs may not constrain it beyond
+        # type:object (apiextensions rejects nested metadata schemas)
+        if name == "metadata" and path.endswith("openAPIV3Schema"):
+            if set(child) - {"type"}:
+                errors.append(f"{path}.metadata: must be bare type:object")
+            continue
+        _walk_schema(child, f"{path}.{name}", errors)
+    ap = node.get("additionalProperties")
+    if isinstance(ap, dict):
+        _walk_schema(ap, f"{path}.additionalProperties", errors)
+    if "items" in node:
+        _walk_schema(node["items"], f"{path}.items", errors)
+
+
+class TestStructuralSchemas:
+    @pytest.mark.parametrize("kind", sorted(CRDS))
+    def test_crd_is_structural(self, kind):
+        fname, doc = CRDS[kind]
+        assert doc["apiVersion"] == "apiextensions.k8s.io/v1", fname
+        assert doc["kind"] == "CustomResourceDefinition", fname
+        spec = doc["spec"]
+        plural = spec["names"]["plural"]
+        assert doc["metadata"]["name"] == f"{plural}.{spec['group']}", fname
+        assert spec["scope"] in ("Namespaced", "Cluster"), fname
+        storage = [v for v in spec["versions"] if v.get("storage")]
+        assert len(storage) == 1, f"{fname}: exactly one storage version"
+        for version in spec["versions"]:
+            schema = version["schema"]["openAPIV3Schema"]
+            assert schema.get("type") == "object", f"{fname}: root not object"
+            errors = []
+            _walk_schema(schema, f"{fname}:{version['name']}.openAPIV3Schema", errors)
+            assert not errors, "\n".join(errors)
+
+    def test_cluster_scoped_kinds(self):
+        assert CRDS["ClusterTopology"][1]["spec"]["scope"] == "Cluster"
+        for kind in ("PodCliqueSet", "PodClique", "PodCliqueScalingGroup", "PodGang"):
+            assert CRDS[kind][1]["spec"]["scope"] == "Namespaced"
+
+
+class TestFixturesValidateAgainstCRDs:
+    @pytest.mark.parametrize("fixture", sorted(FIXTURE_KINDS))
+    def test_wire_doc_matches_crd_schema(self, fixture):
+        import jsonschema
+
+        kind = FIXTURE_KINDS[fixture]
+        _, crd = CRDS[kind]
+        version = next(
+            v for v in crd["spec"]["versions"] if v.get("storage")
+        )
+        schema = version["schema"]["openAPIV3Schema"]
+        doc = json.loads((FIXTURE_DIR / f"{fixture}.json").read_text())
+        group = crd["spec"]["group"]
+        assert doc["apiVersion"] == f"{group}/{version['name']}"
+        assert doc["kind"] == kind
+        jsonschema.validate(doc, schema)
+
+    @pytest.mark.parametrize("fixture", sorted(FIXTURE_KINDS))
+    def test_spec_fields_all_modeled(self, fixture):
+        """Pruning check: a real apiserver silently DROPS wire fields absent
+        from the CRD schema (unless preserve-unknown-fields). Assert no spec
+        field in the wire doc would be pruned — that is exactly the drift
+        class (serializer knows a field, CRD doesn't) pruning would hide."""
+        kind = FIXTURE_KINDS[fixture]
+        _, crd = CRDS[kind]
+        version = next(v for v in crd["spec"]["versions"] if v.get("storage"))
+        schema = version["schema"]["openAPIV3Schema"]
+        doc = json.loads((FIXTURE_DIR / f"{fixture}.json").read_text())
+        pruned = []
+
+        def walk(value, node, path):
+            if not isinstance(node, dict) or node.get(
+                "x-kubernetes-preserve-unknown-fields"
+            ):
+                return
+            if isinstance(value, dict):
+                props = node.get("properties")
+                ap = node.get("additionalProperties")
+                if props is not None:
+                    for k, v in value.items():
+                        if k in props:
+                            walk(v, props[k], f"{path}.{k}")
+                        else:
+                            pruned.append(f"{path}.{k}")
+                elif isinstance(ap, dict):
+                    for k, v in value.items():
+                        walk(v, ap, f"{path}.{k}")
+            elif isinstance(value, list) and "items" in node:
+                for i, v in enumerate(value):
+                    walk(v, node["items"], f"{path}[{i}]")
+
+        for top in ("spec", "status"):
+            if top in doc and top in (schema.get("properties") or {}):
+                walk(doc[top], schema["properties"][top], top)
+        assert not pruned, (
+            "wire fields a real apiserver would prune (missing from CRD "
+            "schema): " + ", ".join(sorted(set(pruned)))
+        )

@@ -242,12 +242,10 @@ class TestSchedulerThroughSidecar:
 
 
 class TestSidecarResilience:
-    def test_dead_sidecar_raises_retryable_grove_error(self):
-        """An unreachable sidecar surfaces as a GroveError (the retryable
-        type every control loop already guards), never a raw grpc error."""
-        import pytest
-
-        from grove_tpu.runtime.errors import GroveError
+    def test_dead_sidecar_falls_back_in_process(self):
+        """An unreachable sidecar must not stall gang admission: the batch
+        is solved in-process (never a raw grpc error), and the fallback is
+        counted for observability."""
         from grove_tpu.sim.harness import SimHarness
 
         harness = SimHarness(num_nodes=8)
@@ -256,10 +254,214 @@ class TestSidecarResilience:
             (__import__("pathlib").Path(__file__).resolve().parents[1]
              / "samples" / "simple1.yaml").read_text()
         )
-        harness.engine.drain()
-        with pytest.raises(GroveError) as err:
-            harness.scheduler.schedule_pending()
-        assert "sidecar" in err.value.message
+        harness.converge()
+        assert harness.scheduler.sidecar_fallbacks >= 1
+        from grove_tpu.api.pod import is_scheduled
+
+        pods = harness.store.list("Pod")
+        assert pods and all(is_scheduled(p) for p in pods)
+
+    def test_crash_restart_falls_back_then_reattaches(self):
+        """Sidecar crash mid-operation: the next rounds solve in-process;
+        a restarted sidecar (same address) is reattached automatically."""
+        from grove_tpu.sim.harness import SimHarness
+
+        server = SolverServer().start()
+        host, port = server.address.rsplit(":", 1)
+        harness = SimHarness(num_nodes=8)
+        harness.scheduler.solver_sidecar = server.address
+        sample = (
+            __import__("pathlib").Path(__file__).resolve().parents[1]
+            / "samples" / "simple1.yaml"
+        ).read_text()
+        try:
+            harness.apply_yaml(sample)
+            harness.converge()
+            assert harness.scheduler.sidecar_fallbacks == 0  # solved remotely
+
+            server.stop()  # crash
+            harness.apply_yaml(sample.replace("simple1", "second"))
+            harness.converge()
+            assert harness.scheduler.sidecar_fallbacks >= 1  # in-process
+
+            server = SolverServer(host=host, port=int(port)).start()  # restart
+            fallbacks = harness.scheduler.sidecar_fallbacks
+            harness.apply_yaml(sample.replace("simple1", "third"))
+            harness.converge()
+            # reattached: no NEW fallbacks, and the third set got placed
+            assert harness.scheduler.sidecar_fallbacks == fallbacks
+            from grove_tpu.api.pod import is_scheduled
+
+            third = harness.store.list(
+                "Pod", "default", {"app.kubernetes.io/part-of": "third"}
+            )
+            assert third and all(is_scheduled(p) for p in third)
+        finally:
+            server.stop()
+
+    def test_doomed_request_backs_off_sidecar(self):
+        """Per-request failures (deadline/size/encoding) must not re-ship
+        the identical request every round: the scheduler backs off the
+        sidecar for sidecar_backoff_s and solves in-process meanwhile."""
+        from grove_tpu.sim.harness import SimHarness
+
+        server = SolverServer().start()
+        harness = SimHarness(num_nodes=8)
+        harness.scheduler.solver_sidecar = server.address
+        harness.scheduler.sidecar_timeout = 1e-9  # every RPC blows deadline
+        sample = (
+            __import__("pathlib").Path(__file__).resolve().parents[1]
+            / "samples" / "simple1.yaml"
+        ).read_text()
+        try:
+            harness.apply_yaml(sample)
+            harness.converge()
+            assert harness.scheduler.sidecar_fallbacks == 1
+            assert harness.scheduler._sidecar_skip_until > 0
+            # further rounds stay in-process without new RPC attempts
+            harness.apply_yaml(sample.replace("simple1", "second"))
+            harness.converge()
+            assert harness.scheduler.sidecar_fallbacks == 1
+            from grove_tpu.api.pod import is_scheduled
+
+            pods = harness.store.list("Pod")
+            assert pods and all(is_scheduled(p) for p in pods)
+        finally:
+            server.stop()
+
+    def test_health_watch_streams_not_serving_on_drain(self):
+        """The Watch stream stays open and emits the NOT_SERVING flip when
+        the server drains (stop()'s grace window)."""
+        import threading
+
+        import grpc
+
+        from grove_tpu.cluster.grpcsolver import _HEALTH_SERVICE
+        from grove_tpu.cluster.protos import health_pb2
+
+        server = SolverServer().start()
+        channel = grpc.insecure_channel(server.address)
+        watch = channel.unary_stream(
+            f"/{_HEALTH_SERVICE}/Watch",
+            request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb2.HealthCheckResponse.FromString,
+        )
+        stream = watch(health_pb2.HealthCheckRequest(service=""))
+        statuses = []
+        done = threading.Event()
+
+        def consume():
+            try:
+                for response in stream:
+                    statuses.append(response.status)
+                    if len(statuses) >= 2:
+                        break
+            except grpc.RpcError:
+                pass
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        import time
+
+        time.sleep(0.5)  # first status delivered, stream held open
+        assert statuses == [health_pb2.HealthCheckResponse.SERVING]
+        server.stop(grace=2.0)
+        done.wait(timeout=5.0)
+        channel.close()
+        assert statuses[:2] == [
+            health_pb2.HealthCheckResponse.SERVING,
+            health_pb2.HealthCheckResponse.NOT_SERVING,
+        ]
+
+    def test_health_service(self):
+        """grpc.health.v1 Check: SERVING while up (server-wide and by
+        service name), SERVICE_UNKNOWN for foreign names, unreachable after
+        stop."""
+        from grove_tpu.cluster.grpcsolver import SolverClient, _HEALTH_SERVICE
+        from grove_tpu.cluster.protos import health_pb2
+
+        server = SolverServer().start()
+        client = SolverClient(server.address)
+        try:
+            assert client.healthy()
+            response = client._health(
+                health_pb2.HealthCheckRequest(service=""), timeout=2.0
+            )
+            assert response.status == health_pb2.HealthCheckResponse.SERVING
+            response = client._health(
+                health_pb2.HealthCheckRequest(service="no.such.Service"),
+                timeout=2.0,
+            )
+            assert (
+                response.status
+                == health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+            )
+        finally:
+            server.stop()
+        assert not client.healthy()
+        client.close()
+
+    def test_expired_deadline_rejected_without_solving(self):
+        """A client deadline the solve can't possibly meet aborts
+        DEADLINE_EXCEEDED server-side instead of burning solver time."""
+        import grpc
+        import pytest
+
+        from grove_tpu.cluster.grpcsolver import SolverClient, build_request
+        from grove_tpu.sim.cluster import make_nodes
+
+        server = SolverServer().start()
+        client = SolverClient(server.address)
+        try:
+            request = build_request(
+                make_nodes(4),
+                [{
+                    "name": "g0",
+                    "groups": [{
+                        "name": "a", "demand": {"cpu": 0.1},
+                        "count": 1, "min_count": 1,
+                    }],
+                }],
+            )
+            with pytest.raises(grpc.RpcError) as err:
+                client.solve(request, timeout=0.000001)
+            assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        finally:
+            client.close()
+            server.stop()
+
+    def test_oversized_request_resource_exhausted(self):
+        """The complexity guard rejects requests whose dense encode would
+        exhaust sidecar memory, as RESOURCE_EXHAUSTED (retryable-never)."""
+        import grpc
+        import pytest
+
+        from grove_tpu.cluster.grpcsolver import (
+            MAX_DENSE_CELLS,
+            SolverClient,
+        )
+        from grove_tpu.cluster.protos import solver_pb2 as pb
+
+        server = SolverServer().start()
+        client = SolverClient(server.address)
+        try:
+            request = pb.SolveRequest()
+            n_nodes, n_gangs, n_groups = 10_001, 20_000, 2
+            assert n_nodes * n_gangs * n_groups > MAX_DENSE_CELLS
+            for i in range(n_nodes):
+                request.nodes.add().name = f"n{i}"
+            for i in range(n_gangs):
+                gang = request.gangs.add()
+                gang.name = f"g{i}"
+                for j in range(n_groups):
+                    gang.groups.add().name = f"p{j}"
+            with pytest.raises(grpc.RpcError) as err:
+                client.solve(request, timeout=30.0)
+            assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        finally:
+            client.close()
+            server.stop()
 
     def test_operator_loop_survives_sidecar_outage(self):
         """The deployable operator's control round must keep running when

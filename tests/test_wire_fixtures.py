@@ -1,0 +1,206 @@
+"""Golden wire fixtures: pin the HTTP wire contract independently of the code.
+
+The external-scheduler interop test reuses `GangScheduler` + `HttpStore` on
+both ends of the PodGang contract, so a serialization change would update
+both sides in lockstep and drift would pass unobserved. These fixtures break
+that self-reference: the wire document for every kind the operator emits —
+exactly what `cluster/apiserver.py` sends (`export_object`) and what an
+external consumer parses — is recorded as committed JSON and byte-compared
+on every run. Anyone changing field names, casing, label keys, gate names,
+env-var injection, or envelope shapes must consciously regenerate
+(`GROVE_REGEN_WIRE_FIXTURES=1 python -m pytest tests/test_wire_fixtures.py`)
+and the diff shows the contract change for review.
+
+Contract anchor: /root/reference/scheduler/api/core/v1alpha1/podgang.go:50-175
+(PodGang is the cross-process boundary KAI consumes) plus the reference's
+sample manifest format (operator/samples/).
+
+Volatile scalars (uid, resourceVersion, generation, timestamps) are
+normalized to sentinels before comparison — the fixtures pin the wire SHAPE
+and every semantic string (names, labels, keys), not the run-dependent
+counters, so unrelated reconcile-order changes can't churn them.
+"""
+
+import itertools
+import json
+import os
+import pathlib
+
+import pytest
+
+import grove_tpu.api.meta as meta
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.api.serialize import export_object
+from grove_tpu.api.wire import KIND_REGISTRY, decode_object
+from grove_tpu.sim.harness import SimHarness
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURE_DIR = pathlib.Path(__file__).parent / "fixtures" / "wire"
+REGEN = bool(os.environ.get("GROVE_REGEN_WIRE_FIXTURES"))
+
+# metadata/status keys whose values are run-dependent counters or clocks;
+# normalized to type-stable sentinels (shape still pinned, noise removed)
+_VOLATILE = {
+    "uid": "UID",
+    "resourceVersion": 0,
+    "generation": 0,
+    "creationTimestamp": 0,
+    "deletionTimestamp": 0,
+    "lastTransitionTime": 0,
+    "observedAt": 0,
+    "startedAt": 0,
+}
+
+
+def _normalize(doc):
+    if isinstance(doc, dict):
+        return {
+            k: (_VOLATILE[k] if k in _VOLATILE else _normalize(v))
+            for k, v in doc.items()
+        }
+    if isinstance(doc, list):
+        return [_normalize(v) for v in doc]
+    return doc
+
+
+def _render(doc) -> str:
+    return json.dumps(_normalize(doc), indent=2, sort_keys=True) + "\n"
+
+
+def _check(name: str, doc) -> None:
+    """Byte-compare the rendered wire doc against the committed golden."""
+    path = FIXTURE_DIR / f"{name}.json"
+    rendered = _render(doc)
+    if REGEN:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(rendered)
+        return
+    assert path.exists(), (
+        f"missing golden fixture {path} — run "
+        "GROVE_REGEN_WIRE_FIXTURES=1 python -m pytest tests/test_wire_fixtures.py"
+    )
+    golden = path.read_text()
+    assert rendered == golden, (
+        f"wire contract drift for {name}: serialized bytes differ from "
+        f"{path}. If the change is intentional, regenerate with "
+        "GROVE_REGEN_WIRE_FIXTURES=1 and review the fixture diff."
+    )
+
+
+@pytest.fixture(scope="module")
+def converged():
+    """One deterministic converged control plane for all fixture captures.
+
+    The uid counter is pinned so object identity fields are reproducible
+    within the run (they're normalized out anyway); the agentic-pipeline
+    sample exercises startsAfter → initc injection, the richest pod shape.
+    """
+    meta._uid_counter = itertools.count(1)
+    harness = SimHarness(num_nodes=16)
+    harness.apply(
+        load_podcliqueset_file(str(REPO / "samples" / "agentic-pipeline.yaml"))
+    )
+    harness.apply(load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml")))
+    harness.converge()
+    return harness
+
+
+def _get(harness, kind, name):
+    obj = harness.store.get(kind, "default", name)
+    assert obj is not None, f"{kind} {name} not materialized"
+    return obj
+
+
+class TestGoldenWireDocs:
+    def test_podcliqueset(self, converged):
+        _check("podcliqueset", export_object(_get(converged, "PodCliqueSet", "simple1")))
+
+    def test_podclique_standalone(self, converged):
+        _check(
+            "podclique-standalone",
+            export_object(_get(converged, "PodClique", "simple1-0-frontend")),
+        )
+
+    def test_podclique_scaled_member(self, converged):
+        # PCSG-owned clique: carries gang + base-gang labels, startsAfter FQNs
+        _check(
+            "podclique-pcsg-member",
+            export_object(
+                _get(converged, "PodClique", "simple1-0-workers-0-compute")
+            ),
+        )
+
+    def test_podcliquescalinggroup(self, converged):
+        _check(
+            "podcliquescalinggroup",
+            export_object(
+                _get(converged, "PodCliqueScalingGroup", "simple1-0-workers")
+            ),
+        )
+
+    def test_podgang_base(self, converged):
+        # THE cross-process contract: what an external KAI-equivalent parses
+        _check("podgang-base", export_object(_get(converged, "PodGang", "simple1-0")))
+
+    def test_pod_with_initc(self, converged):
+        # router clique startsAfter [model, tools] → downward-API files,
+        # waiter container, env identity, scheduling gate lifecycle
+        _check(
+            "pod-initc",
+            export_object(_get(converged, "Pod", "agentic-0-router-0")),
+        )
+
+    def test_service(self, converged):
+        _check(
+            "service-headless",
+            export_object(_get(converged, "Service", "simple1-0")),
+        )
+
+    def test_clustertopology(self, converged):
+        _check("clustertopology", export_object(converged.topology))
+
+    def test_list_envelope(self, converged):
+        # the List response shape served by GET .../{plural}
+        info = KIND_REGISTRY["PodGang"]
+        objs = converged.store.list("PodGang", "default")
+        doc = {
+            "apiVersion": info.api_version,
+            "kind": f"{info.kind}List",
+            "items": [
+                export_object(o) for o in objs if o.metadata.name == "simple1-0"
+            ],
+        }
+        _check("list-envelope", doc)
+
+    def test_watch_event_envelope(self, converged):
+        # the chunked watch stream payload shape (apiserver._watch)
+        doc = {
+            "type": "ADDED",
+            "object": export_object(_get(converged, "PodGang", "simple1-0")),
+        }
+        _check("watch-event", doc)
+
+
+class TestRoundTrip:
+    """decode(golden) → export → identical bytes: the decoder accepts every
+    document the encoder emits, losslessly, for each typed kind."""
+
+    @pytest.mark.parametrize(
+        "name",
+        [
+            "podcliqueset",
+            "podclique-standalone",
+            "podclique-pcsg-member",
+            "podcliquescalinggroup",
+            "podgang-base",
+            "pod-initc",
+            "clustertopology",
+        ],
+    )
+    def test_lossless(self, name):
+        path = FIXTURE_DIR / f"{name}.json"
+        if REGEN and not path.exists():
+            pytest.skip("regenerating")
+        golden = json.loads(path.read_text())
+        obj = decode_object(golden)
+        assert _render(export_object(obj)) == path.read_text()
