@@ -23,8 +23,10 @@ check:           ## drift gates: CRDs, api-docs, wire fixtures, CRD conformance
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-crds:            ## regenerate deploy/crds/ from the typed model
+crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
 	$(CPU_ENV) $(PY) -m grove_tpu.cli crds --output-dir deploy/crds
+	rm -f deploy/charts/grove-tpu/crds/*.yaml
+	cp deploy/crds/*.yaml deploy/charts/grove-tpu/crds/
 
 api-docs:        ## regenerate docs/api-reference.md
 	$(CPU_ENV) $(PY) -m grove_tpu.cli api-docs > docs/api-reference.md

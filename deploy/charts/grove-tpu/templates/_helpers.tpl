@@ -1,0 +1,14 @@
+{{- define "grove-tpu.name" -}}
+{{ .Chart.Name }}
+{{- end -}}
+
+{{- define "grove-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "grove-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "grove-tpu.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
