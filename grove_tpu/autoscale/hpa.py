@@ -62,7 +62,9 @@ class HorizontalAutoscaler:
         """Evaluate every HPA once (all namespaces by default); returns the
         number of scale changes."""
         changes = 0
-        for hpa in self.store.list("HorizontalPodAutoscaler", namespace):
+        # readonly scan: evaluation only reads the HPA spec; the target is
+        # re-fetched mutably inside _apply_scale when a scale actually fires
+        for hpa in self.store.scan("HorizontalPodAutoscaler", namespace):
             if self._evaluate(hpa.metadata.namespace, hpa):
                 changes += 1
         return changes
@@ -89,7 +91,7 @@ class HorizontalAutoscaler:
         observed = self.provider.utilization(kind, namespace, name)
         if observed is None:
             return False
-        obj = self.store.get(kind, namespace, name)
+        obj = self.store.get(kind, namespace, name, readonly=True)
         if obj is None or obj.metadata.deletion_timestamp is not None:
             return False
         current = obj.spec.replicas
@@ -126,7 +128,13 @@ class HorizontalAutoscaler:
                 return float(target["averageUtilization"])
         return None
 
-    def _apply_scale(self, obj, desired: int, key: str) -> bool:
+    def _apply_scale(self, view, desired: int, key: str) -> bool:
+        # `view` is a readonly store view — re-get a private copy to write
+        obj = self.store.get(
+            view.kind, view.metadata.namespace, view.metadata.name
+        )
+        if obj is None or obj.metadata.deletion_timestamp is not None:
+            return False
         obj.spec.replicas = desired
         self.store.update(obj)  # generation bump → controllers reconcile
         METRICS.inc(f"hpa_scale_total/{key}")

@@ -55,7 +55,11 @@ class PodCliqueScalingGroupReconciler:
 
     def reconcile(self, key: Key) -> ReconcileStepResult:
         _, ns, name = key
-        pcsg = self.ctx.store.get("PodCliqueScalingGroup", ns, name)
+        # readonly view: the flows read the PCSG; the one-time finalizer
+        # write re-gets a mutable copy
+        pcsg = self.ctx.store.get(
+            "PodCliqueScalingGroup", ns, name, readonly=True
+        )
         if pcsg is None:
             return do_not_requeue()
         if pcsg.metadata.deletion_timestamp is not None:
@@ -65,6 +69,9 @@ class PodCliqueScalingGroupReconciler:
             return do_not_requeue()
         try:
             if FINALIZER not in pcsg.metadata.finalizers:
+                pcsg = self.ctx.store.get("PodCliqueScalingGroup", ns, name)
+                if pcsg is None:  # deleted between view and mutable re-get
+                    return do_not_requeue()
                 pcsg.metadata.finalizers.append(FINALIZER)
                 pcsg = self.ctx.store.update(pcsg, bump_generation=False)
             update_requeue = self._process_rolling_update(pcsg, pcs)
@@ -109,10 +116,15 @@ class PodCliqueScalingGroupReconciler:
             pcsg.metadata.name, pcs.metadata.name, pcs_replica
         )
 
-        existing = self.ctx.store.list(
-            "PodClique", ns, {namegen.LABEL_PCSG: pcsg.metadata.name}, cached=True
-        )
-        existing_by_name = {p.metadata.name: p for p in existing}
+        existing_names = {
+            p.metadata.name
+            for p in self.ctx.store.scan(
+                "PodClique",
+                ns,
+                {namegen.LABEL_PCSG: pcsg.metadata.name},
+                cached=True,
+            )
+        }
 
         expected: Dict[str, PodClique] = {}
         for replica in range(pcsg.spec.replicas):
@@ -128,7 +140,7 @@ class PodCliqueScalingGroupReconciler:
             create_or_adopt(self.ctx, pclq)
 
         # scale-in: delete excess (highest replica indices first — sync.go:130-172)
-        for name in sorted(set(existing_by_name) - set(expected), reverse=True):
+        for name in sorted(existing_names - expected.keys(), reverse=True):
             self.ctx.store.delete("PodClique", ns, name)
 
         return self._terminate_breached_scaled_replicas(pcsg, pcs, pcs_replica)
@@ -295,6 +307,10 @@ class PodCliqueScalingGroupReconciler:
         cliques."""
         from grove_tpu.api.types import PCSGRollingUpdateProgress
 
+        # `pcsg` may be the readonly reconcile view: the steady state (no
+        # outdated replicas, no open progress) reads only; every mutating
+        # branch below re-gets a private copy first
+        ns = pcsg.metadata.namespace
         progress = pcsg.status.rolling_update_progress
         outdated = [
             r
@@ -303,19 +319,38 @@ class PodCliqueScalingGroupReconciler:
         ]
         if not outdated:
             if progress is not None and progress.update_ended_at is None:
-                progress.update_ended_at = self.ctx.clock.now()
-                progress.ready_replica_indices_selected_to_update = []
-                progress.updated_replica_indices = sorted(
-                    set(progress.updated_replica_indices)
-                    | set(range(pcsg.spec.replicas))
+                fresh = self.ctx.store.get(
+                    "PodCliqueScalingGroup", ns, pcsg.metadata.name
                 )
-                self.ctx.store.update_status(pcsg)
+                prog = (
+                    fresh.status.rolling_update_progress
+                    if fresh is not None
+                    else None
+                )
+                if prog is None or prog.update_ended_at is not None:
+                    return None
+                prog.update_ended_at = self.ctx.clock.now()
+                prog.ready_replica_indices_selected_to_update = []
+                prog.updated_replica_indices = sorted(
+                    set(prog.updated_replica_indices)
+                    | set(range(fresh.spec.replicas))
+                )
+                self.ctx.store.update_status(fresh)
                 self.ctx.record_event(
                     "PodCliqueScalingGroup",
                     "RollingUpdateCompleted",
-                    pcsg.metadata.name,
+                    fresh.metadata.name,
                 )
             return None
+        # active update: switch to a private mutable copy for the rest of
+        # the flow (it tracks selection/progress in this CR's status)
+        fresh = self.ctx.store.get(
+            "PodCliqueScalingGroup", ns, pcsg.metadata.name
+        )
+        if fresh is None or fresh.metadata.deletion_timestamp is not None:
+            return None
+        pcsg = fresh
+        progress = pcsg.status.rolling_update_progress
 
         # gate on the PCS-level replica selection: PCSGs of a replica the
         # PCS updater has not reached yet stay on the old template

@@ -29,8 +29,8 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
         **namegen.default_labels(pcs.metadata.name),
         namegen.LABEL_COMPONENT: namegen.COMPONENT_PCS_PODCLIQUE,
     }
-    existing = {
-        p.metadata.name: p for p in ctx.store.list("PodClique", ns, selector)
+    existing_names = {
+        p.metadata.name for p in ctx.store.scan("PodClique", ns, selector)
     }
     expected: Dict[str, PodClique] = {}
     for replica in range(pcs.spec.replicas):
@@ -39,11 +39,11 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
             expected[pclq.metadata.name] = pclq
 
     for name, pclq in expected.items():
-        if name not in existing:
+        if name not in existing_names:
             ctx.record_event("PodClique", "PodCliqueCreateSuccessful", name)
         create_or_adopt(ctx, pclq)
 
-    for name in set(existing) - set(expected):
+    for name in existing_names - expected.keys():
         ctx.store.delete("PodClique", ns, name)
         ctx.record_event("PodClique", "PodCliqueDeleteSuccessful", name)
 

@@ -34,7 +34,18 @@ from grove_tpu.controller.podclique.status import UPDATE_IN_PROGRESS_ANNOTATION
 
 def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
     """Run one step of the rolling update. Returns a requeue delay while the
-    update is in flight, None when idle/complete."""
+    update is in flight, None when idle/complete.
+
+    `pcs` may be the reconciler's readonly view: the steady state (no update
+    in flight) returns without touching the store; an ACTIVE update switches
+    to a private mutable copy for the whole step (this flow tracks its
+    progress in pcs.status)."""
+    progress = pcs.status.rolling_update_progress
+    if progress is None or progress.update_ended_at is not None:
+        return None
+    pcs = ctx.store.get("PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name)
+    if pcs is None or pcs.metadata.deletion_timestamp is not None:
+        return None
     progress = pcs.status.rolling_update_progress
     if progress is None or progress.update_ended_at is not None:
         return None

@@ -26,9 +26,9 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
         **namegen.default_labels(pcs.metadata.name),
         namegen.LABEL_COMPONENT: namegen.COMPONENT_PCSG,
     }
-    existing = {
-        g.metadata.name: g
-        for g in ctx.store.list("PodCliqueScalingGroup", ns, selector)
+    existing_names = {
+        g.metadata.name
+        for g in ctx.store.scan("PodCliqueScalingGroup", ns, selector)
     }
     expected: Dict[str, PodCliqueScalingGroup] = {}
     for replica in range(pcs.spec.replicas):
@@ -48,11 +48,11 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
             )
 
     for name, pcsg in expected.items():
-        if name not in existing:
+        if name not in existing_names:
             ctx.store.create(pcsg)
             ctx.record_event("PodCliqueScalingGroup", "PCSGCreateSuccessful", name)
         # existing PCSGs keep their (possibly HPA-scaled) replicas
 
-    for name in set(existing) - set(expected):
+    for name in existing_names - expected.keys():
         ctx.store.delete("PodCliqueScalingGroup", ns, name)
         ctx.record_event("PodCliqueScalingGroup", "PCSGDeleteSuccessful", name)

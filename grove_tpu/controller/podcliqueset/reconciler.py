@@ -67,7 +67,10 @@ class PodCliqueSetReconciler:
 
     def reconcile(self, key: Key) -> ReconcileStepResult:
         _, ns, name = key
-        pcs = self.ctx.store.get("PodCliqueSet", ns, name)
+        # readonly view: the spec flow READS the PCS (components take it as
+        # input); the rare writes (finalizer add, hash change, observed
+        # generation) each re-get a mutable copy
+        pcs = self.ctx.store.get("PodCliqueSet", ns, name, readonly=True)
         if pcs is None:
             return do_not_requeue()
         if pcs.metadata.deletion_timestamp is not None:
@@ -103,7 +106,11 @@ class PodCliqueSetReconciler:
     # -- spec flow -------------------------------------------------------
 
     def _reconcile_spec(self, pcs: PodCliqueSet) -> ReconcileStepResult:
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
         if FINALIZER not in pcs.metadata.finalizers:
+            pcs = self.ctx.store.get("PodCliqueSet", ns, name)
+            if pcs is None:  # deleted between view and mutable re-get
+                return continue_reconcile()
             pcs.metadata.finalizers.append(FINALIZER)
             pcs = self.ctx.store.update(pcs, bump_generation=False)
 
@@ -118,12 +125,16 @@ class PodCliqueSetReconciler:
         scalinggroup.sync(self.ctx, pcs)
         podgang.sync(self.ctx, pcs)
 
-        fresh = self.ctx.store.get(
-            "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
-        )
-        if fresh is not None and fresh.metadata.deletion_timestamp is None:
-            fresh.status.observed_generation = fresh.metadata.generation
-            self.ctx.store.update_status(fresh)
+        view = self.ctx.store.get("PodCliqueSet", ns, name, readonly=True)
+        if (
+            view is not None
+            and view.metadata.deletion_timestamp is None
+            and view.status.observed_generation != view.metadata.generation
+        ):
+            fresh = self.ctx.store.get("PodCliqueSet", ns, name)
+            if fresh is not None and fresh.metadata.deletion_timestamp is None:
+                fresh.status.observed_generation = fresh.metadata.generation
+                self.ctx.store.update_status(fresh)
 
         waits = [w for w in (breach_wait, update_wait) if w is not None]
         if waits:
@@ -132,21 +143,30 @@ class PodCliqueSetReconciler:
 
     def _process_generation_hash(self, pcs: PodCliqueSet) -> PodCliqueSet:
         """reconcilespec.go:72-123: template hash change starts a rolling
-        update (progress tracked in status)."""
+        update (progress tracked in status). `pcs` may be a readonly view —
+        the steady state (hash unchanged) never touches the store; a change
+        re-gets a mutable copy for the write."""
         new_hash = compute_pcs_generation_hash(pcs)
-        if pcs.status.current_generation_hash is None:
-            pcs.status.current_generation_hash = new_hash
-            return self.ctx.store.update_status(pcs)
-        if pcs.status.current_generation_hash != new_hash:
-            pcs.status.current_generation_hash = new_hash
-            pcs.status.rolling_update_progress = PCSRollingUpdateProgress(
+        if pcs.status.current_generation_hash == new_hash:
+            return pcs
+        fresh = self.ctx.store.get(
+            "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
+        )
+        if fresh is None or fresh.metadata.deletion_timestamp is not None:
+            return pcs
+        if fresh.status.current_generation_hash is None:
+            fresh.status.current_generation_hash = new_hash
+            return self.ctx.store.update_status(fresh)
+        if fresh.status.current_generation_hash != new_hash:
+            fresh.status.current_generation_hash = new_hash
+            fresh.status.rolling_update_progress = PCSRollingUpdateProgress(
                 update_started_at=self.ctx.clock.now()
             )
             self.ctx.record_event(
-                "PodCliqueSet", "RollingUpdateStarted", pcs.metadata.name
+                "PodCliqueSet", "RollingUpdateStarted", fresh.metadata.name
             )
-            return self.ctx.store.update_status(pcs)
-        return pcs
+            return self.ctx.store.update_status(fresh)
+        return fresh
 
     # -- status flow -----------------------------------------------------
 

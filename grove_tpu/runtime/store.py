@@ -305,7 +305,14 @@ class Store:
         stored.metadata.creation_timestamp = self.clock.now()
         blob = self._commit(stored)
         self._emit(ADDED, stored, blob)
-        return _materialize(stored, blob)
+        # return the CALLER's object carrying the committed identity — its
+        # content is what was committed (stored was copied from it), so a
+        # fresh materialized copy would only duplicate it
+        obj.metadata.uid = stored.metadata.uid
+        obj.metadata.resource_version = stored.metadata.resource_version
+        obj.metadata.generation = stored.metadata.generation
+        obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
+        return obj
 
     def get(
         self,
@@ -426,8 +433,19 @@ class Store:
                 meta.uid,
                 meta.creation_timestamp,
             ) = saved
+        def _return_caller_obj(committed) -> object:
+            # hand the CALLER's object back carrying the committed identity
+            # (no materialized copy: obj's content is what was committed —
+            # or, on a no-op, semantically equal to it). update() requires a
+            # caller-OWNED object (never a readonly view), so this is safe.
+            meta.resource_version = committed.metadata.resource_version
+            meta.generation = committed.metadata.generation
+            meta.uid = committed.metadata.uid
+            meta.creation_timestamp = committed.metadata.creation_timestamp
+            return obj
+
         if blob_norm is not None and blob_norm == cur_blob:
-            return pickle.loads(blob_norm)
+            return _return_caller_obj(current)
         if blob_norm is not None:
             stored = pickle.loads(blob_norm)  # private copy, metadata normalized
         else:
@@ -435,7 +453,7 @@ class Store:
             stored.metadata.uid = current.metadata.uid
             stored.metadata.creation_timestamp = current.metadata.creation_timestamp
         if _semantically_equal(stored, current):
-            return _materialize(current, cur_blob)
+            return _return_caller_obj(current)
         self._rv += 1
         stored.metadata.resource_version = self._rv
         stored.metadata.generation = current.metadata.generation + (
@@ -444,7 +462,7 @@ class Store:
         self._index_remove(current)
         blob = self._commit(stored)
         self._emit(MODIFIED, stored, blob)
-        return _materialize(stored, blob)
+        return _return_caller_obj(stored)
 
     def update_status(self, obj) -> object:
         """Status write: no generation bump (status subresource semantics)."""

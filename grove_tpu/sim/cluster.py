@@ -144,15 +144,24 @@ class SimCluster:
         # (real kubelets are independent processes; the init waiter observes
         # parent readiness with at least one tick of delay).
         to_start = []
-        for pod in self.store.list("Pod", namespace):
-            if not is_scheduled(pod) or is_ready(pod) or is_terminating(pod):
+        # readonly scan: readiness and the init-waiter check run against the
+        # zero-copy view; only pods that actually TRANSITION get a private
+        # mutable copy (waiter-blocked pods in a startup cascade stay free)
+        for view in self.store.scan("Pod", namespace):
+            if not is_scheduled(view) or is_ready(view) or is_terminating(view):
                 continue
-            waiter_cfg = pod.spec.extra.get("groveInitWaiter")
-            if waiter_cfg and not pod.status.init_waiter_done:
-                if not is_ready_to_start(
-                    self.store, pod.metadata.namespace, waiter_cfg
-                ):
-                    continue
+            waiter_cfg = view.spec.extra.get("groveInitWaiter")
+            waiter_clears = bool(waiter_cfg) and not view.status.init_waiter_done
+            if waiter_clears and not is_ready_to_start(
+                self.store, view.metadata.namespace, waiter_cfg
+            ):
+                continue
+            pod = self.store.get(
+                "Pod", view.metadata.namespace, view.metadata.name
+            )
+            if pod is None:
+                continue
+            if waiter_clears:
                 pod.status.init_waiter_done = True
             to_start.append(pod)
         for pod in to_start:
