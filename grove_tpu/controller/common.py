@@ -83,6 +83,16 @@ class Component(Protocol):
     def delete(self, ctx: OperatorContext, owner) -> None: ...
 
 
+def shared_template_spec(spec):
+    """Embed a PCS TEMPLATE spec into an EXPECTED child object WITHOUT
+    copying. The template usually comes from a zero-copy readonly PCS view,
+    so the returned spec ALIASES committed store state: the expected object
+    may only flow into [create_or_adopt]/[Store.create] (both copy-on-
+    write); never mutate it. One helper so the invariant has one home
+    instead of per-call-site comments."""
+    return spec
+
+
 def status_shadow(view):
     """Shadow object over a zero-copy readonly store view: SHARES metadata
     and spec (read-only by the scan/readonly contract) with a PRIVATE

@@ -33,6 +33,7 @@ from grove_tpu.controller.common import (
     create_or_adopt,
     record_last_error,
     resolve_starts_after,
+    shared_template_spec,
     write_status_if_changed,
 )
 from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
@@ -198,9 +199,6 @@ class PodCliqueScalingGroupReconciler:
         if deps:
             annotations[STARTUP_DEPS_ANNOTATION] = json.dumps(deps)
 
-        from grove_tpu.api.meta import deep_copy
-
-        spec = deep_copy(tmpl.spec)
         return PodClique(
             metadata=ObjectMeta(
                 name=fqn,
@@ -208,7 +206,7 @@ class PodCliqueScalingGroupReconciler:
                 labels=labels,
                 annotations=annotations,
             ),
-            spec=spec,
+            spec=shared_template_spec(tmpl.spec),
         )
 
     # -- rolling update (components/podclique/rollingupdate.go:55-260) ----

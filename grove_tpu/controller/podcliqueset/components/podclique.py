@@ -13,12 +13,13 @@ from typing import Dict
 
 from grove_tpu.api import names as namegen
 from grove_tpu.api.hashing import pod_template_hash_for
-from grove_tpu.api.meta import ObjectMeta, deep_copy
+from grove_tpu.api.meta import ObjectMeta
 from grove_tpu.api.types import PodClique, PodCliqueSet
 from grove_tpu.controller.common import (
     OperatorContext,
     create_or_adopt,
     resolve_starts_after,
+    shared_template_spec,
 )
 from grove_tpu.controller.podclique.pods import STARTUP_DEPS_ANNOTATION
 
@@ -71,5 +72,5 @@ def build_pclq(pcs: PodCliqueSet, replica: int, clique) -> PodClique:
             labels=labels,
             annotations=annotations,
         ),
-        spec=deep_copy(clique.spec),
+        spec=shared_template_spec(clique.spec),
     )

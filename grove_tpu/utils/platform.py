@@ -11,11 +11,22 @@ import os
 from typing import Dict, Optional
 
 
-def probe_device_health(timeout_s: float = 60.0) -> bool:
+def probe_device_health(
+    timeout_s: float = 60.0,
+    env: Optional[dict] = None,
+    require_accelerator: bool = False,
+) -> bool:
     """Run a trivial jit in a detached subprocess; on timeout the child is
     killed and ABANDONED (a child wedged in uninterruptible device sleep
     ignores SIGKILL — blocking on its exit would hang the caller, the exact
-    condition the probe exists to detect)."""
+    condition the probe exists to detect).
+
+    `env`: environment for the child. Callers probing "is the ACCELERATOR
+    back?" after force_cpu_platform() MUST pass the pre-scrub environment —
+    the child inherits os.environ by default, and a scrubbed parent would
+    make the probe vacuously test CPU (the bug behind round 3's phantom
+    'chip wake windows'). `require_accelerator` additionally rejects a
+    successful probe whose default backend is cpu."""
     import pathlib
     import subprocess
     import sys
@@ -36,6 +47,7 @@ def probe_device_health(timeout_s: float = 60.0) -> bool:
         stderr=subprocess.STDOUT,
         cwd=pathlib.Path(__file__).resolve().parents[2],
         start_new_session=True,
+        env=env,
     )
     try:
         deadline = time.time() + timeout_s
@@ -47,7 +59,12 @@ def probe_device_health(timeout_s: float = 60.0) -> bool:
             proc.kill()
             return False  # abandoned child may still hold the temp file
         out.seek(0)
-        return proc.returncode == 0 and "OK" in out.read()
+        text = out.read()
+        if proc.returncode != 0 or "OK" not in text:
+            return False
+        if require_accelerator and "OK cpu" in text:
+            return False
+        return True
     finally:
         out.close()
         if proc.poll() is not None:  # only unlink when the child is gone

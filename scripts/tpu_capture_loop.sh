@@ -1,11 +1,14 @@
 #!/usr/bin/env bash
 # Opportunistic TPU artifact capture (VERDICT r2 #1c): the chip behind the
-# axon tunnel has brief wake windows between long wedged stretches (a bench
-# background probe caught one ~5s window). Probe on a tight interval; the
-# moment a probe answers, FIRST bank a small fast TPU artifact (small shape,
-# 2 runs — minimal compile, fits a short window), THEN attempt the full-size
-# bench. Runs until a FULL capture succeeds or the deadline passes; small
-# captures accumulate in artifacts/ either way.
+# axon tunnel wedges for hours; probe on a tight interval so any wake window
+# is caught. (Round-3 note: earlier "bench background probe caught a ~5s
+# window" reports were VACUOUS — that prober inherited the CPU-scrubbed env
+# and was testing CPU; fixed in utils/platform.probe_device_health via
+# env= + require_accelerator. THIS loop's probe was always correct: it
+# asserts default_backend() != cpu under a clean env.) On a real answer,
+# FIRST bank a small fast TPU artifact (small shape, 2 runs — minimal
+# compile, fits a short window), THEN attempt the full-size bench. Runs
+# until a FULL capture succeeds or the deadline passes.
 #
 # Usage: scripts/tpu_capture_loop.sh [interval_s] [max_hours]
 set -u
