@@ -27,7 +27,7 @@ import threading
 from typing import Dict, List
 
 from grove_tpu.api import names as namegen
-from grove_tpu.initc.waiter import is_ready_to_start
+from grove_tpu.initc.waiter import ready_or_transport_down
 
 
 def parse_podclique_flag(values: List[str]) -> List[Dict]:
@@ -78,7 +78,7 @@ def wait_for_parents(
     store.subscribe(on_event)
     deadline = store.clock.now() + timeout
     while True:
-        if is_ready_to_start(store, namespace, config):
+        if ready_or_transport_down(store, namespace, config):
             return True
         if store.clock.now() >= deadline:
             return False

@@ -63,8 +63,30 @@ class Waiter:
     def wait(self, poll_interval: float = 1.0, timeout: float = 3600.0) -> bool:
         elapsed = 0.0
         while elapsed <= timeout:
-            if is_ready_to_start(self.store, self.namespace, self.config):
+            if ready_or_transport_down(self.store, self.namespace, self.config):
                 return True
             self.store.clock.sleep(poll_interval)
             elapsed += poll_interval
+        return False
+
+
+def ready_or_transport_down(store: Store, namespace: str, config: Dict) -> bool:
+    """is_ready_to_start, surviving TRANSIENT apiserver outages: transport
+    failures read as not-ready-yet (retry until the caller's deadline — the
+    reference's informer client reconnects the same way); every other error
+    (forbidden, not found, bad request) is permanent and re-raises so the
+    init container fails fast with the real diagnosis."""
+    import sys
+
+    from grove_tpu.runtime.errors import GroveError
+
+    try:
+        return is_ready_to_start(store, namespace, config)
+    except GroveError as e:
+        if e.code != "ERR_TRANSPORT":
+            raise
+        print(
+            f"grove-tpu-initc: apiserver unavailable ({e.code}); retrying",
+            file=sys.stderr,
+        )
         return False

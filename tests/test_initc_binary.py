@@ -162,3 +162,94 @@ class TestInitcBinary:
             text=True,
         ).returncode
         assert rc == 0
+
+
+class TestOutageResilience:
+    def test_wait_survives_transient_apiserver_outage(self):
+        """A transport blip mid-wait must not crash the waiter — it retries
+        until the deadline (the reference's informer client reconnects the
+        same way; VERDICT r3 hardening)."""
+        from grove_tpu.initc.__main__ import wait_for_parents
+        from grove_tpu.runtime.clock import Clock
+        from grove_tpu.runtime.errors import GroveError
+
+        class FlakyThenReadyStore:
+            """Raises ERR_TRANSPORT twice, then reports parents ready."""
+
+            def __init__(self):
+                self.clock = Clock()
+                self.calls = 0
+
+            def subscribe(self, fn):
+                pass
+
+            def scan(self, kind, namespace=None, selector=None, cached=False):
+                return iter(self.list(kind, namespace, selector))
+
+            def list(self, kind, namespace=None, selector=None, cached=False):
+                self.calls += 1
+                if self.calls <= 2:
+                    raise GroveError(
+                        "ERR_TRANSPORT", "connection refused", "list"
+                    )
+                # two ready pods of the parent clique
+                import grove_tpu.api.names as namegen
+                from grove_tpu.api.meta import Condition, ObjectMeta
+                from grove_tpu.api.pod import (
+                    COND_POD_READY,
+                    POD_RUNNING,
+                    Pod,
+                )
+
+                pods = []
+                for i in range(2):
+                    p = Pod(
+                        metadata=ObjectMeta(
+                            name=f"svc-0-prefill-{i}",
+                            namespace="default",
+                            labels={
+                                namegen.LABEL_PODGANG: "svc-0",
+                                namegen.LABEL_PODCLIQUE: "svc-0-prefill",
+                            },
+                        )
+                    )
+                    p.status.phase = POD_RUNNING
+                    p.status.conditions.append(
+                        Condition(type=COND_POD_READY, status="True")
+                    )
+                    pods.append(p)
+                return pods
+
+        store = FlakyThenReadyStore()
+        ok = wait_for_parents(
+            store,
+            "default",
+            "svc-0",
+            [{"pclq": "svc-0-prefill", "min_available": 2}],
+            timeout=30.0,
+            poll_interval=0.05,
+        )
+        assert ok
+        assert store.calls >= 3  # two failures survived, then success
+
+    def test_permanent_errors_fail_fast(self):
+        """Only TRANSPORT errors retry; a forbidden/not-found response is a
+        misconfiguration the init container must surface immediately."""
+        import pytest
+
+        from grove_tpu.initc.waiter import ready_or_transport_down
+        from grove_tpu.runtime.clock import Clock
+        from grove_tpu.runtime.errors import GroveError
+
+        class ForbiddenStore:
+            clock = Clock()
+
+            def list(self, *a, **k):
+                raise GroveError("ERR_FORBIDDEN", "rbac", "list")
+
+        cfg = {
+            "podcliques": [{"pclq": "x", "min_available": 1}],
+            "podgang": "g",
+        }
+        with pytest.raises(GroveError):
+            ready_or_transport_down(ForbiddenStore(), "default", cfg)
