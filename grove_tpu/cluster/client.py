@@ -26,6 +26,7 @@ from grove_tpu.runtime.errors import (
     ERR_CREATE_RESOURCE,
     ERR_FORBIDDEN,
     ERR_NOT_FOUND,
+    ERR_TRANSPORT,
     GroveError,
 )
 from grove_tpu.runtime.store import WatchEvent
@@ -135,7 +136,7 @@ class HttpStore:
             # paths — reconcile requeues, the external scheduler loop —
             # treat it as transient instead of dying on a raw urllib error
             raise GroveError(
-                "ERR_TRANSPORT", str(e), operation or method.lower()
+                ERR_TRANSPORT, str(e), operation or method.lower()
             ) from None
 
     # -- watch ------------------------------------------------------------

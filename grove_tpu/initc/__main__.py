@@ -28,6 +28,7 @@ from typing import Dict, List
 
 from grove_tpu.api import names as namegen
 from grove_tpu.initc.waiter import ready_or_transport_down
+from grove_tpu.runtime.errors import GroveError
 
 
 def parse_podclique_flag(values: List[str]) -> List[Dict]:
@@ -142,6 +143,15 @@ def main(argv=None) -> int:
             timeout=args.timeout,
             poll_interval=args.poll_interval,
         )
+    except GroveError as e:
+        # permanent apiserver rejection (forbidden / not found / bad
+        # request): a misconfiguration, not a timeout — distinct diagnosis
+        # and exit code so operators can tell the two apart from logs
+        print(
+            f"grove-tpu-initc: apiserver rejected the wait ({e.code}): {e}",
+            file=sys.stderr,
+        )
+        return 2
     finally:
         store.stop()
     if ok:

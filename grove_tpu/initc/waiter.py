@@ -14,10 +14,12 @@ deployment (it polls the same predicate).
 
 from __future__ import annotations
 
+import sys
 from typing import Dict, List
 
 from grove_tpu.api import names as namegen
 from grove_tpu.api.pod import is_ready
+from grove_tpu.runtime.errors import ERR_TRANSPORT, GroveError
 from grove_tpu.runtime.store import Store
 
 
@@ -61,13 +63,17 @@ class Waiter:
         self.config = waiter_config
 
     def wait(self, poll_interval: float = 1.0, timeout: float = 3600.0) -> bool:
-        elapsed = 0.0
-        while elapsed <= timeout:
+        # wall-clock deadline, NOT an iteration count: a black-holed
+        # apiserver makes each probe itself block for the transport timeout,
+        # and counting only sleep intervals would overshoot `timeout` by the
+        # ratio of the two
+        deadline = self.store.clock.now() + timeout
+        while True:
             if ready_or_transport_down(self.store, self.namespace, self.config):
                 return True
+            if self.store.clock.now() >= deadline:
+                return False
             self.store.clock.sleep(poll_interval)
-            elapsed += poll_interval
-        return False
 
 
 def ready_or_transport_down(store: Store, namespace: str, config: Dict) -> bool:
@@ -76,14 +82,10 @@ def ready_or_transport_down(store: Store, namespace: str, config: Dict) -> bool:
     reference's informer client reconnects the same way); every other error
     (forbidden, not found, bad request) is permanent and re-raises so the
     init container fails fast with the real diagnosis."""
-    import sys
-
-    from grove_tpu.runtime.errors import GroveError
-
     try:
         return is_ready_to_start(store, namespace, config)
     except GroveError as e:
-        if e.code != "ERR_TRANSPORT":
+        if e.code != ERR_TRANSPORT:
             raise
         print(
             f"grove-tpu-initc: apiserver unavailable ({e.code}); retrying",

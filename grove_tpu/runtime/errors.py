@@ -27,6 +27,9 @@ ERR_VALIDATION = "ERR_VALIDATION"
 ERR_CONFLICT = "ERR_CONFLICT"
 ERR_NOT_FOUND = "ERR_NOT_FOUND"
 ERR_FORBIDDEN = "ERR_FORBIDDEN"
+# wire client: connection-level failure (refused/reset/timeout) — the one
+# code retry loops may classify as transient
+ERR_TRANSPORT = "ERR_TRANSPORT"
 
 
 class GroveError(Exception):

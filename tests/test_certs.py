@@ -1,0 +1,77 @@
+"""Webhook TLS certificate management (cluster/cert.py).
+
+Direct unit tier for the cert controller re-host (reference
+cert/cert.go:38-60): generation, SAN contents, idempotent reuse, and the
+rotation window. The TLS wire tier (tests/test_cluster_mode.py) already
+exercises the generated certs against a real HTTPS webhook server.
+"""
+
+import subprocess
+
+from grove_tpu.cluster.cert import CertPaths, ensure_certs, generate_certs
+
+
+def _cert_text(path) -> str:
+    return subprocess.run(
+        ["openssl", "x509", "-text", "-noout", "-in", str(path)],
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+
+
+class TestCerts:
+    def test_generate_produces_ca_signed_serving_cert(self, tmp_path):
+        paths = generate_certs(str(tmp_path), host="10.0.0.5")
+        assert all(
+            p.exists()
+            for p in (paths.ca_cert, paths.server_cert, paths.server_key)
+        )
+        text = _cert_text(paths.server_cert)
+        # the SUBJECT line specifically — the Issuer line also contains the
+        # CA's "grove-tpu-webhook-ca" CN and would satisfy a bare substring
+        subject = subprocess.run(
+            [
+                "openssl", "x509", "-subject", "-noout", "-in",
+                str(paths.server_cert),
+            ],
+            check=True, capture_output=True, text=True,
+        ).stdout.strip()
+        assert subject.replace(" ", "").endswith("CN=grove-tpu-webhook"), subject
+        # SANs cover the requested host plus loopback defaults
+        assert "10.0.0.5" in text
+        assert "localhost" in text
+        # signed by the CA, and the chain verifies
+        verify = subprocess.run(
+            [
+                "openssl", "verify", "-CAfile", str(paths.ca_cert),
+                str(paths.server_cert),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert verify.returncode == 0, verify.stderr
+
+    def test_dns_host_gets_dns_san(self, tmp_path):
+        paths = generate_certs(str(tmp_path), host="grove-tpu.grove-system.svc")
+        assert "grove-tpu.grove-system.svc" in _cert_text(paths.server_cert)
+
+    def test_ensure_is_idempotent(self, tmp_path):
+        first = ensure_certs(str(tmp_path))
+        before = first.server_cert.read_bytes()
+        second = ensure_certs(str(tmp_path))
+        assert isinstance(second, CertPaths)
+        assert second.server_cert.read_bytes() == before  # reused, not rotated
+
+    def test_rotation_window_regenerates(self, tmp_path):
+        # a 1-day cert is inside the default 30-day rotation window
+        generate_certs(str(tmp_path), days=1)
+        before = (tmp_path / "tls.crt").read_bytes()
+        rotated = ensure_certs(str(tmp_path))
+        assert rotated.server_cert.read_bytes() != before
+
+    def test_missing_files_regenerate(self, tmp_path):
+        paths = ensure_certs(str(tmp_path))
+        paths.server_key.unlink()
+        again = ensure_certs(str(tmp_path))
+        assert again.server_key.exists()
