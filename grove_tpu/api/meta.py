@@ -17,6 +17,7 @@ import copy
 import itertools
 import pickle
 import re
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -123,10 +124,17 @@ def set_condition(conditions: List[Condition], new: Condition, now: float) -> bo
 # ---------------------------------------------------------------------------
 
 _uid_counter = itertools.count(1)
+# Store-incarnation token: purely sequential uids repeat across apiserver
+# restarts, so an operator surviving a restart (HttpStore reconnect) could
+# see a RE-created object reuse a (uid, generation) pair and serve a stale
+# cached template hash (api/hashing.py keys on exactly that pair — wrong
+# pod-template-hash labels, missed rolling updates). The random token makes
+# uids unique per store incarnation, like k8s's uuid-based object UIDs.
+_UID_TOKEN = uuid.uuid4().hex[:8]
 
 
 def next_uid() -> str:
-    return f"uid-{next(_uid_counter)}"
+    return f"uid-{_UID_TOKEN}-{next(_uid_counter)}"
 
 
 @dataclass

@@ -81,6 +81,17 @@ class GangInputs(NamedTuple):
     # replacements away from already-loaded survivor domains (the spread
     # analogue of the pack path's gang_pin)
     spread_seed: jnp.ndarray = None  # [D]
+    # demand-dedup PAIR index ([P] int32, None = dedup off): row u of the
+    # chunk's shared `cs_pair [U, N+1]` capped-fit prefix-sum table for this
+    # group's (demand row, count) pair. Gangs stamped from a handful of
+    # templates repeat identical (demand, count) pairs ~100x in the stress
+    # mix; the wave solver computes min(_pods_fit_per_node, count) + cumsum
+    # once per UNIQUE pair per chunk, and each gang's candidate scan becomes
+    # pure boundary gathers — BIT-exact (same integer ops on the same
+    # values), the per-gang [P,N,R] divide and [P,N] cumsum disappear.
+    # Row 0 is reserved all-zero: gangs masked out by the pending filter
+    # (count == 0) redirect here on device.
+    uidx: jnp.ndarray = None  # [P]
 
 
 def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
@@ -437,20 +448,31 @@ def _gang_pin_mask(
     return pin_mask, free_vis
 
 
-def _aggregate_tables(free: jnp.ndarray, gang: GangInputs):
+def _aggregate_tables(free: jnp.ndarray, gang: GangInputs, cs_pair=None):
     """Shared prelude of both per-gang selectors: capped per-node fit counts,
     prefix-sum tables for boundary gathers, float-cumsum tolerance, and the
-    admission floor's joint resource demand."""
+    admission floor's joint resource demand.
+
+    `cs_pair [U, N+1]` (wave path only): pre-computed capped-fit prefix sums
+    for the chunk's unique (demand, count) pairs against the SHARED capacity
+    snapshot — the per-gang [P,N,R] divide, count cap, and [P,N] cumsum all
+    collapse into the shared table; the level loop gathers the SAME integer
+    values at segment boundaries (bit-exact). `cs_k` comes back None on that
+    path. Only valid when every gang in the vmap sees the same `free` (never
+    under recovery pins, whose `free_vis` differs per gang — caller guards)."""
     active = gang.count > 0
-    k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
-    # cap per-node fits at the group count: preserves every >=min/>=count
-    # comparison (sum-of-mins bound) while keeping int32 prefix sums exact
-    k_all = jnp.minimum(k_all, gang.count[:, None])
+    if cs_pair is not None and gang.uidx is not None:
+        cs_k = None  # level loop gathers from cs_pair via the gang's uidx
+    else:
+        k_all = jax.vmap(lambda d: _pods_fit_per_node(free, d))(gang.demand)  # [P,N]
+        # cap per-node fits at the group count: preserves every >=min/>=count
+        # comparison (sum-of-mins bound) while keeping int32 prefix sums exact
+        k_all = jnp.minimum(k_all, gang.count[:, None])
+        zero_col = jnp.zeros((k_all.shape[0], 1), dtype=k_all.dtype)
+        cs_k = jnp.concatenate([zero_col, jnp.cumsum(k_all, axis=1)], axis=1)
     min_demand = jnp.sum(
         gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
     )  # [R]
-    zero_col = jnp.zeros((k_all.shape[0], 1), dtype=k_all.dtype)
-    cs_k = jnp.concatenate([zero_col, jnp.cumsum(k_all, axis=1)], axis=1)
     cs_free = jnp.concatenate(
         [jnp.zeros((1, free.shape[1]), dtype=free.dtype), jnp.cumsum(free, axis=0)],
         axis=0,
@@ -761,6 +783,9 @@ def solve_wave_chunk(
     spread_min: jnp.ndarray = None,  # [C]
     spread_required: jnp.ndarray = None,  # [C]
     spread_seed: jnp.ndarray = None,  # [C, D]
+    pair_demand: jnp.ndarray = None,  # [U, R]
+    pair_count: jnp.ndarray = None,  # [U]
+    pair_idx: jnp.ndarray = None,  # [C, P]
     commit_iters: int = 2,
     grouped: bool = False,
     pinned: bool = False,
@@ -802,6 +827,9 @@ def solve_wave_chunk(
             grouped,
             pinned,
             spread,
+            pair_dem=pair_demand,
+            pair_cap=pair_count,
+            uidx=pair_idx,
         )
     )
     n_levels = topo.shape[1]
@@ -830,24 +858,48 @@ def wave_chunk_core(
     dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
     spreadlvl, spreadmin, spreadreq, spreadseed, commit_iters,
     grouped=False, pinned=False, spread=False,
+    pair_dem=None, pair_cap=None, uidx=None,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
     prefix-acceptance with a final joint-feasibility guarantee, and produce
     the retry/narrow-cap bookkeeping for the next wave.
+
+    `pair_dem [U,R]` + `pair_cap [U]` + `uidx [C,P]` (optional, encode-time
+    demand dedup — kernel.dedup_demand): the candidate scan's capped-fit
+    prefix sums are computed once per UNIQUE (demand, count) pair against
+    the shared snapshot; each gang's level loop then gathers the SAME
+    integer values at segment boundaries (bit-exact), eliminating the
+    per-gang divide + cumsum that dominates wave 1 in template-stamped
+    populations. Disabled under `pinned` (per-gang `free_vis` breaks the
+    shared-snapshot premise).
     Returns (free, accept, placed, score, chosen, retry, new_cap,
     fill_failed, alloc)."""
     cnt = cnt * pend[:, None]
+    use_dedup = pair_dem is not None and uidx is not None and not pinned
+    cs_pair = None
+    if use_dedup:
+        fit_pair = jax.vmap(
+            lambda d, cap: jnp.minimum(_pods_fit_per_node(free, d), cap)
+        )(pair_dem, pair_cap)  # [U, N]
+        cs_pair = jnp.concatenate(
+            [
+                jnp.zeros((fit_pair.shape[0], 1), dtype=fit_pair.dtype),
+                jnp.cumsum(fit_pair, axis=1),
+            ],
+            axis=1,
+        )  # [U, N+1]
     inputs = GangInputs(
         dem, cnt, mn, rq, pf, grq, gpin, gangpin,
         spreadlvl, spreadmin, spreadreq, spreadseed,
+        uidx if use_dedup else None,
     )
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
         lambda *xs: gang_select_single(
             *xs, grouped=grouped, pinned=pinned, spread=spread
         ),
-        in_axes=(None, None, None, None, 0, 0, 0),
-    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds)
+        in_axes=(None, None, None, None, 0, 0, 0, None),
+    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds, cs_pair)
 
     usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
     accept = ok
@@ -883,6 +935,7 @@ def wave_chunk_core(
 
 def gang_select_single(
     free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
+    cs_pair=None,
     grouped: bool = False, pinned: bool = False, spread: bool = False,
 ):
     """Single-fill variant of gang_select_and_fill for the wave solver.
@@ -902,14 +955,25 @@ def gang_select_single(
 
     pin_mask, free_vis = _gang_pin_mask(free, topo, gang, pinned)
     active, cs_k, cs_free, free_tol, min_demand = _aggregate_tables(
-        free_vis, gang
+        free_vis, gang, cs_pair
     )
     any_active = jnp.any(active)
+    if cs_k is None:
+        # dedup path: redirect masked-out gangs (count zeroed by the pending
+        # filter) to the reserved all-zero row 0, then gather the capped-fit
+        # prefix sums at segment boundaries only
+        eff = jnp.where(active, gang.uidx, 0)
 
     oks, bests = [], []
     for l in range(n_levels):
         starts, ends = seg_starts[l], seg_ends[l]
-        K = cs_k[:, ends] - cs_k[:, starts]
+        if cs_k is None:
+            K = (
+                cs_pair[eff[:, None], ends[None, :]]
+                - cs_pair[eff[:, None], starts[None, :]]
+            )  # [P, D]
+        else:
+            K = cs_k[:, ends] - cs_k[:, starts]
         free_agg = cs_free[ends] - cs_free[starts]
         feas = jnp.all(
             jnp.where(active[:, None], K >= gang.min_count[:, None], True), axis=0
@@ -1083,6 +1147,9 @@ def solve_waves_device(
     spread_min=None,  # [G]
     spread_required=None,  # [G]
     spread_seed=None,  # [G, D]
+    pair_demand=None,  # [U, R] encode-time demand dedup (kernel.dedup_demand)
+    pair_count=None,  # [U]
+    pair_idx=None,  # [G, P]
     n_chunks: int = 20,
     max_waves: int = 8,
     commit_iters: int = 2,
@@ -1114,6 +1181,12 @@ def solve_waves_device(
     spread_level, spread_min, spread_required, spread_seed = _spread_defaults(
         (g_total,), spread_level, spread_min, spread_required, spread_seed
     )
+    use_dedup = (
+        pair_demand is not None
+        and pair_count is not None
+        and pair_idx is not None
+        and not pinned
+    )
     c = g_total // n_chunks
 
     def reshape_chunks(a):
@@ -1135,10 +1208,7 @@ def solve_waves_device(
     def chunk_step(free, xs):
         # settled chunks skip the whole decision+commit (lax.cond executes
         # one branch): waves after the first mostly touch a few chunks
-        (
-            dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
-            slvl, smin, sreq, sseed,
-        ) = xs
+        dem, pend, ncap = xs[0], xs[5], xs[6]
         c_gangs = dem.shape[0]
 
         def passthrough(free):
@@ -1160,13 +1230,17 @@ def solve_waves_device(
         (
             dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
             slvl, smin, sreq, sseed,
-        ) = xs
+        ) = xs[:15]
+        uidx_c = xs[15] if use_dedup else None
         free, accept, placed, score, chosen, retry, new_cap, fill_failed, _ = (
             wave_chunk_core(
                 free, topo, seg_starts, seg_ends,
                 dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
                 slvl, smin, sreq, sseed,
                 commit_iters, grouped, pinned, spread,
+                pair_dem=pair_demand if use_dedup else None,
+                pair_cap=pair_count if use_dedup else None,
+                uidx=uidx_c,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)
@@ -1198,7 +1272,8 @@ def solve_waves_device(
                 reshape_chunks(spread_min),
                 reshape_chunks(spread_required),
                 reshape_chunks(spread_seed),
-            ),
+            )
+            + ((reshape_chunks(pair_idx),) if use_dedup else ()),
         )
         accept, placed, score, chosen, retry, new_cap, fill_failed = (
             y.reshape((g_total,) + y.shape[2:]) for y in ys

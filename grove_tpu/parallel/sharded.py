@@ -122,7 +122,7 @@ def solve_stress_sharded(
     is purely a throughput/memory choice, never a semantics one.
     """
     from grove_tpu.ops.packing import solve_waves_device
-    from grove_tpu.solver.kernel import pad_problem_for_waves
+    from grove_tpu.solver.kernel import dedup_extra_args, pad_problem_for_waves
 
     g = problem.num_gangs
     raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
@@ -137,9 +137,17 @@ def solve_stress_sharded(
         jax.device_put(jnp.asarray(a), s)
         for a, s in zip(raw_args, shardings)
     ]
+    # demand dedup (exact — admissions stay bit-identical, see kernel.py);
+    # the shared capped-fit table carries the node axis so its cumsum and
+    # boundary gathers shard/communicate exactly like capacity's
+    extra = dedup_extra_args(
+        raw_args[4], raw_args[5], n_chunks, pinned,
+        place=lambda a: jax.device_put(jnp.asarray(a), rep),
+    )
     with mesh:
         out = solve_waves_device(
             *placed,
+            **extra,
             n_chunks=n_chunks,
             max_waves=max_waves,
             grouped=grouped,

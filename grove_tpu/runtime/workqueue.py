@@ -18,6 +18,15 @@ Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 BASE_BACKOFF = 0.005
 MAX_BACKOFF = 1000.0
+# a zero (or negative) requeue delay would make the key ready again within
+# the SAME engine drain round — `Engine.drain` freezes `now` per call and
+# drains each controller's whole ready set, so the re-add would livelock
+# inside one round, bypassing the max_rounds backstop. Floor every delayed
+# re-add at a strictly positive epsilon: the key lands in the NEXT drain.
+# 1us, NOT something tinier: `now` under the wall Clock is ~1.7e9 where the
+# float64 ULP is ~2.4e-7 — an epsilon below that would vanish in the
+# addition and resurrect the livelock.
+MIN_DELAY = 1e-6
 
 
 @dataclass(order=True)
@@ -41,6 +50,7 @@ class WorkQueue:
             self._ready.append(key)
 
     def add_after(self, key: Key, delay: float, now: float) -> None:
+        delay = max(delay, MIN_DELAY)
         heapq.heappush(self._delayed, _Delayed(now + delay, next(self._seq), key))
 
     def add_rate_limited(self, key: Key, now: float) -> None:

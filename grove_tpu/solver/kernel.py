@@ -136,6 +136,69 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
     )
 
 
+def dedup_demand(demand: np.ndarray, count: np.ndarray, chunk_size: int):
+    """Encode-time (demand, count)-pair dedup for the wave solvers.
+
+    Template-stamped gang populations repeat identical (demand row, count)
+    pairs (the 10k-gang stress mix has ~30 unique pairs across 30k rows):
+    the wave kernel computes the candidate scan's capped-fit prefix sums
+    once per UNIQUE pair per chunk and turns each gang's level loop into
+    boundary gathers of the SAME integer values — bit-exact, no semantics
+    change (packing.wave_chunk_core). Returns
+    `(pair_demand [U,R], pair_count [U], pair_idx [G,P])` with row 0
+    reserved all-zero (gangs masked out by the pending filter redirect
+    there on device), or `(None, None, None)` when dedup cannot pay: the
+    shared table is recomputed per chunk (capacity changes), so it only
+    wins when U is well below the chunk's own C*P row count.
+    """
+    g, p, r = demand.shape
+    key = np.concatenate(
+        [
+            np.ascontiguousarray(demand.reshape(g * p, r)),
+            count.reshape(g * p, 1).astype(demand.dtype),
+        ],
+        axis=1,
+    )
+    uniq, inv = np.unique(key, axis=0, return_inverse=True)
+    if (uniq[0] != 0).any():
+        # demands/counts are non-negative, so an all-zero row sorts first
+        # when present; otherwise reserve index 0 explicitly
+        uniq = np.vstack([np.zeros((1, r + 1), dtype=uniq.dtype), uniq])
+        inv = inv + 1
+    if uniq.shape[0] * 2 > chunk_size * p:
+        return None, None, None
+    return (
+        uniq[:, :r].astype(demand.dtype, copy=False),
+        uniq[:, r].astype(np.int32),
+        inv.reshape(g, p).astype(np.int32),
+    )
+
+
+def dedup_extra_args(
+    demand: np.ndarray, count: np.ndarray, n_chunks: int, pinned: bool,
+    place=None,
+) -> dict:
+    """The ONE home for the dedup guard + decline heuristic + packaging:
+    kwargs for the wave solvers' `pair_*` params ({} when dedup is off).
+    Shared by the stats path, the binding path, and the node-sharded
+    multi-chip path so the three can never diverge. `pinned` problems skip
+    dedup (per-gang capacity views break the shared-snapshot premise);
+    `place` overrides device placement (the sharded path replicates)."""
+    if pinned:
+        return {}
+    pdem, pcnt, pidx = dedup_demand(
+        demand, count, demand.shape[0] // n_chunks
+    )
+    if pdem is None:
+        return {}
+    place = place or jnp.asarray
+    return {
+        "pair_demand": place(pdem),
+        "pair_count": place(pcnt),
+        "pair_idx": place(pidx),
+    }
+
+
 def solve_waves(
     problem: PackingProblem,
     chunk_size: int = 32,
@@ -202,6 +265,14 @@ def solve_waves(
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
     spread = bool((spread_level >= 0).any())
+    dedup_extra = dedup_extra_args(demand, count, n_chunks, pinned)
+    pidx_chunks = None
+    if dedup_extra:
+        pidx_full = dedup_extra.pop("pair_idx")
+        pidx_chunks = [
+            pidx_full[c * chunk_size : (c + 1) * chunk_size]
+            for c in range(n_chunks)
+        ]
     # immutable chunk tensors go to the device ONCE (only mask/cap/seeds
     # change between waves; re-uploading per wave would pay the remote-link
     # latency this path exists to avoid)
@@ -257,6 +328,9 @@ def solve_waves(
                 spread_min=smin_c,
                 spread_required=sreq_c,
                 spread_seed=sseed_c,
+                pair_demand=dedup_extra.get("pair_demand"),
+                pair_count=dedup_extra.get("pair_count"),
+                pair_idx=None if pidx_chunks is None else pidx_chunks[c],
                 grouped=grouped,
                 pinned=pinned,
                 spread=spread,
@@ -357,7 +431,10 @@ def solve_waves_stats(
         problem, chunk_size
     )
     args = tuple(jnp.asarray(a) for a in raw_args)
+    # encode-time demand dedup (exact semantics; packing.wave_chunk_core)
+    extra = dedup_extra_args(raw_args[4], raw_args[5], n_chunks, pinned)
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
+        tuple(extra["pair_demand"].shape) if extra else None,
         n_chunks,
         max_waves,
         grouped,
@@ -370,6 +447,7 @@ def solve_waves_stats(
         t0 = time.perf_counter()
         compiled = solve_waves_device.lower(
             *args,
+            **extra,
             n_chunks=n_chunks,
             max_waves=max_waves,
             grouped=grouped,
@@ -379,7 +457,7 @@ def solve_waves_stats(
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
     t0 = time.perf_counter()
-    out = compiled(*args)
+    out = compiled(*args, **extra)
     admitted = np.array(out["admitted"])[:g]
     elapsed = time.perf_counter() - t0  # wave execution (sync on admitted)
     placed = np.array(out["placed"])[:g]

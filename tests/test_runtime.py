@@ -157,6 +157,22 @@ class TestWorkQueue:
         assert q.pop(5.0) is None
         assert q.pop(10.0) == key
 
+    def test_zero_delay_readd_is_not_ready_at_same_now(self):
+        """requeue_after(0) must NOT be poppable at the same frozen `now`:
+        Engine.drain drains each controller's whole ready set per round, so
+        an immediately-ready re-add would livelock inside one round and
+        bypass the max_rounds backstop (round-3 advisor). The floored delay
+        lands it in the next drain instead."""
+        q = WorkQueue()
+        key = ("PodClique", "default", "a")
+        # wall-clock-magnitude `now`: the epsilon must survive float64
+        # addition at ~1.7e9 (ULP ~2.4e-7), not just at toy sim times
+        now = 1.7e9
+        q.add_after(key, 0.0, now=now)
+        assert q.pop(now) is None
+        assert q.next_delayed_at() > now
+        assert q.pop(now + 1.0) == key
+
     def test_backoff_grows(self):
         q = WorkQueue()
         key = ("PodClique", "default", "a")

@@ -326,6 +326,50 @@ class TestWaveSolver:
         assert list(waves.admitted) == list(exact.admitted)
         np.testing.assert_array_equal(waves.placed, exact.placed)
 
+    def test_demand_dedup_is_bit_identical(self, monkeypatch):
+        """Encode-time demand dedup (wave-1 candidate-scan sharing) is a pure
+        execution optimization: every output of the device-resident wave
+        solve must be BIT-identical with dedup on vs off. The stress mix is
+        template-stamped, so the dedup path genuinely engages (~17 unique
+        rows; asserted)."""
+        import grove_tpu.solver.kernel as kernel_mod
+        from grove_tpu.models import build_stress_problem
+        from grove_tpu.solver.kernel import dedup_demand, solve_waves_stats
+
+        problem = build_stress_problem(256, 512)
+        pdem, pcnt, pidx = dedup_demand(problem.demand, problem.count, 128)
+        assert pdem is not None and pdem.shape[0] < 64, (
+            "stress mix must engage the dedup path"
+        )
+        assert pidx.shape == problem.demand.shape[:2]
+        # row 0 is the reserved all-zero pair; gathered rows reconstruct the
+        # original (demand, count) pairs exactly
+        assert (pdem[0] == 0).all() and pcnt[0] == 0
+        np.testing.assert_array_equal(pdem[pidx], problem.demand)
+        np.testing.assert_array_equal(pcnt[pidx], problem.count)
+
+        r_on = solve_waves_stats(problem, chunk_size=128, max_waves=16)
+        monkeypatch.setattr(
+            kernel_mod, "dedup_demand", lambda d, c, s: (None, None, None)
+        )
+        r_off = solve_waves_stats(problem, chunk_size=128, max_waves=16)
+        np.testing.assert_array_equal(r_on.admitted, r_off.admitted)
+        np.testing.assert_array_equal(r_on.placed, r_off.placed)
+        np.testing.assert_array_equal(r_on.score, r_off.score)
+        np.testing.assert_array_equal(r_on.chosen_level, r_off.chosen_level)
+        np.testing.assert_array_equal(r_on.free_after, r_off.free_after)
+
+    def test_dedup_declines_when_rows_mostly_unique(self):
+        """dedup_demand must hand back (None, None) when the shared table
+        would not pay (U not far below the chunk's own row count)."""
+        from grove_tpu.solver.kernel import dedup_demand
+
+        rng = np.random.default_rng(0)
+        demand = rng.uniform(1.0, 100.0, size=(64, 2, 3))
+        count = rng.integers(1, 5, size=(64, 2))
+        pdem, pcnt, pidx = dedup_demand(demand, count, 8)
+        assert pdem is None and pcnt is None and pidx is None
+
 
 class TestSpreadConstraints:
     """Topology spread (grove-tpu extension; the reference lists 'Topology
