@@ -278,6 +278,39 @@ class Store:
         self._index_add(stored)
         return blob
 
+    def verify_readonly_integrity(self) -> int:
+        """Test-mode write barrier for the zero-copy readonly contract
+        (`scan()` / `get(readonly=True)` / watch payloads): every committed
+        object must still match its canonical blob byte-for-byte. A caller
+        that mutated a readonly view in place diverges the object from the
+        blob — the exact silent-corruption class the zero-copy optimization
+        created — and fails HERE with the object named, instead of
+        corrupting store state invisibly. O(total blob bytes), so it is
+        wired to test harnesses (SimHarness under GROVE_TPU_STORE_GUARD),
+        not production paths. Returns the number of objects verified;
+        committed objects with no canonical blob (unpicklable — reads fall
+        back to deep_copy) cannot be byte-compared and are tallied in
+        `self.unverified_readonly` so the coverage gap is visible rather
+        than silent."""
+        checked = 0
+        self.unverified_readonly = 0
+        for kind, view in self._committed.items():
+            blobs = self._blob.get(kind, {})
+            for key, obj in view.items():
+                blob = blobs.get(key)
+                if blob is None:
+                    self.unverified_readonly += 1
+                    continue
+                if _dumps(obj) != blob:
+                    raise AssertionError(
+                        f"readonly contract violated: committed {kind} {key} "
+                        "no longer matches its canonical blob — some caller "
+                        "mutated a scan()/get(readonly=True)/watch view in "
+                        "place (deep_copy before building updates)"
+                    )
+                checked += 1
+        return checked
+
     def _uncommit(self, obj) -> Optional[bytes]:
         key = obj_key(obj)
         self._committed.get(obj.kind, {}).pop(key, None)

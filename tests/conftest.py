@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# test-mode write barrier: SimHarness.converge verifies every committed
+# object still matches its canonical blob, so a reconciler mutating a
+# zero-copy readonly view (scan / get(readonly=True) / watch payload)
+# fails the suite loudly instead of corrupting store state silently
+os.environ.setdefault("GROVE_TPU_STORE_GUARD", "1")
 
 import jax  # noqa: E402
 
