@@ -38,9 +38,13 @@ import time
 # configured; the marker env var prevents a loop. Guarded on __main__ so
 # `import bench` (tests) can never execve the importing process. Real
 # backend failures surface as Python exceptions regardless of log level.
+# Only the unset case and the image's known startup default ("1") are
+# overridden — an operator who EXPLICITLY exports 0 or 2 to see the C++
+# logs keeps them (we cannot distinguish an explicit "1", the one
+# ambiguous value; _GROVE_BENCH_REEXEC=1 is the manual escape hatch).
 if (
     __name__ == "__main__"
-    and os.environ.get("TF_CPP_MIN_LOG_LEVEL") != "3"
+    and os.environ.get("TF_CPP_MIN_LOG_LEVEL") in (None, "1")
     and "_GROVE_BENCH_REEXEC" not in os.environ
 ):
     os.execve(

@@ -107,7 +107,7 @@ def solve_stress_sharded(
     mesh: Mesh,
     problem,
     chunk_size: int = 128,
-    max_waves: int = 16,
+    max_waves: int = 32,
 ):
     """ONE large placement problem with the NODE axis sharded across every
     device of the mesh's ``tp`` axis — the flagship multi-chip path: each

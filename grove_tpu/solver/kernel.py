@@ -202,7 +202,7 @@ def dedup_extra_args(
 def solve_waves(
     problem: PackingProblem,
     chunk_size: int = 32,
-    max_waves: int = 16,
+    max_waves: int = 32,
     with_alloc: bool = True,
 ) -> PackingResult:
     """Wave-parallel solve WITH per-pod allocations (the binding path).
@@ -421,7 +421,7 @@ def pad_problem_for_waves(
 def solve_waves_stats(
     problem: PackingProblem,
     chunk_size: int = 128,
-    max_waves: int = 16,
+    max_waves: int = 32,
 ) -> PackingResult:
     """Device-resident wave solve (ops.packing.solve_waves_device): the whole
     multi-wave loop runs as one XLA program — the stress-bench path. Returns

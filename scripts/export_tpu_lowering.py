@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Deviceless TPU lowering proof for the bench program (round-4 VERDICT #2).
+
+The axon-tunneled chip has been wedged for three rounds, so the headline
+TPU claim has only round-1/2 self-measurement behind it. This script
+converts "should run on TPU" into "compiles for TPU today" WITHOUT a chip:
+it AOT-lowers the EXACT bench program — `ops.packing.solve_waves_device`
+at the BASELINE full-size shape (10,240 gangs x 5,120 nodes, chunk 128,
+demand dedup on: the very callable `solver.kernel.solve_waves_stats`
+compiles for bench.py) — plus the GSPMD node-sharded 8-device variant and
+a small drift-sentinel shape, all for platform `tpu` via `jax.export`.
+
+The serialized StableHLO artifacts are committed under
+`artifacts/tpu_lowering/` and drift-tested (tests/test_tpu_lowering.py):
+the moment a chip window opens, measurement is `export.deserialize(bytes)`
++ compile + run, nothing else. `meta.json` records shapes, hashes, and
+MXU-relevant op statistics of the lowered modules.
+
+Usage: python scripts/export_tpu_lowering.py   (re-run after kernel changes;
+the drift test names this command when the sentinel hash mismatches)
+"""
+
+import hashlib
+import json
+import os
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# deviceless: lowering must never touch (or hang on) the axon tunnel, and
+# the sharded export needs 8 virtual devices
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+OUT_DIR = REPO / "artifacts" / "tpu_lowering"
+
+# ops whose counts say something about how the program maps to the TPU:
+# while (the wave loop stays device-resident), dot_general (MXU), gather /
+# scatter (sparse memory traffic the design avoids on the hot path),
+# reduce + sort (VPU collectives-adjacent). Each name is matched with a
+# word-boundary lookahead so `stablehlo.reduce` does not also count
+# `reduce_window` (and `gather` does not count nothing — MLIR prints the
+# op name followed by `(` or a space).
+_STAT_OPS = (
+    "stablehlo.while",
+    "stablehlo.dot_general",
+    "stablehlo.gather",
+    "stablehlo.scatter",
+    "stablehlo.reduce",
+    "stablehlo.sort",
+    "stablehlo.convolution",
+)
+
+
+def _module_stats(mlir_text: str) -> dict:
+    return {
+        op: len(re.findall(re.escape(op) + r"(?![_\w])", mlir_text))
+        for op in _STAT_OPS
+    }
+
+
+def _export_one(name: str, fn, args, kwargs, static, meta_extra=None):
+    import jax
+    from jax import export
+
+    exp = export.export(fn, platforms=["tpu"])(*args, **kwargs, **static)
+    data = exp.serialize()
+    path = OUT_DIR / f"{name}.tpu.stablehlo"
+    path.write_bytes(data)
+    mlir = exp.mlir_module()
+    entry = {
+        "file": path.name,
+        "bytes": len(data),
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "platforms": list(exp.platforms),
+        "nr_devices": exp.nr_devices,
+        "in_avals": [str(a) for a in exp.in_avals],
+        "module_ops": _module_stats(mlir),
+        "static": {k: str(v) for k, v in static.items()},
+    }
+    if meta_extra:
+        entry.update(meta_extra)
+    print(
+        f"{name}: {len(data)} bytes, {exp.nr_devices} device(s), "
+        f"ops={entry['module_ops']}"
+    )
+    return entry
+
+
+def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int):
+    """(args, extra, static) exactly as solve_waves_stats builds them."""
+    import jax.numpy as jnp
+
+    from grove_tpu.models import build_stress_problem
+    from grove_tpu.solver.kernel import dedup_extra_args, pad_problem_for_waves
+
+    problem = build_stress_problem(n_nodes, n_gangs)
+    raw, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
+        problem, chunk
+    )
+    args = tuple(jnp.asarray(a) for a in raw)
+    extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
+    static = dict(
+        n_chunks=n_chunks,
+        max_waves=16,
+        grouped=grouped,
+        pinned=pinned,
+        spread=spread,
+    )
+    return args, extra, static
+
+
+def main() -> int:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from grove_tpu.ops.packing import solve_waves_device
+    from grove_tpu.parallel.sharded import make_solver_mesh
+
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    meta = {"jax_version": jax.__version__, "programs": []}
+
+    # 0) drift sentinel: small shape, cheap to re-export inside the test
+    #    suite. NOTE the drift compare is STRUCTURAL (module op counts +
+    #    input avals), not serialized bytes: jax.export serialization
+    #    embeds per-process naming state, so byte equality only holds
+    #    within one process (verified empirically) — op counts are a
+    #    process-independent fingerprint of the lowered program.
+    args_s, extra_s, static_s = _stress_export_inputs(512, 1024, 128)
+    meta["programs"].append(
+        _export_one(
+            "solve_waves_sentinel",
+            solve_waves_device,
+            args_s,
+            extra_s,
+            static_s,
+            {"shape": "1024 gangs x 512 nodes, chunk 128 (drift sentinel)"},
+        )
+    )
+
+    # 1) the full-size bench program (single device) — what bench.py times
+    args, extra, static = _stress_export_inputs(5120, 10240, 128)
+    meta["programs"].append(
+        _export_one(
+            "solve_waves_full",
+            solve_waves_device,
+            args,
+            extra,
+            static,
+            {"shape": "10240 gangs x 5120 nodes, chunk 128 (BASELINE)"},
+        )
+    )
+
+    # 2) the GSPMD node-sharded variant on an 8-device mesh — what
+    #    parallel.sharded.solve_stress_sharded runs (full-size shape)
+    mesh = make_solver_mesh(8)
+    node_sh = NamedSharding(mesh, P("tp", None))
+    rep = NamedSharding(mesh, P())
+    shardings = (node_sh, node_sh) + (rep,) * (len(args) - 2)
+    placed = tuple(
+        jax.device_put(a, s) for a, s in zip(args, shardings)
+    )
+    extra_placed = {k: jax.device_put(v, rep) for k, v in extra.items()}
+    with mesh:
+        meta["programs"].append(
+            _export_one(
+                "solve_waves_sharded8",
+                solve_waves_device,
+                placed,
+                extra_placed,
+                static,
+                {
+                    "shape": "10240 gangs x 5120 nodes, chunk 128, "
+                    "node axis sharded over mesh tp=2 (8 devices)",
+                },
+            )
+        )
+
+    (OUT_DIR / "meta.json").write_text(json.dumps(meta, indent=2) + "\n")
+    print(f"wrote {OUT_DIR}/meta.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

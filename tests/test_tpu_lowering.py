@@ -1,0 +1,130 @@
+"""Deviceless TPU lowering proof (round-4 VERDICT missing #1).
+
+The committed StableHLO artifacts under artifacts/tpu_lowering/ prove the
+EXACT bench program (and its GSPMD node-sharded variant) lowers for
+platform `tpu` without a chip — so a healthy chip window goes straight to
+measurement (deserialize + compile + run). Three tiers:
+
+1. the committed artifacts deserialize, target tpu, and match their
+   recorded hashes (artifact integrity);
+2. the full-size artifact's input avals match what the CURRENT encode path
+   produces for the BASELINE shape (shape-contract drift);
+3. a fresh small-shape export must SUCCEED (today's kernel lowers for
+   tpu) and structurally match the committed sentinel — module op counts
+   + input avals, NOT bytes: jax.export serialization embeds per-process
+   naming state, so byte equality only reproduces within one process.
+   The structural fingerprint cannot see changes confined to op
+   attributes/constants; re-run the export script after any kernel
+   change regardless.
+
+On drift: re-run `python scripts/export_tpu_lowering.py` and commit.
+"""
+
+import hashlib
+import json
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+ART = REPO / "artifacts" / "tpu_lowering"
+
+EXPECTED_FILES = {
+    "solve_waves_full.tpu.stablehlo",
+    "solve_waves_sharded8.tpu.stablehlo",
+    "solve_waves_sentinel.tpu.stablehlo",
+}
+
+
+def _meta():
+    return json.loads((ART / "meta.json").read_text())
+
+
+class TestTPULowering:
+    def test_committed_artifacts_deserialize_for_tpu(self):
+        from jax import export
+
+        meta = _meta()
+        assert {p["file"] for p in meta["programs"]} == EXPECTED_FILES
+        for prog in meta["programs"]:
+            data = (ART / prog["file"]).read_bytes()
+            assert hashlib.sha256(data).hexdigest() == prog["sha256"], (
+                f"{prog['file']} does not match meta.json — re-run "
+                "scripts/export_tpu_lowering.py"
+            )
+            exp = export.deserialize(data)
+            assert exp.platforms == ("tpu",), prog["file"]
+            assert exp.nr_devices == prog["nr_devices"]
+            # the wave loop is device-resident in the lowered module (no
+            # host round trips to hide behind a slow tunnel)
+            if prog["module_ops"] is not None:
+                assert prog["module_ops"]["stablehlo.while"] >= 1
+
+    def test_sharded_artifact_is_8_device(self):
+        meta = _meta()
+        by_name = {p["file"]: p for p in meta["programs"]}
+        assert by_name["solve_waves_sharded8.tpu.stablehlo"]["nr_devices"] == 8
+        assert by_name["solve_waves_full.tpu.stablehlo"]["nr_devices"] == 1
+
+    def test_full_size_avals_match_current_bench_contract(self):
+        """The committed full-size artifact was exported from the same
+        input-prep path bench.py compiles — if the encoder's shapes or the
+        dedup packaging change, this catches the stale artifact."""
+        import jax.numpy as jnp
+
+        from grove_tpu.models import build_stress_problem
+        from grove_tpu.solver.kernel import (
+            dedup_extra_args,
+            pad_problem_for_waves,
+        )
+
+        problem = build_stress_problem(5120, 10240)
+        raw, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
+            problem, 128
+        )
+        args = [jnp.asarray(a) for a in raw]
+        extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
+        # jax.export flattens kwargs in sorted-key order after positionals
+        expected = [
+            f"{a.dtype}[{','.join(str(d) for d in a.shape)}]"
+            for a in args + [v for _, v in sorted(extra.items())]
+        ]
+        by_name = {p["file"]: p for p in _meta()["programs"]}
+        got = by_name["solve_waves_full.tpu.stablehlo"]["in_avals"]
+        assert got == expected, (
+            "bench input contract drifted from the committed TPU artifact "
+            "— re-run scripts/export_tpu_lowering.py"
+        )
+
+    def test_sentinel_matches_current_kernel(self):
+        """A FRESH small-shape TPU export must succeed right now (the core
+        deviceless claim: today's kernel lowers for platform tpu) and its
+        structural fingerprint — module op counts + input avals — must
+        match the committed sentinel. Byte equality is deliberately NOT
+        asserted: jax.export serialization embeds per-process naming
+        state, so bytes only reproduce within one process; op counts are
+        process-independent and flip on real kernel changes."""
+        from jax import export as jexport
+
+        from grove_tpu.ops.packing import solve_waves_device
+        from scripts.export_tpu_lowering import (
+            _module_stats,
+            _stress_export_inputs,
+        )
+
+        args, extra, static = _stress_export_inputs(512, 1024, 128)
+        exp = jexport.export(solve_waves_device, platforms=["tpu"])(
+            *args, **extra, **static
+        )
+        assert exp.platforms == ("tpu",)
+        by_name = {p["file"]: p for p in _meta()["programs"]}
+        committed = by_name["solve_waves_sentinel.tpu.stablehlo"]
+        fresh_ops = _module_stats(exp.mlir_module())
+        assert fresh_ops == committed["module_ops"], (
+            "the wave kernel's TPU lowering changed — re-run "
+            "scripts/export_tpu_lowering.py and commit the refreshed "
+            "artifacts"
+        )
+        fresh_avals = [str(a) for a in exp.in_avals]
+        assert fresh_avals == committed["in_avals"], (
+            "sentinel input contract drifted — re-run "
+            "scripts/export_tpu_lowering.py"
+        )
