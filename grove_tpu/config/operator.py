@@ -72,7 +72,7 @@ class SolverConfig:
     piece the reference delegates to KAI)."""
 
     chunk_size: int = 128
-    max_waves: int = 32
+    max_waves: int = 16
     priority_classes: Dict[str, int] = field(default_factory=dict)
     # route packing solves through a gRPC gang-solver sidecar (host:port;
     # empty -> solve in-process). BASELINE north-star boundary.
@@ -143,7 +143,7 @@ def load_operator_configuration(text: str) -> OperatorConfiguration:
     solver = raw.get("solver") or {}
     cfg.solver = SolverConfig(
         chunk_size=int(solver.get("chunkSize", 128)),
-        max_waves=int(solver.get("maxWaves", 32)),
+        max_waves=int(solver.get("maxWaves", 16)),
         priority_classes=dict(solver.get("priorityClasses") or {}),
         sidecar_address=str(solver.get("sidecarAddress", "")),
     )

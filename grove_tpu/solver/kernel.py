@@ -202,7 +202,7 @@ def dedup_extra_args(
 def solve_waves(
     problem: PackingProblem,
     chunk_size: int = 32,
-    max_waves: int = 32,
+    max_waves: int = 16,
     with_alloc: bool = True,
 ) -> PackingResult:
     """Wave-parallel solve WITH per-pod allocations (the binding path).

@@ -43,7 +43,7 @@ class GangScheduler:
         topology: Optional[ClusterTopology] = None,
         priority_map: Optional[Dict[str, int]] = None,
         chunk_size: int = 32,
-        max_waves: int = 32,
+        max_waves: int = 16,
         solver_sidecar: Optional[str] = None,
     ) -> None:
         self.store = store
