@@ -418,10 +418,21 @@ def pad_problem_for_waves(
     return args, n_chunks, grouped, pinned, spread
 
 
+# The BASELINE bench configuration (bench.py runs solve_waves_stats with
+# these defaults). Single source shared with the committed TPU lowering
+# proof (scripts/export_tpu_lowering.py) and its drift test
+# (tests/test_tpu_lowering.py) so a re-tune here forces the lowering
+# artifacts to be regenerated instead of silently diverging from the
+# program the bench actually times. Chunk 64: post-dedup sweep optimum
+# (docs/benchmarks.md round-4 re-tune table).
+BENCH_CHUNK_SIZE = 64
+BENCH_MAX_WAVES = 32
+
+
 def solve_waves_stats(
     problem: PackingProblem,
-    chunk_size: int = 128,
-    max_waves: int = 32,
+    chunk_size: int = BENCH_CHUNK_SIZE,
+    max_waves: int = BENCH_MAX_WAVES,
 ) -> PackingResult:
     """Device-resident wave solve (ops.packing.solve_waves_device): the whole
     multi-wave loop runs as one XLA program — the stress-bench path. Returns

@@ -72,13 +72,16 @@ class TestTPULowering:
 
         from grove_tpu.models import build_stress_problem
         from grove_tpu.solver.kernel import (
+            BENCH_CHUNK_SIZE,
             dedup_extra_args,
             pad_problem_for_waves,
         )
 
         problem = build_stress_problem(5120, 10240)
+        # the SHARED bench constant: retuning the default forces this test
+        # (and the export script) onto the new program together
         raw, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
-            problem, 128
+            problem, BENCH_CHUNK_SIZE
         )
         args = [jnp.asarray(a) for a in raw]
         extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
@@ -110,7 +113,7 @@ class TestTPULowering:
             _stress_export_inputs,
         )
 
-        args, extra, static = _stress_export_inputs(512, 1024, 128)
+        args, extra, static = _stress_export_inputs(512, 1024)
         exp = jexport.export(solve_waves_device, platforms=["tpu"])(
             *args, **extra, **static
         )
