@@ -103,7 +103,7 @@ def _pods_fit_per_node(free: jnp.ndarray, demand_p: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(k, 0, _INT_CAP).astype(jnp.int32)
 
 
-def _fill_floors_first(free, mask, demand, count, min_count):
+def _fill_floors_first(free, mask, demand, count, min_count, uniform=False):
     """Two-phase fill: place every group's admission FLOOR first, then the
     best-effort extras — a full-count greedy would let an early group's
     extras starve a later group's floor (guaranteed gang scheduling is for
@@ -114,7 +114,21 @@ def _fill_floors_first(free, mask, demand, count, min_count):
     floor (count < min_count), and a negative extras count would corrupt the
     fill (negative allocations inflate free capacity). The clamped floor can
     never satisfy `placed_min >= min_count`, so such gangs correctly wait.
+
+    `uniform` is a STATIC host-side flag: min_count == count for EVERY gang
+    in the problem (the all-or-nothing common case — the whole stress mix).
+    Then floors == min(count, count) == count and extras == 0 everywhere a
+    fill runs with the gang's own counts, and the callers that substitute
+    counts (spill: min_count=0; rescue: the gang's own uniform pair) keep
+    the extras phase a provable no-op — so HALF the fill scans compile
+    away, bit-exactly. (Spill's placed_min changes from 0 to placed, but
+    its only consumer gates on cluster_rescue, which is False for spill.)
     Returns (alloc [P,N], placed [P], placed_min [P], free_after)."""
+    if uniform:
+        alloc, placed, free_after = _fill(
+            free, mask, demand, jnp.minimum(min_count, count)
+        )
+        return alloc, placed, placed, free_after
     floors = jnp.minimum(min_count, count)
     extras = jnp.maximum(count - min_count, 0)
     alloc_min, placed_min, free1 = _fill(free, mask, demand, floors)
@@ -189,7 +203,7 @@ def _fill_grouped(
 
 def _fill_dispatch(
     grouped, free, mask, demand, count, min_count, group_req, group_pin,
-    topo, seg_starts, seg_ends, seed,
+    topo, seg_starts, seg_ends, seed, uniform=False,
 ):
     """Static dispatch: problems with no group-level constraints (the common
     case — checked host-side) compile the cheap two-phase fill; the grouped
@@ -199,7 +213,7 @@ def _fill_dispatch(
             free, mask, demand, count, min_count, group_req, group_pin,
             topo, seg_starts, seg_ends, seed,
         )
-    return _fill_floors_first(free, mask, demand, count, min_count)
+    return _fill_floors_first(free, mask, demand, count, min_count, uniform)
 
 
 def _fill(free, mask, demand, count):
@@ -360,7 +374,7 @@ def _spread_select(gang: GangInputs, seg_starts, seg_ends, topo):
 
 def _dispatch_with_spread(
     spread, grouped, free, mask, gang: GangInputs,
-    topo, seg_starts, seg_ends, seed,
+    topo, seg_starts, seg_ends, seed, uniform=False,
 ):
     """Fill dispatch for problems that may mix spread and non-spread gangs:
     with the static `spread` flag off, exactly the plain dispatch; with it
@@ -371,6 +385,7 @@ def _dispatch_with_spread(
         a, p, pm, f = _fill_dispatch(
             grouped, free, mask, gang.demand, gang.count, gang.min_count,
             gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+            uniform,
         )
         return a, p, pm, f, jnp.int32(0), jnp.asarray(False)
     spread_on, topo_col, starts_l, ends_l = _spread_select(
@@ -383,6 +398,7 @@ def _dispatch_with_spread(
     a_n, p_n, pm_n, f_n = _fill_dispatch(
         grouped, free, mask, gang.demand, gang.count, gang.min_count,
         gang.group_req, gang.group_pin, topo, seg_starts, seg_ends, seed,
+        uniform,
     )
     alloc = jnp.where(spread_on, a_s, a_n)
     placed = jnp.where(spread_on, p_s, p_n)
@@ -515,6 +531,7 @@ def gang_select_and_fill(
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,
+    uniform: bool = False,
 ):
     """One gang's placement decision against `free`.
 
@@ -582,7 +599,7 @@ def gang_select_and_fill(
         alloc_l, placed_l, placed_min_l, free_l, used_l, spread_on = (
             _dispatch_with_spread(
                 spread, grouped, free, mask_l, gang,
-                topo, seg_starts, seg_ends, jnp.int32(0),
+                topo, seg_starts, seg_ends, jnp.int32(0), uniform,
             )
         )
         fill_ok = (
@@ -600,7 +617,7 @@ def gang_select_and_fill(
     alloc_c, placed_c, placed_min_c, free_c, used_c, spread_on = (
         _dispatch_with_spread(
             spread, grouped, free, all_nodes, gang,
-            topo, seg_starts, seg_ends, jnp.int32(0),
+            topo, seg_starts, seg_ends, jnp.int32(0), uniform,
         )
     )
     cluster_ok = (
@@ -688,7 +705,7 @@ def gang_select_and_fill(
     return free_new, alloc, placed_total, ok_min, chosen_l, score
 
 
-@partial(jax.jit, static_argnames=("with_alloc", "grouped", "pinned", "spread"))
+@partial(jax.jit, static_argnames=("with_alloc", "grouped", "pinned", "spread", "uniform"))
 def solve_packing(
     capacity: jnp.ndarray,  # [N, R] float32
     topo: jnp.ndarray,  # [N, L] int32, dense ids per level
@@ -710,6 +727,7 @@ def solve_packing(
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,
+    uniform: bool = False,
 ):
     """Exact sequential greedy (oracle-parity kernel)."""
     if group_req is None:
@@ -725,7 +743,7 @@ def solve_packing(
     def gang_step(free, gang: GangInputs):
         free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
             free, topo, seg_starts, seg_ends, gang, grouped=grouped,
-            pinned=pinned, spread=spread,
+            pinned=pinned, spread=spread, uniform=uniform,
         )
         ys = (ok_min, placed, score, chosen_l)
         if with_alloc:
@@ -762,7 +780,7 @@ def solve_packing(
     }
 
 
-@partial(jax.jit, static_argnames=("commit_iters", "grouped", "pinned", "spread"))
+@partial(jax.jit, static_argnames=("commit_iters", "grouped", "pinned", "spread", "uniform"))
 def solve_wave_chunk(
     free: jnp.ndarray,  # [N, R]
     topo: jnp.ndarray,  # [N, L]
@@ -790,6 +808,7 @@ def solve_wave_chunk(
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,
+    uniform: bool = False,
 ):
     """One wave over one chunk, with per-pod allocations materialized (the
     binding path). Same core as the device-resident stats solver."""
@@ -830,6 +849,7 @@ def solve_wave_chunk(
             pair_dem=pair_demand,
             pair_cap=pair_count,
             uidx=pair_idx,
+            uniform=uniform,
         )
     )
     n_levels = topo.shape[1]
@@ -858,7 +878,7 @@ def wave_chunk_core(
     dem, cnt, mn, rq, pf, pend, ncap, seeds, grq, gpin, gangpin,
     spreadlvl, spreadmin, spreadreq, spreadseed, commit_iters,
     grouped=False, pinned=False, spread=False,
-    pair_dem=None, pair_cap=None, uidx=None,
+    pair_dem=None, pair_cap=None, uidx=None, uniform=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
@@ -896,7 +916,8 @@ def wave_chunk_core(
     )
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
         lambda *xs: gang_select_single(
-            *xs, grouped=grouped, pinned=pinned, spread=spread
+            *xs, grouped=grouped, pinned=pinned, spread=spread,
+            uniform=uniform,
         ),
         in_axes=(None, None, None, None, 0, 0, 0, None),
     )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds, cs_pair)
@@ -937,6 +958,7 @@ def gang_select_single(
     free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
     cs_pair=None,
     grouped: bool = False, pinned: bool = False, spread: bool = False,
+    uniform: bool = False,
 ):
     """Single-fill variant of gang_select_and_fill for the wave solver.
 
@@ -1046,7 +1068,7 @@ def gang_select_single(
     alloc, placed, placed_min, free_after, used, spread_on = (
         _dispatch_with_spread(
             spread, grouped, free, mask, gang,
-            topo, seg_starts, seg_ends, seed,
+            topo, seg_starts, seg_ends, seed, uniform,
         )
     )
     level_fill_ok = (
@@ -1091,7 +1113,7 @@ def gang_select_single(
     alloc2, placed2, placed2_min, _, used2, _ = _dispatch_with_spread(
         spread, grouped, base_free, all_nodes,
         gang._replace(count=remaining, min_count=rescue_min),
-        topo, seg_starts, seg_ends, seed,
+        topo, seg_starts, seg_ends, seed, uniform,
     )
     rescue_ok = (
         cluster_rescue
@@ -1127,7 +1149,8 @@ def gang_select_single(
 @partial(
     jax.jit,
     static_argnames=(
-        "n_chunks", "max_waves", "commit_iters", "grouped", "pinned", "spread"
+        "n_chunks", "max_waves", "commit_iters", "grouped", "pinned",
+        "spread", "uniform",
     ),
 )
 def solve_waves_device(
@@ -1156,6 +1179,7 @@ def solve_waves_device(
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,
+    uniform: bool = False,
 ):
     """Whole multi-wave wave-parallel solve in ONE device program — zero
     host↔device round trips until the final results (critical when the chip
@@ -1241,6 +1265,7 @@ def solve_waves_device(
                 pair_dem=pair_demand if use_dedup else None,
                 pair_cap=pair_count if use_dedup else None,
                 uidx=uidx_c,
+                uniform=uniform,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)

@@ -125,8 +125,8 @@ def solve_stress_sharded(
     from grove_tpu.solver.kernel import dedup_extra_args, pad_problem_for_waves
 
     g = problem.num_gangs
-    raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
-        problem, chunk_size
+    raw_args, n_chunks, grouped, pinned, spread, uniform = (
+        pad_problem_for_waves(problem, chunk_size)
     )
     node_sh = NamedSharding(mesh, P("tp", None))
     rep = NamedSharding(mesh, P())
@@ -153,6 +153,7 @@ def solve_stress_sharded(
             grouped=grouped,
             pinned=pinned,
             spread=spread,
+            uniform=uniform,
         )
 
     if jax.process_count() > 1:

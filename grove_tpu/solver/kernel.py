@@ -46,12 +46,16 @@ def _maybe_enable_disk_cache() -> None:
         pass
 
 
-def _get_compiled(args, with_alloc: bool, grouped: bool, pinned: bool, spread: bool):
+def _get_compiled(
+    args, with_alloc: bool, grouped: bool, pinned: bool, spread: bool,
+    uniform: bool,
+):
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         with_alloc,
         grouped,
         pinned,
         spread,
+        uniform,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
@@ -59,7 +63,7 @@ def _get_compiled(args, with_alloc: bool, grouped: bool, pinned: bool, spread: b
         t0 = time.perf_counter()
         compiled = solve_packing.lower(
             *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned,
-            spread=spread,
+            spread=spread, uniform=uniform,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
@@ -120,7 +124,8 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
     spread = bool((spread_level >= 0).any())
-    compiled = _get_compiled(args, with_alloc, grouped, pinned, spread)
+    uniform = bool((problem.min_count == problem.count).all())
+    compiled = _get_compiled(args, with_alloc, grouped, pinned, spread, uniform)
     t0 = time.perf_counter()
     out = compiled(*args)
     admitted = np.asarray(out["admitted"])  # device sync
@@ -265,6 +270,8 @@ def solve_waves(
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
     spread = bool((spread_level >= 0).any())
+    # padded gangs have min_count == count == 0, preserving uniformity
+    uniform = bool((problem.min_count == problem.count).all())
     dedup_extra = dedup_extra_args(demand, count, n_chunks, pinned)
     pidx_chunks = None
     if dedup_extra:
@@ -334,6 +341,7 @@ def solve_waves(
                 grouped=grouped,
                 pinned=pinned,
                 spread=spread,
+                uniform=uniform,
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -376,8 +384,9 @@ def pad_problem_for_waves(
     """SINGLE home for the wave solver's input-prep contract: clamp the
     chunk size, pad the gang axis to a chunk multiple (sentinel -1 for the
     level/pin fields, 0 elsewhere), and decide the `grouped`/`pinned`/
-    `spread` compile flags. Returns (args, n_chunks, grouped, pinned,
-    spread) where args is the positional tuple of solve_waves_device.
+    `spread`/`uniform` compile flags. Returns (args, n_chunks, grouped,
+    pinned, spread, uniform) where args is the positional tuple of
+    solve_waves_device.
     Shared by the stats path, the node-sharded multi-chip path, and the
     parity tests — a padding-contract change lands exactly once."""
     g = problem.num_gangs
@@ -415,7 +424,10 @@ def pad_problem_for_waves(
     grouped = bool((problem.group_req >= 0).any())
     pinned = bool((problem.gang_pin >= 0).any())
     spread = bool((spread_level >= 0).any())
-    return args, n_chunks, grouped, pinned, spread
+    # all-or-nothing population (padded gangs are 0 == 0): half the fill
+    # scans compile away, bit-exactly (ops.packing._fill_floors_first)
+    uniform = bool((problem.min_count == problem.count).all())
+    return args, n_chunks, grouped, pinned, spread, uniform
 
 
 # The BASELINE bench configuration (bench.py runs solve_waves_stats with
@@ -438,8 +450,8 @@ def solve_waves_stats(
     multi-wave loop runs as one XLA program — the stress-bench path. Returns
     stats only (no per-pod alloc); use solve_waves/solve for binding."""
     g = problem.num_gangs
-    raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
-        problem, chunk_size
+    raw_args, n_chunks, grouped, pinned, spread, uniform = (
+        pad_problem_for_waves(problem, chunk_size)
     )
     args = tuple(jnp.asarray(a) for a in raw_args)
     # encode-time demand dedup (exact semantics; packing.wave_chunk_core)
@@ -451,6 +463,7 @@ def solve_waves_stats(
         grouped,
         pinned,
         spread,
+        uniform,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
@@ -464,6 +477,7 @@ def solve_waves_stats(
             grouped=grouped,
             pinned=pinned,
             spread=spread,
+            uniform=uniform,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled

@@ -111,7 +111,7 @@ def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int = None):
     )
 
     problem = build_stress_problem(n_nodes, n_gangs)
-    raw, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
+    raw, n_chunks, grouped, pinned, spread, uniform = pad_problem_for_waves(
         problem, chunk or BENCH_CHUNK_SIZE
     )
     args = tuple(jnp.asarray(a) for a in raw)
@@ -122,6 +122,7 @@ def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int = None):
         grouped=grouped,
         pinned=pinned,
         spread=spread,
+        uniform=uniform,
     )
     return args, extra, static
 

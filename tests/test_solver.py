@@ -359,6 +359,47 @@ class TestWaveSolver:
         np.testing.assert_array_equal(r_on.chosen_level, r_off.chosen_level)
         np.testing.assert_array_equal(r_on.free_after, r_off.free_after)
 
+    def test_uniform_fill_shortcut_is_bit_identical(self):
+        """The static `uniform` flag (min_count == count everywhere — the
+        all-or-nothing common case) halves the fill scans; outputs must be
+        BIT-identical with it forced on vs off for both kernels."""
+        import jax.numpy as jnp
+
+        from grove_tpu.models import build_stress_problem
+        from grove_tpu.ops.packing import solve_packing, solve_waves_device
+        from grove_tpu.solver.kernel import (
+            dedup_extra_args,
+            pad_problem_for_waves,
+        )
+
+        problem = build_stress_problem(128, 256)
+        raw, n_chunks, grouped, pinned, spread, uniform = (
+            pad_problem_for_waves(problem, 64)
+        )
+        assert uniform, "stress mix must be uniform (min_count == count)"
+        args = tuple(jnp.asarray(a) for a in raw)
+        extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
+        outs = []
+        for u in (False, True):
+            out = solve_waves_device(
+                *args, **extra, n_chunks=n_chunks, max_waves=32,
+                grouped=grouped, pinned=pinned, spread=spread, uniform=u,
+            )
+            outs.append({k: np.asarray(v) for k, v in out.items()})
+        for k in ("admitted", "placed", "score", "chosen_level", "free_after"):
+            np.testing.assert_array_equal(outs[0][k], outs[1][k], err_msg=k)
+        exact = []
+        for u in (False, True):
+            out = solve_packing(
+                *args[:16], with_alloc=False,
+                grouped=grouped, pinned=pinned, spread=spread, uniform=u,
+            )
+            exact.append(
+                {k: np.asarray(v) for k, v in out.items() if v is not None}
+            )
+        for k in ("admitted", "placed", "score", "chosen_level", "free_after"):
+            np.testing.assert_array_equal(exact[0][k], exact[1][k], err_msg=k)
+
     def test_dedup_declines_when_rows_mostly_unique(self):
         """dedup_demand must hand back (None, None) when the shared table
         would not pay (U not far below the chunk's own row count)."""
@@ -693,8 +734,8 @@ class TestMultiChip:
         from grove_tpu.solver.kernel import pad_problem_for_waves
 
         g = problem.num_gangs
-        raw_args, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
-            problem, 128
+        raw_args, n_chunks, grouped, pinned, spread, uniform = (
+            pad_problem_for_waves(problem, 128)
         )
         out = solve_waves_device(
             *[jnp.asarray(a) for a in raw_args],
@@ -703,6 +744,7 @@ class TestMultiChip:
             grouped=grouped,
             pinned=pinned,
             spread=spread,
+            uniform=uniform,
         )
         np.testing.assert_array_equal(
             sharded["admitted"], np.asarray(out["admitted"])[:g]

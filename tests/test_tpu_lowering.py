@@ -80,8 +80,8 @@ class TestTPULowering:
         problem = build_stress_problem(5120, 10240)
         # the SHARED bench constant: retuning the default forces this test
         # (and the export script) onto the new program together
-        raw, n_chunks, grouped, pinned, spread = pad_problem_for_waves(
-            problem, BENCH_CHUNK_SIZE
+        raw, n_chunks, grouped, pinned, spread, uniform = (
+            pad_problem_for_waves(problem, BENCH_CHUNK_SIZE)
         )
         args = [jnp.asarray(a) for a in raw]
         extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
