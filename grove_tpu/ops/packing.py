@@ -1175,7 +1175,13 @@ def solve_waves_device(
     pair_idx=None,  # [G, P]
     n_chunks: int = 20,
     max_waves: int = 8,
-    commit_iters: int = 2,
+    # ONE removal pass + the final joint-feasibility guarantee: extra
+    # refinement iterations buy within-wave acceptances, but with late-wave
+    # compaction a rejected gang's retry wave is nearly free, so the
+    # refinement's [C,N,R] cumsum passes cost more than they save
+    # (measured full-size: 29.9 -> 28.2 s, identical admissions/score).
+    # The host-loop binding path keeps 2 (its waves are not compacted).
+    commit_iters: int = 1,
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,

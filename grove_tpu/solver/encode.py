@@ -34,9 +34,13 @@ def _next_pow2(x: int) -> int:
 # Minimum padded sizes: every distinct (G, P) shape compiles its own
 # executable, so small problems share a handful of buckets instead of
 # compiling one per pending-gang count (compiles dominate wall time when the
-# chip sits behind a remote link).
+# chip sits behind a remote link). The GANG axis keeps pow2 buckets — the
+# pending-gang count changes every solve. The GROUP axis pads EXACTLY to
+# the population's max group count (round 4): it is template-driven and
+# changes rarely, while every padded group row costs a full [N,R] fill
+# scan per gang per fill — pow2(3)=4 wasted 25% of the stress mix's fill
+# work, and a single-group population would pay 4x.
 MIN_GANG_BUCKET = 32
-MIN_GROUP_BUCKET = 4
 
 
 def encode_nodes(
@@ -146,7 +150,7 @@ def encode_gangs(
     g = len(gang_specs)
     p = max((len(s["groups"]) for s in gang_specs), default=1)
     gp = pad_gangs or _next_pow2(max(g, MIN_GANG_BUCKET))
-    pp = pad_groups or _next_pow2(max(p, MIN_GROUP_BUCKET))
+    pp = pad_groups or max(p, 1)
     r = len(resource_names)
 
     demand = np.zeros((gp, pp, r), dtype=np.float32)

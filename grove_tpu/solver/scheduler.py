@@ -57,6 +57,10 @@ class GangScheduler:
         # solve through a gRPC sidecar (host:port) instead of in-process —
         # the same boundary the reference's scheduler plugin puts KAI behind
         self.solver_sidecar = solver_sidecar
+        # sticky group-axis padding (see _solve_batch): grows to the widest
+        # template seen, never shrinks — pending-mix churn must not force
+        # per-shape recompiles of the wave program
+        self._pad_groups = 1
         self._sidecar_client = None
         # per-solve gRPC deadline; past it the sidecar aborts the solve
         # server-side (DEADLINE_EXCEEDED) and we fall back in-process
@@ -84,8 +88,19 @@ class GangScheduler:
         onto the locally-encoded problem's index space, so every downstream
         consumer (binding, preemption trials, recovery pins) is agnostic to
         where the kernel ran. Returns (PackingResult, PackingProblem)."""
+        # STICKY group padding: the encoder pads the group axis exactly
+        # (wide pow2 padding wastes fill scans), but the PENDING mix's max
+        # group count flips as multi-group gangs drain and re-arrive — and
+        # every distinct padded shape is a fresh XLA compile. Remember the
+        # widest template seen and keep padding there: compiles stay
+        # monotone-few, executables keep getting reused.
+        batch_max = max(
+            (len(s["groups"]) for s in gang_specs), default=1
+        )
+        self._pad_groups = max(self._pad_groups, batch_max, 1)
         problem = build_problem(
-            nodes, gang_specs, self.topology, free_capacity=free_capacity
+            nodes, gang_specs, self.topology, free_capacity=free_capacity,
+            pad_groups=self._pad_groups,
         )
         import time as _time
 

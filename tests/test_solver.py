@@ -810,6 +810,35 @@ class TestRingCollectives:
             )
 
 
+class TestStickyGroupPadding:
+    def test_scheduler_padding_never_shrinks(self):
+        """The encoder pads the group axis exactly, so the SCHEDULER must
+        pin padding to the widest template seen — otherwise the pending
+        mix's max group count flips as multi-group gangs drain and every
+        distinct shape forces a fresh XLA compile of the wave program."""
+        from grove_tpu.sim.harness import SimHarness
+
+        h = SimHarness(num_nodes=8)
+        sched = h.scheduler
+        assert sched._pad_groups == 1
+        nodes = list(h.cluster.nodes)
+        wide = [
+            gang(
+                "w",
+                [group(f"w-{i}", cpu=1.0, count=1) for i in range(3)],
+            )
+        ]
+        narrow = [gang("n", [group("n-0", cpu=1.0, count=1)])]
+        _, prob_wide = sched._solve_batch(nodes, wide, None, with_alloc=False)
+        assert prob_wide.demand.shape[1] == 3
+        assert sched._pad_groups == 3
+        # a later narrow batch keeps the wide padding -> same compiled shape
+        _, prob_narrow = sched._solve_batch(
+            nodes, narrow, None, with_alloc=False
+        )
+        assert prob_narrow.demand.shape[1] == 3
+
+
 class TestEncoder:
     def test_topology_sorted_contiguous(self):
         nodes = make_nodes(8, hosts_per_ici_block=2)
