@@ -120,6 +120,15 @@ class RequestDecodeError(ValueError):
     """Malformed/undecodable request — maps to INVALID_ARGUMENT."""
 
 
+# Sticky group-axis padding, server-side twin of GangScheduler._pad_groups:
+# the encoder pads the group axis exactly, so without memory the pending
+# mix's max group count would flip between requests and every distinct
+# shape would force a fresh XLA compile INSIDE the Solve handler — burning
+# the client's per-solve deadline (DEADLINE_EXCEEDED → sidecar fallback).
+# Grows to the widest template seen this process, never shrinks.
+_PAD_GROUPS = [1]
+
+
 def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
     """Pure request → response solve (shared by the gRPC handler and
     in-process callers/tests)."""
@@ -131,7 +140,13 @@ def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
     except Exception as exc:
         raise RequestDecodeError(str(exc)) from exc
     try:
-        problem = build_problem(nodes, gang_specs, topology)
+        _PAD_GROUPS[0] = max(
+            _PAD_GROUPS[0],
+            max((len(s["groups"]) for s in gang_specs), default=1),
+        )
+        problem = build_problem(
+            nodes, gang_specs, topology, pad_groups=_PAD_GROUPS[0]
+        )
     except ConstraintError as exc:
         # declared-constraint contradictions (unknown hard keys, spread +
         # per-group pack) are the caller's fault → INVALID_ARGUMENT; any

@@ -435,9 +435,11 @@ def pad_problem_for_waves(
 # proof (scripts/export_tpu_lowering.py) and its drift test
 # (tests/test_tpu_lowering.py) so a re-tune here forces the lowering
 # artifacts to be regenerated instead of silently diverging from the
-# program the bench actually times. Chunk 64: post-dedup sweep optimum
-# (docs/benchmarks.md round-4 re-tune table).
-BENCH_CHUNK_SIZE = 64
+# program the bench actually times. Chunk 48: the sweep optimum kept
+# sliding down as per-gang work shrank (128 pre-dedup → 64 post-dedup →
+# 48 after the uniform shortcut + exact group padding; docs/benchmarks.md
+# round-4 re-tune tables).
+BENCH_CHUNK_SIZE = 48
 BENCH_MAX_WAVES = 32
 
 
