@@ -41,6 +41,9 @@ import jax
 import jax.numpy as jnp
 
 _INT_CAP = 1 << 20  # cap on pods-per-node fit counts (avoid inf→int wrap)
+# narrow-cap sentinel (lazy-rescue wave path): "retry with the cluster-wide
+# fill only" — distinct from -1 ("no broader level; done for good")
+_CLUSTER_RETRY = -2
 
 
 class GangInputs(NamedTuple):
@@ -879,6 +882,7 @@ def wave_chunk_core(
     spreadlvl, spreadmin, spreadreq, spreadseed, commit_iters,
     grouped=False, pinned=False, spread=False,
     pair_dem=None, pair_cap=None, uidx=None, uniform=False,
+    lazy_rescue=False,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
@@ -895,6 +899,10 @@ def wave_chunk_core(
     shared-snapshot premise).
     Returns (free, accept, placed, score, chosen, retry, new_cap,
     fill_failed, alloc)."""
+    assert not lazy_rescue or uniform, (
+        "lazy_rescue requires the uniform invariant: only then is the "
+        "extras spill provably empty"
+    )
     cnt = cnt * pend[:, None]
     use_dedup = pair_dem is not None and uidx is not None and not pinned
     cs_pair = None
@@ -917,7 +925,7 @@ def wave_chunk_core(
     alloc, placed, ok, chosen, score, had_cand, fallback_cap = jax.vmap(
         lambda *xs: gang_select_single(
             *xs, grouped=grouped, pinned=pinned, spread=spread,
-            uniform=uniform,
+            uniform=uniform, lazy_rescue=lazy_rescue,
         ),
         in_axes=(None, None, None, None, 0, 0, 0, None),
     )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds, cs_pair)
@@ -941,6 +949,9 @@ def wave_chunk_core(
     new_cap = jnp.where(fill_failed, fallback_cap, ncap)
     min_allowed = jnp.where(rq >= 0, rq, 0)
     retry = pend & ((ok & ~accept) | (fill_failed & (new_cap >= min_allowed)))
+    if lazy_rescue:
+        # deferred cluster rescues carry the sentinel cap and MUST retry
+        retry = retry | (pend & fill_failed & (new_cap == _CLUSTER_RETRY))
     return (
         free,
         accept & pend,
@@ -958,7 +969,7 @@ def gang_select_single(
     free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
     cs_pair=None,
     grouped: bool = False, pinned: bool = False, spread: bool = False,
-    uniform: bool = False,
+    uniform: bool = False, lazy_rescue: bool = False,
 ):
     """Single-fill variant of gang_select_and_fill for the wave solver.
 
@@ -969,8 +980,13 @@ def gang_select_single(
     and retries next wave — amortizing the L+1 fills of the exact kernel
     across waves instead of paying them per gang.
 
-    Returns (alloc, placed, ok, chosen, score, had_candidate).
+    Returns (alloc, placed, ok, chosen, score, had_candidate, fallback_cap).
     chosen: level index, n_levels for cluster-wide, -1 when nothing allowed.
+    fallback_cap: the retry narrow-cap for a fill-failed gang — the next
+    BROADER aggregate-feasible level, -1 when none remains, or the
+    _CLUSTER_RETRY sentinel (-2, lazy_rescue only) meaning "retry with the
+    cluster-wide fill next wave" (wave_chunk_core's retry rule understands
+    the sentinel).
     """
     n_nodes, n_levels = topo.shape
     weights = _level_weights(n_levels)
@@ -1083,55 +1099,79 @@ def gang_select_single(
     lower_feasible = jnp.where(allowed & (lv < chosen_level), lv, -1)
     fallback_cap = jnp.max(lower_feasible)
 
-    # Second fill doubles as both paths:
-    # - level fill met the floor → best-effort extras spill cluster-wide
-    # - level fill missed the floor AND no broader feasible level remains
-    #   (and no required pack) → cluster-wide scatter as a last resort;
-    #   otherwise the gang retries at the fallback level next wave, keeping
-    #   it packed instead of eagerly scattering
-    cluster_rescue = (
-        has_level
-        & ~level_fill_ok
-        & (gang.req_level < 0)
-        & (fallback_cap < 0)
-        & any_active
-    )
-    # spread gangs never spill: their whole allocation comes from the
-    # balanced fill (rescue still applies — it re-runs the spread fill
-    # cluster-wide, where more domains are visible)
-    spill = level_fill_ok & has_level & (gang.req_level < 0) & ~spread_on
-    base_free = jnp.where(cluster_rescue, free, free_after)
-    # extras of group-constrained groups must stay inside their chosen
-    # domain — only unconstrained groups may spill cluster-wide
-    spillable = gang.group_req < 0
-    remaining = jnp.where(
-        cluster_rescue,
-        gang.count,
-        jnp.where(spill & spillable, gang.count - placed, 0),
-    )
-    rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
-    alloc2, placed2, placed2_min, _, used2, _ = _dispatch_with_spread(
-        spread, grouped, base_free, all_nodes,
-        gang._replace(count=remaining, min_count=rescue_min),
-        topo, seg_starts, seg_ends, seed, uniform,
-    )
-    rescue_ok = (
-        cluster_rescue
-        & jnp.all(jnp.where(active, placed2_min >= gang.min_count, True))
-        & _spread_admit(gang, spread_on, used2, placed2.sum())
-    )
-    alloc = jnp.where(
-        rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)
-    )
-    placed = jnp.where(
-        rescue_ok, placed2, jnp.where(spill, placed + placed2, placed)
-    )
-    used = jnp.where(rescue_ok, used2, used)
-    fill_ok = level_fill_ok | rescue_ok
-    chosen_level = jnp.where(rescue_ok, n_levels, chosen_level)
-    has_level = has_level & ~rescue_ok
-    use_cluster = use_cluster | rescue_ok
+    if lazy_rescue:
+        # uniform-only fast path (caller asserts): the extras spill is
+        # provably empty (placed == count whenever the level fill met the
+        # floor), and the cluster rescue is DEFERRED to the next wave via
+        # the _CLUSTER_RETRY narrow-cap sentinel — the retry wave is
+        # compacted and nearly free, while the in-wave second fill below
+        # costs a full dispatch for EVERY gang in EVERY wave. A deferred
+        # gang's next decide sees no allowed level (cap sentinel) and
+        # takes the existing use_cluster branch, i.e. the same
+        # cluster-wide fill, one wave later against fresher capacity.
+        defer = (
+            has_level
+            & ~level_fill_ok
+            & (gang.req_level < 0)
+            & (fallback_cap < 0)
+            & any_active
+        )
+        fallback_cap = jnp.where(
+            defer, jnp.int32(_CLUSTER_RETRY), fallback_cap
+        )
+        fill_ok = level_fill_ok
+    else:
+        # Second fill doubles as both paths:
+        # - level fill met the floor → best-effort extras spill cluster-wide
+        # - level fill missed the floor AND no broader feasible level remains
+        #   (and no required pack) → cluster-wide scatter as a last resort;
+        #   otherwise the gang retries at the fallback level next wave,
+        #   keeping it packed instead of eagerly scattering
+        cluster_rescue = (
+            has_level
+            & ~level_fill_ok
+            & (gang.req_level < 0)
+            & (fallback_cap < 0)
+            & any_active
+        )
+        # spread gangs never spill: their whole allocation comes from the
+        # balanced fill (rescue still applies — it re-runs the spread fill
+        # cluster-wide, where more domains are visible)
+        spill = level_fill_ok & has_level & (gang.req_level < 0) & ~spread_on
+        base_free = jnp.where(cluster_rescue, free, free_after)
+        # extras of group-constrained groups must stay inside their chosen
+        # domain — only unconstrained groups may spill cluster-wide
+        spillable = gang.group_req < 0
+        remaining = jnp.where(
+            cluster_rescue,
+            gang.count,
+            jnp.where(spill & spillable, gang.count - placed, 0),
+        )
+        rescue_min = jnp.where(cluster_rescue, gang.min_count, 0)
+        alloc2, placed2, placed2_min, _, used2, _ = _dispatch_with_spread(
+            spread, grouped, base_free, all_nodes,
+            gang._replace(count=remaining, min_count=rescue_min),
+            topo, seg_starts, seg_ends, seed, uniform,
+        )
+        rescue_ok = (
+            cluster_rescue
+            & jnp.all(jnp.where(active, placed2_min >= gang.min_count, True))
+            & _spread_admit(gang, spread_on, used2, placed2.sum())
+        )
+        alloc = jnp.where(
+            rescue_ok, alloc2, jnp.where(spill, alloc + alloc2, alloc)
+        )
+        placed = jnp.where(
+            rescue_ok, placed2, jnp.where(spill, placed + placed2, placed)
+        )
+        used = jnp.where(rescue_ok, used2, used)
+        fill_ok = level_fill_ok | rescue_ok
+        chosen_level = jnp.where(rescue_ok, n_levels, chosen_level)
+        has_level = has_level & ~rescue_ok
+        use_cluster = use_cluster | rescue_ok
 
+    # shared epilogue (lazy and eager): mask out failed fills, score, pick
+    # the reported level
     alloc = jnp.where(fill_ok, alloc, 0)
     placed = jnp.where(fill_ok, placed, 0)
 
@@ -1150,7 +1190,7 @@ def gang_select_single(
     jax.jit,
     static_argnames=(
         "n_chunks", "max_waves", "commit_iters", "grouped", "pinned",
-        "spread", "uniform",
+        "spread", "uniform", "lazy_rescue",
     ),
 )
 def solve_waves_device(
@@ -1186,6 +1226,7 @@ def solve_waves_device(
     pinned: bool = False,
     spread: bool = False,
     uniform: bool = False,
+    lazy_rescue: bool = False,
 ):
     """Whole multi-wave wave-parallel solve in ONE device program — zero
     host↔device round trips until the final results (critical when the chip
@@ -1272,6 +1313,7 @@ def solve_waves_device(
                 pair_cap=pair_count if use_dedup else None,
                 uidx=uidx_c,
                 uniform=uniform,
+                lazy_rescue=lazy_rescue,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)

@@ -154,6 +154,7 @@ def solve_stress_sharded(
             pinned=pinned,
             spread=spread,
             uniform=uniform,
+            lazy_rescue=uniform,
         )
 
     if jax.process_count() > 1:

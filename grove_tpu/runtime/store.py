@@ -114,7 +114,13 @@ def matches_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
     if not selector:
         return True
     labels = obj.metadata.labels or {}
-    return all(labels.get(k) == v for k, v in selector.items())
+    # plain loop, not all(genexpr): this runs per candidate per selector on
+    # every controller list/scan — the generator frame overhead alone was
+    # ~2% of a 2,000-set converge (profiled round 4)
+    for k, v in selector.items():
+        if labels.get(k) != v:
+            return False
+    return True
 
 
 class Store:

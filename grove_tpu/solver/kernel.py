@@ -466,7 +466,7 @@ def solve_waves_stats(
         pinned,
         spread,
         uniform,
-    )
+    )  # lazy_rescue == uniform, so the sig needs no extra field
     compiled = _compiled_cache.get(sig)
     if compiled is None:
         _maybe_enable_disk_cache()
@@ -480,6 +480,9 @@ def solve_waves_stats(
             pinned=pinned,
             spread=spread,
             uniform=uniform,
+            # all-or-nothing populations defer cluster rescues to the next
+            # compacted wave instead of paying an in-wave second fill
+            lazy_rescue=uniform,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled

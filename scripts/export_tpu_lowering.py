@@ -123,6 +123,10 @@ def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int = None):
         pinned=pinned,
         spread=spread,
         uniform=uniform,
+        # MUST mirror solve_waves_stats' lower() call exactly — the
+        # committed artifact is only a proof if it is the program bench.py
+        # times
+        lazy_rescue=uniform,
     )
     return args, extra, static
 
