@@ -1215,13 +1215,18 @@ def solve_waves_device(
     pair_idx=None,  # [G, P]
     n_chunks: int = 20,
     max_waves: int = 8,
-    # ONE removal pass + the final joint-feasibility guarantee: extra
-    # refinement iterations buy within-wave acceptances, but with late-wave
-    # compaction a rejected gang's retry wave is nearly free, so the
-    # refinement's [C,N,R] cumsum passes cost more than they save
-    # (measured full-size: 29.9 -> 28.2 s, identical admissions/score).
-    # The host-loop binding path keeps 2 (its waves are not compacted).
-    commit_iters: int = 1,
+    # ZERO refinement passes — the final joint-feasibility mask alone.
+    # Safety: the final cumsum includes usage of gangs the mask then
+    # rejects, so every accepted gang's own prefix is <= the checked cum —
+    # the accepted set is always jointly feasible, just conservatively
+    # small (rejected-by-inflation gangs retry in a compacted, nearly-free
+    # wave). Refinement iterations buy within-wave acceptances at one
+    # [C,N,R] cumsum+reduce pass each; measured full-size, 2 -> 1 -> 0
+    # gave 29.9 -> 28.2 -> (post-lazy) 17.4 -> 16.4 s with IDENTICAL
+    # admissions/score — the strided capacity-weighted domain picks
+    # already avoid most intra-chunk collisions. The host-loop binding
+    # path keeps 2 (its waves are not compacted).
+    commit_iters: int = 0,
     grouped: bool = False,
     pinned: bool = False,
     spread: bool = False,
