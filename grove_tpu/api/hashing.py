@@ -90,7 +90,14 @@ def _cached(key, compute):
                         )
                     ):
                         _HASH_CACHE.pop(stale, None)
-        h = _HASH_CACHE[key] = compute()
+        h = compute()
+        # Insert under the same lock as eviction: the eviction snapshot
+        # iterates the dict, and an unlocked concurrent insert is only safe
+        # by the grace of CPython's GIL (free-threaded builds would raise
+        # "dictionary changed size during iteration"). Uncontended in the
+        # warm path, which never reaches here.
+        with _EVICT_LOCK:
+            _HASH_CACHE[key] = h
     return h
 
 

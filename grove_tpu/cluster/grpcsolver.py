@@ -125,8 +125,15 @@ class RequestDecodeError(ValueError):
 # mix's max group count would flip between requests and every distinct
 # shape would force a fresh XLA compile INSIDE the Solve handler — burning
 # the client's per-solve deadline (DEADLINE_EXCEEDED → sidecar fallback).
-# Grows to the widest template seen this process, never shrinks.
-_PAD_GROUPS = [1]
+# Grows to the widest template seen this process, never shrinks; the
+# shared helper locks the read-modify-write so concurrent Solve RPCs can't
+# interleave a narrow request over a wider width (encode.StickyGroupPad).
+# Constructed at import time: a lazy check-then-act would itself race two
+# first Solve RPCs into separate instances (encode imports no jax, so the
+# top-level import costs nothing).
+from grove_tpu.solver.encode import StickyGroupPad
+
+_PAD_GROUPS = StickyGroupPad()
 
 
 def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
@@ -140,12 +147,9 @@ def solve_request(request: pb.SolveRequest) -> pb.SolveResponse:
     except Exception as exc:
         raise RequestDecodeError(str(exc)) from exc
     try:
-        _PAD_GROUPS[0] = max(
-            _PAD_GROUPS[0],
-            max((len(s["groups"]) for s in gang_specs), default=1),
-        )
         problem = build_problem(
-            nodes, gang_specs, topology, pad_groups=_PAD_GROUPS[0]
+            nodes, gang_specs, topology,
+            pad_groups=_PAD_GROUPS.grow(gang_specs),
         )
     except ConstraintError as exc:
         # declared-constraint contradictions (unknown hard keys, spread +

@@ -1109,6 +1109,13 @@ def gang_select_single(
         # gang's next decide sees no allowed level (cap sentinel) and
         # takes the existing use_cluster branch, i.e. the same
         # cluster-wide fill, one wave later against fresher capacity.
+        # Boundary: a gang that defers on the LAST wave (max_waves
+        # exhausted, or the no-progress early-exit fires) never gets the
+        # cluster attempt the eager path would have made in-wave. Accepted:
+        # max_waves was raised 16→32 alongside this knob, deferrals fire in
+        # early waves in practice, and the two-zone frag parity test pins
+        # the multi-root case — but any future max_waves cut must re-check
+        # admission parity at budget exhaustion.
         defer = (
             has_level
             & ~level_fill_ok

@@ -9,6 +9,7 @@ bucket (SURVEY §7 'dynamic shapes' hard part).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -256,6 +257,35 @@ def _quantize_resources(
     return capacity.astype(np.float32), demand.astype(np.float32)
 
 
+class StickyGroupPad:
+    """Thread-safe sticky group-axis padding for repeat ``build_problem``
+    callers.
+
+    The encoder pads the group axis EXACTLY (wide pow2 padding wastes fill
+    scans — measured 25% at full size), which means the padded shape tracks
+    the pending mix's max group count. Any caller that solves repeatedly
+    (scheduler round loop, gRPC sidecar, multi-problem batchers) must
+    remember the widest template seen and keep padding there, or shape
+    churn forces a fresh XLA compile of the wave program per distinct
+    width. One instance per solve endpoint; ``grow()`` is a locked
+    read-modify-write so concurrent solvers can't momentarily shrink the
+    sticky width (which would trigger exactly the redundant recompiles the
+    mechanism exists to prevent).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._width = 1
+
+    def grow(self, gang_specs: List[dict]) -> int:
+        """Fold one batch's max group count into the sticky width and
+        return the width to pass as ``build_problem(pad_groups=...)``."""
+        batch_max = max((len(s["groups"]) for s in gang_specs), default=1)
+        with self._lock:
+            self._width = max(self._width, batch_max, 1)
+            return self._width
+
+
 def build_problem(
     nodes: Sequence,
     gang_specs: List[dict],
@@ -264,6 +294,14 @@ def build_problem(
     pad_gangs: Optional[int] = None,
     pad_groups: Optional[int] = None,
 ) -> PackingProblem:
+    """Encode nodes + gang specs into padded solver tensors.
+
+    ``pad_groups``: the group axis is padded EXACTLY when omitted, so the
+    problem shape follows this batch's widest template. One-shot callers
+    can omit it; every repeat caller should hold a ``StickyGroupPad`` and
+    pass ``sticky.grow(gang_specs)`` here, or pending-mix churn recompiles
+    the wave program per distinct width (see StickyGroupPad).
+    """
     # resource name space = union over nodes and demands
     rset = set()
     for node in nodes:

@@ -29,7 +29,7 @@ from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
-from grove_tpu.solver.encode import build_problem
+from grove_tpu.solver.encode import StickyGroupPad, build_problem
 from grove_tpu.solver.kernel import solve_waves
 
 
@@ -60,7 +60,7 @@ class GangScheduler:
         # sticky group-axis padding (see _solve_batch): grows to the widest
         # template seen, never shrinks — pending-mix churn must not force
         # per-shape recompiles of the wave program
-        self._pad_groups = 1
+        self._pad_groups = StickyGroupPad()
         self._sidecar_client = None
         # per-solve gRPC deadline; past it the sidecar aborts the solve
         # server-side (DEADLINE_EXCEEDED) and we fall back in-process
@@ -94,13 +94,9 @@ class GangScheduler:
         # every distinct padded shape is a fresh XLA compile. Remember the
         # widest template seen and keep padding there: compiles stay
         # monotone-few, executables keep getting reused.
-        batch_max = max(
-            (len(s["groups"]) for s in gang_specs), default=1
-        )
-        self._pad_groups = max(self._pad_groups, batch_max, 1)
         problem = build_problem(
             nodes, gang_specs, self.topology, free_capacity=free_capacity,
-            pad_groups=self._pad_groups,
+            pad_groups=self._pad_groups.grow(gang_specs),
         )
         import time as _time
 

@@ -893,7 +893,7 @@ class TestStickyGroupPadding:
 
         h = SimHarness(num_nodes=8)
         sched = h.scheduler
-        assert sched._pad_groups == 1
+        assert sched._pad_groups._width == 1
         nodes = list(h.cluster.nodes)
         wide = [
             gang(
@@ -904,7 +904,7 @@ class TestStickyGroupPadding:
         narrow = [gang("n", [group("n-0", cpu=1.0, count=1)])]
         _, prob_wide = sched._solve_batch(nodes, wide, None, with_alloc=False)
         assert prob_wide.demand.shape[1] == 3
-        assert sched._pad_groups == 3
+        assert sched._pad_groups._width == 3
         # a later narrow batch keeps the wide padding -> same compiled shape
         _, prob_narrow = sched._solve_batch(
             nodes, narrow, None, with_alloc=False
