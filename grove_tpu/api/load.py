@@ -32,3 +32,25 @@ def load_podcliqueset_file(path: str) -> PodCliqueSet:
     if len(sets) != 1:
         raise ValueError(f"{path}: expected exactly one PodCliqueSet, got {len(sets)}")
     return sets[0]
+
+
+def load_manifest_objects(text: str) -> list:
+    """Multi-doc manifest → typed objects for ANY wire-registered kind.
+
+    PodCliqueSet keeps the hand-written ``from_dict`` path (the compat
+    contract with reference-format manifests); every other kind —
+    ClusterTopology, PodGang, ... — decodes through the wire kind
+    registry. Offline consumers (CLI validate/apply, tests) share this so
+    mixed-kind manifests behave identically everywhere.
+    """
+    from grove_tpu.api.wire import decode_object
+
+    out = []
+    for doc in yaml.safe_load_all(text):
+        if not doc:
+            continue
+        if doc.get("kind") == "PodCliqueSet":
+            out.append(PodCliqueSet.from_dict(doc))
+        else:
+            out.append(decode_object(doc))
+    return out

@@ -15,30 +15,46 @@ from typing import List
 
 
 def _cmd_validate(args) -> int:
+    """Admission-check manifests of any webhook-validated kind: the same
+    defaulting+validation the operator's webhooks run, offline
+    (PodCliqueSet and ClusterTopology — mirroring the reference's two
+    validating-webhook targets)."""
     from grove_tpu.admission.defaulting import default_podcliqueset
-    from grove_tpu.admission.validation import validate_podcliqueset
-    from grove_tpu.api.load import load_podcliquesets
+    from grove_tpu.admission.validation import (
+        validate_cluster_topology,
+        validate_podcliqueset,
+    )
+    from grove_tpu.api.load import load_manifest_objects
     from grove_tpu.api.topology import ClusterTopology
+    from grove_tpu.api.types import PodCliqueSet
 
     failed = 0
     for path in args.manifests:
         with open(path) as f:
             try:
-                sets = load_podcliquesets(f.read())
+                objs = load_manifest_objects(f.read())
+                for obj in objs:
+                    if not isinstance(obj, (PodCliqueSet, ClusterTopology)):
+                        raise ValueError(
+                            f"kind {obj.kind!r} has no admission validator"
+                        )
             except Exception as exc:
                 print(f"{path}: LOAD ERROR: {exc}")
                 failed += 1
                 continue
-        for pcs in sets:
-            default_podcliqueset(pcs)
-            res = validate_podcliqueset(pcs, ClusterTopology())
+        for obj in objs:
+            if isinstance(obj, ClusterTopology):
+                res = validate_cluster_topology(obj)
+            else:
+                default_podcliqueset(obj)
+                res = validate_podcliqueset(obj, ClusterTopology())
             if res.ok:
-                print(f"{path}: {pcs.metadata.name}: OK")
+                print(f"{path}: {obj.metadata.name}: OK")
                 for w in res.warnings:
                     print(f"  warning: {w}")
             else:
                 failed += 1
-                print(f"{path}: {pcs.metadata.name}: INVALID")
+                print(f"{path}: {obj.metadata.name}: INVALID")
                 for e in res.errors:
                     print(f"  {e}")
     return 1 if failed else 0

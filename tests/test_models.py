@@ -32,6 +32,65 @@ class TestScenarioModels:
         multi = load_sample("multinode_disaggregated")
         assert multi.spec.template.pod_clique_scaling_group_configs
 
+    def test_explicit_startup_order_samples(self):
+        """simple2/simple3 quickstart-parity pair (reference
+        operator/samples/simple/simple{2,3}-explicit-startup-order.yaml):
+        explicit startup diamond, and ordering across the scaling-group
+        boundary."""
+        s2 = load_sample("simple2-explicit-startup-order.yaml")
+        default_podcliqueset(s2)
+        res = validate_podcliqueset(s2, ClusterTopology())
+        assert res.ok, res.errors
+        assert s2.spec.template.startup_type == "CliqueStartupTypeExplicit"
+        after = {
+            c.name: list(c.spec.starts_after)
+            for c in s2.spec.template.cliques
+        }
+        assert after["router"] == []
+        assert after["encoder"] == ["router"]
+        assert after["retriever"] == ["router"]
+        assert set(after["ranker"]) == {"encoder", "retriever"}
+
+        s3 = load_sample("simple3-explicit-startup-order.yaml")
+        default_podcliqueset(s3)
+        res = validate_podcliqueset(s3, ClusterTopology())
+        assert res.ok, res.errors
+        sg = s3.spec.template.pod_clique_scaling_group_configs
+        assert len(sg) == 1 and set(sg[0].clique_names) == {
+            "encoder", "retriever", "ranker",
+        }
+        # auditor: standalone clique gating on scaling-group cliques
+        auditor = next(
+            c for c in s3.spec.template.cliques if c.name == "auditor"
+        )
+        assert set(auditor.spec.starts_after) == {"encoder", "retriever"}
+
+    def test_cluster_topology_sample(self):
+        """Curated ClusterTopology CR for the TPU hierarchy (reference
+        analogue: samples/clustertopology/cluster-topology-host-only.yaml).
+        Decodes through the wire registry and passes admission."""
+        import yaml
+
+        from grove_tpu.admission.validation import validate_cluster_topology
+        from grove_tpu.api.wire import decode_object
+        from grove_tpu.models.scenarios import SAMPLES_DIR
+
+        doc = yaml.safe_load(
+            (SAMPLES_DIR / "cluster-topology-tpu.yaml").read_text()
+        )
+        topo = decode_object(doc)
+        assert isinstance(topo, ClusterTopology)
+        res = validate_cluster_topology(topo)
+        assert res.ok, res.errors
+        assert [l.domain for l in topo.spec.levels] == [
+            "zone", "cluster", "slice", "ici-block", "host",
+        ]
+        # the narrowest level drives the auto-generated preferred constraint
+        assert topo.narrowest_key() == "kubernetes.io/hostname"
+        assert topo.translate_pack_domain("slice") == (
+            "cloud.google.com/gke-tpu-slice"
+        )
+
     def test_stress_problem_shape_and_mix(self):
         problem = build_stress_problem(256, 64)
         assert problem.num_nodes == 256
