@@ -219,9 +219,12 @@ def _fill_dispatch(
     return _fill_floors_first(free, mask, demand, count, min_count, uniform)
 
 
-def _fill(free, mask, demand, count):
+def _fill(free, mask, demand, count, unroll=False):
     """Sequentially fill each group inside `mask` (nodes are topology-sorted,
     so the exclusive-cumsum take packs into contiguous domains first).
+    `unroll` (static): unroll the group scan — worth it when P is small and
+    the last group's carry (free_after) is dead downstream, which a scan
+    must still compute but an unrolled chain lets XLA eliminate.
     Returns (alloc [P,N], placed [P], free_after)."""
 
     def group_step(free_c, inputs):
@@ -236,8 +239,50 @@ def _fill(free, mask, demand, count):
         free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
         return free_c, (take, take.sum())
 
-    free_after, (alloc, placed) = jax.lax.scan(group_step, free, (demand, count))
+    free_after, (alloc, placed) = jax.lax.scan(
+        group_step, free, (demand, count), unroll=unroll
+    )
     return alloc, placed, free_after
+
+
+def _fill_slab_pair(free, sl_start, sl_end, gang: GangInputs, cs_pair, eff):
+    """Uniform fill over the contiguous node slab [sl_start, sl_end) using
+    the chunk-shared capped-fit prefix tables (`cs_pair [U, N+1]`) instead
+    of per-gang divides.
+
+    Group 0 always fills against the pristine chunk snapshot (every gang
+    in the wave decides against the same `free`), so its per-node take is
+    pure boundary math on its pair's prefix row — the [N, R] divide, the
+    [N] min-reduce AND the [N] cumsum of the generic fill all collapse
+    into one row gather (bit-exact: the row is the same capped-fit cumsum
+    the generic fill would compute). Later groups see free mutated by
+    group 0's take, so they keep the generic path (unrolled: the final
+    free update is dead and XLA removes it).
+
+    Caller guarantees (static): uniform (floors == counts), no group
+    constraints, no spread, no recovery pins, lazy_rescue (free_after is
+    never consumed). Returns (alloc [P,N], placed [P])."""
+    p_dim = gang.demand.shape[0]
+    n_nodes = free.shape[0]
+    cs0 = cs_pair[eff[0]]  # [N+1] row gather: capped-fit prefix sums
+    k0 = cs0[1:] - cs0[:-1]  # capped per-node fits (recovered, no divide)
+    n_idx = jnp.arange(n_nodes)
+    in_slab = (n_idx >= sl_start) & (n_idx < sl_end)
+    cnt0 = gang.count[0]
+    # exclusive prefix WITHIN the slab = cs - cs[start] (zeros before the
+    # slab never contribute; positions outside the slab are masked anyway)
+    cumex = cs0[:-1] - cs0[sl_start]
+    take0 = jnp.where(in_slab, jnp.clip(cnt0 - cumex, 0, k0), 0)
+    placed0 = jnp.minimum(cnt0, cs0[sl_end] - cs0[sl_start])
+    if p_dim == 1:
+        return take0[None], placed0[None]
+    free1 = free - take0[:, None].astype(free.dtype) * gang.demand[0][None, :]
+    alloc_rest, placed_rest, _ = _fill(
+        free1, in_slab, gang.demand[1:], gang.count[1:], unroll=True
+    )
+    alloc = jnp.concatenate([take0[None], alloc_rest], axis=0)
+    placed = jnp.concatenate([placed0[None], placed_rest])
+    return alloc, placed
 
 
 def _spread_defaults(
@@ -503,25 +548,35 @@ def _aggregate_tables(free: jnp.ndarray, gang: GangInputs, cs_pair=None):
 
 
 def _coloc_score(
-    alloc, placed_total, seg_starts, seg_ends, weights, ok
+    alloc, placed_total, seg_starts, seg_ends, weights, ok, seg_list=None
 ):
-    """Level-weighted dominant-domain co-location score (shared)."""
-    n_levels = seg_starts.shape[0]
+    """Level-weighted dominant-domain co-location score (shared).
+
+    `seg_list` (optional): per-level ragged (starts, ends) views — the
+    padded [L, D] rows pad EVERY level to the broadest level's domain
+    count (host level: one domain per node), so the boundary gathers of
+    the narrow levels read mostly padding; the ragged views keep them at
+    their true width (identical values — padding only appends empty
+    ranges whose max can never win)."""
+    n_levels = seg_starts.shape[0] if seg_list is None else len(seg_list)
     pods_per_node = alloc.sum(axis=0)
     total = jnp.maximum(placed_total.sum(), 1)
     cs_pods = jnp.concatenate(
         [jnp.zeros((1,), dtype=pods_per_node.dtype), jnp.cumsum(pods_per_node)]
     )
-    score = sum(
-        weights[l]
-        * (
-            jnp.max(cs_pods[seg_ends[l]] - cs_pods[seg_starts[l]]).astype(
-                jnp.float32
-            )
+
+    def bounds(l):
+        if seg_list is not None:
+            return seg_list[l]
+        return seg_starts[l], seg_ends[l]
+
+    score = 0.0
+    for l in range(n_levels):
+        starts_l, ends_l = bounds(l)
+        score = score + weights[l] * (
+            jnp.max(cs_pods[ends_l] - cs_pods[starts_l]).astype(jnp.float32)
             / total.astype(jnp.float32)
         )
-        for l in range(n_levels)
-    )
     return jnp.clip(jnp.where(ok, score, 0.0), 0.0, 1.0)
 
 
@@ -783,7 +838,13 @@ def solve_packing(
     }
 
 
-@partial(jax.jit, static_argnames=("commit_iters", "grouped", "pinned", "spread", "uniform"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "commit_iters", "grouped", "pinned", "spread", "uniform",
+        "level_widths",
+    ),
+)
 def solve_wave_chunk(
     free: jnp.ndarray,  # [N, R]
     topo: jnp.ndarray,  # [N, L]
@@ -812,9 +873,16 @@ def solve_wave_chunk(
     pinned: bool = False,
     spread: bool = False,
     uniform: bool = False,
+    level_widths: tuple = None,
 ):
     """One wave over one chunk, with per-pod allocations materialized (the
     binding path). Same core as the device-resident stats solver."""
+    seg_list = None
+    if level_widths is not None:
+        seg_list = tuple(
+            (seg_starts[l, :w], seg_ends[l, :w])
+            for l, w in enumerate(level_widths)
+        )
     if group_req is None:
         group_req = jnp.full(count.shape, -1, dtype=jnp.int32)
     if group_pin is None:
@@ -853,6 +921,7 @@ def solve_wave_chunk(
             pair_cap=pair_count,
             uidx=pair_idx,
             uniform=uniform,
+            seg_list=seg_list,
         )
     )
     n_levels = topo.shape[1]
@@ -882,7 +951,7 @@ def wave_chunk_core(
     spreadlvl, spreadmin, spreadreq, spreadseed, commit_iters,
     grouped=False, pinned=False, spread=False,
     pair_dem=None, pair_cap=None, uidx=None, uniform=False,
-    lazy_rescue=False,
+    lazy_rescue=False, seg_list=None,
 ):
     """Decide one chunk of gangs in parallel (gang_select_single vmapped over
     the chunk against one capacity snapshot), commit via iterative vectorized
@@ -927,8 +996,8 @@ def wave_chunk_core(
             *xs, grouped=grouped, pinned=pinned, spread=spread,
             uniform=uniform, lazy_rescue=lazy_rescue,
         ),
-        in_axes=(None, None, None, None, 0, 0, 0, None),
-    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds, cs_pair)
+        in_axes=(None, None, None, None, 0, 0, 0, None, None),
+    )(free, topo, seg_starts, seg_ends, inputs, ncap, seeds, cs_pair, seg_list)
 
     usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
     accept = ok
@@ -967,7 +1036,7 @@ def wave_chunk_core(
 
 def gang_select_single(
     free, topo, seg_starts, seg_ends, gang: GangInputs, narrow_cap, seed,
-    cs_pair=None,
+    cs_pair=None, seg_list=None,
     grouped: bool = False, pinned: bool = False, spread: bool = False,
     uniform: bool = False, lazy_rescue: bool = False,
 ):
@@ -1004,7 +1073,15 @@ def gang_select_single(
 
     oks, bests = [], []
     for l in range(n_levels):
-        starts, ends = seg_starts[l], seg_ends[l]
+        # ragged per-level views when provided: the padded [L, D] rows pad
+        # every level to the broadest level's width (host level = N
+        # domains), so the narrow levels' [P, D] boundary gathers below
+        # would read ~4x more padding than data at stress shape
+        # (1/1/80/640/5120 real domains, all padded to 5120)
+        if seg_list is not None:
+            starts, ends = seg_list[l]
+        else:
+            starts, ends = seg_starts[l], seg_ends[l]
         if cs_k is None:
             K = (
                 cs_pair[eff[:, None], ends[None, :]]
@@ -1074,19 +1151,47 @@ def gang_select_single(
     use_cluster = (~has_level) & (gang.req_level < 0) & any_active
     had_candidate = has_level | use_cluster
 
-    all_nodes = jnp.ones((n_nodes,), dtype=bool)
-    no_nodes = jnp.zeros((n_nodes,), dtype=bool)
-    packed_mask = (topo[:, chosen_level] == bests[chosen_level]) & pin_mask
-    mask = jnp.where(
-        has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
+    # Slab fast path (the stress-bench configuration): with the dedup
+    # tables present and no grouped/spread/pin machinery, every fill mask
+    # is a contiguous node slab — the chosen level's picked domain, the
+    # whole cluster, or nothing — so the fill can run on slab BOUNDS and
+    # reuse the chunk-shared prefix tables instead of per-gang divides
+    # (_fill_slab_pair). lazy_rescue is required because this path never
+    # materializes free_after (the eager rescue consumes it).
+    use_slab_fill = (
+        cs_pair is not None and gang.uidx is not None and uniform
+        and lazy_rescue and not grouped and not spread and not pinned
     )
-
-    alloc, placed, placed_min, free_after, used, spread_on = (
-        _dispatch_with_spread(
-            spread, grouped, free, mask, gang,
-            topo, seg_starts, seg_ends, seed, uniform,
+    if use_slab_fill:
+        sl_start = jnp.where(
+            has_level,
+            seg_starts[chosen_level, bests[chosen_level]],
+            jnp.int32(0),
         )
-    )
+        sl_end = jnp.where(
+            has_level,
+            seg_ends[chosen_level, bests[chosen_level]],
+            jnp.where(use_cluster, jnp.int32(n_nodes), jnp.int32(0)),
+        )
+        alloc, placed = _fill_slab_pair(
+            free, sl_start, sl_end, gang, cs_pair, eff
+        )
+        placed_min = placed  # uniform: floors ARE the counts
+        used, spread_on = jnp.int32(0), jnp.asarray(False)
+    else:
+        all_nodes = jnp.ones((n_nodes,), dtype=bool)
+        no_nodes = jnp.zeros((n_nodes,), dtype=bool)
+        packed_mask = (topo[:, chosen_level] == bests[chosen_level]) & pin_mask
+        mask = jnp.where(
+            has_level, packed_mask, jnp.where(use_cluster, all_nodes, no_nodes)
+        )
+
+        alloc, placed, placed_min, free_after, used, spread_on = (
+            _dispatch_with_spread(
+                spread, grouped, free, mask, gang,
+                topo, seg_starts, seg_ends, seed, uniform,
+            )
+        )
     level_fill_ok = (
         had_candidate
         & any_active
@@ -1182,7 +1287,9 @@ def gang_select_single(
     alloc = jnp.where(fill_ok, alloc, 0)
     placed = jnp.where(fill_ok, placed, 0)
 
-    score = _coloc_score(alloc, placed, seg_starts, seg_ends, weights, fill_ok)
+    score = _coloc_score(
+        alloc, placed, seg_starts, seg_ends, weights, fill_ok, seg_list
+    )
     score = jnp.where(
         fill_ok, _spread_score(gang, spread_on, used, placed.sum(), score), 0.0
     )
@@ -1197,7 +1304,7 @@ def gang_select_single(
     jax.jit,
     static_argnames=(
         "n_chunks", "max_waves", "commit_iters", "grouped", "pinned",
-        "spread", "uniform", "lazy_rescue",
+        "spread", "uniform", "lazy_rescue", "level_widths",
     ),
 )
 def solve_waves_device(
@@ -1239,6 +1346,10 @@ def solve_waves_device(
     spread: bool = False,
     uniform: bool = False,
     lazy_rescue: bool = False,
+    # per-level REAL domain counts (static; host-derived from the
+    # topology): lets the candidate scan and score use ragged per-level
+    # segment views instead of rows padded to the broadest level's width
+    level_widths: tuple = None,
 ):
     """Whole multi-wave wave-parallel solve in ONE device program — zero
     host↔device round trips until the final results (critical when the chip
@@ -1271,6 +1382,12 @@ def solve_waves_device(
         and not pinned
     )
     c = g_total // n_chunks
+    seg_list = None
+    if level_widths is not None:
+        seg_list = tuple(
+            (seg_starts[l, :w], seg_ends[l, :w])
+            for l, w in enumerate(level_widths)
+        )
 
     def reshape_chunks(a):
         return a.reshape((n_chunks, c) + a.shape[1:])
@@ -1326,6 +1443,7 @@ def solve_waves_device(
                 uidx=uidx_c,
                 uniform=uniform,
                 lazy_rescue=lazy_rescue,
+                seg_list=seg_list,
             )
         )
         return free, (accept, placed, score, chosen, retry, new_cap, fill_failed)

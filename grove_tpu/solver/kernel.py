@@ -272,6 +272,7 @@ def solve_waves(
     spread = bool((spread_level >= 0).any())
     # padded gangs have min_count == count == 0, preserving uniformity
     uniform = bool((problem.min_count == problem.count).all())
+    level_widths = level_widths_of(problem)
     dedup_extra = dedup_extra_args(demand, count, n_chunks, pinned)
     pidx_chunks = None
     if dedup_extra:
@@ -342,6 +343,7 @@ def solve_waves(
                 pinned=pinned,
                 spread=spread,
                 uniform=uniform,
+                level_widths=level_widths,
             )
             committed = np.asarray(out["admitted"])
             retry = np.asarray(out["retry"])
@@ -376,6 +378,17 @@ def solve_waves(
         free_after=np.asarray(free),
         solve_seconds=elapsed,
     )
+
+
+def level_widths_of(problem: PackingProblem) -> tuple:
+    """Per-level REAL domain counts (dense ids ⇒ max id + 1), the static
+    `level_widths` for the wave solvers' ragged candidate scan. Derived
+    from the topology only — stable for a given cluster, so repeat solves
+    keep hitting one executable."""
+    topo = np.asarray(problem.topo)
+    if topo.size == 0:
+        return tuple(1 for _ in range(topo.shape[1]))
+    return tuple(int(topo[:, l].max()) + 1 for l in range(topo.shape[1]))
 
 
 def pad_problem_for_waves(
@@ -458,6 +471,9 @@ def solve_waves_stats(
     args = tuple(jnp.asarray(a) for a in raw_args)
     # encode-time demand dedup (exact semantics; packing.wave_chunk_core)
     extra = dedup_extra_args(raw_args[4], raw_args[5], n_chunks, pinned)
+    # ragged candidate scan: per-level REAL domain counts (static, derived
+    # from the topology — stable for a given cluster, so no compile churn)
+    level_widths = level_widths_of(problem)
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         tuple(extra["pair_demand"].shape) if extra else None,
         n_chunks,
@@ -466,6 +482,7 @@ def solve_waves_stats(
         pinned,
         spread,
         uniform,
+        level_widths,
     )  # lazy_rescue == uniform, so the sig needs no extra field
     compiled = _compiled_cache.get(sig)
     if compiled is None:
@@ -483,6 +500,7 @@ def solve_waves_stats(
             # all-or-nothing populations defer cluster rescues to the next
             # compacted wave instead of paying an in-wave second fill
             lazy_rescue=uniform,
+            level_widths=level_widths,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
