@@ -161,6 +161,10 @@ class HttpStore:
     def _watch_loop(self, kind: str) -> None:
         path = self._path(kind, None, None)
         url = self.base_url + path + "?watch=true"
+        # informer-local last-seen objects: lets MODIFIED events carry the
+        # previous object (WatchEvent.old) so transition predicates work in
+        # cluster mode too; a reconnect clears it (old=None fails open)
+        last: dict = {}
         while not self._stop.is_set():
             try:
                 with urllib.request.urlopen(url, timeout=None) as resp:
@@ -171,17 +175,24 @@ class HttpStore:
                         if not line:
                             continue
                         payload = json.loads(line)
+                        obj = decode_object(payload["object"])
+                        key = (obj.metadata.namespace, obj.metadata.name)
                         # wire uses k8s event casing; Store uses title case
+                        type_ = payload["type"].capitalize()
+                        old = last.get(key)
+                        if type_ == "Deleted":
+                            last.pop(key, None)
+                        else:
+                            last[key] = obj
                         ev = WatchEvent(
-                            type=payload["type"].capitalize(),
-                            kind=kind,
-                            obj=decode_object(payload["object"]),
+                            type=type_, kind=kind, obj=obj, old=old
                         )
                         for w in list(self._watchers):
                             w(ev)
             except Exception:
                 if self._stop.is_set():
                     return
+                last.clear()
                 self._stop.wait(0.2)  # reconnect (server restart etc.)
 
     # -- CRUD -------------------------------------------------------------

@@ -58,14 +58,22 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique) -> int:
         - pclq.spec.replicas
         - len(pending_deletes)
     )
+    created = 0
     if diff < 0:
-        _create_pods(ctx, pclq, -diff, cached_pods)
+        created = _create_pods(ctx, pclq, -diff, cached_pods)
     elif diff > 0:
         _delete_excess_pods(ctx, pclq, diff, cached_pods, pending_deletes)
 
     _process_pending_updates(ctx, pclq, cached_pods, pending_deletes)
 
-    return _remove_scheduling_gates(ctx, pclq)
+    # Pods created THIS reconcile are born schedule-gated but may not be
+    # visible to the cached gate scan yet (informer lag) — count them as
+    # still-gated so the reconciler schedules the gate-retry requeue.
+    # Without this, a creating reconcile can return "all clear" and, with
+    # pod-ADDED events predicate-filtered (reference podPredicate
+    # CreateFunc=false, podclique/register.go:102), nothing would ever
+    # revisit the gate.
+    return created + _remove_scheduling_gates(ctx, pclq)
 
 
 def _process_pending_updates(
@@ -115,7 +123,7 @@ def _process_pending_updates(
 
 def _create_pods(
     ctx: OperatorContext, pclq: PodClique, count: int, existing: List[Pod]
-) -> None:
+) -> int:
     from grove_tpu.runtime.errors import GroveError
     from grove_tpu.utils.concurrent import Task, run_concurrently_with_slow_start
 
@@ -145,6 +153,7 @@ def _create_pods(
         raise GroveError(
             "ERR_SYNC_PODS", result.summary(), f"create-pods {pclq.metadata.name}"
         )
+    return len(indices)
 
 
 def build_pod(ctx: OperatorContext, pclq: PodClique, pod_index: int) -> Pod:

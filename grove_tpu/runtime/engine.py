@@ -31,6 +31,7 @@ from grove_tpu.runtime.store import Store, WatchEvent
 from grove_tpu.runtime.workqueue import Key, WorkQueue
 
 MapFn = Callable[[WatchEvent], List[Tuple[str, str]]]  # -> [(namespace, name)]
+PredicateFn = Callable[[WatchEvent], bool]
 ReconcileFn = Callable[[Key], ReconcileStepResult]
 
 
@@ -39,7 +40,15 @@ class Controller:
     name: str
     kind: str
     reconcile: ReconcileFn
-    watches: List[Tuple[str, MapFn]] = field(default_factory=list)
+    # watch entries: (kind, map_fn) or (kind, map_fn, predicate) — the
+    # predicate is controller-runtime's builder.WithPredicates: an event it
+    # rejects never reaches the map fn (reference register.go:100-171
+    # predicate.Funcs). Without one, every event of the kind enqueues.
+    watches: List[tuple] = field(default_factory=list)
+    # predicate on the PRIMARY kind's own events (For(..., WithPredicates)),
+    # e.g. GenerationChangedPredicate so self-inflicted status writes don't
+    # re-enqueue the owner (podcliqueset/register.go:53)
+    primary_predicate: Optional[PredicateFn] = None
     queue: WorkQueue = field(default_factory=WorkQueue)
     # ConcurrentSyncs equivalent: keys processed per engine round. In the
     # default single-threaded drain this is batching; drain_concurrent runs
@@ -118,14 +127,27 @@ class Engine:
             if self.store.cache_lag:
                 self.store.apply_event_to_cache(ev)
             for ctrl in self.controllers:
-                if ev.kind == ctrl.kind:
+                if ev.kind == ctrl.kind and (
+                    ctrl.primary_predicate is None or ctrl.primary_predicate(ev)
+                ):
+                    METRICS.inc(f"events_enqueued/{ctrl.name}/self")
                     ctrl.queue.add(
                         (ctrl.kind, ev.obj.metadata.namespace, ev.obj.metadata.name)
                     )
-                for watched_kind, map_fn in ctrl.watches:
-                    if ev.kind == watched_kind:
-                        for ns, name in map_fn(ev):
-                            ctrl.queue.add((ctrl.kind, ns, name))
+                for watch in ctrl.watches:
+                    watched_kind, map_fn = watch[0], watch[1]
+                    if ev.kind != watched_kind:
+                        continue
+                    if len(watch) > 2 and watch[2] is not None and not watch[2](ev):
+                        continue
+                    hits = map_fn(ev)
+                    if hits:
+                        METRICS.inc(
+                            f"events_enqueued/{ctrl.name}/{watched_kind}",
+                            len(hits),
+                        )
+                    for ns, name in hits:
+                        ctrl.queue.add((ctrl.kind, ns, name))
         self._event_backlog.extend(remaining)
 
     # -- run loop --------------------------------------------------------

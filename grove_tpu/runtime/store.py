@@ -70,6 +70,11 @@ class WatchEvent:
     obj: object  # READ-ONLY view shared by all subscribers — never mutate;
     # call materialize() for a private copy
     blob: Optional[bytes] = field(default=None, repr=False, compare=False)
+    # previous committed object on MODIFIED events (same read-only
+    # contract) — what controller-runtime's UpdateEvent.ObjectOld carries,
+    # so watch predicates can gate on actual state TRANSITIONS
+    # (reference register.go predicate.Funcs UpdateFunc(old, new))
+    old: Optional[object] = field(default=None, repr=False, compare=False)
 
     def materialize(self):
         """Private deep copy of the event payload (cheap: pre-pickled)."""
@@ -183,11 +188,13 @@ class Store:
     def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
         self._watchers.append(fn)
 
-    def _emit(self, type_: str, obj, blob: Optional[bytes]) -> None:
+    def _emit(
+        self, type_: str, obj, blob: Optional[bytes], old: object = None
+    ) -> None:
         # zero-copy fanout: committed objects are immutable once stored, so
         # every subscriber may share the payload; WatchEvent.materialize()
         # (pre-pickled) is the escape hatch for watchers that must mutate
-        ev = WatchEvent(type=type_, kind=obj.kind, obj=obj, blob=blob)
+        ev = WatchEvent(type=type_, kind=obj.kind, obj=obj, blob=blob, old=old)
         for w in self._watchers:
             w(ev)
 
@@ -307,7 +314,13 @@ class Store:
                 if blob is None:
                     self.unverified_readonly += 1
                     continue
-                if _dumps(obj) != blob:
+                # byte compare first; pickle is not byte-idempotent for
+                # every graph (e.g. an attribute string aliasing the
+                # pickled class-name string dumps as a memo BINGET from
+                # the caller's object but as a fresh string after loads),
+                # so a byte mismatch falls back to structural equality —
+                # a mutated readonly view still differs structurally
+                if _dumps(obj) != blob and pickle.loads(blob) != obj:
                     raise AssertionError(
                         f"readonly contract violated: committed {kind} {key} "
                         "no longer matches its canonical blob — some caller "
@@ -336,13 +349,35 @@ class Store:
             raise GroveError(
                 ERR_CONFLICT, f"{obj.kind} {key} already exists", "create"
             )
-        stored = deep_copy(obj)  # caller keeps ownership of its argument
+        # Serialize ONCE with the final identity already stamped: the same
+        # bytes are the private committed copy (loads) and the canonical
+        # blob (a deep_copy + commit-time dumps would pickle twice; create
+        # is a per-pod cost at stress scale). The caller keeps ownership of
+        # its argument — its metadata is restored below via the identity
+        # copy-back.
+        meta = obj.metadata
+        saved = (
+            meta.uid,
+            meta.resource_version,
+            meta.generation,
+            meta.creation_timestamp,
+        )
         self._rv += 1
-        stored.metadata.uid = stored.metadata.uid or next_uid()
-        stored.metadata.resource_version = self._rv
-        stored.metadata.generation = 1
-        stored.metadata.creation_timestamp = self.clock.now()
-        blob = self._commit(stored)
+        try:
+            meta.uid = meta.uid or next_uid()
+            meta.resource_version = self._rv
+            meta.generation = 1
+            meta.creation_timestamp = self.clock.now()
+            blob = _dumps(obj)
+            stored = pickle.loads(blob) if blob is not None else deep_copy(obj)
+        finally:
+            (
+                meta.uid,
+                meta.resource_version,
+                meta.generation,
+                meta.creation_timestamp,
+            ) = saved
+        self._commit(stored, blob)
         self._emit(ADDED, stored, blob)
         # return the CALLER's object carrying the committed identity — its
         # content is what was committed (stored was copied from it), so a
@@ -442,16 +477,19 @@ class Store:
                 f"{current.metadata.resource_version}",
                 "update",
             )
-        # No-op detection, fast path first: pickle `obj` with its metadata
-        # bookkeeping normalized to current's and byte-compare against the
-        # canonical committed blob. Identical bytes prove a no-op with ONE
-        # dumps and no copies. Differing bytes fall back to the structural
-        # comparison (pickle is order-sensitive for dicts, so byte
-        # inequality does not prove semantic inequality). No-op writes get
-        # no version bump and no event — the role the reference's change
-        # predicates (GenerationChanged etc.) play in preventing
-        # self-triggering reconcile livelock.
-        cur_blob = self._blob.get(obj.kind, {}).get(key)
+        # No-op detection by STRUCTURAL equality with `obj`'s metadata
+        # bookkeeping normalized to current's — zero serialization on the
+        # no-op path (dataclass __eq__ short-circuits at the first real
+        # difference on the write path). No-op writes get no version bump
+        # and no event — the role the reference's change predicates
+        # (GenerationChanged etc.) play in preventing self-triggering
+        # reconcile livelock.
+        #
+        # A real write then pickles ONCE, with the FINAL metadata already
+        # in place, so the same bytes serve as both the private committed
+        # copy (loads) and the canonical blob — round 4 paid dumps(norm) +
+        # loads + dumps(commit) per write; profiling the 10k-set
+        # integrated bench put pickle at the top of control-plane cost.
         meta = obj.metadata
         saved = (
             meta.resource_version,
@@ -459,19 +497,7 @@ class Store:
             meta.uid,
             meta.creation_timestamp,
         )
-        try:
-            meta.resource_version = current.metadata.resource_version
-            meta.generation = current.metadata.generation
-            meta.uid = current.metadata.uid
-            meta.creation_timestamp = current.metadata.creation_timestamp
-            blob_norm = _dumps(obj)
-        finally:
-            (
-                meta.resource_version,
-                meta.generation,
-                meta.uid,
-                meta.creation_timestamp,
-            ) = saved
+
         def _return_caller_obj(committed) -> object:
             # hand the CALLER's object back carrying the committed identity
             # (no materialized copy: obj's content is what was committed —
@@ -483,24 +509,34 @@ class Store:
             meta.creation_timestamp = committed.metadata.creation_timestamp
             return obj
 
-        if blob_norm is not None and blob_norm == cur_blob:
-            return _return_caller_obj(current)
-        if blob_norm is not None:
-            stored = pickle.loads(blob_norm)  # private copy, metadata normalized
-        else:
-            stored = deep_copy(obj)
-            stored.metadata.uid = current.metadata.uid
-            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
-        if _semantically_equal(stored, current):
-            return _return_caller_obj(current)
+        try:
+            meta.resource_version = current.metadata.resource_version
+            meta.generation = current.metadata.generation
+            meta.uid = current.metadata.uid
+            meta.creation_timestamp = current.metadata.creation_timestamp
+            if obj == current:
+                return _return_caller_obj(current)
+            # real write: stamp the final identity and serialize once
+            meta.resource_version = self._rv + 1
+            meta.generation = current.metadata.generation + (
+                1 if bump_generation else 0
+            )
+            blob = _dumps(obj)
+            if blob is not None:
+                stored = pickle.loads(blob)  # private committed copy
+            else:  # unpicklable: fall back to a structural deep copy
+                stored = deep_copy(obj)
+        finally:
+            (
+                meta.resource_version,
+                meta.generation,
+                meta.uid,
+                meta.creation_timestamp,
+            ) = saved
         self._rv += 1
-        stored.metadata.resource_version = self._rv
-        stored.metadata.generation = current.metadata.generation + (
-            1 if bump_generation else 0
-        )
         self._index_remove(current)
-        blob = self._commit(stored)
-        self._emit(MODIFIED, stored, blob)
+        self._commit(stored, blob)
+        self._emit(MODIFIED, stored, blob, old=current)
         return _return_caller_obj(stored)
 
     def update_status(self, obj) -> object:
@@ -525,7 +561,7 @@ class Store:
                 stored.metadata.resource_version = self._rv
                 self._index_remove(obj)
                 blob = self._commit(stored)
-                self._emit(MODIFIED, stored, blob)
+                self._emit(MODIFIED, stored, blob, old=obj)
             return
         blob = self._uncommit(obj)
         self._emit(DELETED, obj, blob)
@@ -546,7 +582,7 @@ class Store:
             stored.metadata.resource_version = self._rv
             self._index_remove(obj)
             blob = self._commit(stored)
-            self._emit(MODIFIED, stored, blob)
+            self._emit(MODIFIED, stored, blob, old=obj)
         self.complete_deletion_if_drained(kind, namespace, name)
 
     def complete_deletion_if_drained(
