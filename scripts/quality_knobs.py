@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Per-knob quality accounting at the BASELINE full-size shape (round-5
+VERDICT #8): for each admission-order-affecting solver knob, report
+admitted count, total score, and quality vs the exact oracle, so the
+aggregate >= 0.995 gate is not the only line of defense.
+
+Each row re-runs the full wave solve with ONE knob flipped from the bench
+default; the oracle row is the exact sequential kernel. Rows print as they
+complete (a killed run still shows the table so far).
+
+Usage: python -u scripts/quality_knobs.py [--nodes N] [--gangs G]
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=5120)
+    ap.add_argument("--gangs", type=int, default=10240)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from grove_tpu.models import build_stress_problem
+    from grove_tpu.ops.packing import solve_waves_device
+    from grove_tpu.solver.kernel import (
+        BENCH_CHUNK_SIZE,
+        BENCH_MAX_WAVES,
+        dedup_extra_args,
+        level_widths_of,
+        pad_problem_for_waves,
+        solve,
+    )
+
+    problem = build_stress_problem(args.nodes, args.gangs)
+    g = problem.num_gangs
+
+    exact = solve(problem, with_alloc=False)
+    oracle_score = float(exact.score.sum())
+    oracle_admitted = int(exact.admitted.sum())
+    print(
+        f"oracle (exact sequential): admitted={oracle_admitted} "
+        f"score={oracle_score:.1f}",
+        flush=True,
+    )
+
+    raw_args, n_chunks, grouped, pinned, spread, uniform = (
+        pad_problem_for_waves(problem, BENCH_CHUNK_SIZE)
+    )
+    dev_args = tuple(jnp.asarray(a) for a in raw_args)
+    extra = dedup_extra_args(raw_args[4], raw_args[5], n_chunks, pinned)
+    widths = level_widths_of(problem)
+
+    base = dict(
+        n_chunks=n_chunks,
+        max_waves=BENCH_MAX_WAVES,
+        grouped=grouped,
+        pinned=pinned,
+        spread=spread,
+        uniform=uniform,
+        lazy_rescue=uniform,
+        level_widths=widths,
+        commit_iters=0,
+    )
+    # knob -> overrides vs the bench default configuration
+    rows = [
+        ("bench default (commit_iters=0, lazy_rescue, dedup)", {}),
+        ("commit_iters=2 (pre-round-4 commit refinement)", {"commit_iters": 2}),
+        ("lazy_rescue=off (eager in-wave cluster rescue)", {"lazy_rescue": False}),
+        ("dedup=off (per-gang candidate tables)", {"_no_dedup": True}),
+        ("level_widths=off (padded candidate scan)", {"level_widths": None}),
+    ]
+    print(
+        f"{'knob':55s} {'admitted':>8s} {'score':>10s} {'quality':>8s}"
+        f" {'t(s)':>7s}",
+        flush=True,
+    )
+    for label, overrides in rows:
+        kwargs = dict(base)
+        call_extra = dict(extra)
+        if overrides.pop("_no_dedup", False):
+            call_extra = {}
+        kwargs.update(overrides)
+        t0 = time.perf_counter()
+        out = solve_waves_device(*dev_args, **call_extra, **kwargs)
+        admitted = int(out["admitted"][:g].sum())
+        # pending stragglers would go to the exact tail in solve_waves_stats;
+        # report the raw wave outcome here so the knob's own effect shows
+        score = float(out["score"][:g].sum())
+        dt = time.perf_counter() - t0
+        q = score / oracle_score if oracle_score else 1.0
+        flag = "" if q >= 0.995 else "  <-- BELOW 0.995 GATE"
+        print(
+            f"{label:55s} {admitted:8d} {score:10.1f} {q:8.4f} {dt:7.1f}{flag}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
