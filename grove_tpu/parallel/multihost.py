@@ -83,7 +83,9 @@ assert gathered.shape[0] == int(os.environ["NPROC"]), gathered.shape
 from jax.sharding import Mesh
 from grove_tpu.models import build_stress_problem
 from grove_tpu.parallel.sharded import solve_stress_sharded
-problem = build_stress_problem(16 * mesh.devices.size, 32)
+n_nodes = int(os.environ.get("SHAPE_NODES", "0")) or 16 * mesh.devices.size
+n_gangs = int(os.environ.get("SHAPE_GANGS", "0")) or 32
+problem = build_stress_problem(n_nodes, n_gangs)
 sharded = solve_stress_sharded(mesh, problem, chunk_size=16, max_waves=8)
 local_mesh = Mesh(
     np.array(jax.local_devices()[:1]).reshape(1, 1), ("dp", "tp")
@@ -97,9 +99,16 @@ print("MULTIHOST_OK", mesh.axis_names, tuple(mesh.devices.shape),
 """
 
 
-def spawn_local_cluster(num_processes: int = 2, port: int = 12765) -> bool:
+def spawn_local_cluster(
+    num_processes: int = 2,
+    port: int = 12765,
+    n_nodes: int = 0,
+    n_gangs: int = 0,
+    timeout: float = 120.0,
+) -> bool:
     """Spawn N single-device CPU processes that form one distributed mesh.
-    Returns True when every worker reports the global mesh."""
+    Returns True when every worker reports the global mesh. ``n_nodes``/
+    ``n_gangs`` override the worker's solve shape (0 = tiny default)."""
     import pathlib
     import subprocess
     import sys
@@ -115,6 +124,8 @@ def spawn_local_cluster(num_processes: int = 2, port: int = 12765) -> bool:
                 COORD=f"127.0.0.1:{port}",
                 NPROC=str(num_processes),
                 PID_IDX=str(pid),
+                SHAPE_NODES=str(n_nodes),
+                SHAPE_GANGS=str(n_gangs),
             )
             procs.append(
                 subprocess.Popen(
@@ -129,7 +140,7 @@ def spawn_local_cluster(num_processes: int = 2, port: int = 12765) -> bool:
         ok = True
         for proc in procs:
             try:
-                out, _ = proc.communicate(timeout=120)
+                out, _ = proc.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 ok = False
                 continue

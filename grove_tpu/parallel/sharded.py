@@ -122,7 +122,11 @@ def solve_stress_sharded(
     is purely a throughput/memory choice, never a semantics one.
     """
     from grove_tpu.ops.packing import solve_waves_device
-    from grove_tpu.solver.kernel import dedup_extra_args, pad_problem_for_waves
+    from grove_tpu.solver.kernel import (
+        dedup_extra_args,
+        level_widths_of,
+        pad_problem_for_waves,
+    )
 
     g = problem.num_gangs
     raw_args, n_chunks, grouped, pinned, spread, uniform = (
@@ -155,6 +159,10 @@ def solve_stress_sharded(
             spread=spread,
             uniform=uniform,
             lazy_rescue=uniform,
+            # ragged candidate scan (same bit-exact win as the single-chip
+            # path); the narrow levels' bounds are replicated scalars-wise,
+            # so the slicing doesn't change the node-axis sharding story
+            level_widths=level_widths_of(problem),
         )
 
     if jax.process_count() > 1:

@@ -116,6 +116,8 @@ def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int = None):
     )
     args = tuple(jnp.asarray(a) for a in raw)
     extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
+    from grove_tpu.solver.kernel import level_widths_of
+
     static = dict(
         n_chunks=n_chunks,
         max_waves=BENCH_MAX_WAVES,
@@ -127,6 +129,7 @@ def _stress_export_inputs(n_nodes: int, n_gangs: int, chunk: int = None):
         # committed artifact is only a proof if it is the program bench.py
         # times
         lazy_rescue=uniform,
+        level_widths=level_widths_of(problem),
     )
     return args, extra, static
 

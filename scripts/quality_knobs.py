@@ -20,8 +20,11 @@ import time
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-OVERRIDE (not setdefault): the dev box pre-sets an axon pool and
+# platform, and a setdefault would leave this script hanging on the
+# wedged chip (utils/platform.force_cpu_platform does the same scrub)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def main() -> None:
