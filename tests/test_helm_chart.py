@@ -25,6 +25,9 @@ VALUES["solver"]["enabled"] = True
 VALUES["config"]["leaderElection"]["enabled"] = True
 VALUES["operator"]["authorizer"] = True
 VALUES["operator"]["autoDetectTopology"] = True
+VALUES["webhooks"]["register"] = True
+VALUES["webhooks"]["caBundle"] = "Q0EgUEVN"
+VALUES["priorityClass"]["enabled"] = True
 
 CONTEXT = {
     "Release": {"Name": "grove", "Namespace": "grove-system", "Service": "Helm"},
@@ -283,6 +286,74 @@ class TestChart:
         ):
             assert kind in rendered_kinds, f"chart renders no {kind}"
         assert rendered_kinds.count("Deployment") == 2  # operator + solver
+        # real-apiserver topology manifests (reference charts/templates/
+        # *-webhook-config.yaml + priorityclass.yaml), values-gated
+        assert rendered_kinds.count("ValidatingWebhookConfiguration") == 3
+        assert "MutatingWebhookConfiguration" in rendered_kinds
+        assert "PriorityClass" in rendered_kinds
+
+    def test_webhook_configs_match_served_paths(self):
+        """Every clientConfig path the chart registers must be a route the
+        operator's webhook server actually serves (cluster/webhook.py) —
+        a renamed route breaks HERE, not at admission time in a real
+        cluster. Also: disabling webhooks.register must render nothing."""
+        import grove_tpu.cluster.webhook as webhook_mod
+
+        served = set(
+            re.findall(r"/webhooks/[\w-]+", pathlib.Path(webhook_mod.__file__).read_text())
+        )
+        tpl = (CHART / "templates" / "webhook-configs.yaml").read_text()
+        text = render(tpl)
+        registered = set(re.findall(r"path: (/webhooks/[\w-]+)", text))
+        assert registered, "webhook-configs rendered no webhook paths"
+        assert registered <= served, (
+            f"chart registers paths the server does not serve: "
+            f"{registered - served}"
+        )
+        # the Service object the chart actually renders: every clientConfig
+        # must reference ITS name and an exposed port, or a real apiserver
+        # resolves a nonexistent backend and (failurePolicy: Fail) rejects
+        # every CR write cluster-wide
+        svc_doc = next(
+            iter(
+                yaml.safe_load_all(
+                    render((CHART / "templates" / "service.yaml").read_text())
+                )
+            )
+        )
+        svc_ports = {p["port"] for p in svc_doc["spec"]["ports"]}
+        for doc in yaml.safe_load_all(text):
+            if doc is None:
+                continue
+            for wh in doc.get("webhooks", []):
+                ref = wh["clientConfig"]["service"]
+                assert ref["name"] == svc_doc["metadata"]["name"]
+                assert ref["port"] in svc_ports
+                assert wh["clientConfig"]["caBundle"] == (
+                    VALUES["webhooks"]["caBundle"]
+                )
+        # authorizer scope mirrors the in-process registration: every
+        # MANAGED_KIND's plural appears in some rule, with CREATE included
+        from grove_tpu.api.wire import KIND_REGISTRY
+        from grove_tpu.admission.authorization import MANAGED_KINDS
+
+        auth_doc = [
+            d
+            for d in yaml.safe_load_all(text)
+            if d and d["metadata"]["name"].endswith("-authorizer")
+        ]
+        assert auth_doc, "authorizer webhook config missing"
+        rules = auth_doc[0]["webhooks"][0]["rules"]
+        covered = {r for rule in rules for r in rule["resources"]}
+        for kind in MANAGED_KINDS:
+            assert KIND_REGISTRY[kind].plural in covered, kind
+        assert all("CREATE" in rule["operations"] for rule in rules)
+        saved = VALUES["webhooks"]["register"]
+        try:
+            VALUES["webhooks"]["register"] = False
+            assert not render(tpl).strip()
+        finally:
+            VALUES["webhooks"]["register"] = saved
 
     def test_values_references_resolve(self):
         """Every .Values path referenced by any template exists in
