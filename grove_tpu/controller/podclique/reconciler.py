@@ -47,7 +47,19 @@ class PodCliqueReconciler:
                 pclq = self.ctx.store.get("PodClique", ns, name)
                 pclq.metadata.finalizers.append(FINALIZER)
                 pclq = self.ctx.store.update(pclq, bump_generation=False)
-            skipped_gated = pod_component.sync_pods(self.ctx, pclq)
+            # ONE pod scan shared by the sync flow and the gate pass (both
+            # always decided against the pre-sync view — the diff math uses
+            # expectations for in-flight creates). The STATUS compute below
+            # keeps its own scan: it must reflect this reconcile's own
+            # mutations where the store view can show them (cluster mode),
+            # and the predicate rationale for filtering pod-ADDED events
+            # relies on the creating reconcile re-counting.
+            pods = list(
+                self.ctx.store.scan(
+                    "Pod", ns, {namegen.LABEL_PODCLIQUE: name}, cached=True
+                )
+            )
+            skipped_gated = pod_component.sync_pods(self.ctx, pclq, pods)
             view = self.ctx.store.get("PodClique", ns, name, readonly=True)
             if view is not None and view.metadata.deletion_timestamp is None:
                 # compute on the zero-copy view; write only on difference

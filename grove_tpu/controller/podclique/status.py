@@ -31,28 +31,27 @@ from grove_tpu.controller.common import OperatorContext
 UPDATE_IN_PROGRESS_ANNOTATION = "grove.io/update-in-progress"
 
 
-def compute_status(ctx: OperatorContext, pclq: PodClique):
+def compute_status(ctx: OperatorContext, pclq: PodClique, pods=None):
     """The status `pclq` SHOULD have, computed WITHOUT mutating it — safe on
     zero-copy readonly store views. The reconciler compares the result
     against the live status and writes only on difference, so steady-state
     reconciles cost no serialization at all (the write-free analogue of the
-    reference's status-patch-if-changed)."""
+    reference's status-patch-if-changed). ``pods``: optional pre-scanned
+    pod views shared with the pod-sync flow (one scan per reconcile)."""
     from grove_tpu.controller.common import status_shadow
 
     shadow = status_shadow(pclq)
-    reconcile_status(ctx, shadow)
+    reconcile_status(ctx, shadow, pods)
     return shadow.status
 
 
-def reconcile_status(ctx: OperatorContext, pclq: PodClique) -> PodClique:
+def reconcile_status(ctx: OperatorContext, pclq: PodClique, pods=None) -> PodClique:
     ns = pclq.metadata.namespace
-    pods = [
-        p
-        for p in ctx.store.scan(
+    if pods is None:
+        pods = ctx.store.scan(
             "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
         )
-        if not is_terminating(p)
-    ]
+    pods = [p for p in pods if not is_terminating(p)]
     st = pclq.status
     st.replicas = len(pods)
     st.ready_replicas = sum(1 for p in pods if is_ready(p))

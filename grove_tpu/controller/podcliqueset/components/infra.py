@@ -41,8 +41,26 @@ def _reap(
 
 def sync_rbac(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
     """Per-PCS ServiceAccount/Role/RoleBinding (pods list/watch for the init
-    waiter) + SA token secret mounted into it."""
+    waiter) + SA token secret mounted into it.
+
+    Existence check FIRST (four readonly dict lookups): these objects are
+    immutable once created, and the steady state — every PCS reconcile
+    after the first — must not pay four object constructions just to find
+    them already present (profiled: sync_rbac was ~2% of the 10k-set
+    integrated converge)."""
     ns = pcs.metadata.namespace
+    name = pcs.metadata.name
+    wanted = (
+        ("ServiceAccount", namegen.pod_service_account_name(name)),
+        ("Role", namegen.pod_role_name(name)),
+        ("RoleBinding", namegen.pod_role_binding_name(name)),
+        ("Secret", namegen.initc_sa_token_secret_name(name)),
+    )
+    if all(
+        ctx.store.get(kind, ns, obj_name, readonly=True) is not None
+        for kind, obj_name in wanted
+    ):
+        return
     base = namegen.default_labels(pcs.metadata.name)
     items = [
         GenericObject(
