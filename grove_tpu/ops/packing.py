@@ -590,6 +590,7 @@ def gang_select_and_fill(
     pinned: bool = False,
     spread: bool = False,
     uniform: bool = False,
+    seg_list=None,  # ragged per-level (starts, ends) views (see above)
 ):
     """One gang's placement decision against `free`.
 
@@ -616,8 +617,10 @@ def gang_select_and_fill(
     # feasibility (both optimistic w.r.t. fragmentation — the actual fill
     # below is the ground truth). Best-fit tie-break by smallest spare.
     def level_candidate(l):
-        starts = seg_starts[l]
-        ends = seg_ends[l]
+        if seg_list is not None:
+            starts, ends = seg_list[l]
+        else:
+            starts, ends = seg_starts[l], seg_ends[l]
         K = cs_k[:, ends] - cs_k[:, starts]  # [P, D] gather
         free_agg = cs_free[ends] - cs_free[starts]  # [D, R] gather
         feas = jnp.all(
@@ -753,7 +756,9 @@ def gang_select_and_fill(
     any_level = ok_min & (chosen < n_levels)
     chosen_l = jnp.where(any_level, chosen, -1)
 
-    score = _coloc_score(alloc, placed_total, seg_starts, seg_ends, weights, ok_min)
+    score = _coloc_score(
+        alloc, placed_total, seg_starts, seg_ends, weights, ok_min, seg_list
+    )
     score = jnp.where(
         ok_min,
         _spread_score(gang, spread_on, used, placed_total.sum(), score),
@@ -763,7 +768,12 @@ def gang_select_and_fill(
     return free_new, alloc, placed_total, ok_min, chosen_l, score
 
 
-@partial(jax.jit, static_argnames=("with_alloc", "grouped", "pinned", "spread", "uniform"))
+@partial(
+    jax.jit,
+    static_argnames=(
+        "with_alloc", "grouped", "pinned", "spread", "uniform", "level_widths",
+    ),
+)
 def solve_packing(
     capacity: jnp.ndarray,  # [N, R] float32
     topo: jnp.ndarray,  # [N, L] int32, dense ids per level
@@ -786,6 +796,7 @@ def solve_packing(
     pinned: bool = False,
     spread: bool = False,
     uniform: bool = False,
+    level_widths: tuple = None,  # ragged candidate scan (see solve_waves_device)
 ):
     """Exact sequential greedy (oracle-parity kernel)."""
     if group_req is None:
@@ -798,10 +809,17 @@ def solve_packing(
         count.shape[:1], spread_level, spread_min, spread_required, spread_seed
     )
 
+    seg_list = None
+    if level_widths is not None:
+        seg_list = tuple(
+            (seg_starts[l, :w], seg_ends[l, :w])
+            for l, w in enumerate(level_widths)
+        )
+
     def gang_step(free, gang: GangInputs):
         free_new, alloc, placed, ok_min, chosen_l, score = gang_select_and_fill(
             free, topo, seg_starts, seg_ends, gang, grouped=grouped,
-            pinned=pinned, spread=spread, uniform=uniform,
+            pinned=pinned, spread=spread, uniform=uniform, seg_list=seg_list,
         )
         ys = (ok_min, placed, score, chosen_l)
         if with_alloc:

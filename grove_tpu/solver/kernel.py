@@ -48,7 +48,7 @@ def _maybe_enable_disk_cache() -> None:
 
 def _get_compiled(
     args, with_alloc: bool, grouped: bool, pinned: bool, spread: bool,
-    uniform: bool,
+    uniform: bool, level_widths: tuple = None,
 ):
     sig = tuple((a.shape, str(a.dtype)) for a in args) + (
         with_alloc,
@@ -56,6 +56,7 @@ def _get_compiled(
         pinned,
         spread,
         uniform,
+        level_widths,
     )
     compiled = _compiled_cache.get(sig)
     if compiled is None:
@@ -63,7 +64,7 @@ def _get_compiled(
         t0 = time.perf_counter()
         compiled = solve_packing.lower(
             *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned,
-            spread=spread, uniform=uniform,
+            spread=spread, uniform=uniform, level_widths=level_widths,
         ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
@@ -125,7 +126,10 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
     pinned = bool((problem.gang_pin >= 0).any())
     spread = bool((spread_level >= 0).any())
     uniform = bool((problem.min_count == problem.count).all())
-    compiled = _get_compiled(args, with_alloc, grouped, pinned, spread, uniform)
+    compiled = _get_compiled(
+        args, with_alloc, grouped, pinned, spread, uniform,
+        level_widths_of(problem),
+    )
     t0 = time.perf_counter()
     out = compiled(*args)
     admitted = np.asarray(out["admitted"])  # device sync

@@ -359,6 +359,55 @@ class TestWaveSolver:
         np.testing.assert_array_equal(r_on.chosen_level, r_off.chosen_level)
         np.testing.assert_array_equal(r_on.free_after, r_off.free_after)
 
+    def test_ragged_level_widths_bit_identical_both_kernels(self):
+        """The static `level_widths` ragged candidate scan (the shipped
+        configuration — kernel.solve and solve_waves_stats always pass it)
+        must be BIT-identical to the padded [L, D] scan for BOTH kernels:
+        padding only appends empty ranges, which every consumer treats as
+        neutral. Guards the shipped-ragged vs tested-padded gap."""
+        import jax.numpy as jnp
+
+        from grove_tpu.models import build_stress_problem
+        from grove_tpu.ops.packing import solve_packing, solve_waves_device
+        from grove_tpu.solver.kernel import (
+            dedup_extra_args,
+            level_widths_of,
+            pad_problem_for_waves,
+        )
+
+        problem = build_stress_problem(128, 256)
+        raw, n_chunks, grouped, pinned, spread, uniform = (
+            pad_problem_for_waves(problem, 64)
+        )
+        args = tuple(jnp.asarray(a) for a in raw)
+        extra = dedup_extra_args(raw[4], raw[5], n_chunks, pinned)
+        widths = level_widths_of(problem)
+        assert max(widths) < problem.seg_starts.shape[1] or len(set(widths)) > 1
+
+        outs = []
+        for lw in (None, widths):
+            out = solve_waves_device(
+                *args, **extra, n_chunks=n_chunks, max_waves=32,
+                grouped=grouped, pinned=pinned, spread=spread,
+                uniform=uniform, lazy_rescue=uniform, level_widths=lw,
+            )
+            outs.append({k: np.asarray(v) for k, v in out.items()})
+        for k in ("admitted", "placed", "score", "chosen_level", "free_after"):
+            np.testing.assert_array_equal(outs[0][k], outs[1][k], err_msg=k)
+
+        exact = []
+        for lw in (None, widths):
+            out = solve_packing(
+                *args[:16], with_alloc=False,
+                grouped=grouped, pinned=pinned, spread=spread,
+                uniform=uniform, level_widths=lw,
+            )
+            exact.append(
+                {k: np.asarray(v) for k, v in out.items() if v is not None}
+            )
+        for k in ("admitted", "placed", "score", "chosen_level", "free_after"):
+            np.testing.assert_array_equal(exact[0][k], exact[1][k], err_msg=k)
+
     def test_uniform_fill_shortcut_is_bit_identical(self):
         """The static `uniform` flag (min_count == count everywhere — the
         all-or-nothing common case) halves the fill scans; outputs must be
