@@ -481,6 +481,97 @@ def _cmd_describe(args) -> int:
     return 0
 
 
+def _print_trace_summary(summary: dict, top: int) -> None:
+    """Render a /debug/traces-shaped summary as an aligned table, widest
+    total first."""
+    spans = summary.get("spans", {})
+    if not spans:
+        print("no spans recorded (tracing enabled?)")
+        return
+    rows = sorted(spans.items(), key=lambda kv: -kv[1]["total_s"])[:top]
+    name_w = max(len(n) for n, _ in rows)
+    print(
+        f"{'span':<{name_w}}  {'count':>7}  {'total_s':>9}  {'p50_s':>9}"
+        f"  {'p99_s':>9}  {'max_s':>9}"
+    )
+    for name, agg in rows:
+        print(
+            f"{name:<{name_w}}  {agg['count']:>7}  {agg['total_s']:>9.4f}"
+            f"  {agg['p50_s']:>9.6f}  {agg['p99_s']:>9.6f}"
+            f"  {agg['max_s']:>9.6f}"
+        )
+    dropped = summary.get("dropped", 0)
+    if dropped:
+        print(f"({dropped} oldest spans dropped by the bounded buffer)")
+
+
+def _cmd_trace(args) -> int:
+    """Span-level latency view: pretty-print the top-N slowest span names —
+    from a live apiserver's /debug/traces (--apiserver), or by running the
+    manifests through a traced sim. --chrome writes the Chrome trace_event
+    JSON for chrome://tracing / Perfetto."""
+    import json as _json
+
+    if args.apiserver:
+        import urllib.request
+
+        url = args.apiserver
+        if "://" not in url:
+            url = f"http://{url}"
+        try:
+            with urllib.request.urlopen(f"{url}/debug/traces", timeout=10) as r:
+                summary = _json.loads(r.read())
+            if args.chrome:
+                with urllib.request.urlopen(
+                    f"{url}/debug/traces/chrome", timeout=30
+                ) as r:
+                    with open(args.chrome, "wb") as f:
+                        f.write(r.read())
+                print(f"chrome trace written to {args.chrome}")
+        except (OSError, ValueError) as e:
+            # ValueError covers json.JSONDecodeError: a 200 from something
+            # that is not this apiserver (proxy page, wrong port) must fail
+            # with the friendly message, not a traceback
+            print(f"trace: {url}: {e}", file=sys.stderr)
+            return 1
+        if not summary.get("enabled", False):
+            print(
+                "note: tracing is disabled on the server"
+                " (set GROVE_TPU_TRACE=1)",
+                file=sys.stderr,
+            )
+        _print_trace_summary(summary, args.top)
+        return 0
+
+    if not args.manifests:
+        print(
+            "trace: provide manifests to simulate, or --apiserver URL to"
+            " read a live operator's traces",
+            file=sys.stderr,
+        )
+        return 2
+    from grove_tpu.observability.tracing import TRACER
+
+    TRACER.enable()
+    TRACER.reset()
+    harness = _sim_from_manifests(args)
+    _print_trace_summary(TRACER.summary_json(), args.top)
+    print()
+    print(f"top {args.top} slowest spans:")
+    for sp in TRACER.slowest(args.top):
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sp.attrs.items() if k != "vt"
+        )
+        print(f"  {sp.dur_us / 1e6:>9.6f}s  {sp.name}  {attrs}")
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            _json.dump(TRACER.chrome_trace(), f)
+        print(f"\nchrome trace written to {args.chrome}")
+    # keep the harness alive through the export (watch threads etc.)
+    del harness
+    return 0
+
+
 def _cmd_bench(args) -> int:
     import subprocess
 
@@ -726,6 +817,24 @@ def main(argv: List[str] | None = None) -> int:
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")
     p.set_defaults(fn=_cmd_bench)
+
+    p = sub.add_parser(
+        "trace",
+        help=(
+            "pretty-print the slowest trace spans — from a live apiserver"
+            " (--apiserver URL) or by running manifests through a traced sim"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="read /debug/traces from a live server")
+    p.add_argument("--top", type=int, default=15, help="span rows to show")
+    p.add_argument(
+        "--chrome",
+        metavar="PATH",
+        help="also write the Chrome trace_event JSON (chrome://tracing)",
+    )
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("config-check", help="validate an operator config file")
     p.add_argument("config")

@@ -259,7 +259,8 @@ class APIServer:
 
             # ---- helpers
 
-            def _send_json(self, code: int, payload: dict) -> None:
+            def _send_json(self, code: int, payload) -> None:
+                # payload: any JSON document (object or array)
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -346,6 +347,43 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/debug/traces":
+                    # span summary: per-span-name count/total/p50/p99
+                    # (observability/tracing.py; tracer is process-global —
+                    # populated when the operator runs in this process)
+                    from grove_tpu.observability.tracing import TRACER
+
+                    return self._send_json(200, TRACER.summary_json())
+                if path == "/debug/traces/chrome":
+                    # Chrome trace_event array: load in chrome://tracing or
+                    # Perfetto (docs/observability.md)
+                    from grove_tpu.observability.tracing import TRACER
+
+                    return self._send_json(200, TRACER.chrome_trace())
+                if path == "/events":
+                    # deduped k8s-style Events (count/first/lastTimestamp),
+                    # filterable: ?namespace=...&reason=...&kind=...
+                    from grove_tpu.observability.events import EVENTS
+
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query
+                    )
+
+                    def qp(name):
+                        return (query.get(name) or [None])[0]
+
+                    items = EVENTS.list(
+                        namespace=qp("namespace"),
+                        reason=qp("reason"),
+                        kind=qp("kind"),
+                    )
+                    return self._send_json(
+                        200,
+                        {
+                            "kind": "EventList",
+                            "items": [e.as_dict() for e in items],
+                        },
+                    )
                 if path == "/debug/profile":
                     # pprof-server equivalent: sample every thread's stack
                     # for ?seconds=N and return aggregated frame counts

@@ -42,11 +42,27 @@ class OperatorContext:
     _event_seq: int = 0
     max_events: int = 1000  # ring buffer (k8s Events have a TTL; we cap)
 
-    def record_event(self, kind: str, reason: str, message: str) -> None:
+    def record_event(
+        self,
+        kind: str,
+        reason: str,
+        message: str,
+        namespace: str = "default",
+        name: Optional[str] = None,
+        type: str = "Normal",
+    ) -> None:
         """k8s-Event equivalent: kept as a readable log AND materialized as an
         Event object in the store (the reference emits corev1 Events on every
         important transition — SURVEY §5). Capped as a ring buffer so long
-        sims don't accumulate unbounded Event objects."""
+        sims don't accumulate unbounded Event objects.
+
+        Also forwarded to the process-global deduping EventRecorder
+        (observability/events.py) — the view `GET /events` serves. Most call
+        sites pass the object name as the message; `name` defaults to it so
+        dedup identity works without touching every site."""
+        from grove_tpu.observability.events import EVENTS
+
+        EVENTS.record((kind, namespace, name or message), type, reason, message)
         self.events.append(f"{kind} {reason}: {message}")
         from grove_tpu.api.meta import ObjectMeta
         from grove_tpu.api.types import GenericObject

@@ -62,22 +62,26 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique, pods) -> int:
         - pclq.spec.replicas
         - len(pending_deletes)
     )
-    created = 0
+    created_pods: List[Pod] = []
     if diff < 0:
-        created = _create_pods(ctx, pclq, -diff, cached_pods)
+        created_pods = _create_pods(ctx, pclq, -diff, cached_pods)
     elif diff > 0:
         _delete_excess_pods(ctx, pclq, diff, cached_pods, pending_deletes)
 
     _process_pending_updates(ctx, pclq, cached_pods, pending_deletes)
 
-    # Pods created THIS reconcile are born schedule-gated but may not be
-    # visible to the cached gate scan yet (informer lag) — count them as
-    # still-gated so the reconciler schedules the gate-retry requeue.
-    # Without this, a creating reconcile can return "all clear" and, with
+    # Pods created THIS reconcile are born schedule-gated and may not be
+    # visible to the cached gate scan yet (informer lag) — feed their fresh
+    # store copies straight into the gate pass: a pod recreated while its
+    # gang is already scheduled ungates IN THIS reconcile instead of waiting
+    # out the GATE_RETRY_SECONDS requeue (recreate-latency regression noted
+    # in ADVICE r5). Pods the gang does not reference yet still count as
+    # gated, so the reconciler schedules the gate-retry requeue — without
+    # that, a creating reconcile could return "all clear" and, with
     # pod-ADDED events predicate-filtered (reference podPredicate
     # CreateFunc=false, podclique/register.go:102), nothing would ever
     # revisit the gate.
-    return created + _remove_scheduling_gates(ctx, pclq, cached_pods)
+    return _remove_scheduling_gates(ctx, pclq, cached_pods + created_pods)
 
 
 def _process_pending_updates(
@@ -112,7 +116,13 @@ def _process_pending_updates(
         for pod in not_ready_stale:
             ctx.pod_expectations.expect_deletions(key, [pod.metadata.uid])
             ctx.store.delete("Pod", ns, pod.metadata.name)
-            ctx.record_event("Pod", "PodUpdateDeleteSuccessful", pod.metadata.name)
+            ctx.record_event(
+                "Pod",
+                "PodUpdateDeleteSuccessful",
+                pod.metadata.name,
+                namespace=ns,
+                name=pod.metadata.name,
+            )
         return
 
     # every pod is ready; only proceed when no replacement is still missing
@@ -122,12 +132,20 @@ def _process_pending_updates(
     victim = sorted(stale, key=deletion_order)[0]
     ctx.pod_expectations.expect_deletions(key, [victim.metadata.uid])
     ctx.store.delete("Pod", ns, victim.metadata.name)
-    ctx.record_event("Pod", "PodUpdateDeleteSuccessful", victim.metadata.name)
+    ctx.record_event(
+        "Pod",
+        "PodUpdateDeleteSuccessful",
+        victim.metadata.name,
+        namespace=ns,
+        name=victim.metadata.name,
+    )
 
 
 def _create_pods(
     ctx: OperatorContext, pclq: PodClique, count: int, existing: List[Pod]
-) -> int:
+) -> List[Pod]:
+    """Create `count` pods; returns the created store copies so the caller's
+    gate pass can consider them in the same reconcile."""
     from grove_tpu.runtime.errors import GroveError
     from grove_tpu.utils.concurrent import Task, run_concurrently_with_slow_start
 
@@ -135,13 +153,21 @@ def _create_pods(
     active_names = [p.metadata.name for p in existing]
     indices = indexer.allocate_indices(pclq.metadata.name, active_names, count)
     key = f"{ns}/{pclq.metadata.name}"
+    created_pods: List[Pod] = []  # list.append is atomic across task threads
 
     def make_create(idx: int):
         def create() -> None:
             pod = build_pod(ctx, pclq, idx)
             created = ctx.store.create(pod)
             ctx.pod_expectations.expect_creations(key, [created.metadata.uid])
-            ctx.record_event("Pod", "PodCreateSuccessful", created.metadata.name)
+            ctx.record_event(
+                "Pod",
+                "PodCreateSuccessful",
+                created.metadata.name,
+                namespace=ns,
+                name=created.metadata.name,
+            )
+            created_pods.append(created)
 
         return create
 
@@ -157,7 +183,8 @@ def _create_pods(
         raise GroveError(
             "ERR_SYNC_PODS", result.summary(), f"create-pods {pclq.metadata.name}"
         )
-    return len(indices)
+    created_pods.sort(key=lambda p: p.metadata.name)  # deterministic order
+    return created_pods
 
 
 def build_pod(ctx: OperatorContext, pclq: PodClique, pod_index: int) -> Pod:
@@ -251,7 +278,13 @@ def _delete_excess_pods(
     for pod in candidates[:count]:
         ctx.pod_expectations.expect_deletions(key, [pod.metadata.uid])
         ctx.store.delete("Pod", ns, pod.metadata.name)
-        ctx.record_event("Pod", "PodDeleteSuccessful", pod.metadata.name)
+        ctx.record_event(
+            "Pod",
+            "PodDeleteSuccessful",
+            pod.metadata.name,
+            namespace=ns,
+            name=pod.metadata.name,
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -338,6 +338,8 @@ class PodCliqueScalingGroupReconciler:
                     "PodCliqueScalingGroup",
                     "RollingUpdateCompleted",
                     fresh.metadata.name,
+                    namespace=fresh.metadata.namespace,
+                    name=fresh.metadata.name,
                 )
             return None
         # active update: switch to a private mutable copy for the rest of
@@ -403,6 +405,8 @@ class PodCliqueScalingGroupReconciler:
                 "PodCliqueScalingGroup",
                 "RollingUpdateReplicaStarted",
                 f"{pcsg.metadata.name} replica {pick}",
+                namespace=pcsg.metadata.namespace,
+                name=pcsg.metadata.name,
             )
 
         # bookkeeping: replicas no longer outdated are done
@@ -443,6 +447,8 @@ class PodCliqueScalingGroupReconciler:
                     "PodCliqueScalingGroup",
                     "ScaledReplicaGangTerminated",
                     f"{pcsg.metadata.name} replica {replica}",
+                    namespace=pcsg.metadata.namespace,
+                    name=pcsg.metadata.name,
                 )
             else:
                 remaining = delay - age

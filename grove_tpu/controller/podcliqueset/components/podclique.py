@@ -41,12 +41,24 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
 
     for name, pclq in expected.items():
         if name not in existing_names:
-            ctx.record_event("PodClique", "PodCliqueCreateSuccessful", name)
+            ctx.record_event(
+                "PodClique",
+                "PodCliqueCreateSuccessful",
+                name,
+                namespace=ns,
+                name=name,
+            )
         create_or_adopt(ctx, pclq)
 
     for name in existing_names - expected.keys():
         ctx.store.delete("PodClique", ns, name)
-        ctx.record_event("PodClique", "PodCliqueDeleteSuccessful", name)
+        ctx.record_event(
+            "PodClique",
+            "PodCliqueDeleteSuccessful",
+            name,
+            namespace=ns,
+            name=name,
+        )
 
 
 def build_pclq(pcs: PodCliqueSet, replica: int, clique) -> PodClique:

@@ -181,7 +181,9 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
     # delete excess (:368-386)
     for name in existing - expected_names:
         ctx.store.delete("PodGang", ns, name)
-        ctx.record_event("PodGang", "PodGangDeleteSuccessful", name)
+        ctx.record_event(
+            "PodGang", "PodGangDeleteSuccessful", name, namespace=ns, name=name
+        )
 
     live_pclqs = {
         p.metadata.name: p
@@ -320,7 +322,13 @@ def _create_or_update_podgang(
                 spec=spec,
             )
         )
-        ctx.record_event("PodGang", "PodGangCreateSuccessful", gang.fqn)
+        ctx.record_event(
+            "PodGang",
+            "PodGangCreateSuccessful",
+            gang.fqn,
+            namespace=ns,
+            name=gang.fqn,
+        )
     elif current.spec != spec:
         current = ctx.store.get("PodGang", ns, gang.fqn)
         current.spec = spec

@@ -88,4 +88,6 @@ def _terminate_replica(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) ->
         "PodCliqueSet",
         "GangTerminated",
         f"{pcs.metadata.name} replica {replica}: deleted {n} PodCliques",
+        namespace=ns,
+        name=pcs.metadata.name,
     )

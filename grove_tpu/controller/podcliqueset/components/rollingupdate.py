@@ -64,7 +64,13 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
         progress.update_ended_at = ctx.clock.now()
         progress.currently_updating = None
         ctx.store.update_status(pcs)
-        ctx.record_event("PodCliqueSet", "RollingUpdateCompleted", pcs.metadata.name)
+        ctx.record_event(
+            "PodCliqueSet",
+            "RollingUpdateCompleted",
+            pcs.metadata.name,
+            namespace=pcs.metadata.namespace,
+            name=pcs.metadata.name,
+        )
         return None
     progress.currently_updating = PCSReplicaRollingUpdateProgress(
         replica_index=next_replica, update_started_at=ctx.clock.now()
@@ -74,6 +80,8 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
         "PodCliqueSet",
         "RollingUpdateReplicaStarted",
         f"{pcs.metadata.name} replica {next_replica}",
+        namespace=pcs.metadata.namespace,
+        name=pcs.metadata.name,
     )
     _push_template_to_replica(ctx, pcs, next_replica)
     return 2.0
@@ -217,4 +225,6 @@ def _complete_replica(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> 
         "PodCliqueSet",
         "RollingUpdateReplicaCompleted",
         f"{pcs.metadata.name} replica {replica}",
+        namespace=pcs.metadata.namespace,
+        name=pcs.metadata.name,
     )

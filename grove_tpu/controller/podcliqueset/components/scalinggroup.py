@@ -50,9 +50,21 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
     for name, pcsg in expected.items():
         if name not in existing_names:
             ctx.store.create(pcsg)
-            ctx.record_event("PodCliqueScalingGroup", "PCSGCreateSuccessful", name)
+            ctx.record_event(
+                "PodCliqueScalingGroup",
+                "PCSGCreateSuccessful",
+                name,
+                namespace=ns,
+                name=name,
+            )
         # existing PCSGs keep their (possibly HPA-scaled) replicas
 
     for name in existing_names - expected.keys():
         ctx.store.delete("PodCliqueScalingGroup", ns, name)
-        ctx.record_event("PodCliqueScalingGroup", "PCSGDeleteSuccessful", name)
+        ctx.record_event(
+            "PodCliqueScalingGroup",
+            "PCSGDeleteSuccessful",
+            name,
+            namespace=ns,
+            name=name,
+        )

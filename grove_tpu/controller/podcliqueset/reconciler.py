@@ -163,7 +163,11 @@ class PodCliqueSetReconciler:
                 update_started_at=self.ctx.clock.now()
             )
             self.ctx.record_event(
-                "PodCliqueSet", "RollingUpdateStarted", fresh.metadata.name
+                "PodCliqueSet",
+                "RollingUpdateStarted",
+                fresh.metadata.name,
+                namespace=fresh.metadata.namespace,
+                name=fresh.metadata.name,
             )
             return self.ctx.store.update_status(fresh)
         return fresh

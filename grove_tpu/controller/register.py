@@ -200,21 +200,36 @@ def pcsg_rolling_progress_changed(ev) -> bool:
 def podgang_phase_or_spec_changed(ev) -> bool:
     """PodGang events fan out on creation, deletion, SPEC changes (pod
     membership / reservation hints — written with bump_generation=False,
-    podgang.py:327, so compared structurally, not via generation), and
-    PHASE transitions (the base-gang-scheduled signal that unblocks
-    deferred scaled-gang creation and pod ungating) — not on every
-    placement-score or condition touch. Reference analogue:
-    podGangPredicate (podclique/register.go:271-278) passes all updates;
-    the narrower gate is safe here because the repo store suppresses
-    no-op writes and every scheduler-visible transition moves phase or
-    spec. DELETED passes so an out-of-band gang deletion re-runs the
+    podgang.py:327, so compared structurally, not via generation), PHASE
+    transitions (the base-gang-scheduled signal that unblocks deferred
+    scaled-gang creation and pod ungating), and CONDITION transitions (the
+    PCS status flow mirrors gang conditions into pod_gang_statuses,
+    reconciler.py — a condition-only flip like Unhealthy must refresh the
+    mirror; condition flips are rare because the store suppresses no-op
+    writes) — NOT on placement-score touches, which move on every
+    re-admission. Conditions are compared by (type, status, reason) only:
+    _mark_scheduled embeds the score in the Scheduled condition's MESSAGE
+    (scheduler.py), so a message-sensitive compare would re-admit the very
+    score churn this predicate exists to filter. Reference analogue:
+    podGangPredicate
+    (podclique/register.go:271-278) passes all updates. The contract test
+    (tests/test_podgang_status_contract.py) asserts controller flows read
+    ONLY the fields this predicate passes — a new consumer of
+    placement_score breaks the build instead of stalling behind the
+    filter. DELETED passes so an out-of-band gang deletion re-runs the
     owner's podgang sync (recreate)."""
     if ev.type != MODIFIED:
         return True  # creates AND deletes both matter
     if ev.old is None:
         return True
+
+    def cond_key(conditions):
+        return [(c.type, c.status, c.reason) for c in conditions]
+
     return (
         ev.old.status.phase != ev.obj.status.phase
+        or cond_key(ev.old.status.conditions)
+        != cond_key(ev.obj.status.conditions)
         or ev.old.spec != ev.obj.spec
     )
 
@@ -302,8 +317,9 @@ def register_controllers(engine: Engine, ctx: OperatorContext, config=None) -> N
                 # owner as coalesced PCLQ status transitions). Kept here
                 # because the repo's podgang component defers scaled-gang
                 # creation on the base gang's phase and mirrors gang
-                # phases into PCS status — gated to phase/spec
-                # transitions, a handful of events per gang lifetime.
+                # phases + conditions into PCS status — gated to
+                # phase/spec/condition transitions, a handful of events
+                # per gang lifetime.
                 ("PodGang", _map_to_part_of, podgang_phase_or_spec_changed),
             ],
         )

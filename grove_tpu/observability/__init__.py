@@ -1,0 +1,18 @@
+"""Observability: metrics registry, span tracer, event recorder, logging.
+
+Singletons (process-global, mirroring the reference manager's one metrics
+server / one event broadcaster): ``METRICS``, ``TRACER``, ``EVENTS``.
+"""
+
+from grove_tpu.observability.events import EVENTS, EventRecorder
+from grove_tpu.observability.metrics import METRICS, Metrics
+from grove_tpu.observability.tracing import TRACER, Tracer
+
+__all__ = [
+    "EVENTS",
+    "EventRecorder",
+    "METRICS",
+    "Metrics",
+    "TRACER",
+    "Tracer",
+]

@@ -1,0 +1,144 @@
+"""Kubernetes-style Event recorder with dedup-and-count semantics.
+
+Re-host of client-go's EventRecorder/EventCorrelator boundary: the reference
+operator emits corev1 Events on every important transition and the apiserver
+aggregates repeats into one Event with a bumped ``count``. Here the recorder
+IS the aggregator: ``record(obj_ref, type, reason, message)`` dedups on
+(kind, namespace, name, type, reason), bumps ``count``, and keeps
+first/last timestamps — so "this gang was admitted 14 times" reads as one
+line, not 14.
+
+The recorder is process-global (``EVENTS``), mirroring how one event
+broadcaster serves every controller in the reference manager; the sim
+apiserver's ``GET /events`` endpoint and the CLI read from it. Bounded:
+oldest dedup groups are evicted once ``max_events`` distinct groups exist.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+# canonical reasons emitted by the scheduler/controllers (docs/observability.md)
+REASON_GANG_ADMITTED = "GangAdmitted"
+REASON_GANG_DEFERRED = "GangDeferred"
+REASON_POD_BOUND = "PodBound"
+REASON_PREEMPTED = "Preempted"
+REASON_ROLLING_UPDATE_STARTED = "RollingUpdateStarted"
+
+
+@dataclass
+class EventRecord:
+    kind: str
+    namespace: str
+    name: str
+    type: str
+    reason: str
+    message: str
+    count: int
+    first_timestamp: float
+    last_timestamp: float
+
+    def as_dict(self) -> dict:
+        return {
+            "involvedObject": {
+                "kind": self.kind,
+                "namespace": self.namespace,
+                "name": self.name,
+            },
+            "type": self.type,
+            "reason": self.reason,
+            "message": self.message,
+            "count": self.count,
+            "firstTimestamp": self.first_timestamp,
+            "lastTimestamp": self.last_timestamp,
+        }
+
+
+def ref_of(obj) -> Tuple[str, str, str]:
+    """(kind, namespace, name) from a typed API object."""
+    return (
+        getattr(obj, "kind", type(obj).__name__),
+        obj.metadata.namespace,
+        obj.metadata.name,
+    )
+
+
+class EventRecorder:
+    """Thread-safe: reconcile worker threads and the scheduler record
+    concurrently in cluster mode."""
+
+    def __init__(self, max_events: int = 8192, clock=None) -> None:
+        self.max_events = max_events
+        # virtual clock (optional): sim timestamps then line up with the
+        # harness's requeue math instead of wall time
+        self.clock = clock
+        self._lock = threading.Lock()
+        # dedup key -> EventRecord, recency-ordered (LRU) for bounded
+        # eviction: least-recently-updated groups drop first
+        self._events: "OrderedDict[tuple, EventRecord]" = OrderedDict()
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.time()
+
+    def record(self, obj_ref, type: str, reason: str, message: str) -> EventRecord:
+        """obj_ref: (kind, namespace, name) tuple or a typed API object."""
+        if not isinstance(obj_ref, tuple):
+            obj_ref = ref_of(obj_ref)
+        kind, namespace, name = obj_ref
+        key = (kind, namespace, name, type, reason)
+        now = self._now()
+        with self._lock:
+            rec = self._events.get(key)
+            if rec is not None:
+                rec.count += 1
+                rec.last_timestamp = now
+                rec.message = message  # latest message wins (client-go)
+                # LRU: an actively-updated group must outlive idle ones, or
+                # bounded eviction would silently reset its count to 1
+                self._events.move_to_end(key)
+                return rec
+            rec = EventRecord(
+                kind=kind,
+                namespace=namespace,
+                name=name,
+                type=type,
+                reason=reason,
+                message=message,
+                count=1,
+                first_timestamp=now,
+                last_timestamp=now,
+            )
+            self._events[key] = rec
+            while len(self._events) > self.max_events:
+                self._events.popitem(last=False)
+            return rec
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        reason: Optional[str] = None,
+        kind: Optional[str] = None,
+    ) -> List[EventRecord]:
+        with self._lock:
+            records = list(self._events.values())
+        return [
+            r
+            for r in records
+            if (namespace is None or r.namespace == namespace)
+            and (reason is None or r.reason == reason)
+            and (kind is None or r.kind == kind)
+        ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+EVENTS = EventRecorder()

@@ -87,7 +87,12 @@ class Metrics:
                 f"{base}_sum{label and '{' + label + '}'} "
                 f"{hist_sum.get(name, 0.0)}"
             )
+            # an empty recent window would render `nan` quantile samples —
+            # invalid for many scrapers; _count/_sum above still expose the
+            # cumulative series, so skipping the quantile lines is lossless
             window = sorted(values)
+            if not window:
+                continue
             for q in (0.5, 0.9, 0.99):
                 qlabel = f'quantile="{q}"' + (f",{label}" if label else "")
                 lines.append(f"{base}{{{qlabel}}} {_quantile(window, q)}")

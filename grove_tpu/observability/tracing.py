@@ -1,0 +1,243 @@
+"""Span tracing: the latency layer the metrics registry cannot provide.
+
+The reference operator leans on controller-runtime's Prometheus server for
+counters; the question it cannot answer — "why did gang X take 8s to
+place?" — needs spans. This module provides:
+
+- ``Span``: a named, timed interval with kv attributes and a parent link
+  (nesting via a per-thread span stack, so ``engine.reconcile`` naturally
+  parents whatever a reconcile opens, and ``scheduler.schedule`` parents
+  encode/solve/commit/status-write).
+- ``Tracer``: a thread-safe, bounded in-memory collector exporting
+  (1) a JSON summary — per-span-name count/total/p50/p99 — and
+  (2) Chrome ``trace_event`` format (an array of ``ph:"X"`` complete
+  events) loadable by ``chrome://tracing`` and Perfetto.
+
+Cost model: tracing is OFF by default; every instrumentation site reduces
+to a single ``TRACER.enabled`` boolean check (``span()`` returns a shared
+no-op span), so tier-1 runtime and the bench's hot path are unaffected.
+Durations come from ``time.perf_counter()`` (real latency is the point);
+when a virtual clock is attached (``TRACER.clock``), every span also
+carries the virtual timestamp as a ``vt`` attribute so sim traces can be
+correlated with virtual-time requeue math.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from grove_tpu.observability.metrics import _quantile
+
+
+class _NullSpan:
+    """Shared no-op span returned while tracing is disabled: instrumented
+    code never branches on enablement beyond the one check in span()."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, key: str, value) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    __slots__ = (
+        "name",
+        "attrs",
+        "parent",
+        "tid",
+        "ts_us",
+        "dur_us",
+        "_t0",
+        "_tracer",
+        "_done",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict) -> None:
+        self.name = name
+        self.attrs = attrs
+        self._tracer = tracer
+        self.tid = threading.get_ident()
+        stack = tracer._stack()
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        if tracer.clock is not None:
+            attrs["vt"] = round(tracer.clock.now(), 3)
+        self._done = False
+        self._t0 = time.perf_counter()
+        self.ts_us = int((self._t0 - tracer._origin) * 1e6)
+        self.dur_us = 0
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        tracer = self._tracer
+        stack = tracer._stack()
+        # tolerate out-of-order ends (a span ended from a finally after its
+        # child leaked): drop this span from wherever it sits in the stack
+        if self in stack:
+            stack.remove(self)
+        with tracer._lock:
+            tracer._spans.append(self)
+            tracer.recorded += 1
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+class Tracer:
+    """Bounded in-memory span collector (oldest spans drop when full)."""
+
+    def __init__(self, max_spans: int = 20_000, clock=None) -> None:
+        self.enabled = os.environ.get("GROVE_TPU_TRACE", "") not in (
+            "",
+            "0",
+            "false",
+        )
+        self.max_spans = max_spans
+        # virtual clock (optional): spans carry its reading as a `vt` attr
+        self.clock = clock
+        self.recorded = 0
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max_spans)
+        self._origin = time.perf_counter()
+        self._tls = threading.local()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.recorded = 0
+        self._origin = time.perf_counter()
+
+    # -- recording -------------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, name: str, **attrs):
+        """Open a span (context manager, or call .end() explicitly).
+        The disabled path is ONE attribute check + a shared no-op object."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, attrs)
+
+    def current_span(self):
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # -- export ----------------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-span-name aggregate: count, total/p50/p99/max seconds."""
+        by_name: Dict[str, List[int]] = {}
+        for sp in self.spans():
+            by_name.setdefault(sp.name, []).append(sp.dur_us)
+        out: Dict[str, Dict[str, float]] = {}
+        for name, durs in sorted(by_name.items()):
+            durs.sort()
+            out[name] = {
+                "count": len(durs),
+                "total_s": round(sum(durs) / 1e6, 6),
+                "p50_s": round(_quantile(durs, 0.5) / 1e6, 6),
+                "p99_s": round(_quantile(durs, 0.99) / 1e6, 6),
+                "max_s": round(durs[-1] / 1e6, 6),
+            }
+        return out
+
+    def summary_json(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "recorded": self.recorded,
+            "retained": len(self._spans),
+            "dropped": max(0, self.recorded - len(self._spans)),
+            "spans": self.summary(),
+        }
+
+    def slowest(self, n: int = 10) -> List[Span]:
+        return sorted(self.spans(), key=lambda s: -s.dur_us)[:n]
+
+    def chrome_trace(self) -> List[dict]:
+        """Chrome trace_event complete events ("ph":"X"), ts/dur in µs.
+        A JSON array — chrome://tracing and Perfetto load it directly;
+        nesting is by time containment within (pid, tid)."""
+        pid = os.getpid()
+        events = []
+        for sp in self.spans():
+            events.append(
+                {
+                    "name": sp.name,
+                    "ph": "X",
+                    "ts": sp.ts_us,
+                    "dur": sp.dur_us,
+                    "pid": pid,
+                    "tid": sp.tid,
+                    "args": dict(sp.attrs, parent=sp.parent),
+                }
+            )
+        events.sort(key=lambda e: e["ts"])
+        return events
+
+
+def validate_chrome_trace(events) -> List[str]:
+    """Well-formedness check shared by `make trace-smoke` and the tier-1
+    test: an array of objects each carrying ph/ts/name (dur for "X"
+    events). Returns a list of problems (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(events, list):
+        return [f"top-level JSON must be an array, got {type(events).__name__}"]
+    if not events:
+        problems.append("trace is empty (tracing enabled?)")
+    for i, ev in enumerate(events[:10_000]):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        for field in ("ph", "ts", "name"):
+            if field not in ev:
+                problems.append(f"event {i} missing {field!r}")
+        if ev.get("ph") == "X" and not isinstance(ev.get("dur"), int):
+            problems.append(f"event {i} ('X') missing integer 'dur'")
+        if not isinstance(ev.get("ts"), int):
+            problems.append(f"event {i} 'ts' must be an integer (µs)")
+    return problems
+
+
+TRACER = Tracer()

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.tracing import TRACER
 from grove_tpu.runtime.clock import Clock
 from grove_tpu.runtime.flow import ReconcileStepResult
 from grove_tpu.runtime.store import Store, WatchEvent
@@ -212,9 +213,25 @@ class Engine:
 
     def _timed(self, ctrl: Controller, key):
         t0 = time.perf_counter()
+        # disabled tracing costs exactly this one boolean check per reconcile
+        span = (
+            TRACER.span(
+                "engine.reconcile",
+                controller=ctrl.name,
+                key=f"{key[1]}/{key[2]}",
+            )
+            if TRACER.enabled
+            else None
+        )
+        outcome = "error"
         try:
-            return ctrl.reconcile(key)
+            result = ctrl.reconcile(key)
+            outcome = result.result if result is not None else "done"
+            return result
         finally:
+            if span is not None:
+                span.set("outcome", outcome)
+                span.end()
             METRICS.observe(
                 f"reconcile_seconds/{ctrl.name}", time.perf_counter() - t0
             )

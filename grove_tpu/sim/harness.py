@@ -65,6 +65,16 @@ class SimHarness:
                 exempt_users=self.config.authorizer.exempt_service_accounts,
             )
         self.engine = Engine(self.store, self.clock)
+        # virtual-clock awareness: spans carry the sim's virtual timestamp
+        # (`vt` attr) and event first/last timestamps use virtual time, so
+        # traces/events line up with requeue math instead of wall time.
+        # Process-global singletons — the newest harness wins (one sim per
+        # process in practice).
+        from grove_tpu.observability.events import EVENTS
+        from grove_tpu.observability.tracing import TRACER
+
+        TRACER.clock = self.clock
+        EVENTS.clock = self.clock
         self.ctx = OperatorContext(
             store=self.store, clock=self.clock, topology=self.topology
         )

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.tracing import TRACER
 from grove_tpu.ops.packing import (
     solve_packing,
     solve_wave_chunk,
@@ -62,10 +63,11 @@ def _get_compiled(
     if compiled is None:
         _maybe_enable_disk_cache()
         t0 = time.perf_counter()
-        compiled = solve_packing.lower(
-            *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned,
-            spread=spread, uniform=uniform, level_widths=level_widths,
-        ).compile()
+        with TRACER.span("solver.compile", kernel="solve_packing"):
+            compiled = solve_packing.lower(
+                *args, with_alloc=with_alloc, grouped=grouped, pinned=pinned,
+                spread=spread, uniform=uniform, level_widths=level_widths,
+            ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
     return compiled
@@ -131,8 +133,11 @@ def solve(problem: PackingProblem, with_alloc: bool = True) -> PackingResult:
         level_widths_of(problem),
     )
     t0 = time.perf_counter()
-    out = compiled(*args)
-    admitted = np.asarray(out["admitted"])  # device sync
+    with TRACER.span(
+        "solver.execute", kernel="solve_packing", gangs=problem.num_gangs
+    ):
+        out = compiled(*args)
+        admitted = np.asarray(out["admitted"])  # device sync
     elapsed = time.perf_counter() - t0
     return PackingResult(
         admitted=admitted,
@@ -308,66 +313,86 @@ def solve_waves(
     for wave in range(max_waves):
         if not pending.any():
             break
+        # per-wave span (single enabled check per wave; chunk execs nest
+        # inside by time containment on this thread)
+        wave_span = (
+            TRACER.span(
+                "solver.wave", wave=wave, pending=int(pending.sum())
+            )
+            if TRACER.enabled
+            else None
+        )
         progress = False
         waves_used += 1
         seeds = np.arange(g_pad, dtype=np.int32) + np.int32(wave * 7919)
-        for c in range(n_chunks):
-            sl = slice(c * chunk_size, (c + 1) * chunk_size)
-            mask = pending[sl]
-            if not mask.any():
-                continue
-            (
-                dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c,
-                slvl_c, smin_c, sreq_c, sseed_c,
-            ) = chunk_const[c]
-            out = solve_wave_chunk(
-                free,
-                topo,
-                seg_starts,
-                seg_ends,
-                dem_c,
-                cnt_c,
-                mn_c,
-                rq_c,
-                pf_c,
-                jnp.asarray(mask),
-                jnp.asarray(narrow_cap[sl]),
-                jnp.asarray(seeds[sl]),
-                group_req=grq_c,
-                group_pin=gpin_c,
-                gang_pin=gangpin_c,
-                spread_level=slvl_c,
-                spread_min=smin_c,
-                spread_required=sreq_c,
-                spread_seed=sseed_c,
-                pair_demand=dedup_extra.get("pair_demand"),
-                pair_count=dedup_extra.get("pair_count"),
-                pair_idx=None if pidx_chunks is None else pidx_chunks[c],
-                grouped=grouped,
-                pinned=pinned,
-                spread=spread,
-                uniform=uniform,
-                level_widths=level_widths,
-            )
-            committed = np.asarray(out["admitted"])
-            retry = np.asarray(out["retry"])
-            free = out["free_after"]
-            admitted[sl] |= committed
-            placed[sl] = np.where(committed[:, None], out["placed"], placed[sl])
-            score[sl] = np.where(committed, out["score"], score[sl])
-            chosen_level[sl] = np.where(
-                committed, out["chosen_level"], chosen_level[sl]
-            )
-            narrow_cap[sl] = np.asarray(out["new_cap"])
-            if with_alloc:
-                alloc[sl] = np.where(
-                    committed[:, None, None], np.asarray(out["alloc"]), alloc[sl]
+        try:
+            for c in range(n_chunks):
+                sl = slice(c * chunk_size, (c + 1) * chunk_size)
+                mask = pending[sl]
+                if not mask.any():
+                    continue
+                (
+                    dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c,
+                    slvl_c, smin_c, sreq_c, sseed_c,
+                ) = chunk_const[c]
+                out = solve_wave_chunk(
+                    free,
+                    topo,
+                    seg_starts,
+                    seg_ends,
+                    dem_c,
+                    cnt_c,
+                    mn_c,
+                    rq_c,
+                    pf_c,
+                    jnp.asarray(mask),
+                    jnp.asarray(narrow_cap[sl]),
+                    jnp.asarray(seeds[sl]),
+                    group_req=grq_c,
+                    group_pin=gpin_c,
+                    gang_pin=gangpin_c,
+                    spread_level=slvl_c,
+                    spread_min=smin_c,
+                    spread_required=sreq_c,
+                    spread_seed=sseed_c,
+                    pair_demand=dedup_extra.get("pair_demand"),
+                    pair_count=dedup_extra.get("pair_count"),
+                    pair_idx=None if pidx_chunks is None else pidx_chunks[c],
+                    grouped=grouped,
+                    pinned=pinned,
+                    spread=spread,
+                    uniform=uniform,
+                    level_widths=level_widths,
                 )
-            pending[sl] = mask & retry
-            # retry counts as progress: the narrow-cap fallback walk admits
-            # gangs in LATER waves even when this one committed nothing
-            # (device-loop parity)
-            progress |= committed.any() or retry.any()
+                committed = np.asarray(out["admitted"])
+                retry = np.asarray(out["retry"])
+                free = out["free_after"]
+                admitted[sl] |= committed
+                placed[sl] = np.where(
+                    committed[:, None], out["placed"], placed[sl]
+                )
+                score[sl] = np.where(committed, out["score"], score[sl])
+                chosen_level[sl] = np.where(
+                    committed, out["chosen_level"], chosen_level[sl]
+                )
+                narrow_cap[sl] = np.asarray(out["new_cap"])
+                if with_alloc:
+                    alloc[sl] = np.where(
+                        committed[:, None, None],
+                        np.asarray(out["alloc"]),
+                        alloc[sl],
+                    )
+                pending[sl] = mask & retry
+                # retry counts as progress: the narrow-cap fallback walk
+                # admits gangs in LATER waves even when this one committed
+                # nothing (device-loop parity)
+                progress |= committed.any() or retry.any()
+        finally:
+            # end even on a backend error: a leaked span would mis-parent
+            # every later span on this thread
+            if wave_span is not None:
+                wave_span.set("admitted", int(admitted.sum()))
+                wave_span.end()
         if not progress:
             break
     elapsed = time.perf_counter() - t0
@@ -492,25 +517,30 @@ def solve_waves_stats(
     if compiled is None:
         _maybe_enable_disk_cache()
         t0 = time.perf_counter()
-        compiled = solve_waves_device.lower(
-            *args,
-            **extra,
-            n_chunks=n_chunks,
-            max_waves=max_waves,
-            grouped=grouped,
-            pinned=pinned,
-            spread=spread,
-            uniform=uniform,
-            # all-or-nothing populations defer cluster rescues to the next
-            # compacted wave instead of paying an in-wave second fill
-            lazy_rescue=uniform,
-            level_widths=level_widths,
-        ).compile()
+        with TRACER.span("solver.compile", kernel="solve_waves_device"):
+            compiled = solve_waves_device.lower(
+                *args,
+                **extra,
+                n_chunks=n_chunks,
+                max_waves=max_waves,
+                grouped=grouped,
+                pinned=pinned,
+                spread=spread,
+                uniform=uniform,
+                # all-or-nothing populations defer cluster rescues to the
+                # next compacted wave instead of paying an in-wave second
+                # fill
+                lazy_rescue=uniform,
+                level_widths=level_widths,
+            ).compile()
         METRICS.observe("gang_solve_compile_seconds", time.perf_counter() - t0)
         _compiled_cache[sig] = compiled
     t0 = time.perf_counter()
-    out = compiled(*args, **extra)
-    admitted = np.array(out["admitted"])[:g]
+    with TRACER.span(
+        "solver.execute", kernel="solve_waves_device", gangs=g
+    ):
+        out = compiled(*args, **extra)
+        admitted = np.array(out["admitted"])[:g]
     elapsed = time.perf_counter() - t0  # wave execution (sync on admitted)
     placed = np.array(out["placed"])[:g]
     score = np.array(out["score"])[:g]

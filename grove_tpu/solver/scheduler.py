@@ -25,7 +25,17 @@ from grove_tpu.api.types import (
     PHASE_STARTING,
     SPREAD_SCHEDULE_ANYWAY,
 )
+from grove_tpu.observability.events import (
+    EVENTS,
+    REASON_GANG_ADMITTED,
+    REASON_GANG_DEFERRED,
+    REASON_POD_BOUND,
+    REASON_PREEMPTED,
+    TYPE_NORMAL,
+    TYPE_WARNING,
+)
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.tracing import TRACER
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
@@ -94,26 +104,35 @@ class GangScheduler:
         # every distinct padded shape is a fresh XLA compile. Remember the
         # widest template seen and keep padding there: compiles stay
         # monotone-few, executables keep getting reused.
-        problem = build_problem(
-            nodes, gang_specs, self.topology, free_capacity=free_capacity,
-            pad_groups=self._pad_groups.grow(gang_specs),
-        )
+        with TRACER.span(
+            "scheduler.encode", gangs=len(gang_specs), nodes=len(nodes)
+        ):
+            problem = build_problem(
+                nodes, gang_specs, self.topology, free_capacity=free_capacity,
+                pad_groups=self._pad_groups.grow(gang_specs),
+            )
         import time as _time
 
         if (
             self.solver_sidecar is None
             or _time.monotonic() < self._sidecar_skip_until
         ):
-            result = solve_waves(
-                problem,
-                chunk_size=self.chunk_size,
-                max_waves=self.max_waves,
-                with_alloc=with_alloc,
-            )
+            with TRACER.span(
+                "scheduler.solve", gangs=len(gang_specs), where="in-process"
+            ):
+                result = solve_waves(
+                    problem,
+                    chunk_size=self.chunk_size,
+                    max_waves=self.max_waves,
+                    with_alloc=with_alloc,
+                )
             return result, problem
-        return self._solve_remote(
-            problem, nodes, gang_specs, free_capacity, with_alloc
-        )
+        with TRACER.span(
+            "scheduler.solve", gangs=len(gang_specs), where="sidecar"
+        ):
+            return self._solve_remote(
+                problem, nodes, gang_specs, free_capacity, with_alloc
+            )
 
     def _solve_remote(
         self, problem, nodes, gang_specs, free_capacity, with_alloc: bool
@@ -238,6 +257,12 @@ class GangScheduler:
         nodes are shared cluster-wide, so per-namespace rounds would let a
         low-priority gang in an alphabetically-earlier namespace take
         capacity a high-priority gang elsewhere needs (priority inversion)."""
+        with TRACER.span("scheduler.schedule") as span:
+            bound = self._schedule_pending(namespace)
+            span.set("bound", bound)
+            return bound
+
+    def _schedule_pending(self, namespace: Optional[str] = None) -> int:
         if namespace is None:
             # every namespace with pending pods OR existing gangs: gang
             # phase/health maintenance must keep running after everything is
@@ -253,18 +278,21 @@ class GangScheduler:
         gang_specs: List[dict] = []
         gang_pods: Dict[str, Dict[str, List]] = {}
         loose_pods: List = []  # (namespace, pod)
-        for ns in namespaces:
-            self.update_gang_phases(ns)
-            self.update_gang_health(ns)
-            pending = self._pending_pods(ns)
-            if not pending:
-                continue
-            sticky, pending = self._bind_with_reused_reservations(ns, pending)
-            sticky_bound += sticky
-            specs, pods, loose = self._encode_pending(ns, pending)
-            gang_specs.extend(specs)
-            gang_pods.update(pods)
-            loose_pods.extend((ns, p) for p in loose)
+        with TRACER.span("scheduler.pending-scan", namespaces=len(namespaces)):
+            for ns in namespaces:
+                self.update_gang_phases(ns)
+                self.update_gang_health(ns)
+                pending = self._pending_pods(ns)
+                if not pending:
+                    continue
+                sticky, pending = self._bind_with_reused_reservations(
+                    ns, pending
+                )
+                sticky_bound += sticky
+                specs, pods, loose = self._encode_pending(ns, pending)
+                gang_specs.extend(specs)
+                gang_pods.update(pods)
+                loose_pods.extend((ns, p) for p in loose)
 
         # global priority order across all namespaces (kernel admits in
         # input order; ties broken by name for determinism)
@@ -290,28 +318,62 @@ class GangScheduler:
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
                 preempted = self._maybe_preempt(gang_specs, result)
                 assignments = result.assignments(problem)
-                for gi, spec in enumerate(gang_specs):
-                    ns = spec["namespace"]
-                    if not result.admitted[gi] or (
-                        (ns, spec["gang_name"]) in preempted
-                    ):
-                        # a victim's stale admission from this solve must not
-                        # overwrite its Preempted status (its pods are gone)
-                        continue
-                    for pclq_fqn, node_names in assignments[spec["name"]].items():
-                        pods = gang_pods[spec["name"]].get(pclq_fqn, [])
-                        for pod, node_name in zip(pods, node_names):
-                            self.cluster.bind(pod, node_name)
-                            bound += 1
-                    # A recovery delta-solve (floors reduced by pods already
-                    # placed) only covers the missing pods; its score says
-                    # nothing about the whole gang — keep the original.
-                    partial = any(g["partial"] for g in spec["groups"])
-                    self._mark_scheduled(
-                        ns,
-                        spec["gang_name"],
-                        None if partial else float(result.score[gi]),
-                    )
+                to_mark = []
+                with TRACER.span(
+                    "scheduler.commit", gangs=len(gang_specs)
+                ) as commit_span:
+                    for gi, spec in enumerate(gang_specs):
+                        ns = spec["namespace"]
+                        if not result.admitted[gi]:
+                            if (ns, spec["gang_name"]) not in preempted:
+                                EVENTS.record(
+                                    ("PodGang", ns, spec["gang_name"]),
+                                    TYPE_WARNING,
+                                    REASON_GANG_DEFERRED,
+                                    "not admitted this round (insufficient "
+                                    "capacity or unsatisfiable topology)",
+                                )
+                            continue
+                        if (ns, spec["gang_name"]) in preempted:
+                            # a victim's stale admission from this solve must
+                            # not overwrite its Preempted status (its pods
+                            # are gone)
+                            continue
+                        for pclq_fqn, node_names in assignments[
+                            spec["name"]
+                        ].items():
+                            pods = gang_pods[spec["name"]].get(pclq_fqn, [])
+                            for pod, node_name in zip(pods, node_names):
+                                self.cluster.bind(pod, node_name)
+                                EVENTS.record(
+                                    ("Pod", ns, pod.metadata.name),
+                                    TYPE_NORMAL,
+                                    REASON_POD_BOUND,
+                                    f"bound to {node_name}",
+                                )
+                                bound += 1
+                        # A recovery delta-solve (floors reduced by pods
+                        # already placed) only covers the missing pods; its
+                        # score says nothing about the whole gang — keep the
+                        # original.
+                        partial = any(g["partial"] for g in spec["groups"])
+                        EVENTS.record(
+                            ("PodGang", ns, spec["gang_name"]),
+                            TYPE_NORMAL,
+                            REASON_GANG_ADMITTED,
+                            f"placement score {float(result.score[gi]):.4f}",
+                        )
+                        to_mark.append(
+                            (
+                                ns,
+                                spec["gang_name"],
+                                None if partial else float(result.score[gi]),
+                            )
+                        )
+                    commit_span.set("bound", bound)
+                with TRACER.span("scheduler.status-write", gangs=len(to_mark)):
+                    for ns, gang_name, score in to_mark:
+                        self._mark_scheduled(ns, gang_name, score)
 
         # pods not in any gang (shouldn't happen for grove pods): first-fit
         for _ns, pod in loose_pods:
@@ -359,6 +421,12 @@ class GangScheduler:
                 )
             ):
                 self.cluster.bind(pod, prev)
+                EVENTS.record(
+                    ("Pod", namespace, pod.metadata.name),
+                    TYPE_NORMAL,
+                    REASON_POD_BOUND,
+                    f"bound to {prev} (reused reservation)",
+                )
                 bound += 1
             else:
                 remaining.append(pod)
@@ -916,6 +984,12 @@ class GangScheduler:
                 except GroveError as e:
                     if e.code != ERR_NOT_FOUND:
                         raise
+        EVENTS.record(
+            ("PodGang", ns, name),
+            TYPE_WARNING,
+            REASON_PREEMPTED,
+            f"preempted by higher-priority gang {preemptor['name']}",
+        )
         METRICS.inc("gang_preemptions_total")
 
     def update_gang_health(self, namespace: str = "default") -> None:

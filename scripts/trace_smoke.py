@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Trace smoke test: run a gang-scheduling sim with tracing enabled and
+validate the exported Chrome trace (the `make trace-smoke` target and the
+tier-1 test in tests/test_tracing.py share this logic).
+
+Checks:
+- the export is well-formed Chrome trace_event JSON (an array of events
+  with ph/ts/name, integer µs timestamps);
+- engine-reconcile spans are present;
+- scheduler.schedule spans carry nested encode/solve/commit children
+  (parent-linked AND time-contained, which is what chrome://tracing and
+  Perfetto use to nest).
+
+Usage: python scripts/trace_smoke.py [--gangs N] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# CPU pin before jax import: the smoke must not hang on a wedged accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from a checkout without an installed package (make trace-smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SET_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: trace-smoke
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: leader
+        spec:
+          roleName: role-leader
+          replicas: 1
+          podSpec:
+            containers:
+              - name: leader
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 10m
+      - name: worker
+        spec:
+          roleName: role-worker
+          replicas: 2
+          podSpec:
+            containers:
+              - name: worker
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 10m
+"""
+
+
+def run_traced_sim(n_gangs: int, num_nodes: int = 0):
+    """Apply n_gangs single-gang PodCliqueSets to a traced sim and converge.
+    Returns (harness, chrome_events)."""
+    from grove_tpu.api.load import load_podcliquesets
+    from grove_tpu.api.meta import deep_copy
+    from grove_tpu.observability.tracing import TRACER
+    from grove_tpu.sim.harness import SimHarness
+
+    TRACER.enable()
+    TRACER.reset()
+    base = load_podcliquesets(_SET_YAML)[0]
+    harness = SimHarness(num_nodes=num_nodes or max(16, n_gangs // 2))
+    for i in range(n_gangs):
+        pcs = deep_copy(base)
+        pcs.metadata.name = f"trace-{i:04d}"
+        harness.apply(pcs)
+    harness.converge(max_ticks=60 + n_gangs)
+    return harness, TRACER.chrome_trace()
+
+
+def check_trace(events) -> list:
+    """Structural validation + span-taxonomy assertions; returns problems."""
+    from grove_tpu.observability.tracing import validate_chrome_trace
+
+    problems = list(validate_chrome_trace(events))
+    names = {ev.get("name") for ev in events if isinstance(ev, dict)}
+    for required in (
+        "engine.reconcile",
+        "scheduler.schedule",
+        "scheduler.encode",
+        "scheduler.solve",
+        "scheduler.commit",
+    ):
+        if required not in names:
+            problems.append(f"no {required!r} spans in the trace")
+    # nesting: every encode/solve/commit child is parent-linked to the
+    # schedule phase chain and time-contained in SOME schedule span
+    schedules = [
+        ev
+        for ev in events
+        if isinstance(ev, dict) and ev.get("name") == "scheduler.schedule"
+    ]
+    for child_name in ("scheduler.encode", "scheduler.solve", "scheduler.commit"):
+        for ev in events:
+            if not isinstance(ev, dict) or ev.get("name") != child_name:
+                continue
+            contained = any(
+                s["ts"] <= ev["ts"]
+                and ev["ts"] + ev["dur"] <= s["ts"] + s["dur"]
+                and s["tid"] == ev["tid"]
+                for s in schedules
+            )
+            if not contained:
+                problems.append(
+                    f"a {child_name} span is not nested inside any "
+                    "scheduler.schedule span"
+                )
+            break  # one per name suffices for the smoke
+    return problems
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gangs", type=int, default=100)
+    parser.add_argument("--out", default="/tmp/grove_tpu_trace.json")
+    args = parser.parse_args()
+
+    harness, events = run_traced_sim(args.gangs)
+    gangs = len(harness.store.list("PodGang"))
+    with open(args.out, "w") as f:
+        json.dump(events, f)
+    # round-trip through the file: validate what a browser would load
+    with open(args.out) as f:
+        loaded = json.load(f)
+    problems = check_trace(loaded)
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"OK: {gangs} gangs, {len(loaded)} trace events -> {args.out} "
+        "(load in chrome://tracing or https://ui.perfetto.dev)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -559,3 +559,63 @@ class TestMultinodeSampleSpread:
             if pcsg.endswith("-prefill")
         }
         assert len(prefill_blocks) == 2, prefill_blocks
+
+
+class TestRecreateWhileScheduled:
+    def test_recreated_pod_ungates_in_the_recreating_reconcile(self):
+        """A pod deleted while its gang is already scheduled is recreated AND
+        ungated in the SAME reconcile — no GATE_RETRY_SECONDS (2s) wait
+        (ADVICE r5 recreate-latency regression)."""
+        harness = SimHarness(num_nodes=4)
+        harness.apply(simple1())
+        harness.converge()
+        base_pods = [
+            p
+            for p in harness.store.list("Pod")
+            if p.metadata.labels[namegen.LABEL_PODGANG] == "simple1-0"
+        ]
+        assert base_pods and all(is_ready(p) for p in base_pods)
+        victim = sorted(base_pods, key=lambda p: p.metadata.name)[0]
+
+        t0 = harness.clock.now()
+        harness.store.delete("Pod", "default", victim.metadata.name)
+        # drain WITHOUT advancing virtual time: the gate-retry requeue can
+        # never fire, so an ungated recreate proves the in-line path
+        harness.engine.drain()
+        fresh = harness.store.get("Pod", "default", victim.metadata.name)
+        assert fresh is not None, "pod was not recreated"
+        assert not fresh.spec.scheduling_gates, (
+            "recreated pod still schedule-gated — the in-line ungate for "
+            "already-scheduled gangs regressed to the 2s gate-retry requeue"
+        )
+        assert harness.clock.now() == t0
+
+    def test_recreated_scaled_pod_stays_gated_while_base_unscheduled(self):
+        """The in-line ungate must preserve the base-gang handshake: a
+        SCALED-gang pod recreated while the base gang is still unscheduled
+        must come back gated (syncflow.go:303-387 condition 2)."""
+        from grove_tpu.api.pod import is_schedule_gated
+
+        harness = SimHarness(num_nodes=2)
+        for n in harness.cluster.nodes:
+            n.cordoned = True  # nothing schedules: base gang stays pending
+        pcs = simple1()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 3
+        harness.apply(pcs)
+        harness.converge()
+        scaled_pods = [
+            p
+            for p in harness.store.list("Pod")
+            if p.metadata.labels[namegen.LABEL_PODGANG] != "simple1-0"
+        ]
+        assert scaled_pods and all(is_schedule_gated(p) for p in scaled_pods)
+
+        victim = sorted(scaled_pods, key=lambda p: p.metadata.name)[0]
+        harness.store.delete("Pod", "default", victim.metadata.name)
+        harness.engine.drain()
+        fresh = harness.store.get("Pod", "default", victim.metadata.name)
+        assert fresh is not None, "pod was not recreated"
+        assert is_schedule_gated(fresh), (
+            "in-line ungate fired for a scaled pod whose base gang is not "
+            "scheduled — the all-or-nothing handshake is broken"
+        )

@@ -1,0 +1,55 @@
+"""Prometheus text exposition: counters, labeled histograms, and the
+empty-window case (no `nan` quantile samples — invalid for many scrapers)."""
+
+from grove_tpu.observability.metrics import Metrics
+
+
+class TestExposition:
+    def test_counters_and_gauges(self):
+        m = Metrics()
+        m.inc("reconcile_total/podclique", 3)
+        m.set("workqueue_depth/podclique", 2.0)
+        text = m.prometheus_text()
+        assert 'grove_tpu_reconcile_total{name="podclique"} 3.0' in text
+        assert 'grove_tpu_workqueue_depth{name="podclique"} 2.0' in text
+        assert text.endswith("\n")
+
+    def test_labeled_histogram_series(self):
+        m = Metrics()
+        for v in (0.1, 0.2, 0.3, 0.4):
+            m.observe("reconcile_seconds/podclique", v)
+        text = m.prometheus_text()
+        assert 'grove_tpu_reconcile_seconds_count{name="podclique"} 4.0' in text
+        assert 'grove_tpu_reconcile_seconds_sum{name="podclique"} 1.0' in text
+        for q in ("0.5", "0.9", "0.99"):
+            assert (
+                f'grove_tpu_reconcile_seconds{{quantile="{q}",'
+                f'name="podclique"}}' in text
+            )
+
+    def test_unlabeled_histogram(self):
+        m = Metrics()
+        m.observe("gang_solve_seconds", 0.5)
+        text = m.prometheus_text()
+        assert "grove_tpu_gang_solve_seconds_count 1.0" in text
+        assert 'grove_tpu_gang_solve_seconds{quantile="0.5"} 0.5' in text
+
+    def test_empty_window_emits_no_nan_quantiles(self):
+        m = Metrics()
+        # an empty recent window (registered series, no samples retained):
+        # cumulative _count/_sum must still expose; quantile lines must not
+        m.histograms["gang_solve_seconds"]  # defaultdict registers empty
+        m.hist_count["gang_solve_seconds"] = 10.0
+        m.hist_sum["gang_solve_seconds"] = 5.0
+        text = m.prometheus_text()
+        assert "nan" not in text.lower()
+        assert "grove_tpu_gang_solve_seconds_count 10.0" in text
+        assert "grove_tpu_gang_solve_seconds_sum 5.0" in text
+        assert "quantile" not in text
+
+    def test_percentile_api_empty_returns_nan(self):
+        # the Python-side API keeps its NaN contract (callers check math.isnan)
+        import math
+
+        m = Metrics()
+        assert math.isnan(m.percentile("missing", 0.99))

@@ -1,0 +1,239 @@
+"""Event recorder: dedup-and-count semantics, scheduler/controller wiring
+(GangAdmitted / GangDeferred / PodBound / Preempted), and the sim
+apiserver's GET /events surfacing."""
+
+import json
+import urllib.request
+
+import pytest
+
+from grove_tpu.api.pod import is_ready, is_scheduled
+from grove_tpu.observability.events import (
+    EVENTS,
+    REASON_GANG_ADMITTED,
+    REASON_GANG_DEFERRED,
+    REASON_POD_BOUND,
+    REASON_PREEMPTED,
+    EventRecorder,
+)
+from grove_tpu.sim.harness import SimHarness
+from tests.test_gang_scheduling import simple1
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    """EVENTS is process-global — isolate each test's counts."""
+    EVENTS.reset()
+    yield
+    EVENTS.reset()
+    EVENTS.clock = None
+
+
+class TestRecorderUnit:
+    def test_dedup_bumps_count_and_timestamps(self):
+        rec = EventRecorder()
+        first = rec.record(("Pod", "ns1", "p1"), "Normal", "PodBound", "to n1")
+        again = rec.record(("Pod", "ns1", "p1"), "Normal", "PodBound", "to n2")
+        assert first is again
+        assert again.count == 2
+        assert again.message == "to n2"  # latest message wins
+        assert again.last_timestamp >= again.first_timestamp
+        assert len(rec.list()) == 1
+
+    def test_distinct_objects_do_not_dedup(self):
+        rec = EventRecorder()
+        rec.record(("Pod", "ns1", "p1"), "Normal", "PodBound", "m")
+        rec.record(("Pod", "ns1", "p2"), "Normal", "PodBound", "m")
+        rec.record(("Pod", "ns2", "p1"), "Normal", "PodBound", "m")
+        rec.record(("Pod", "ns1", "p1"), "Warning", "PodBound", "m")
+        assert len(rec.list()) == 4
+        assert all(r.count == 1 for r in rec.list())
+
+    def test_filters(self):
+        rec = EventRecorder()
+        rec.record(("Pod", "a", "p"), "Normal", "PodBound", "m")
+        rec.record(("PodGang", "b", "g"), "Normal", "GangAdmitted", "m")
+        assert [r.name for r in rec.list(namespace="a")] == ["p"]
+        assert [r.name for r in rec.list(reason="GangAdmitted")] == ["g"]
+        assert [r.name for r in rec.list(kind="Pod")] == ["p"]
+
+    def test_bounded_eviction_drops_oldest_groups(self):
+        rec = EventRecorder(max_events=5)
+        for i in range(12):
+            rec.record(("Pod", "ns", f"p{i}"), "Normal", "PodBound", "m")
+        names = [r.name for r in rec.list()]
+        assert names == [f"p{i}" for i in range(7, 12)]
+
+    def test_eviction_is_lru_not_insertion_order(self):
+        """An actively-updated group must survive eviction pressure — a
+        recency-blind pop would silently reset its count to 1."""
+        rec = EventRecorder(max_events=3)
+        rec.record(("PodGang", "ns", "hot"), "Normal", "GangAdmitted", "m")
+        rec.record(("Pod", "ns", "cold1"), "Normal", "PodBound", "m")
+        rec.record(("Pod", "ns", "cold2"), "Normal", "PodBound", "m")
+        # refresh the oldest-inserted group, then overflow
+        rec.record(("PodGang", "ns", "hot"), "Normal", "GangAdmitted", "m")
+        rec.record(("Pod", "ns", "cold3"), "Normal", "PodBound", "m")
+        survivors = {r.name: r.count for r in rec.list()}
+        assert survivors["hot"] == 2  # not evicted, count intact
+        assert "cold1" not in survivors  # least-recently-updated dropped
+
+    def test_record_accepts_typed_object(self):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import PodGang
+
+        rec = EventRecorder()
+        gang = PodGang(metadata=ObjectMeta(name="g", namespace="ns"))
+        r = rec.record(gang, "Normal", "GangAdmitted", "m")
+        assert (r.kind, r.namespace, r.name) == ("PodGang", "ns", "g")
+
+    def test_as_dict_wire_shape(self):
+        rec = EventRecorder()
+        r = rec.record(("Pod", "ns", "p"), "Normal", "PodBound", "m")
+        doc = r.as_dict()
+        assert doc["involvedObject"] == {
+            "kind": "Pod",
+            "namespace": "ns",
+            "name": "p",
+        }
+        assert doc["count"] == 1
+        assert set(doc) >= {"type", "reason", "message", "firstTimestamp"}
+
+
+class TestSchedulerWiring:
+    def test_gang_admission_records_events_with_dedup(self):
+        """The acceptance scenario: converge, then delete a bound pod so the
+        gang re-solves — GangAdmitted and PodBound must dedup to count >= 2
+        on the same objects."""
+        harness = SimHarness(num_nodes=4)
+        harness.apply(simple1())
+        harness.converge()
+        pods = harness.store.list("Pod")
+        assert pods and all(is_ready(p) for p in pods)
+
+        admitted = EVENTS.list(reason=REASON_GANG_ADMITTED, namespace="default")
+        assert any(e.name == "simple1-0" and e.kind == "PodGang" for e in admitted)
+        bound = EVENTS.list(reason=REASON_POD_BOUND, namespace="default")
+        assert {e.name for e in bound} == {p.metadata.name for p in pods}
+
+        # kill one bound pod: the controllers recreate it (ungated in-line,
+        # gang already scheduled) and the scheduler re-admits the gang
+        victim = sorted(pods, key=lambda p: p.metadata.name)[0]
+        harness.store.delete("Pod", "default", victim.metadata.name)
+        harness.converge()
+
+        from grove_tpu.api import names as namegen
+
+        admitted = {
+            e.name: e.count
+            for e in EVENTS.list(reason=REASON_GANG_ADMITTED)
+        }
+        gang_name = victim.metadata.labels[namegen.LABEL_PODGANG]
+        assert admitted.get(gang_name, 0) >= 2
+        bound = {e.name: e.count for e in EVENTS.list(reason=REASON_POD_BOUND)}
+        assert bound.get(victim.metadata.name, 0) >= 2
+
+    def test_gang_deferred_on_insufficient_capacity(self):
+        harness = SimHarness(num_nodes=1)
+        harness.cluster.nodes[0].capacity = {"cpu": 0.05}  # gang needs 0.09
+        harness.apply(simple1())
+        harness.converge()
+        deferred = EVENTS.list(reason=REASON_GANG_DEFERRED)
+        assert any(e.name == "simple1-0" for e in deferred)
+        assert all(e.type == "Warning" for e in deferred)
+        # every retry round dedups into the same record
+        assert all(e.count >= 1 for e in deferred)
+        assert not EVENTS.list(reason=REASON_GANG_ADMITTED)
+
+    def test_preemption_records_victim_event(self):
+        from grove_tpu.config.operator import load_operator_configuration
+        from tests.test_preemption import small_pcs
+
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        harness = SimHarness(num_nodes=2, config=cfg)
+        for n in harness.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        harness.apply(small_pcs("low", cpu=4, priority_class="batch"))
+        harness.converge()
+        assert all(is_scheduled(p) for p in harness.store.list("Pod"))
+
+        harness.apply(small_pcs("high", cpu=4, priority_class="critical"))
+        harness.converge()
+
+        preempted = EVENTS.list(reason=REASON_PREEMPTED)
+        assert any(e.name == "low-0" and e.kind == "PodGang" for e in preempted)
+        assert all(e.type == "Warning" for e in preempted)
+
+    def test_controller_events_flow_through_recorder(self):
+        harness = SimHarness(num_nodes=4)
+        harness.apply(simple1())
+        harness.converge()
+        created = EVENTS.list(reason="PodCreateSuccessful")
+        assert created and all(e.kind == "Pod" for e in created)
+        gangs = EVENTS.list(reason="PodGangCreateSuccessful")
+        assert any(e.name == "simple1-0" for e in gangs)
+
+    def test_controller_events_carry_object_namespace(self):
+        """Events for objects outside 'default' must be attributed to THEIR
+        namespace — a hard-defaulted namespace would hide them from
+        GET /events?namespace=... and cross-dedup same-named objects."""
+        harness = SimHarness(num_nodes=4)
+        pcs = simple1()
+        pcs.metadata.namespace = "team1"
+        harness.apply(pcs)
+        harness.converge()
+        for reason in (
+            "PodGangCreateSuccessful",
+            "PodCliqueCreateSuccessful",
+            "PodCreateSuccessful",
+            REASON_GANG_ADMITTED,
+            REASON_POD_BOUND,
+        ):
+            team1 = EVENTS.list(namespace="team1", reason=reason)
+            assert team1, f"no {reason} events attributed to team1"
+            assert not EVENTS.list(namespace="default", reason=reason)
+
+
+class TestEventsEndpoint:
+    def test_get_events_filters_and_counts(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        harness = SimHarness(num_nodes=4)
+        harness.apply(simple1())
+        harness.converge()
+        victim = sorted(
+            harness.store.list("Pod"), key=lambda p: p.metadata.name
+        )[0]
+        harness.store.delete("Pod", "default", victim.metadata.name)
+        harness.converge()
+
+        server = APIServer().start()
+        try:
+            with urllib.request.urlopen(
+                f"{server.address}/events?namespace=default"
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["kind"] == "EventList"
+            by_reason = {}
+            for item in doc["items"]:
+                by_reason.setdefault(item["reason"], []).append(item)
+            admitted = by_reason[REASON_GANG_ADMITTED]
+            assert max(i["count"] for i in admitted) >= 2
+            bound = by_reason[REASON_POD_BOUND]
+            assert max(i["count"] for i in bound) >= 2
+            # reason filter narrows server-side
+            with urllib.request.urlopen(
+                f"{server.address}/events?reason={REASON_POD_BOUND}"
+            ) as resp:
+                only_bound = json.loads(resp.read())["items"]
+            assert only_bound
+            assert all(i["reason"] == REASON_POD_BOUND for i in only_bound)
+            # a namespace with no events returns an empty list, not an error
+            with urllib.request.urlopen(
+                f"{server.address}/events?namespace=elsewhere"
+            ) as resp:
+                assert json.loads(resp.read())["items"] == []
+        finally:
+            server.stop()

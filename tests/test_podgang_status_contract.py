@@ -1,0 +1,127 @@
+"""Guard for the narrowed podgang_phase_or_spec_changed predicate (ADVICE
+r5): the controller package's reconcile flows may read from PodGang.status
+ONLY the fields whose transitions the watch predicate passes — today
+`phase` and `conditions` (writing this test surfaced that the PCS status
+flow mirrors gang *conditions* into pod_gang_statuses, so the predicate
+was widened to pass condition transitions). A future controller-side
+consumer of `placement_score` (or any new status field) breaks this build
+instead of silently stalling behind the filter.
+
+The scheduler (grove_tpu/solver/) intentionally reads conditions and
+placement_score — it runs outside the engine's watch plumbing and is
+excluded.
+"""
+
+import os
+
+import grove_tpu.api.types as api_types
+from grove_tpu.sim.harness import SimHarness
+from tests.test_gang_scheduling import simple1
+
+CONTROLLER_PKG = os.sep + os.path.join("grove_tpu", "controller") + os.sep
+
+# exactly the PodGang.status fields podgang_phase_or_spec_changed passes
+# transitions for (controller/register.py) — keep the two in lockstep
+PREDICATE_VISIBLE_FIELDS = {"phase", "conditions"}
+
+
+class TestPodGangStatusContract:
+    def test_controller_flows_read_only_predicate_visible_fields(
+        self, monkeypatch
+    ):
+        seen = {}
+        orig = api_types.PodGangStatus.__getattribute__
+
+        def spy(self, name):
+            if not name.startswith("__"):
+                import sys
+
+                caller = sys._getframe(1).f_code.co_filename
+                if CONTROLLER_PKG in caller:
+                    seen.setdefault(name, set()).add(os.path.basename(caller))
+            return orig(self, name)
+
+        monkeypatch.setattr(api_types.PodGangStatus, "__getattribute__", spy)
+
+        # a scenario that exercises every controller-side PodGang consumer:
+        # scaled gangs (base-gang phase gating), phase/condition mirroring
+        # into PCS status, pod recreate (gate handshake), a rolling update
+        harness = SimHarness(num_nodes=4)
+        pcs = simple1()
+        pcs.spec.template.pod_clique_scaling_group_configs[0].replicas = 2
+        harness.apply(pcs)
+        harness.converge()
+        victim = sorted(
+            harness.store.list("Pod"), key=lambda p: p.metadata.name
+        )[0]
+        harness.store.delete("Pod", "default", victim.metadata.name)
+        harness.converge()
+        pcs = harness.store.get("PodCliqueSet", "default", "simple1")
+        pcs.spec.template.cliques[0].spec.pod_spec.containers[0].image = (
+            "busybox:new"
+        )
+        harness.store.update(pcs)
+        for _ in range(30):
+            harness.converge()
+            harness.advance(2.0)
+            fresh = harness.store.get("PodCliqueSet", "default", "simple1")
+            prog = fresh.status.rolling_update_progress
+            if prog is not None and prog.update_ended_at is not None:
+                break
+
+        assert seen, "scenario never exercised a controller PodGang read"
+        extra = set(seen) - PREDICATE_VISIBLE_FIELDS
+        assert not extra, (
+            f"controller flows read PodGang status fields {sorted(extra)} "
+            f"(from {[seen[f] for f in sorted(extra)]}) — but "
+            "podgang_phase_or_spec_changed (controller/register.py) only "
+            f"passes {sorted(PREDICATE_VISIBLE_FIELDS)} transitions, so "
+            "those reads can observe stale values and the flow can stall. "
+            "Either widen the predicate (and this test's allowed set) or "
+            "stop reading the field."
+        )
+
+    def test_predicate_passes_exactly_the_contract_fields(self):
+        """Unit check on the predicate: score-only updates are filtered;
+        phase, condition, and spec transitions pass."""
+        from grove_tpu.api.meta import Condition, ObjectMeta
+        from grove_tpu.api.types import PodGang, PodGangSpec, PodGroup
+        from grove_tpu.controller.register import podgang_phase_or_spec_changed
+        from grove_tpu.runtime.store import MODIFIED, WatchEvent
+
+        def gang(phase="Pending", score=None, conds=(), groups=()):
+            g = PodGang(
+                metadata=ObjectMeta(name="g", namespace="default"),
+                spec=PodGangSpec(
+                    pod_groups=[PodGroup(name=n) for n in groups]
+                ),
+            )
+            g.status.phase = phase
+            g.status.placement_score = score
+            g.status.conditions = list(conds)
+            return g
+
+        def ev(old, new):
+            return WatchEvent(type=MODIFIED, kind="PodGang", obj=new, old=old)
+
+        # placement-score-only touches are swallowed (move every re-admission)
+        assert not podgang_phase_or_spec_changed(ev(gang(), gang(score=0.9)))
+        # ...including the score riding in a condition MESSAGE: _mark_scheduled
+        # rewrites the Scheduled condition's message per re-admission
+        # (scheduler.py), which must not re-open the score-churn fan-out
+        sched = lambda msg: Condition(  # noqa: E731
+            type="Scheduled", status="True", reason="AllPodGroupsPlaced",
+            message=msg,
+        )
+        assert not podgang_phase_or_spec_changed(
+            ev(
+                gang(conds=[sched("placement score 0.8")]),
+                gang(conds=[sched("placement score 0.9")]),
+            )
+        )
+        # phase, condition-status, and spec transitions pass
+        assert podgang_phase_or_spec_changed(ev(gang(), gang(phase="Starting")))
+        assert podgang_phase_or_spec_changed(
+            ev(gang(), gang(conds=[Condition(type="Unhealthy", status="True")]))
+        )
+        assert podgang_phase_or_spec_changed(ev(gang(), gang(groups=("a",))))

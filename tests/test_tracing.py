@@ -1,0 +1,257 @@
+"""Span tracer: unit semantics, Chrome-trace export, sim instrumentation,
+surfacing (apiserver debug endpoints, CLI trace subcommand), and the
+trace-smoke validation wired as a tier-1 test (`make trace-smoke` runs the
+same logic at 100 gangs)."""
+
+import json
+import pathlib
+import sys
+import threading
+
+import pytest
+
+from grove_tpu.observability.tracing import (
+    TRACER,
+    Tracer,
+    validate_chrome_trace,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "scripts"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """The singleton is process-global: leave it how other tests expect it
+    (disabled, empty)."""
+    TRACER.reset()
+    yield
+    TRACER.disable()
+    TRACER.reset()
+    TRACER.clock = None
+
+
+class TestTracerUnit:
+    def test_disabled_records_nothing(self):
+        t = Tracer()
+        assert not t.enabled  # off unless GROVE_TPU_TRACE set
+        with t.span("a", key="v") as sp:
+            sp.set("x", 1)  # no-op span accepts the full API
+        assert t.spans() == []
+        assert t.summary() == {}
+        assert t.chrome_trace() == []
+
+    def test_nesting_records_parent_links(self):
+        t = Tracer()
+        t.enable()
+        with t.span("outer"):
+            with t.span("inner"):
+                pass
+            with t.span("inner"):
+                pass
+        spans = {((s.name, s.parent)) for s in t.spans()}
+        assert ("outer", None) in spans
+        assert ("inner", "outer") in spans
+
+    def test_summary_aggregates_per_name(self):
+        t = Tracer()
+        t.enable()
+        for _ in range(5):
+            with t.span("work"):
+                pass
+        summary = t.summary()
+        assert summary["work"]["count"] == 5
+        assert summary["work"]["total_s"] >= 0
+        assert summary["work"]["p50_s"] <= summary["work"]["p99_s"]
+        assert summary["work"]["p99_s"] <= summary["work"]["max_s"]
+
+    def test_bounded_buffer_drops_oldest(self):
+        t = Tracer(max_spans=10)
+        t.enable()
+        for i in range(25):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.spans()
+        assert len(spans) == 10
+        assert spans[0].name == "s15"  # oldest dropped
+        assert t.summary_json()["dropped"] == 15
+
+    def test_virtual_clock_attribute(self):
+        from grove_tpu.runtime.clock import VirtualClock
+
+        t = Tracer(clock=VirtualClock(start=42.0))
+        t.enable()
+        with t.span("tick"):
+            pass
+        assert t.spans()[0].attrs["vt"] == 42.0
+
+    def test_thread_safety_and_per_thread_stacks(self):
+        t = Tracer()
+        t.enable()
+
+        def worker(n):
+            for _ in range(50):
+                with t.span(f"thread-{n}"):
+                    with t.span(f"child-{n}"):
+                        pass
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        spans = t.spans()
+        assert len(spans) == 4 * 50 * 2
+        # parent links never cross threads
+        for sp in spans:
+            if sp.name.startswith("child-"):
+                assert sp.parent == f"thread-{sp.name.split('-')[1]}"
+
+    def test_explicit_end_is_idempotent(self):
+        t = Tracer()
+        t.enable()
+        sp = t.span("once")
+        sp.end()
+        sp.end()
+        assert len(t.spans()) == 1
+
+
+class TestChromeTrace:
+    def test_export_shape(self):
+        t = Tracer()
+        t.enable()
+        with t.span("outer", k="v"):
+            with t.span("inner"):
+                pass
+        events = t.chrome_trace()
+        assert validate_chrome_trace(events) == []
+        assert json.loads(json.dumps(events)) == events  # JSON-serializable
+        byname = {e["name"]: e for e in events}
+        inner, outer = byname["inner"], byname["outer"]
+        assert inner["args"]["parent"] == "outer"
+        # time containment on the same tid — what chrome://tracing nests by
+        assert inner["tid"] == outer["tid"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+
+    def test_validator_rejects_malformed(self):
+        assert validate_chrome_trace({"not": "a list"})
+        assert validate_chrome_trace([{"ph": "X", "ts": 1}])  # missing name
+        assert validate_chrome_trace(
+            [{"ph": "X", "ts": 1.5, "name": "a", "dur": 1}]
+        )  # float ts
+        assert validate_chrome_trace([])  # empty is a problem too
+
+
+class TestSimInstrumentation:
+    def test_traced_sim_has_engine_and_scheduler_spans(self):
+        from trace_smoke import check_trace, run_traced_sim
+
+        harness, events = run_traced_sim(n_gangs=8, num_nodes=16)
+        assert len(harness.store.list("PodGang")) == 8
+        assert check_trace(events) == [], check_trace(events)
+        # engine.reconcile spans carry controller/key/outcome
+        rec = [e for e in events if e["name"] == "engine.reconcile"]
+        assert rec
+        assert all("controller" in e["args"] for e in rec)
+        assert all("outcome" in e["args"] for e in rec)
+        # virtual-clock awareness: spans carry the sim's virtual timestamp
+        assert all("vt" in e["args"] for e in rec)
+
+    def test_trace_smoke_file_roundtrip(self, tmp_path):
+        """The `make trace-smoke` contract end-to-end at reduced size."""
+        from trace_smoke import check_trace, run_traced_sim
+
+        _, events = run_traced_sim(n_gangs=4, num_nodes=8)
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps(events))
+        loaded = json.loads(path.read_text())
+        assert check_trace(loaded) == []
+
+    def test_disabled_tracing_sim_records_nothing(self):
+        from grove_tpu.sim.harness import SimHarness
+        from tests.test_gang_scheduling import simple1
+
+        TRACER.disable()
+        TRACER.reset()
+        harness = SimHarness(num_nodes=4)
+        harness.apply(simple1())
+        harness.converge()
+        assert TRACER.spans() == []
+
+
+class TestSurfacing:
+    def test_apiserver_debug_endpoints(self):
+        import urllib.request
+
+        from grove_tpu.cluster.apiserver import APIServer
+
+        TRACER.enable()
+        with TRACER.span("scheduler.schedule"):
+            with TRACER.span("scheduler.solve"):
+                pass
+        server = APIServer().start()
+        try:
+            with urllib.request.urlopen(
+                f"{server.address}/debug/traces"
+            ) as resp:
+                summary = json.loads(resp.read())
+            assert summary["enabled"] is True
+            assert summary["spans"]["scheduler.solve"]["count"] == 1
+            with urllib.request.urlopen(
+                f"{server.address}/debug/traces/chrome"
+            ) as resp:
+                events = json.loads(resp.read())
+            assert validate_chrome_trace(events) == []
+        finally:
+            server.stop()
+
+    def test_cli_trace_sim(self, capsys, tmp_path):
+        from grove_tpu.cli import main
+
+        chrome = tmp_path / "trace.json"
+        rc = main(
+            [
+                "trace",
+                str(REPO / "samples" / "simple1.yaml"),
+                "--nodes",
+                "8",
+                "--top",
+                "5",
+                "--chrome",
+                str(chrome),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "scheduler.schedule" in out
+        assert "slowest spans" in out
+        events = json.loads(chrome.read_text())
+        assert validate_chrome_trace(events) == []
+
+    def test_cli_trace_apiserver(self, capsys):
+        from grove_tpu.cli import main
+        from grove_tpu.cluster.apiserver import APIServer
+
+        TRACER.enable()
+        with TRACER.span("engine.reconcile", controller="podclique"):
+            pass
+        server = APIServer().start()
+        try:
+            rc = main(["trace", "--apiserver", server.address])
+        finally:
+            server.stop()
+        assert rc == 0
+        assert "engine.reconcile" in capsys.readouterr().out
+
+    def test_bench_trace_artifact_shape(self):
+        import bench
+
+        TRACER.enable()
+        with TRACER.span("solver.execute"):
+            pass
+        artifact = bench._trace_artifact()
+        assert artifact["enabled"] is True
+        assert "solver.execute" in artifact["spans"]
