@@ -7,7 +7,8 @@ CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 IMAGE ?= grove-tpu:0.2.0
 
 .PHONY: test test-fast check crds api-docs bench bench-small \
-        control-plane-bench trace-smoke dryrun docker-build compose-up clean
+        control-plane-bench cp-bench-smoke trace-smoke dryrun docker-build \
+        compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -39,6 +40,9 @@ bench-small:
 
 control-plane-bench:
 	$(CPU_ENV) $(PY) bench.py --control-plane --sets 256
+
+cp-bench-smoke:  ## small-N integrated control-plane smoke: per-PR regression sentinel ("control_plane" block: reconcile count, wall time, reconcile.batch spans)
+	$(CPU_ENV) $(PY) bench.py --integrated --sets 256 --nodes 256
 
 trace-smoke:     ## 100-gang traced sim; validates the Chrome trace export
 	$(CPU_ENV) $(PY) scripts/trace_smoke.py

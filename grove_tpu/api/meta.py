@@ -177,6 +177,19 @@ class NamespacedName:
         return f"{self.namespace}/{self.name}"
 
 
+def clone_status(status):
+    """Cheap private clone of a status object for a condition-writing flow:
+    shallow copy plus PRIVATE Condition copies. Safe because status flows
+    only REPLACE fields by assignment or call set_condition (which mutates
+    Condition objects and appends to the conditions list) — a flow that
+    mutates any OTHER nested status field in place (e.g. container
+    statuses) must use deep_copy instead. An order of magnitude cheaper
+    than the pickled deep copy on the per-reconcile status hot path."""
+    st = copy.copy(status)
+    st.conditions = [copy.copy(c) for c in status.conditions]
+    return st
+
+
 def deep_copy(obj):
     """Deep-copy an API object. pickle round-trip is several times faster
     than copy.deepcopy for plain dataclass trees (the store copies on every

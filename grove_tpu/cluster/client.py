@@ -48,6 +48,38 @@ _CODE_FOR_STATUS = {
 }
 
 
+class _PodSpecShim:
+    """The single pod-spec field the watch predicates compare."""
+
+    __slots__ = ("scheduling_gates",)
+
+    def __init__(self, gates) -> None:
+        self.scheduling_gates = gates
+
+
+class _OldView:
+    """Predicate-sufficient retention of a watched object for
+    WatchEvent.old: shares the decoded metadata and status sub-objects and
+    keeps spec only where a registered predicate compares it (PodGang spec
+    membership; the Pod scheduling-gate list as a shim). Everything else —
+    for Pods, the whole container/env template — is dropped, so the
+    informer-local `last` map no longer duplicates a second fully-decoded
+    copy of every live object (~47k pod specs in cluster mode)."""
+
+    __slots__ = ("kind", "metadata", "status", "spec")
+
+    def __init__(self, obj) -> None:
+        self.kind = obj.kind
+        self.metadata = obj.metadata
+        self.status = getattr(obj, "status", None)
+        if obj.kind == "PodGang":
+            self.spec = obj.spec  # podgang_phase_or_spec_changed compares it
+        elif obj.kind == "Pod":
+            self.spec = _PodSpecShim(obj.spec.scheduling_gates)
+        else:
+            self.spec = None  # no registered predicate reads old.spec
+
+
 class HttpStore:
     """Store-compatible client over HTTP. Reads are live (no informer lag);
     watches feed subscribe() callbacks from per-kind reader threads."""
@@ -163,7 +195,8 @@ class HttpStore:
         url = self.base_url + path + "?watch=true"
         # informer-local last-seen objects: lets MODIFIED events carry the
         # previous object (WatchEvent.old) so transition predicates work in
-        # cluster mode too; a reconnect clears it (old=None fails open)
+        # cluster mode too; a reconnect clears it (old=None fails open).
+        # Stored as predicate-sufficient _OldView slices, not full decodes.
         last: dict = {}
         while not self._stop.is_set():
             try:
@@ -183,7 +216,7 @@ class HttpStore:
                         if type_ == "Deleted":
                             last.pop(key, None)
                         else:
-                            last[key] = obj
+                            last[key] = _OldView(obj)
                         ev = WatchEvent(
                             type=type_, kind=kind, obj=obj, old=old
                         )
@@ -197,7 +230,9 @@ class HttpStore:
 
     # -- CRUD -------------------------------------------------------------
 
-    def create(self, obj):
+    def create(self, obj, consume: bool = False, share: bool = False):
+        # `consume`/`share` are Store-interface fast-path markers; over
+        # HTTP every request body is a private JSON export already
         doc = export_object(obj)
         out = self._request(
             "POST",

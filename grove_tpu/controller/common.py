@@ -41,6 +41,30 @@ class OperatorContext:
     events: List[str] = field(default_factory=list)
     _event_seq: int = 0
     max_events: int = 1000  # ring buffer (k8s Events have a TTL; we cap)
+    # desired-child memo: the EXPECTED PodCliques/PCSGs of a set are a pure
+    # function of (pcs uid, generation) — rebuilding the label dicts /
+    # startup-dep JSON / template hashes on every reconcile was a flat
+    # per-reconcile component-rebuild cost. Entries are reused READ-ONLY
+    # (create_or_adopt only reads; Store.create commits a private copy).
+    _desired_memo: Dict[tuple, object] = field(default_factory=dict)
+    # sized above the live population at stress scale (10,240 sets × 2
+    # entries each) so steady state never evicts a live key
+    _desired_memo_max: int = 65536
+
+    def desired_cache(self, key: tuple, build):
+        """Memoized desired-children build for `key` (kind, uid, generation).
+        A generation bump changes the key; stale generations age out LRU
+        (hits move to the end, so insertion order is recency)."""
+        hit = self._desired_memo.pop(key, None)
+        if hit is not None:
+            self._desired_memo[key] = hit
+            return hit
+        if len(self._desired_memo) >= self._desired_memo_max:
+            # drop the least-recently-used quarter
+            for stale in list(self._desired_memo)[: self._desired_memo_max // 4]:
+                self._desired_memo.pop(stale, None)
+        value = self._desired_memo[key] = build()
+        return value
 
     def record_event(
         self,
@@ -79,7 +103,8 @@ class OperatorContext:
                         "message": message,
                         "timestamp": self.clock.now(),
                     },
-                )
+                ),
+                consume=True,  # fire-and-forget: no private pickled copy
             )
         except Exception:
             pass  # events are best-effort (conflict on replayed names etc.)
@@ -112,15 +137,17 @@ def shared_template_spec(spec):
 def status_shadow(view):
     """Shadow object over a zero-copy readonly store view: SHARES metadata
     and spec (read-only by the scan/readonly contract) with a PRIVATE
-    deep-copied status, so a mutating status flow can run against it without
+    status clone, so a mutating status flow can run against it without
     touching committed store state. The one sanctioned way to do this —
-    pair with [write_status_if_changed] for the write side."""
-    from grove_tpu.api.meta import deep_copy
+    pair with [write_status_if_changed] for the write side. The clone is
+    condition-aware-shallow (api/meta.clone_status): status flows only
+    assign fields or set_condition."""
+    from grove_tpu.api.meta import clone_status
 
     return type(view)(
         metadata=view.metadata,
         spec=view.spec,
-        status=deep_copy(view.status),
+        status=clone_status(view.status),
     )
 
 
@@ -135,17 +162,17 @@ def write_status_if_changed(
     place to fix, three reconcilers using it. Steady-state (unchanged)
     reconciles return without touching the store. Returns True on write.
     """
+    from grove_tpu.runtime.store import commit_status
+
     view = ctx.store.get(kind, namespace, name, readonly=True)
     if view is None or view.metadata.deletion_timestamp is not None:
         return False
     if status == view.status:
         return False
-    fresh = ctx.store.get(kind, namespace, name)
-    if fresh is None or fresh.metadata.deletion_timestamp is not None:
-        return False
-    fresh.status = status
-    ctx.store.update_status(fresh)
-    return True
+    # copy-on-write commit: the new committed object shares metadata/spec
+    # with `view` and takes `status` (the caller's private shadow copy) —
+    # no mutable re-get, no pickling (HttpStore falls back internally)
+    return commit_status(ctx.store, view, status) is not None
 
 
 def record_last_error(
@@ -192,7 +219,10 @@ def create_or_adopt(ctx: OperatorContext, desired) -> None:
     # mutable copy only when adoption actually writes
     current = ctx.store.get(desired.kind, ns, desired.metadata.name, readonly=True)
     if current is None:
-        ctx.store.create(desired)
+        # share=True: `desired` may be a memoized desired-state object
+        # (desired_cache) reused read-only by later reconciles — the store
+        # commits a private-spined copy and never stamps identity back
+        ctx.store.create(desired, share=True)
         return
     if current.metadata.deletion_timestamp is not None:
         return
@@ -234,9 +264,27 @@ def translate_topology_constraint(
     """Operator-side level *name* → scheduler-side topology *key* translation
     (docs/designs/topology.md:541-616): the user's packDomain becomes the
     `required` key; the topology's narrowest level becomes the auto-generated
-    `preferred` key; spreadDomain becomes a TopologySpreadConstraint."""
+    `preferred` key; spreadDomain becomes a TopologySpreadConstraint.
+
+    Memoized per topology INSTANCE keyed by the four translated fields: the
+    translation is a pure function of (those fields, topology levels), and
+    the gang sync re-runs it for every PodGroup of every reconcile — at
+    stress scale the same handful of template shapes translate millions of
+    times. The shared result is immutable by the committed-object contract."""
     if tc is None or topology is None:
         return None
+    memo_key = (
+        tc.pack_domain,
+        tc.spread_domain,
+        tc.spread_min_domains,
+        tc.spread_when_unsatisfiable,
+    )
+    memo = getattr(topology, "_translate_memo", None)
+    if memo is None:
+        memo = {}
+        object.__setattr__(topology, "_translate_memo", memo)
+    if memo_key in memo:
+        return memo[memo_key]
     pack = spread = None
     if tc.pack_domain is not None:
         pack = TopologyPackConstraint(
@@ -256,9 +304,13 @@ def translate_topology_constraint(
                 tc.spread_when_unsatisfiable or SPREAD_DO_NOT_SCHEDULE
             ),
         )
-    if pack is None and spread is None:
-        return None
-    return SchedTopologyConstraint(pack_constraint=pack, spread_constraint=spread)
+    result = (
+        None
+        if pack is None and spread is None
+        else SchedTopologyConstraint(pack_constraint=pack, spread_constraint=spread)
+    )
+    memo[memo_key] = result
+    return result
 
 
 def pcs_child_selector(pcs_name: str) -> Dict[str, str]:

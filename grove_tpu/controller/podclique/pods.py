@@ -12,6 +12,7 @@ Re-host of /root/reference/operator/internal/controller/podclique/components/pod
 
 from __future__ import annotations
 
+import copy
 import json
 from typing import List, Optional
 
@@ -31,6 +32,7 @@ from grove_tpu.api.types import (
 )
 from grove_tpu.controller.common import OperatorContext
 from grove_tpu.runtime import indexer
+from grove_tpu.runtime.store import commit_spec
 
 STARTUP_DEPS_ANNOTATION = "grove.io/startup-dependencies"  # JSON on the PCLQ
 
@@ -39,7 +41,9 @@ def owner_pcs_name(pclq: PodClique) -> str:
     return pclq.metadata.labels.get(namegen.LABEL_PART_OF, "")
 
 
-def sync_pods(ctx: OperatorContext, pclq: PodClique, pods) -> int:
+def sync_pods(
+    ctx: OperatorContext, pclq: PodClique, pods, base_sched_memo=None
+) -> int:
     """Create/delete pods to match spec.replicas; returns pods still gated.
 
     ``pods``: the reconciler's pre-scanned pod list (read-only views),
@@ -47,7 +51,12 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique, pods) -> int:
     against the pre-sync snapshot (the replica diff covers in-flight
     creates via expectations), so sharing one scan is behavior-identical
     and halves the per-reconcile scan cost (one LIST instead of two in
-    HttpStore cluster mode)."""
+    HttpStore cluster mode).
+
+    ``base_sched_memo``: optional per-drain-batch memo for the base-gang-
+    scheduled check — scaled PCLQs of one set share a base gang, and under
+    cache lag the cached view is frozen for the whole round, so one check
+    serves every sibling in the batch."""
     ns = pclq.metadata.namespace
     cached_pods = [p for p in pods if not is_terminating(p)]
     observed_uids = [p.metadata.uid for p in cached_pods]
@@ -81,7 +90,9 @@ def sync_pods(ctx: OperatorContext, pclq: PodClique, pods) -> int:
     # pod-ADDED events predicate-filtered (reference podPredicate
     # CreateFunc=false, podclique/register.go:102), nothing would ever
     # revisit the gate.
-    return _remove_scheduling_gates(ctx, pclq, cached_pods + created_pods)
+    return _remove_scheduling_gates(
+        ctx, pclq, cached_pods + created_pods, base_sched_memo
+    )
 
 
 def _process_pending_updates(
@@ -158,7 +169,10 @@ def _create_pods(
     def make_create(idx: int):
         def create() -> None:
             pod = build_pod(ctx, pclq, idx)
-            created = ctx.store.create(pod)
+            # ownership-transfer create: the freshly built pod becomes the
+            # committed object directly (no private pickled copy); the gate
+            # pass below only READS it
+            created = ctx.store.create(pod, consume=True)
             ctx.pod_expectations.expect_creations(key, [created.metadata.uid])
             ctx.record_event(
                 "Pod",
@@ -237,10 +251,27 @@ def build_pod(ctx: OperatorContext, pclq: PodClique, pod_index: int) -> Pod:
     )
 
 
-def _clone_pod_spec(pclq: PodClique):
-    from grove_tpu.api.meta import deep_copy
+def _clone_container(c):
+    # env dicts are the only container field set_env mutates in place
+    c2 = copy.copy(c)
+    c2.env = [dict(e) for e in c.env]
+    return c2
 
-    return deep_copy(pclq.spec.pod_spec)
+
+def _clone_pod_spec(pclq: PodClique):
+    """Copy-on-write pod-spec clone. build_pod customizes exactly: the gate
+    list, identity fields (assigned), per-container env (set_env), and the
+    extra dict — those get private copies; everything else (resources,
+    commands, tolerations, unmodeled passthrough) stays shared with the
+    PCLQ's immutable committed template. Replaces a pickled deep copy of
+    the whole template per pod (the dominant pod-create cost at scale)."""
+    src = pclq.spec.pod_spec
+    spec = copy.copy(src)
+    spec.containers = [_clone_container(c) for c in src.containers]
+    spec.init_containers = [_clone_container(c) for c in src.init_containers]
+    spec.scheduling_gates = list(src.scheduling_gates)
+    spec.extra = dict(src.extra)
+    return spec
 
 
 def _owner_ref(pclq: PodClique):
@@ -292,7 +323,9 @@ def _delete_excess_pods(
 # ---------------------------------------------------------------------------
 
 
-def _remove_scheduling_gates(ctx: OperatorContext, pclq: PodClique, pods) -> int:
+def _remove_scheduling_gates(
+    ctx: OperatorContext, pclq: PodClique, pods, base_sched_memo=None
+) -> int:
     ns = pclq.metadata.namespace
     podgang_name = pclq.metadata.labels.get(namegen.LABEL_PODGANG, "")
     gated = [p for p in pods if PODGANG_SCHEDULING_GATE in p.spec.scheduling_gates]
@@ -310,7 +343,15 @@ def _remove_scheduling_gates(ctx: OperatorContext, pclq: PodClique, pods) -> int
             for ref in group.pod_references:
                 names_in_gang.add(ref.name)
 
-    base_scheduled = _base_podgang_scheduled(ctx, pclq)
+    if base_sched_memo is None:
+        base_scheduled = _base_podgang_scheduled(ctx, pclq)
+    else:
+        mkey = (ns, pclq.metadata.labels.get(namegen.LABEL_BASE_PODGANG))
+        base_scheduled = base_sched_memo.get(mkey)
+        if base_scheduled is None:
+            base_scheduled = base_sched_memo[mkey] = _base_podgang_scheduled(
+                ctx, pclq
+            )
 
     skipped = 0
     for pod in gated:
@@ -322,13 +363,16 @@ def _remove_scheduling_gates(ctx: OperatorContext, pclq: PodClique, pods) -> int
         if not base_scheduled:
             skipped += 1
             continue
-        fresh = ctx.store.get("Pod", ns, pod.metadata.name)
-        if fresh is None or not fresh.spec.scheduling_gates:
+        view = ctx.store.get("Pod", ns, pod.metadata.name, readonly=True)
+        if view is None or not view.spec.scheduling_gates:
             continue
-        fresh.spec.scheduling_gates = [
-            g for g in fresh.spec.scheduling_gates if g != PODGANG_SCHEDULING_GATE
+        # copy-on-write ungate: clone only the spec spine with a private
+        # gate list; containers/env stay shared with the committed object
+        new_spec = copy.copy(view.spec)
+        new_spec.scheduling_gates = [
+            g for g in view.spec.scheduling_gates if g != PODGANG_SCHEDULING_GATE
         ]
-        ctx.store.update(fresh, bump_generation=False)
+        commit_spec(ctx.store, view, new_spec)
     return skipped
 
 

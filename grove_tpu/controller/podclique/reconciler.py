@@ -32,6 +32,15 @@ GATE_RETRY_SECONDS = 2.0
 class PodCliqueReconciler:
     def __init__(self, ctx: OperatorContext) -> None:
         self.ctx = ctx
+        self._base_sched_memo = None
+
+    def begin_batch(self, keys) -> None:
+        """Engine batch hook (deterministic drain only): scaled PCLQs of a
+        set share one base gang, and under cache lag the cached view is
+        FROZEN for the whole round — so the base-gang-scheduled check is
+        computed once per (ns, base gang) per batch instead of per PCLQ.
+        Without cache lag reads are live and the memo stays off."""
+        self._base_sched_memo = {} if self.ctx.store.cache_lag else None
 
     def reconcile(self, key: Key) -> ReconcileStepResult:
         _, ns, name = key
@@ -44,9 +53,11 @@ class PodCliqueReconciler:
             return self._reconcile_delete(pclq)
         try:
             if FINALIZER not in pclq.metadata.finalizers:
-                pclq = self.ctx.store.get("PodClique", ns, name)
-                pclq.metadata.finalizers.append(FINALIZER)
-                pclq = self.ctx.store.update(pclq, bump_generation=False)
+                from grove_tpu.runtime.store import commit_finalizer_add
+
+                pclq = commit_finalizer_add(self.ctx.store, pclq, FINALIZER)
+                if pclq is None:  # deleted between view and write
+                    return do_not_requeue()
             # ONE pod scan shared by the sync flow and the gate pass (both
             # always decided against the pre-sync view — the diff math uses
             # expectations for in-flight creates). The STATUS compute below
@@ -59,7 +70,9 @@ class PodCliqueReconciler:
                     "Pod", ns, {namegen.LABEL_PODCLIQUE: name}, cached=True
                 )
             )
-            skipped_gated = pod_component.sync_pods(self.ctx, pclq, pods)
+            skipped_gated = pod_component.sync_pods(
+                self.ctx, pclq, pods, self._base_sched_memo
+            )
             view = self.ctx.store.get("PodClique", ns, name, readonly=True)
             if view is not None and view.metadata.deletion_timestamp is None:
                 # compute on the zero-copy view; write only on difference

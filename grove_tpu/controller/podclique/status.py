@@ -47,36 +47,54 @@ def compute_status(ctx: OperatorContext, pclq: PodClique, pods=None):
 
 def reconcile_status(ctx: OperatorContext, pclq: PodClique, pods=None) -> PodClique:
     ns = pclq.metadata.namespace
-    if pods is None:
-        pods = ctx.store.scan(
-            "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
-        )
-    pods = [p for p in pods if not is_terminating(p)]
     st = pclq.status
-    st.replicas = len(pods)
-    st.ready_replicas = sum(1 for p in pods if is_ready(p))
-    st.scheduled_replicas = sum(1 for p in pods if is_scheduled(p))
-    st.schedule_gated_replicas = sum(1 for p in pods if is_schedule_gated(p))
     current_hash = pclq.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH)
-    st.updated_replicas = sum(
-        1
-        for p in pods
-        if current_hash
-        and p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) == current_hash
-    )
+    counters = None
+    if pods is None:
+        # event-driven aggregation: the store maintains these counters
+        # incrementally from watch deltas (runtime/aggregate.py), exactly
+        # equal to a full rescan of the same cached view — so the per-event
+        # O(pods) rescan drops to O(1). HttpStore has no aggregate (reads
+        # are live lists); it keeps the scan below.
+        pod_counters = getattr(ctx.store, "pod_counters", None)
+        if pod_counters is not None:
+            counters = pod_counters(ns, pclq.metadata.name, cached=True)
+    if counters is not None:
+        st.replicas = counters.total
+        st.ready_replicas = counters.ready
+        st.scheduled_replicas = counters.scheduled
+        st.schedule_gated_replicas = counters.gated
+        st.updated_replicas = counters.updated(current_hash)
+        num_error_exits = counters.error_exits
+        num_started_not_ready = counters.started_not_ready
+    else:
+        if pods is None:
+            pods = ctx.store.scan(
+                "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.metadata.name}, cached=True
+            )
+        pods = [p for p in pods if not is_terminating(p)]
+        st.replicas = len(pods)
+        st.ready_replicas = sum(1 for p in pods if is_ready(p))
+        st.scheduled_replicas = sum(1 for p in pods if is_scheduled(p))
+        st.schedule_gated_replicas = sum(1 for p in pods if is_schedule_gated(p))
+        st.updated_replicas = sum(
+            1
+            for p in pods
+            if current_hash
+            and p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH) == current_hash
+        )
+        num_error_exits = sum(
+            1 for p in pods if not is_ready(p) and has_erroneous_exit(p)
+        )
+        num_started_not_ready = sum(
+            1
+            for p in pods
+            if is_scheduled(p)
+            and not is_ready(p)
+            and not has_erroneous_exit(p)
+            and any(cs.started for cs in p.status.container_statuses)
+        )
     st.selector = f"{namegen.LABEL_PODCLIQUE}={pclq.metadata.name}"
-
-    num_error_exits = sum(
-        1 for p in pods if not is_ready(p) and has_erroneous_exit(p)
-    )
-    num_started_not_ready = sum(
-        1
-        for p in pods
-        if is_scheduled(p)
-        and not is_ready(p)
-        and not has_erroneous_exit(p)
-        and any(cs.started for cs in p.status.container_statuses)
-    )
     now = ctx.clock.now()
     set_condition(
         st.conditions, _scheduled_condition(pclq), now

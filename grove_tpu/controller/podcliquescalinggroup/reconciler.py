@@ -70,11 +70,11 @@ class PodCliqueScalingGroupReconciler:
             return do_not_requeue()
         try:
             if FINALIZER not in pcsg.metadata.finalizers:
-                pcsg = self.ctx.store.get("PodCliqueScalingGroup", ns, name)
-                if pcsg is None:  # deleted between view and mutable re-get
+                from grove_tpu.runtime.store import commit_finalizer_add
+
+                pcsg = commit_finalizer_add(self.ctx.store, pcsg, FINALIZER)
+                if pcsg is None:  # deleted between view and write
                     return do_not_requeue()
-                pcsg.metadata.finalizers.append(FINALIZER)
-                pcsg = self.ctx.store.update(pcsg, bump_generation=False)
             update_requeue = self._process_rolling_update(pcsg, pcs)
             requeue_in = self._sync_podcliques(pcsg, pcs)
             self._reconcile_status(pcsg, pcs)
@@ -127,14 +127,30 @@ class PodCliqueScalingGroupReconciler:
             )
         }
 
-        expected: Dict[str, PodClique] = {}
-        for replica in range(pcsg.spec.replicas):
-            for clique_name in pcsg.spec.clique_names:
-                pclq = self._build_pclq(
-                    pcs, pcs_replica, pcsg, sg_name, replica, clique_name
-                )
-                if pclq is not None:
-                    expected[pclq.metadata.name] = pclq
+        def build() -> Dict[str, PodClique]:
+            out: Dict[str, PodClique] = {}
+            for replica in range(pcsg.spec.replicas):
+                for clique_name in pcsg.spec.clique_names:
+                    pclq = self._build_pclq(
+                        pcs, pcs_replica, pcsg, sg_name, replica, clique_name
+                    )
+                    if pclq is not None:
+                        out[pclq.metadata.name] = pclq
+            return out
+
+        # pure function of the PCSG spec (its generation covers HPA scale
+        # writes) and the owning PCS template (its generation covers
+        # template pushes) — see ctx.desired_cache
+        expected = self.ctx.desired_cache(
+            (
+                "pcsg-pclq",
+                pcsg.metadata.uid,
+                pcsg.metadata.generation,
+                pcs.metadata.uid,
+                pcs.metadata.generation,
+            ),
+            build,
+        )
 
         # create missing; adopt label/annotation drift on existing
         for pclq in expected.values():

@@ -24,7 +24,8 @@ def _ensure(ctx: OperatorContext, obj: GenericObject) -> None:
         )
         is None
     ):
-        ctx.store.create(obj)
+        # freshly built, caller drops it: ownership-transfer create
+        ctx.store.create(obj, consume=True)
 
 
 def _reap(

@@ -33,11 +33,20 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
     existing_names = {
         p.metadata.name for p in ctx.store.scan("PodClique", ns, selector)
     }
-    expected: Dict[str, PodClique] = {}
-    for replica in range(pcs.spec.replicas):
-        for clique in pcs.spec.template.standalone_clique_templates():
-            pclq = build_pclq(pcs, replica, clique)
-            expected[pclq.metadata.name] = pclq
+
+    def build() -> Dict[str, PodClique]:
+        out: Dict[str, PodClique] = {}
+        for replica in range(pcs.spec.replicas):
+            for clique in pcs.spec.template.standalone_clique_templates():
+                pclq = build_pclq(pcs, replica, clique)
+                out[pclq.metadata.name] = pclq
+        return out
+
+    # pure function of (uid, generation): spec/replica changes bump the
+    # generation, so the memoized desired set is exact across reconciles
+    expected = ctx.desired_cache(
+        ("pclq", pcs.metadata.uid, pcs.metadata.generation), build
+    )
 
     for name, pclq in expected.items():
         if name not in existing_names:

@@ -58,25 +58,32 @@ class PodGangInfo:
 
 
 def compute_expected_podgangs(
-    ctx: OperatorContext, pcs: PodCliqueSet
+    ctx: OperatorContext,
+    pcs: PodCliqueSet,
+    live_pclqs: Optional[Dict] = None,
+    live_pcsgs: Optional[Dict] = None,
 ) -> List[PodGangInfo]:
-    """syncflow.go:113-132."""
+    """syncflow.go:113-132. ``live_pclqs``/``live_pcsgs``: pre-fetched
+    name→view dicts from the reconcile's shared ChildSnapshot (None →
+    fetch here)."""
     ns = pcs.metadata.namespace
-    live_pclqs = {
-        p.metadata.name: p
-        for p in ctx.store.scan(
-            "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
-        )
-    }
-    live_pcsgs = {
-        g.metadata.name: g
-        for g in ctx.store.scan(
-            "PodCliqueScalingGroup",
-            ns,
-            namegen.default_labels(pcs.metadata.name),
-            cached=True,
-        )
-    }
+    if live_pclqs is None:
+        live_pclqs = {
+            p.metadata.name: p
+            for p in ctx.store.scan(
+                "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
+            )
+        }
+    if live_pcsgs is None:
+        live_pcsgs = {
+            g.metadata.name: g
+            for g in ctx.store.scan(
+                "PodCliqueScalingGroup",
+                ns,
+                namegen.default_labels(pcs.metadata.name),
+                cached=True,
+            )
+        }
     out: List[PodGangInfo] = []
     for replica in range(pcs.spec.replicas):
         out.append(_base_podgang_info(pcs, replica, live_pclqs))
@@ -168,9 +175,18 @@ def _scaled_podgang_infos(pcs, replica: int, live_pcsgs) -> List[PodGangInfo]:
     return out
 
 
-def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
+def sync(ctx: OperatorContext, pcs: PodCliqueSet, snap=None) -> None:
     ns = pcs.metadata.namespace
-    expected = compute_expected_podgangs(ctx, pcs)
+    # one informer snapshot serves the expected-gang computation, the
+    # pending checks, AND the PodGroup builds (previously this flow ran the
+    # same PodClique scan twice plus one pod scan per constituent PCLQ)
+    if snap is not None:
+        live_pclqs = {p.metadata.name: p for p in snap.pclqs}
+        live_pcsgs = {g.metadata.name: g for g in snap.pcsgs}
+        set_pods = snap.pods_by_pclq()
+    else:
+        live_pclqs = live_pcsgs = set_pods = None
+    expected = compute_expected_podgangs(ctx, pcs, live_pclqs, live_pcsgs)
     expected_names = {g.fqn for g in expected}
     selector = {
         **namegen.default_labels(pcs.metadata.name),
@@ -185,16 +201,17 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
             "PodGang", "PodGangDeleteSuccessful", name, namespace=ns, name=name
         )
 
-    live_pclqs = {
-        p.metadata.name: p
-        for p in ctx.store.scan(
-            "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
-        )
-    }
+    if live_pclqs is None:
+        live_pclqs = {
+            p.metadata.name: p
+            for p in ctx.store.scan(
+                "PodClique", ns, namegen.default_labels(pcs.metadata.name), cached=True
+            )
+        }
 
     for gang in expected:
         pods_by_pclq, pending = _pods_pending_creation_or_association(
-            ctx, ns, gang, live_pclqs
+            ctx, ns, gang, live_pclqs, set_pods
         )
         if gang.fqn not in existing and pending > 0:
             # defer creation until every constituent pod exists & is labeled
@@ -204,11 +221,13 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
 
 
 def _pods_pending_creation_or_association(
-    ctx: OperatorContext, ns: str, gang: PodGangInfo, live_pclqs
+    ctx: OperatorContext, ns: str, gang: PodGangInfo, live_pclqs, set_pods=None
 ):
     """:394-461: count pods that are (a) from PCLQs not yet created,
     (b) not yet created in existing PCLQs, or (c) missing/mismatching the
-    podgang label. Also returns the pod names per PCLQ for PodGroups."""
+    podgang label. Also returns the pod names per PCLQ for PodGroups.
+    ``set_pods``: the snapshot's pods-by-PCLQ grouping (one scan for the
+    whole set instead of one per constituent PCLQ)."""
     pending = 0
     pods_by_pclq: Dict[str, List[str]] = {}
     for pclq in gang.pclqs:
@@ -216,9 +235,12 @@ def _pods_pending_creation_or_association(
         if live is None:
             pending += pclq.replicas
             continue
-        pods = ctx.store.scan(
-            "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.fqn}, cached=True
-        )
+        if set_pods is not None:
+            pods = set_pods.get(pclq.fqn, ())
+        else:
+            pods = ctx.store.scan(
+                "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq.fqn}, cached=True
+            )
         pods = [p for p in pods if p.metadata.deletion_timestamp is None]
         pending += max(0, live.spec.replicas - len(pods))
         names: List[str] = []
@@ -320,7 +342,8 @@ def _create_or_update_podgang(
             PodGang(
                 metadata=ObjectMeta(name=gang.fqn, namespace=ns, labels=labels),
                 spec=spec,
-            )
+            ),
+            consume=True,  # freshly built and dropped: no pickled copy
         )
         ctx.record_event(
             "PodGang",
@@ -330,8 +353,10 @@ def _create_or_update_podgang(
             name=gang.fqn,
         )
     elif current.spec != spec:
-        current = ctx.store.get("PodGang", ns, gang.fqn)
-        current.spec = spec
-        ctx.store.update(current, bump_generation=False)
+        # copy-on-write spec push: `spec` is freshly built (private); the
+        # committed clone shares metadata/status with the previous object
+        from grove_tpu.runtime.store import commit_spec
+
+        commit_spec(ctx.store, current, spec)
 
 

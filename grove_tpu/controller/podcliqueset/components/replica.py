@@ -18,13 +18,15 @@ from grove_tpu.api.types import COND_MIN_AVAILABLE_BREACHED, PodCliqueSet
 from grove_tpu.controller.common import OperatorContext
 
 
-def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
-    """Returns the minimum remaining breach wait (requeue hint) or None."""
+def sync(ctx: OperatorContext, pcs: PodCliqueSet, snap=None) -> Optional[float]:
+    """Returns the minimum remaining breach wait (requeue hint) or None.
+    ``snap``: the reconcile's shared ChildSnapshot (one informer fetch per
+    reconcile under cache lag) — None falls back to per-replica scans."""
     delay = pcs.spec.template.termination_delay or 0.0
     now = ctx.clock.now()
     min_wait: Optional[float] = None
     for replica in range(pcs.spec.replicas):
-        since = _replica_breach_since(ctx, pcs, replica)
+        since = _replica_breach_since(ctx, pcs, replica, snap)
         if since is None:
             continue
         age = now - since
@@ -37,35 +39,41 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
 
 
 def _replica_breach_since(
-    ctx: OperatorContext, pcs: PodCliqueSet, replica: int
+    ctx: OperatorContext, pcs: PodCliqueSet, replica: int, snap=None
 ) -> Optional[float]:
     """Earliest still-True breach among the replica's standalone PCLQs and its
     PCSGs (gangterminate.go:67-105; PCSG aggregation covers base replicas)."""
     ns = pcs.metadata.namespace
     breach_times: List[float] = []
-    standalone = ctx.store.scan(
-        "PodClique",
-        ns,
-        {
-            **namegen.default_labels(pcs.metadata.name),
-            namegen.LABEL_COMPONENT: namegen.COMPONENT_PCS_PODCLIQUE,
-            namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
-        },
-        cached=True,
-    )
+    if snap is not None:
+        standalone = snap.pclqs_for_replica(
+            replica, namegen.COMPONENT_PCS_PODCLIQUE
+        )
+        pcsgs = snap.pcsgs_for_replica(replica)
+    else:
+        standalone = ctx.store.scan(
+            "PodClique",
+            ns,
+            {
+                **namegen.default_labels(pcs.metadata.name),
+                namegen.LABEL_COMPONENT: namegen.COMPONENT_PCS_PODCLIQUE,
+                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+            },
+            cached=True,
+        )
+        pcsgs = ctx.store.scan(
+            "PodCliqueScalingGroup",
+            ns,
+            {
+                **namegen.default_labels(pcs.metadata.name),
+                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+            },
+            cached=True,
+        )
     for pclq in standalone:
         cond = get_condition(pclq.status.conditions, COND_MIN_AVAILABLE_BREACHED)
         if cond is not None and cond.is_true():
             breach_times.append(cond.last_transition_time)
-    pcsgs = ctx.store.scan(
-        "PodCliqueScalingGroup",
-        ns,
-        {
-            **namegen.default_labels(pcs.metadata.name),
-            namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
-        },
-        cached=True,
-    )
     for pcsg in pcsgs:
         cond = get_condition(pcsg.status.conditions, COND_MIN_AVAILABLE_BREACHED)
         if cond is not None and cond.is_true():

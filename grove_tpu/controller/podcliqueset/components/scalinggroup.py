@@ -30,26 +30,36 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> None:
         g.metadata.name
         for g in ctx.store.scan("PodCliqueScalingGroup", ns, selector)
     }
-    expected: Dict[str, PodCliqueScalingGroup] = {}
-    for replica in range(pcs.spec.replicas):
-        for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
-            fqn = namegen.pcsg_name(pcs.metadata.name, replica, cfg.name)
-            labels = dict(namegen.default_labels(pcs.metadata.name))
-            labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PCSG
-            labels[namegen.LABEL_PCS_REPLICA_INDEX] = str(replica)
-            labels[namegen.LABEL_PCSG] = fqn
-            expected[fqn] = PodCliqueScalingGroup(
-                metadata=ObjectMeta(name=fqn, namespace=ns, labels=labels),
-                spec=PodCliqueScalingGroupSpec(
-                    replicas=cfg.replicas or 1,
-                    min_available=cfg.min_available or 1,
-                    clique_names=list(cfg.clique_names),
-                ),
-            )
+
+    def build() -> Dict[str, PodCliqueScalingGroup]:
+        out: Dict[str, PodCliqueScalingGroup] = {}
+        for replica in range(pcs.spec.replicas):
+            for cfg in pcs.spec.template.pod_clique_scaling_group_configs:
+                fqn = namegen.pcsg_name(pcs.metadata.name, replica, cfg.name)
+                labels = dict(namegen.default_labels(pcs.metadata.name))
+                labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PCSG
+                labels[namegen.LABEL_PCS_REPLICA_INDEX] = str(replica)
+                labels[namegen.LABEL_PCSG] = fqn
+                out[fqn] = PodCliqueScalingGroup(
+                    metadata=ObjectMeta(name=fqn, namespace=ns, labels=labels),
+                    spec=PodCliqueScalingGroupSpec(
+                        replicas=cfg.replicas or 1,
+                        min_available=cfg.min_available or 1,
+                        clique_names=list(cfg.clique_names),
+                    ),
+                )
+        return out
+
+    # pure function of (uid, generation) — see podclique.sync
+    expected = ctx.desired_cache(
+        ("pcsg", pcs.metadata.uid, pcs.metadata.generation), build
+    )
 
     for name, pcsg in expected.items():
         if name not in existing_names:
-            ctx.store.create(pcsg)
+            # share=True: memoized desired object, reused read-only (see
+            # create_or_adopt)
+            ctx.store.create(pcsg, share=True)
             ctx.record_event(
                 "PodCliqueScalingGroup",
                 "PCSGCreateSuccessful",

@@ -61,6 +61,85 @@ CHILD_KINDS_CASCADE = [
 ]
 
 
+class ChildSnapshot:
+    """ONE informer-view fetch of a set's children per reconcile.
+
+    Under cache lag the cached view is FROZEN for the whole drain round
+    (events apply to it only at round start), so every component and the
+    status flow can be served from this single snapshot instead of
+    re-scanning per component — the "one component build" of the batched
+    drain. Built only for cache-lag stores; live-read stores keep their
+    per-component scans (committed state can move mid-reconcile there).
+    All held objects are zero-copy readonly views."""
+
+    __slots__ = ("_ctx", "_ns", "_pcs_name", "pclqs", "pcsgs", "_gangs", "_pods")
+
+    def __init__(self, ctx: OperatorContext, ns: str, pcs_name: str) -> None:
+        self._ctx = ctx
+        self._ns = ns
+        self._pcs_name = pcs_name
+        sel = namegen.default_labels(pcs_name)
+        self.pclqs = list(ctx.store.scan("PodClique", ns, sel, cached=True))
+        self.pcsgs = list(
+            ctx.store.scan("PodCliqueScalingGroup", ns, sel, cached=True)
+        )
+        self._gangs = None
+        self._pods = None
+
+    def gangs(self):
+        """The set's PodGangs (component-labeled), lazily fetched."""
+        if self._gangs is None:
+            self._gangs = list(
+                self._ctx.store.scan(
+                    "PodGang",
+                    self._ns,
+                    {
+                        **namegen.default_labels(self._pcs_name),
+                        namegen.LABEL_COMPONENT: namegen.COMPONENT_PODGANG,
+                    },
+                    cached=True,
+                )
+            )
+        return self._gangs
+
+    def pods_by_pclq(self):
+        """The set's pods grouped by their PodClique label — one scan
+        instead of one per constituent PCLQ."""
+        if self._pods is None:
+            grouped: dict = {}
+            for pod in self._ctx.store.scan(
+                "Pod",
+                self._ns,
+                namegen.default_labels(self._pcs_name),
+                cached=True,
+            ):
+                pclq = pod.metadata.labels.get(namegen.LABEL_PODCLIQUE)
+                if pclq is not None:
+                    grouped.setdefault(pclq, []).append(pod)
+            self._pods = grouped
+        return self._pods
+
+    def pclqs_for_replica(self, replica: int, component: str = None):
+        idx = str(replica)
+        return [
+            p
+            for p in self.pclqs
+            if p.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX) == idx
+            and (
+                component is None
+                or p.metadata.labels.get(namegen.LABEL_COMPONENT) == component
+            )
+        ]
+
+    def pcsgs_for_replica(self, replica: int):
+        idx = str(replica)
+        return [
+            g
+            for g in self.pcsgs
+            if g.metadata.labels.get(namegen.LABEL_PCS_REPLICA_INDEX) == idx
+        ]
+
+
 class PodCliqueSetReconciler:
     def __init__(self, ctx: OperatorContext) -> None:
         self.ctx = ctx
@@ -75,9 +154,14 @@ class PodCliqueSetReconciler:
             return do_not_requeue()
         if pcs.metadata.deletion_timestamp is not None:
             return self._reconcile_delete(pcs)
+        snap = (
+            ChildSnapshot(self.ctx, ns, name)
+            if self.ctx.store.cache_lag
+            else None
+        )
         try:
-            result = self._reconcile_spec(pcs)
-            self._reconcile_status(ns, name)
+            result = self._reconcile_spec(pcs, snap)
+            self._reconcile_status(ns, name, snap)
         except GroveError as err:
             record_last_error(self.ctx, "PodCliqueSet", ns, name, err)
             return reconcile_with_errors(f"pcs {ns}/{name}", err)
@@ -105,25 +189,27 @@ class PodCliqueSetReconciler:
 
     # -- spec flow -------------------------------------------------------
 
-    def _reconcile_spec(self, pcs: PodCliqueSet) -> ReconcileStepResult:
+    def _reconcile_spec(
+        self, pcs: PodCliqueSet, snap: ChildSnapshot = None
+    ) -> ReconcileStepResult:
         ns, name = pcs.metadata.namespace, pcs.metadata.name
         if FINALIZER not in pcs.metadata.finalizers:
-            pcs = self.ctx.store.get("PodCliqueSet", ns, name)
-            if pcs is None:  # deleted between view and mutable re-get
+            from grove_tpu.runtime.store import commit_finalizer_add
+
+            pcs = commit_finalizer_add(self.ctx.store, pcs, FINALIZER)
+            if pcs is None:  # deleted between view and write
                 return continue_reconcile()
-            pcs.metadata.finalizers.append(FINALIZER)
-            pcs = self.ctx.store.update(pcs, bump_generation=False)
 
         pcs = self._process_generation_hash(pcs)
 
         infra.sync_rbac(self.ctx, pcs)
         infra.sync_headless_services(self.ctx, pcs)
         infra.sync_hpas(self.ctx, pcs)
-        breach_wait = replica_component.sync(self.ctx, pcs)
+        breach_wait = replica_component.sync(self.ctx, pcs, snap)
         update_wait = rollingupdate.sync(self.ctx, pcs)
         podclique.sync(self.ctx, pcs)
         scalinggroup.sync(self.ctx, pcs)
-        podgang.sync(self.ctx, pcs)
+        podgang.sync(self.ctx, pcs, snap)
 
         view = self.ctx.store.get("PodCliqueSet", ns, name, readonly=True)
         if (
@@ -174,24 +260,35 @@ class PodCliqueSetReconciler:
 
     # -- status flow -----------------------------------------------------
 
-    def _reconcile_status(self, ns: str, name: str) -> None:
+    def _reconcile_status(
+        self, ns: str, name: str, snap: ChildSnapshot = None
+    ) -> None:
         # compute on the zero-copy view; write only on difference (the
         # steady state then costs no serialization at all)
         view = self.ctx.store.get("PodCliqueSet", ns, name, readonly=True)
         if view is None or view.metadata.deletion_timestamp is not None:
             return
-        gangs = self.ctx.store.scan(
-            "PodGang",
-            ns,
-            {
-                **namegen.default_labels(name),
-                namegen.LABEL_COMPONENT: namegen.COMPONENT_PODGANG,
-            },
-            cached=True,
+        gangs = (
+            snap.gangs()
+            if snap is not None
+            else self.ctx.store.scan(
+                "PodGang",
+                ns,
+                {
+                    **namegen.default_labels(name),
+                    namegen.LABEL_COMPONENT: namegen.COMPONENT_PODGANG,
+                },
+                cached=True,
+            )
         )
-        from grove_tpu.api.meta import deep_copy
+        import copy as _copy
 
-        st = deep_copy(view.status)
+        # shallow status clone: every bulky field is REBUILT fresh below
+        # (pod_gang_statuses, last_errors) or left untouched-and-shared
+        # (conditions, rolling_update_progress — written only by flows that
+        # work on their own mutable PCS copies), so a deep copy of the old
+        # status would only pickle data about to be thrown away
+        st = _copy.copy(view.status)
         st.replicas = view.spec.replicas
         st.pod_gang_statuses = [
             PodGangStatusSummary(
@@ -201,13 +298,15 @@ class PodCliqueSetReconciler:
             )
             for g in gangs
         ]
-        st.available_replicas = self._count_available_replicas(view)
-        st.updated_replicas = self._count_updated_replicas(view)
+        st.available_replicas = self._count_available_replicas(view, snap)
+        st.updated_replicas = self._count_updated_replicas(view, snap)
         st.selector = f"{namegen.LABEL_PART_OF}={name}"
         st.last_errors = []  # cleared on a clean reconcile
         write_status_if_changed(self.ctx, "PodCliqueSet", ns, name, st)
 
-    def _count_updated_replicas(self, pcs: PodCliqueSet) -> int:
+    def _count_updated_replicas(
+        self, pcs: PodCliqueSet, snap: ChildSnapshot = None
+    ) -> int:
         """Replicas whose every PCLQ carries the current template hash with
         all pods updated (podcliqueset.go:68-70 UpdatedReplicas)."""
         from grove_tpu.api.hashing import pod_template_hash_for
@@ -224,11 +323,16 @@ class PodCliqueSetReconciler:
         }
         count = 0
         for replica in range(pcs.spec.replicas):
-            sel = {
-                **namegen.default_labels(pcs.metadata.name),
-                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
-            }
-            pclqs = list(self.ctx.store.scan("PodClique", ns, sel, cached=True))
+            if snap is not None:
+                pclqs = snap.pclqs_for_replica(replica)
+            else:
+                sel = {
+                    **namegen.default_labels(pcs.metadata.name),
+                    namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+                }
+                pclqs = list(
+                    self.ctx.store.scan("PodClique", ns, sel, cached=True)
+                )
             if not pclqs:
                 continue
             updated = True
@@ -247,7 +351,9 @@ class PodCliqueSetReconciler:
                 count += 1
         return count
 
-    def _count_available_replicas(self, pcs: PodCliqueSet) -> int:
+    def _count_available_replicas(
+        self, pcs: PodCliqueSet, snap: ChildSnapshot = None
+    ) -> int:
         """A PCS replica is available when every standalone PCLQ is actually
         scheduled up to minAvailable (PodCliqueScheduled=True), every PCSG has
         scheduledReplicas >= minAvailable, and none of them currently breach
@@ -256,19 +362,27 @@ class PodCliqueSetReconciler:
         ns = pcs.metadata.namespace
         count = 0
         for replica in range(pcs.spec.replicas):
-            sel = {
-                **namegen.default_labels(pcs.metadata.name),
-                namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
-            }
-            pclqs = [
-                p
-                for p in self.ctx.store.scan("PodClique", ns, sel, cached=True)
-                if p.metadata.labels.get(namegen.LABEL_COMPONENT)
-                == namegen.COMPONENT_PCS_PODCLIQUE
-            ]
-            pcsgs = list(self.ctx.store.scan(
-                "PodCliqueScalingGroup", ns, sel, cached=True
-            ))
+            if snap is not None:
+                pclqs = snap.pclqs_for_replica(
+                    replica, namegen.COMPONENT_PCS_PODCLIQUE
+                )
+                pcsgs = snap.pcsgs_for_replica(replica)
+            else:
+                sel = {
+                    **namegen.default_labels(pcs.metadata.name),
+                    namegen.LABEL_PCS_REPLICA_INDEX: str(replica),
+                }
+                pclqs = [
+                    p
+                    for p in self.ctx.store.scan(
+                        "PodClique", ns, sel, cached=True
+                    )
+                    if p.metadata.labels.get(namegen.LABEL_COMPONENT)
+                    == namegen.COMPONENT_PCS_PODCLIQUE
+                ]
+                pcsgs = list(self.ctx.store.scan(
+                    "PodCliqueScalingGroup", ns, sel, cached=True
+                ))
             entities = pclqs + pcsgs
             if not entities:
                 continue

@@ -329,6 +329,7 @@ def register_controllers(engine: Engine, ctx: OperatorContext, config=None) -> N
             name="podclique",
             kind="PodClique",
             reconcile=pclq.reconcile,
+            batch_hook=pclq.begin_batch,
             concurrent_syncs=syncs[1],
             primary_predicate=generation_changed,
             watches=[

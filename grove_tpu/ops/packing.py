@@ -1233,12 +1233,12 @@ def gang_select_single(
         # takes the existing use_cluster branch, i.e. the same
         # cluster-wide fill, one wave later against fresher capacity.
         # Boundary: a gang that defers on the LAST wave (max_waves
-        # exhausted, or the no-progress early-exit fires) never gets the
-        # cluster attempt the eager path would have made in-wave. Accepted:
-        # max_waves was raised 16→32 alongside this knob, deferrals fire in
-        # early waves in practice, and the two-zone frag parity test pins
-        # the multi-root case — but any future max_waves cut must re-check
-        # admission parity at budget exhaustion.
+        # exhausted, or the no-progress early-exit fires) would never get
+        # the cluster attempt the eager path makes in-wave — CLOSED by the
+        # solve_waves_device epilogue, which runs exactly the deferred
+        # residue through one final pass after the wave loop (admission
+        # parity at budget exhaustion is pinned by test_solver.py::
+        # test_lazy_rescue_deferral_at_max_waves_matches_eager).
         defer = (
             has_level
             & ~level_fill_ok
@@ -1540,6 +1540,34 @@ def solve_waves_device(
         )
 
     final = jax.lax.while_loop(cond, wave_body, state0)
+    if lazy_rescue:
+        # Budget-boundary epilogue (round-4 advisor #3 / verdict weak #6):
+        # a gang that DEFERS its cluster rescue on the final wave exits the
+        # loop with the _CLUSTER_RETRY sentinel still pending and would
+        # never get the cluster attempt the eager path makes in-wave. Run
+        # ONE more pass restricted to exactly that residue: with the
+        # sentinel cap, the deferred gang's decide sees no allowed level
+        # and takes the ordinary use_cluster branch — the same cluster-wide
+        # fill the eager path would have run, so admissions match the
+        # eager path at budget exhaustion. Other pending gangs (level
+        # retries that ran out of waves) are EXCLUDED: giving them an extra
+        # level attempt would over-admit relative to eager-with-max_waves.
+        deferred = final["pending"] & (
+            final["narrow_cap"] == jnp.int32(_CLUSTER_RETRY)
+        )
+
+        def _epilogue(state):
+            epi = wave_body({**state, "pending": deferred})
+            return {
+                **epi,
+                "pending": epi["pending"] | (state["pending"] & ~deferred),
+            }
+
+        # deferral on the exact final wave is rare; skip the extra full
+        # wave pass entirely when nothing deferred
+        final = jax.lax.cond(
+            jnp.any(deferred), _epilogue, lambda state: state, final
+        )
     chosen = final["chosen"]
     return {
         "admitted": final["admitted"],

@@ -58,6 +58,11 @@ class Controller:
     concurrent_syncs: int = 1
     # keys currently being reconciled by a worker thread (drain_concurrent)
     busy: set = field(default_factory=set)
+    # batched-drain hook: called once per drain round with the controller's
+    # COALESCED ready keys before any of them reconciles — reconcilers use
+    # it to build per-batch state (one component build / informer-frozen
+    # memo) served to every key of the round instead of rebuilt per key
+    batch_hook: Optional[Callable[[List[Key]], None]] = None
 
 
 class Engine:
@@ -73,10 +78,40 @@ class Engine:
         self._event_backlog = deque()
         self.held_kinds: set = set()
         self._pool = None  # lazy engine-lifetime reconcile thread pool
+        # per-kind routing table (built lazily after registration): an event
+        # consults only the entries subscribed to its kind instead of
+        # iterating every controller × watch per event — at stress scale
+        # (hundreds of thousands of events) the miss checks dominated
+        # _route_events
+        self._dispatch = None
         store.subscribe(self._event_backlog.append)
 
     def register(self, controller: Controller) -> None:
         self.controllers.append(controller)
+        self._dispatch = None  # rebuilt on next routing
+
+    def _build_dispatch(self):
+        """kind -> [(ctrl, map_fn, predicate, metric_name)] in registration
+        order (primary entry first per controller, map_fn=None), matching
+        the original iteration order exactly."""
+        dispatch: dict = {}
+        for ctrl in self.controllers:
+            dispatch.setdefault(ctrl.kind, []).append(
+                (ctrl, None, None, f"events_enqueued/{ctrl.name}/self")
+            )
+            for watch in ctrl.watches:
+                watched_kind, map_fn = watch[0], watch[1]
+                pred = watch[2] if len(watch) > 2 else None
+                dispatch.setdefault(watched_kind, []).append(
+                    (
+                        ctrl,
+                        map_fn,
+                        pred,
+                        f"events_enqueued/{ctrl.name}/{watched_kind}",
+                    )
+                )
+        self._dispatch = dispatch
+        return dispatch
 
     # -- event delivery --------------------------------------------------
 
@@ -127,28 +162,29 @@ class Engine:
             # (incremental informer application); held kinds stay stale
             if self.store.cache_lag:
                 self.store.apply_event_to_cache(ev)
-            for ctrl in self.controllers:
-                if ev.kind == ctrl.kind and (
-                    ctrl.primary_predicate is None or ctrl.primary_predicate(ev)
-                ):
-                    METRICS.inc(f"events_enqueued/{ctrl.name}/self")
-                    ctrl.queue.add(
-                        (ctrl.kind, ev.obj.metadata.namespace, ev.obj.metadata.name)
-                    )
-                for watch in ctrl.watches:
-                    watched_kind, map_fn = watch[0], watch[1]
-                    if ev.kind != watched_kind:
-                        continue
-                    if len(watch) > 2 and watch[2] is not None and not watch[2](ev):
-                        continue
-                    hits = map_fn(ev)
-                    if hits:
-                        METRICS.inc(
-                            f"events_enqueued/{ctrl.name}/{watched_kind}",
-                            len(hits),
+            dispatch = self._dispatch
+            if dispatch is None:
+                dispatch = self._build_dispatch()
+            for ctrl, map_fn, pred, metric in dispatch.get(ev.kind, ()):
+                if map_fn is None:
+                    # primary-kind entry (For(...) + primary predicate)
+                    if ctrl.primary_predicate is None or ctrl.primary_predicate(ev):
+                        METRICS.inc(metric)
+                        ctrl.queue.add(
+                            (
+                                ctrl.kind,
+                                ev.obj.metadata.namespace,
+                                ev.obj.metadata.name,
+                            )
                         )
-                    for ns, name in hits:
-                        ctrl.queue.add((ctrl.kind, ns, name))
+                    continue
+                if pred is not None and not pred(ev):
+                    continue
+                hits = map_fn(ev)
+                if hits:
+                    METRICS.inc(metric, len(hits))
+                for ns, name in hits:
+                    ctrl.queue.add((ctrl.kind, ns, name))
         self._event_backlog.extend(remaining)
 
     # -- run loop --------------------------------------------------------
@@ -180,25 +216,50 @@ class Engine:
             self._route_events()
             progressed = False
             for ctrl in self.controllers:
-                # Drain the controller's whole ready set this round: events
-                # emitted by these reconciles are routed only at the next
-                # round's start, so sibling updates COALESCE into one owner
-                # requeue (dedup) instead of one owner reconcile per child
-                # event. Terminates: reconciles can only add to the backlog
-                # (routed next round) or the delayed heap (>= backoff).
+                # BATCHED drain: pop the controller's whole ready set up
+                # front (events emitted by these reconciles are routed only
+                # at the next round's start, and every delayed re-add lands
+                # strictly after `now`, so the upfront pop sees exactly the
+                # keys the old pop-one-at-a-time loop would have) — sibling
+                # updates COALESCE into one owner requeue (dedup) instead
+                # of one owner reconcile per child event, and the batch
+                # hook lets a reconciler serve every key of the round from
+                # one component build. Terminates: reconciles can only add
+                # to the backlog (routed next round) or the delayed heap
+                # (>= backoff).
+                batch: List[Key] = []
                 while True:
                     key = ctrl.queue.pop(now)
                     if key is None:
                         break
-                    progressed = True
-                    executed += 1
-                    METRICS.inc(f"reconcile_total/{ctrl.name}")
-                    result = error = None
-                    try:
-                        result = self._timed(ctrl, key)
-                    except Exception as e:
-                        error = e
-                    self._complete(ctrl, key, result, error, now)
+                    batch.append(key)
+                if not batch:
+                    continue
+                progressed = True
+                executed += len(batch)
+                METRICS.inc(f"reconcile_total/{ctrl.name}", len(batch))
+                span = (
+                    TRACER.span(
+                        "reconcile.batch",
+                        controller=ctrl.name,
+                        keys=len(batch),
+                    )
+                    if TRACER.enabled
+                    else None
+                )
+                if ctrl.batch_hook is not None:
+                    ctrl.batch_hook(batch)
+                try:
+                    for key in batch:
+                        result = error = None
+                        try:
+                            result = self._timed(ctrl, key)
+                        except Exception as e:
+                            error = e
+                        self._complete(ctrl, key, result, error, now)
+                finally:
+                    if span is not None:
+                        span.end()
             for ctrl in self.controllers:
                 METRICS.set(f"workqueue_depth/{ctrl.name}", len(ctrl.queue))
             if not progressed:

@@ -18,12 +18,15 @@ controllers rely on:
 
 from __future__ import annotations
 
+import copy as _copy
+import os
 import pickle
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional
 
 from grove_tpu.api.meta import deep_copy, next_uid
+from grove_tpu.runtime.aggregate import PodAggregate
 from grove_tpu.runtime.clock import Clock
 from grove_tpu.runtime.errors import (
     ERR_CONFLICT,
@@ -35,6 +38,8 @@ from grove_tpu.runtime.errors import (
 ADDED = "Added"
 MODIFIED = "Modified"
 DELETED = "Deleted"
+
+_UNSET = object()  # commit_cow sentinel: "field not replaced"
 
 # Label keys with inverted indices (the controllers' hot selectors). A
 # selector containing any of these resolves to the candidate set instead of
@@ -83,6 +88,53 @@ class WatchEvent:
 
 def obj_key(obj) -> str:
     return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def commit_status(store, view, status):
+    """Status write against a readonly `view` via the store's copy-on-write
+    path when available (in-memory Store), else the portable mutable
+    re-get + update_status cycle (HttpStore). Returns the updated object,
+    or None if it disappeared."""
+    cow = getattr(store, "commit_cow", None)
+    if cow is not None:
+        return cow(view, status=status)
+    fresh = store.get(view.kind, view.metadata.namespace, view.metadata.name)
+    if fresh is None:
+        return None
+    fresh.status = status
+    return store.update_status(fresh)
+
+
+def commit_finalizer_add(store, view, finalizer: str):
+    """Finalizer add (metadata write, no generation bump) against a
+    readonly `view` via the copy-on-write path when available. Returns the
+    committed object, or None if it disappeared (HttpStore fallback)."""
+    cow = getattr(store, "commit_cow", None)
+    if cow is not None:
+        meta = _copy.copy(view.metadata)
+        meta.finalizers = list(view.metadata.finalizers)
+        meta.finalizers.append(finalizer)
+        return cow(view, metadata=meta)
+    fresh = store.get(view.kind, view.metadata.namespace, view.metadata.name)
+    if fresh is None:
+        return None
+    if finalizer not in fresh.metadata.finalizers:
+        fresh.metadata.finalizers.append(finalizer)
+        return store.update(fresh, bump_generation=False)
+    return fresh
+
+
+def commit_spec(store, view, spec):
+    """Spec write (no generation bump) against a readonly `view` via the
+    copy-on-write path when available, else mutable re-get + update."""
+    cow = getattr(store, "commit_cow", None)
+    if cow is not None:
+        return cow(view, spec=spec)
+    fresh = store.get(view.kind, view.metadata.namespace, view.metadata.name)
+    if fresh is None:
+        return None
+    fresh.spec = spec
+    return store.update(fresh, bump_generation=False)
 
 
 def _index_insert(index: Dict[tuple, set], obj) -> None:
@@ -146,6 +198,20 @@ class Store:
         self._cache_index: Dict[str, Dict[tuple, set]] = {}
         self._rv = 0
         self._watchers: List[Callable[[WatchEvent], None]] = []
+        self._system_watchers: List[Callable[[WatchEvent], None]] = []
+        # event-driven status aggregation (runtime/aggregate.py): one
+        # counter mirror per READ VIEW — committed (updated at commit time)
+        # and, under cache lag, the informer cache (updated exactly when
+        # events are applied to it), so pod_counters() always equals a full
+        # rescan of the view the caller would have scanned
+        self._agg_committed = PodAggregate()
+        self._agg_cached = PodAggregate() if cache_lag else self._agg_committed
+        # copy-on-write commits skip the canonical pickle blob; under the
+        # test-mode store guard they compute it eagerly anyway so
+        # verify_readonly_integrity keeps its byte-compare coverage
+        self._guard_blobs = os.environ.get(
+            "GROVE_TPU_STORE_GUARD", ""
+        ).lower() not in ("", "0", "false")
         # optional admission guard (grove_tpu.admission.authorization):
         # writes are checked against the current actor; in-process
         # controllers act as the operator identity
@@ -188,6 +254,13 @@ class Store:
     def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
         self._watchers.append(fn)
 
+    def subscribe_system(self, fn: Callable[[WatchEvent], None]) -> None:
+        """Subscribe a watcher OUTSIDE the operator process (sim kubelet /
+        scheduler): operator-restart tests clear `_watchers` to model the
+        crashed process's watches vanishing, but cluster-side components
+        are separate processes whose watches survive an operator crash."""
+        self._system_watchers.append(fn)
+
     def _emit(
         self, type_: str, obj, blob: Optional[bytes], old: object = None
     ) -> None:
@@ -195,6 +268,11 @@ class Store:
         # every subscriber may share the payload; WatchEvent.materialize()
         # (pre-pickled) is the escape hatch for watchers that must mutate
         ev = WatchEvent(type=type_, kind=obj.kind, obj=obj, blob=blob, old=old)
+        # the committed view just mutated: fold the delta into its aggregate
+        # (kind-gated inside; `old` is the previous committed object)
+        self._agg_committed.apply(type_, obj, old)
+        for w in self._system_watchers:
+            w(ev)
         for w in self._watchers:
             w(ev)
 
@@ -216,6 +294,9 @@ class Store:
         for obj in self._cache[kind].values():
             _index_insert(index, obj)
         self._cache_index[kind] = index
+        if kind == "Pod" and self.cache_lag:
+            # full resync: the cached aggregate re-derives from the new view
+            self._agg_cached.rebuild(self._cache[kind].values())
 
     def apply_event_to_cache(self, ev: "WatchEvent") -> None:
         """Incrementally apply one delivered watch event to the read cache —
@@ -227,6 +308,12 @@ class Store:
         kind_index = self._cache_index.setdefault(ev.kind, {})
         key = obj_key(ev.obj)
         old = kind_cache.get(key)
+        if ev.kind == "Pod" and self.cache_lag:
+            # the cached view advances exactly here — fold the same delta
+            # into its aggregate (old = the view's previous object). Gated
+            # on cache_lag: without lag _agg_cached aliases _agg_committed,
+            # which already folded this delta at commit time.
+            self._agg_cached.apply(ev.type, ev.obj, old)
         if old is not None:
             _index_delete(kind_index, old)
         if ev.type == DELETED:
@@ -278,10 +365,14 @@ class Store:
 
     # -- CRUD -----------------------------------------------------------
 
-    def _commit(self, stored, blob: Optional[bytes] = None) -> Optional[bytes]:
+    def _commit(
+        self, stored, blob: Optional[bytes] = None, serialize: bool = True
+    ) -> Optional[bytes]:
         """Commit `stored` as the new immutable committed state + canonical
-        blob. `stored` must never be mutated after this call."""
-        if blob is None:
+        blob. `stored` must never be mutated after this call. With
+        serialize=False (copy-on-write commits) no blob is computed: later
+        mutable reads fall back to deep_copy."""
+        if blob is None and serialize:
             blob = _dumps(stored)
         self._committed.setdefault(stored.kind, {})[obj_key(stored)] = stored
         if blob is not None:
@@ -340,7 +431,7 @@ class Store:
     def _blob_view(self, use_cache: bool, kind: str) -> Dict[str, bytes]:
         return (self._cache_blob if use_cache else self._blob).get(kind, {})
 
-    def create(self, obj) -> object:
+    def create(self, obj, consume: bool = False, share: bool = False) -> object:
         self._authorize("create", obj)
         self._inject("create", obj)
         kind_objs = self._committed.setdefault(obj.kind, {})
@@ -349,6 +440,39 @@ class Store:
             raise GroveError(
                 ERR_CONFLICT, f"{obj.kind} {key} already exists", "create"
             )
+        if consume:
+            # ownership-transfer create (fire-and-forget objects like
+            # Events): the caller hands the object over and MUST NOT touch
+            # it again, so it becomes the committed state directly — no
+            # private pickled copy at all
+            meta = obj.metadata
+            self._rv += 1
+            meta.uid = meta.uid or next_uid()
+            meta.resource_version = self._rv
+            meta.generation = 1
+            meta.creation_timestamp = self.clock.now()
+            blob = _dumps(obj) if self._guard_blobs else None
+            self._commit(obj, blob, serialize=False)
+            self._emit(ADDED, obj, blob)
+            return obj
+        if share:
+            # structural-sharing create for memoized DESIRED objects
+            # (ctx.desired_cache): the committed object is a spine copy
+            # sharing spec/status with the caller's template — which is
+            # reused read-only across reconciles, so sharing is safe under
+            # the committed-object immutability contract. Metadata gets a
+            # private copy so identity never leaks back into the memo.
+            stored = _copy.copy(obj)
+            meta = stored.metadata = _copy.copy(obj.metadata)
+            self._rv += 1
+            meta.uid = next_uid()
+            meta.resource_version = self._rv
+            meta.generation = 1
+            meta.creation_timestamp = self.clock.now()
+            blob = _dumps(stored) if self._guard_blobs else None
+            self._commit(stored, blob, serialize=False)
+            self._emit(ADDED, stored, blob)
+            return stored
         # Serialize ONCE with the final identity already stamped: the same
         # bytes are the private committed copy (loads) and the canonical
         # blob (a deep_copy + commit-time dumps would pickle twice; create
@@ -542,6 +666,88 @@ class Store:
     def update_status(self, obj) -> object:
         """Status write: no generation bump (status subresource semantics)."""
         return self.update(obj, bump_generation=False)
+
+    def pod_counters(self, namespace: str, name: str, cached: bool = False):
+        """Aggregated pod-status counters for one PodClique — the
+        event-driven replacement for scanning+categorizing its pods on
+        every reconcile. Always equals a full rescan of the view the caller
+        would have scanned (committed, or the lagged cache when
+        cached=True). Returned row is READ-ONLY."""
+        agg = self._agg_cached if (cached and self.cache_lag) else self._agg_committed
+        return agg.counters(namespace, name)
+
+    def commit_cow(
+        self,
+        view,
+        *,
+        status=_UNSET,
+        spec=_UNSET,
+        metadata=_UNSET,
+        bump_generation: bool = False,
+    ) -> object:
+        """Copy-on-write commit — the write half of the zero-copy read path.
+
+        `view` must be the caller's readonly committed view of the object
+        (get(readonly=True)/scan()). The caller supplies PRIVATE replacement
+        subtree(s) — typically a status built on a status_shadow, or a
+        shallow-cloned spec. The new committed object structurally SHARES
+        every untouched field with the previous committed object (both are
+        immutable), so no pickling happens at all: this removes the
+        _materialize loads + canonical dumps that dominated per-reconcile
+        control-plane cost. The returned object is the new committed
+        readonly view (same contract as scan()): do not mutate it.
+
+        Semantics match update(): optimistic concurrency (a view whose
+        resourceVersion is behind committed raises ERR_CONFLICT), no-op
+        suppression (replaced fields equal to committed → no bump, no
+        event), authorization + fault injection, MODIFIED event with `old`.
+        """
+        kind_objs = self._committed.get(view.kind, {})
+        key = obj_key(view)
+        current = kind_objs.get(key)
+        if current is None:
+            raise GroveError(
+                ERR_NOT_FOUND, f"{view.kind} {key} not found", "update"
+            )
+        if (
+            current is not view
+            and view.metadata.resource_version
+            and view.metadata.resource_version != current.metadata.resource_version
+        ):
+            raise GroveError(
+                ERR_CONFLICT,
+                f"{view.kind} {key}: resourceVersion "
+                f"{view.metadata.resource_version} != "
+                f"{current.metadata.resource_version}",
+                "update",
+            )
+        self._authorize("update", current)
+        stored = _copy.copy(current)
+        changed = False
+        if status is not _UNSET:
+            stored.status = status
+            changed = changed or status != current.status
+        if spec is not _UNSET:
+            stored.spec = spec
+            changed = changed or spec != current.spec
+        if metadata is not _UNSET:
+            # caller-supplied private metadata clone (e.g. a finalizer add);
+            # version/generation bookkeeping is restamped below
+            stored.metadata = metadata
+            changed = changed or metadata != current.metadata
+        self._inject("update", stored)  # injectors see the state being written
+        if not changed:
+            return current
+        meta = stored.metadata = _copy.copy(stored.metadata)
+        self._rv += 1
+        meta.resource_version = self._rv
+        if bump_generation:
+            meta.generation = current.metadata.generation + 1
+        blob = _dumps(stored) if self._guard_blobs else None
+        self._index_remove(current)
+        self._commit(stored, blob, serialize=False)
+        self._emit(MODIFIED, stored, blob, old=current)
+        return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         kind_objs = self._committed.get(kind, {})

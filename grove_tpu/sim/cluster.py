@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from grove_tpu.api.meta import Condition, set_condition
+from grove_tpu.api.meta import Condition, clone_status, deep_copy, set_condition
 from grove_tpu.api.pod import (
     COND_POD_READY,
     COND_POD_SCHEDULED,
@@ -25,7 +25,7 @@ from grove_tpu.api.pod import (
     is_terminating,
 )
 from grove_tpu.initc.waiter import is_ready_to_start
-from grove_tpu.runtime.store import Store
+from grove_tpu.runtime.store import Store, commit_status
 
 
 @dataclass
@@ -47,6 +47,61 @@ class SimCluster:
     last_node: Dict[tuple, str] = field(default_factory=dict)
     start_delay: float = 0.0  # container start latency (virtual seconds)
 
+    def __post_init__(self) -> None:
+        # kubelet working set: (ns, name) of pods that exist and are not
+        # Ready — maintained from watch events so kubelet_tick iterates
+        # O(not-ready) instead of rescanning the whole pod population each
+        # tick. None until first use (a SimCluster may be attached to a
+        # store that already holds pods — failover tests); the first tick
+        # builds it with one full scan.
+        self._not_ready = None
+        self._deleted_since_gc = True  # force the first gc pass
+        # per-pod-uid resource-request memo: requests are immutable for a
+        # pod's lifetime (gate removal clones the spec but never touches
+        # requests), and node accounting re-derives them per tick
+        self._requests_by_uid: Dict[str, Dict[str, float]] = {}
+        # in-memory Store only: its events fire synchronously at commit, so
+        # the set is always exact. HttpStore events arrive on watch threads
+        # and LAG live reads — there kubelet_tick keeps the full scan.
+        if isinstance(self.store, Store):
+            self.store.subscribe_system(self._track_pod_event)
+
+    def _track_pod_event(self, ev) -> None:
+        if ev.kind != "Pod":
+            return
+        if ev.type == "Deleted":
+            # stale bindings can only appear through deletions (recreated
+            # pods reuse names); _gc_bindings skips until one happens
+            self._deleted_since_gc = True
+            # recreated pods get fresh uids — drop the dead memo entry so
+            # churn (evictions, rolling updates) doesn't grow it unbounded
+            self._requests_by_uid.pop(ev.obj.metadata.uid, None)
+        if self._not_ready is None:
+            return
+        key = (ev.obj.metadata.namespace, ev.obj.metadata.name)
+        if ev.type == "Deleted" or is_ready(ev.obj):
+            self._not_ready.discard(key)
+        else:
+            self._not_ready.add(key)
+
+    def _not_ready_pods(self, namespace: Optional[str]):
+        """Readonly views of the not-Ready working set (lazy first build)."""
+        if not isinstance(self.store, Store):
+            yield from self.store.scan("Pod", namespace)
+            return
+        if self._not_ready is None:
+            self._not_ready = {
+                (p.metadata.namespace, p.metadata.name)
+                for p in self.store.scan("Pod")
+                if not is_ready(p)
+            }
+        for ns, name in list(self._not_ready):
+            if namespace is not None and ns != namespace:
+                continue
+            pod = self.store.get("Pod", ns, name, readonly=True)
+            if pod is not None:
+                yield pod
+
     def rebuild_bindings(self) -> int:
         """Reconstruct the in-memory binding map from persisted pod status
         (`status.node_name`) — the restart/failover path: a fresh scheduler
@@ -67,7 +122,13 @@ class SimCluster:
 
     def _gc_bindings(self) -> None:
         """Drop bindings whose pod is gone or no longer carries the binding
-        (deleted-and-recreated pods reuse stable names)."""
+        (deleted-and-recreated pods reuse stable names). Skipped entirely
+        while no pod deletion happened since the last pass — bindings only
+        go stale through deletions, and this runs O(bindings) per
+        scheduling round otherwise."""
+        if isinstance(self.store, Store) and not self._deleted_since_gc:
+            return
+        self._deleted_since_gc = False
         stale = []
         for (ns, name), _node in self.bindings.items():
             pod = self.store.get("Pod", ns, name, readonly=True)
@@ -78,6 +139,49 @@ class SimCluster:
 
     # -- capacity --------------------------------------------------------
 
+    def _pod_requests(self, pod) -> Dict[str, float]:
+        uid = pod.metadata.uid
+        reqs = self._requests_by_uid.get(uid)
+        if reqs is None:
+            reqs = self._requests_by_uid[uid] = pod.spec.total_requests()
+        return reqs
+
+    def _used_by_node(self) -> Dict[str, Dict[str, float]]:
+        """Committed resource usage per node in ONE pass over bindings —
+        node_free per node is O(bindings), so mapping every node that way
+        was O(nodes × bindings) per scheduling round (the quadratic term at
+        5k nodes / 47k bound pods)."""
+        used: Dict[str, Dict[str, float]] = {}
+        live_uids = set()
+        for (ns, pod_name), node_name in self.bindings.items():
+            pod = self.store.get("Pod", ns, pod_name, readonly=True)
+            if pod is None or is_terminating(pod):
+                continue
+            live_uids.add(pod.metadata.uid)
+            u = used.setdefault(node_name, {})
+            for k, v in self._pod_requests(pod).items():
+                u[k] = u.get(k, 0.0) + v
+        if not isinstance(self.store, Store) and len(self._requests_by_uid) > (
+            64 + 2 * len(live_uids)
+        ):
+            # HttpStore has no Deleted-event subscription to evict dead
+            # uids; prune to the live set whenever the memo doubles it
+            self._requests_by_uid = {
+                u: r for u, r in self._requests_by_uid.items() if u in live_uids
+            }
+        return used
+
+    def node_free_all(self, nodes: List[Node]) -> Dict[str, Dict[str, float]]:
+        """Free capacity for every given node from one usage pass."""
+        used = self._used_by_node()
+        out: Dict[str, Dict[str, float]] = {}
+        for node in nodes:
+            free = dict(node.capacity)
+            for k, v in used.get(node.name, {}).items():
+                free[k] = free.get(k, 0.0) - v
+            out[node.name] = free
+        return out
+
     def node_free(self, node: Node) -> Dict[str, float]:
         free = dict(node.capacity)
         for (ns, pod_name), node_name in self.bindings.items():
@@ -86,7 +190,7 @@ class SimCluster:
             pod = self.store.get("Pod", ns, pod_name, readonly=True)
             if pod is None or is_terminating(pod):
                 continue
-            for k, v in pod.spec.total_requests().items():
+            for k, v in self._pod_requests(pod).items():
                 free[k] = free.get(k, 0.0) - v
         return free
 
@@ -118,19 +222,25 @@ class SimCluster:
         return bound
 
     def bind(self, pod: Pod, node_name: str) -> None:
-        fresh = self.store.get("Pod", pod.metadata.namespace, pod.metadata.name)
-        if fresh is None:
+        # readonly view + copy-on-write status commit: only the (small) pod
+        # STATUS is copied; metadata/spec are shared with the committed
+        # object — no whole-pod pickling on the per-pod bind path
+        view = self.store.get(
+            "Pod", pod.metadata.namespace, pod.metadata.name, readonly=True
+        )
+        if view is None:
             return
-        key = (fresh.metadata.namespace, fresh.metadata.name)
+        key = (view.metadata.namespace, view.metadata.name)
         self.bindings[key] = node_name
         self.last_node[key] = node_name
-        fresh.status.node_name = node_name
+        st = clone_status(view.status)
+        st.node_name = node_name
         set_condition(
-            fresh.status.conditions,
+            st.conditions,
             Condition(type=COND_POD_SCHEDULED, status="True", reason="Bound"),
             self.store.clock.now(),
         )
-        self.store.update_status(fresh)
+        commit_status(self.store, view, st)
 
     # -- kubelet ---------------------------------------------------------
 
@@ -144,10 +254,12 @@ class SimCluster:
         # (real kubelets are independent processes; the init waiter observes
         # parent readiness with at least one tick of delay).
         to_start = []
-        # readonly scan: readiness and the init-waiter check run against the
-        # zero-copy view; only pods that actually TRANSITION get a private
-        # mutable copy (waiter-blocked pods in a startup cascade stay free)
-        for view in self.store.scan("Pod", namespace):
+        # readonly iteration over the event-maintained not-Ready working
+        # set: readiness and the init-waiter check run against the
+        # zero-copy view; only pods that actually TRANSITION build a
+        # private status for the copy-on-write commit (waiter-blocked pods
+        # in a startup cascade stay free)
+        for view in self._not_ready_pods(namespace):
             if not is_scheduled(view) or is_ready(view) or is_terminating(view):
                 continue
             waiter_cfg = view.spec.extra.get("groveInitWaiter")
@@ -156,27 +268,23 @@ class SimCluster:
                 self.store, view.metadata.namespace, waiter_cfg
             ):
                 continue
-            pod = self.store.get(
-                "Pod", view.metadata.namespace, view.metadata.name
-            )
-            if pod is None:
-                continue
+            to_start.append((view, waiter_clears))
+        for view, waiter_clears in to_start:
+            st = clone_status(view.status)
             if waiter_clears:
-                pod.status.init_waiter_done = True
-            to_start.append(pod)
-        for pod in to_start:
-            pod.status.phase = POD_RUNNING
-            pod.status.container_statuses = [
+                st.init_waiter_done = True
+            st.phase = POD_RUNNING
+            st.container_statuses = [
                 ContainerStatus(name=c.name, ready=True, started=True)
-                for c in pod.spec.containers
+                for c in view.spec.containers
             ]
             set_condition(
-                pod.status.conditions,
+                st.conditions,
                 Condition(type=COND_POD_READY, status="True", reason="Started"),
                 self.store.clock.now(),
             )
-            self.store.update_status(pod)
-            progressed += 1
+            if commit_status(self.store, view, st) is not None:
+                progressed += 1
         return progressed
 
     def fail_node(self, node_name: str) -> int:
@@ -204,25 +312,26 @@ class SimCluster:
 
     def fail_pod(self, namespace: str, name: str, exit_code: int = 1) -> None:
         """Crash a pod's containers (fault injection for breach tests)."""
-        pod = self.store.get("Pod", namespace, name)
-        if pod is None:
+        view = self.store.get("Pod", namespace, name, readonly=True)
+        if view is None:
             return
-        pod.status.phase = POD_PENDING
-        for cs in pod.status.container_statuses:
+        st = deep_copy(view.status)
+        st.phase = POD_PENDING
+        for cs in st.container_statuses:
             cs.ready = False
             cs.exit_code = exit_code
             cs.restart_count += 1
-        if not pod.status.container_statuses:
-            pod.status.container_statuses = [
+        if not st.container_statuses:
+            st.container_statuses = [
                 ContainerStatus(name=c.name, started=True, exit_code=exit_code)
-                for c in pod.spec.containers
+                for c in view.spec.containers
             ]
         set_condition(
-            pod.status.conditions,
+            st.conditions,
             Condition(type=COND_POD_READY, status="False", reason="CrashLoop"),
             self.store.clock.now(),
         )
-        self.store.update_status(pod)
+        commit_status(self.store, view, st)
 
 
 def make_nodes(

@@ -13,7 +13,12 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 from grove_tpu.api import names as namegen
-from grove_tpu.api.meta import Condition, get_condition, set_condition
+from grove_tpu.api.meta import (
+    Condition,
+    clone_status,
+    get_condition,
+    set_condition,
+)
 from grove_tpu.api.pod import is_scheduled, is_terminating
 from grove_tpu.api.topology import ClusterTopology
 from grove_tpu.api.types import (
@@ -304,12 +309,10 @@ class GangScheduler:
 
         bound = 0
         if gang_specs:
-            free = {
-                node.name: self.cluster.node_free(node)
-                for node in self.cluster.nodes
-                if not node.cordoned
-            }
             nodes = [n for n in self.cluster.nodes if not n.cordoned]
+            # one usage pass over bindings (node_free per node would be
+            # O(nodes × bindings) per round at stress scale)
+            free = self.cluster.node_free_all(nodes)
             if nodes:
                 # wave solver with allocations: cheap-to-compile vmapped
                 # decisions (the exact scan kernel stays on the parity/bench
@@ -476,12 +479,30 @@ class GangScheduler:
             METRICS.inc("gang_status_conflicts_total")
             return False
 
+    def _commit_status_tolerant(self, view, status) -> bool:
+        """Copy-on-write variant of the tolerant status upsert: commits a
+        private `status` against a readonly `view` (runtime/store.py
+        commit_status), treating optimistic-concurrency conflicts the same
+        way — the next round re-derives."""
+        from grove_tpu.runtime.store import commit_status
+
+        try:
+            return commit_status(self.store, view, status) is not None
+        except GroveError as e:
+            if e.code != ERR_CONFLICT:
+                raise
+            METRICS.inc("gang_status_conflicts_total")
+            return False
+
     def _pending_pods(self, namespace: Optional[str]) -> List:
-        # read-only scan: pods flow into the encoder; binding always
-        # re-reads fresh copies (SimCluster.bind / store.get)
+        # read-only iteration over the cluster's not-Ready working set (a
+        # pending pod is never Ready, so the subset relation is exact; the
+        # set degrades to a full scan for stores without synchronous
+        # events). Pods flow into the encoder; binding always re-reads
+        # fresh views (SimCluster.bind).
         return [
             p
-            for p in self.store.scan("Pod", namespace)
+            for p in self.cluster._not_ready_pods(namespace)
             if not p.spec.scheduling_gates
             and not is_scheduled(p)
             and not is_terminating(p)
@@ -689,32 +710,31 @@ class GangScheduler:
         # (unlike the periodic health/phase upserts, which re-derive next
         # round anyway)
         for _ in range(4):
-            gang = self.store.get("PodGang", namespace, gang_name)
+            gang = self.store.get("PodGang", namespace, gang_name, readonly=True)
             if gang is None:
                 return
-            if gang.status.phase == PHASE_PENDING:
-                gang.status.phase = PHASE_STARTING
+            st = clone_status(gang.status)
+            if st.phase == PHASE_PENDING:
+                st.phase = PHASE_STARTING
             if score is not None:
-                gang.status.placement_score = score
+                st.placement_score = score
             set_condition(
-                gang.status.conditions,
+                st.conditions,
                 Condition(
                     type=COND_PODGANG_SCHEDULED,
                     status="True",
                     reason="AllPodGroupsPlaced",
-                    message=f"placement score {gang.status.placement_score}",
+                    message=f"placement score {st.placement_score}",
                 ),
                 self.store.clock.now(),
             )
             # a successfully (re)scheduled gang is no longer a disruption
             # target
             if (
-                dt := get_condition(
-                    gang.status.conditions, COND_PODGANG_DISRUPTION_TARGET
-                )
+                dt := get_condition(st.conditions, COND_PODGANG_DISRUPTION_TARGET)
             ) is not None and dt.is_true():
                 set_condition(
-                    gang.status.conditions,
+                    st.conditions,
                     Condition(
                         type=COND_PODGANG_DISRUPTION_TARGET,
                         status="False",
@@ -722,7 +742,7 @@ class GangScheduler:
                     ),
                     self.store.clock.now(),
                 )
-            if self._update_status_tolerant(gang):
+            if self._commit_status_tolerant(gang, st):
                 return
 
     # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----
@@ -998,7 +1018,11 @@ class GangScheduler:
         (scheduler podgang.go:157-161)."""
         from grove_tpu.api.types import COND_MIN_AVAILABLE_BREACHED
 
-        for gang in self.store.list("PodGang", namespace):
+        # readonly scan + change detection: gangs whose Unhealthy condition
+        # already reads correctly are not materialized and not written —
+        # previously this loop pickled and structurally re-compared EVERY
+        # gang EVERY round (the dominant steady-state cost at 10k gangs)
+        for gang in self.store.scan("PodGang", namespace):
             breached = False
             for group in gang.spec.pod_groups:
                 pclq = self.store.get(
@@ -1012,20 +1036,32 @@ class GangScheduler:
                 if cond is not None and cond.is_true():
                     breached = True
                     break
+            want_status = "True" if breached else "False"
+            want_reason = (
+                "ConstituentBreachedMinAvailable"
+                if breached
+                else "AllConstituentsHealthy"
+            )
+            existing = get_condition(
+                gang.status.conditions, COND_PODGANG_UNHEALTHY
+            )
+            if (
+                existing is not None
+                and existing.status == want_status
+                and existing.reason == want_reason
+            ):
+                continue  # exactly the store's no-op suppression, earlier
+            st = clone_status(gang.status)
             set_condition(
-                gang.status.conditions,
+                st.conditions,
                 Condition(
                     type=COND_PODGANG_UNHEALTHY,
-                    status="True" if breached else "False",
-                    reason=(
-                        "ConstituentBreachedMinAvailable"
-                        if breached
-                        else "AllConstituentsHealthy"
-                    ),
+                    status=want_status,
+                    reason=want_reason,
                 ),
                 self.store.clock.now(),
             )
-            self._update_status_tolerant(gang)
+            self._commit_status_tolerant(gang, st)
 
     def update_gang_phases(self, namespace: str = "default") -> None:
         """Advance Starting → Running (+ Ready condition) once every pod of
@@ -1036,17 +1072,33 @@ class GangScheduler:
         than stranding it (no other path revisits a fully-bound gang)."""
         from grove_tpu.api.pod import is_ready
 
-        for gang in self.store.list("PodGang", namespace):
+        # readonly scan: Running gangs (the steady-state majority) are
+        # skipped without materializing a copy; only an actual phase
+        # transition builds a private status for the copy-on-write commit
+        for gang in self.store.scan("PodGang", namespace):
             if gang.status.phase == PHASE_PENDING and gang.spec.pod_groups:
-                pods = [
-                    self.store.get("Pod", ref.namespace, ref.name, readonly=True)
-                    for group in gang.spec.pod_groups
-                    for ref in group.pod_references
-                ]
-                if pods and all(
-                    p is not None and is_scheduled(p) and not is_terminating(p)
-                    for p in pods
-                ):
+                # short-circuit at the first unbound pod: this self-heal
+                # check re-runs for every still-pending gang every round,
+                # and during ramp-up almost every gang fails on pod #1
+                all_bound = False
+                total = 0
+                for group in gang.spec.pod_groups:
+                    all_bound = True
+                    for ref in group.pod_references:
+                        total += 1
+                        p = self.store.get(
+                            "Pod", ref.namespace, ref.name, readonly=True
+                        )
+                        if (
+                            p is None
+                            or not is_scheduled(p)
+                            or is_terminating(p)
+                        ):
+                            all_bound = False
+                            break
+                    if not all_bound:
+                        break
+                if total and all_bound:
                     self._mark_scheduled(
                         namespace, gang.metadata.name, None
                     )
@@ -1056,6 +1108,8 @@ class GangScheduler:
             all_ready = True
             total = 0
             for group in gang.spec.pod_groups:
+                if not all_ready:
+                    break
                 for ref in group.pod_references:
                     total += 1
                     pod = self.store.get(
@@ -1063,10 +1117,12 @@ class GangScheduler:
                     )
                     if pod is None or not is_ready(pod):
                         all_ready = False
+                        break
             if total and all_ready:
-                gang.status.phase = PHASE_RUNNING
+                st = clone_status(gang.status)
+                st.phase = PHASE_RUNNING
                 set_condition(
-                    gang.status.conditions,
+                    st.conditions,
                     Condition(
                         type="Ready",
                         status="True",
@@ -1075,4 +1131,4 @@ class GangScheduler:
                     ),
                     self.store.clock.now(),
                 )
-                self._update_status_tolerant(gang)
+                self._commit_status_tolerant(gang, st)

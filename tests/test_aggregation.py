@@ -1,0 +1,256 @@
+"""Event-driven status aggregation: equivalence under randomized storms.
+
+The aggregate (runtime/aggregate.py) exists only if its incremental
+counters are BYTE-IDENTICAL to a full rescan of the same store view at
+every point in time — across create / status-churn / gate-transition /
+delete / finalizer-gated-terminate / recreate orderings, in both the
+committed view (commit-time folds) and the lagged informer cache
+(apply-at-delivery folds). These tests replay randomized event storms and
+compare after every operation.
+"""
+
+import random
+from collections import Counter
+
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import Condition, ObjectMeta, set_condition
+from grove_tpu.api.pod import (
+    COND_POD_READY,
+    COND_POD_SCHEDULED,
+    ContainerStatus,
+    Pod,
+    has_erroneous_exit,
+    is_ready,
+    is_schedule_gated,
+    is_scheduled,
+    is_terminating,
+)
+from grove_tpu.api.types import PODGANG_SCHEDULING_GATE, PodClique
+from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.store import Store
+
+NS = "default"
+PCLQS = ["storm-a", "storm-b", "storm-c"]
+HASHES = [None, "h1", "h2"]
+
+
+def rescan_counters(store: Store, ns: str, pclq: str, cached: bool):
+    """The full-rescan ground truth, replicating the PCLQ status buckets."""
+    pods = [
+        p
+        for p in store.scan(
+            "Pod", ns, {namegen.LABEL_PODCLIQUE: pclq}, cached=cached
+        )
+        if not is_terminating(p)
+    ]
+    return {
+        "total": len(pods),
+        "ready": sum(1 for p in pods if is_ready(p)),
+        "scheduled": sum(1 for p in pods if is_scheduled(p)),
+        "gated": sum(1 for p in pods if is_schedule_gated(p)),
+        "error_exits": sum(
+            1 for p in pods if not is_ready(p) and has_erroneous_exit(p)
+        ),
+        "started_not_ready": sum(
+            1
+            for p in pods
+            if is_scheduled(p)
+            and not is_ready(p)
+            and not has_erroneous_exit(p)
+            and any(cs.started for cs in p.status.container_statuses)
+        ),
+        "hash_counts": dict(
+            Counter(
+                h
+                for p in pods
+                if (h := p.metadata.labels.get(namegen.LABEL_POD_TEMPLATE_HASH))
+                is not None
+            )
+        ),
+    }
+
+
+def agg_as_dict(store: Store, ns: str, pclq: str, cached: bool):
+    c = store.pod_counters(ns, pclq, cached=cached)
+    return {
+        "total": c.total,
+        "ready": c.ready,
+        "scheduled": c.scheduled,
+        "gated": c.gated,
+        "error_exits": c.error_exits,
+        "started_not_ready": c.started_not_ready,
+        "hash_counts": dict(c.hash_counts),
+    }
+
+
+def assert_view_equivalent(store: Store, cached: bool, where: str):
+    for pclq in PCLQS:
+        assert agg_as_dict(store, NS, pclq, cached) == rescan_counters(
+            store, NS, pclq, cached
+        ), f"{where}: aggregate diverged from rescan for {pclq}"
+
+
+def _build_pod(rng: random.Random, pclq: str, name: str, finalizer: bool) -> Pod:
+    pod = Pod(metadata=ObjectMeta(name=name, namespace=NS))
+    pod.metadata.labels[namegen.LABEL_PODCLIQUE] = pclq
+    h = rng.choice(HASHES)
+    if h is not None:
+        pod.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = h
+    if rng.random() < 0.7:
+        pod.spec.scheduling_gates = [PODGANG_SCHEDULING_GATE]
+    if finalizer:
+        pod.metadata.finalizers = ["grove.io/test"]
+    return pod
+
+
+def _mutate_status(rng: random.Random, pod: Pod) -> None:
+    now = rng.random() * 100
+    roll = rng.random()
+    if roll < 0.3:
+        set_condition(
+            pod.status.conditions,
+            Condition(
+                type=COND_POD_SCHEDULED,
+                status=rng.choice(["True", "False"]),
+                reason="Storm",
+            ),
+            now,
+        )
+        pod.status.node_name = "node-0"
+    elif roll < 0.6:
+        set_condition(
+            pod.status.conditions,
+            Condition(
+                type=COND_POD_READY,
+                status=rng.choice(["True", "False"]),
+                reason="Storm",
+            ),
+            now,
+        )
+    elif roll < 0.75:
+        pod.status.container_statuses = [
+            ContainerStatus(
+                name="c",
+                started=rng.random() < 0.7,
+                exit_code=rng.choice([None, 0, 1]),
+            )
+        ]
+    elif roll < 0.85:
+        # gate transition (spec write)
+        pod.spec.scheduling_gates = (
+            [] if pod.spec.scheduling_gates else [PODGANG_SCHEDULING_GATE]
+        )
+    else:
+        # template-hash relabel (rolling-update shape)
+        h = rng.choice(HASHES)
+        if h is None:
+            pod.metadata.labels.pop(namegen.LABEL_POD_TEMPLATE_HASH, None)
+        else:
+            pod.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = h
+
+
+def _run_storm(store: Store, seed: int, ops: int, flush=None):
+    """Random create/mutate/delete/recreate storm; `flush` (cache-lag mode)
+    is called periodically to deliver queued events to the cache."""
+    rng = random.Random(seed)
+    live: dict = {}  # name -> pclq
+    terminating: set = set()
+    deleted: list = []  # names available for delete/recreate ordering
+    n = 0
+    for step in range(ops):
+        action = rng.random()
+        if (action < 0.35 or not live) and len(live) < 40:
+            if deleted and rng.random() < 0.4:
+                name = deleted.pop()  # recreate a previously deleted name
+            else:
+                name = f"pod-{n}"
+                n += 1
+            pclq = rng.choice(PCLQS)
+            store.create(
+                _build_pod(rng, pclq, name, finalizer=rng.random() < 0.3)
+            )
+            live[name] = pclq
+        elif action < 0.8:
+            name = rng.choice(sorted(live))
+            pod = store.get("Pod", NS, name)
+            _mutate_status(rng, pod)
+            store.update(pod, bump_generation=False)
+        else:
+            name = rng.choice(sorted(live))
+            if name in terminating:
+                # complete the finalizer-gated deletion
+                store.remove_finalizer("Pod", NS, name, "grove.io/test")
+                terminating.discard(name)
+                live.pop(name, None)
+                deleted.append(name)
+            else:
+                view = store.get("Pod", NS, name, readonly=True)
+                store.delete("Pod", NS, name)
+                if view.metadata.finalizers:
+                    terminating.add(name)  # deletion-marked, still present
+                else:
+                    live.pop(name, None)
+                    deleted.append(name)
+        if flush is not None and rng.random() < 0.4:
+            flush(rng)
+        assert_view_equivalent(store, cached=False, where=f"step {step}")
+
+
+class TestAggregateEquivalence:
+    @pytest.mark.parametrize("seed", [1, 7, 42])
+    def test_committed_view_matches_rescan_through_storm(self, seed):
+        store = Store(Clock())
+        _run_storm(store, seed, ops=300)
+
+    @pytest.mark.parametrize("seed", [3, 11])
+    def test_cached_view_matches_rescan_at_every_delivery_point(self, seed):
+        """Cache-lag mode: events apply to the informer cache in random
+        batches; the CACHED aggregate must equal a CACHED rescan at every
+        flush point, and a full resync (sync_cache_kind) must rebuild it."""
+        store = Store(Clock(), cache_lag=True)
+        backlog = []
+        store.subscribe(backlog.append)
+
+        def flush(rng):
+            for _ in range(rng.randrange(0, len(backlog) + 1)):
+                store.apply_event_to_cache(backlog.pop(0))
+            assert_view_equivalent(store, cached=True, where="flush")
+
+        _run_storm(store, seed, ops=250, flush=flush)
+        while backlog:
+            store.apply_event_to_cache(backlog.pop(0))
+        assert_view_equivalent(store, cached=True, where="final flush")
+        # full informer resync rebuilds the cached aggregate from scratch
+        store.sync_cache_kind("Pod")
+        assert_view_equivalent(store, cached=True, where="post-resync")
+
+    def test_compute_status_counters_path_matches_scan_path(self):
+        """The actual consumer: PCLQ compute_status via the aggregate must
+        produce a status byte-identical to the scan path."""
+        from grove_tpu.controller.common import OperatorContext
+        from grove_tpu.controller.podclique.status import compute_status
+        from grove_tpu.runtime.clock import VirtualClock
+
+        store = Store(VirtualClock())  # frozen time: byte-identical stamps
+        ctx = OperatorContext(store=store, clock=store.clock)
+        rng = random.Random(5)
+        for i in range(12):
+            pod = _build_pod(rng, "storm-a", f"p-{i}", finalizer=False)
+            store.create(pod)
+            mut = store.get("Pod", NS, f"p-{i}")
+            _mutate_status(rng, mut)
+            store.update(mut, bump_generation=False)
+        pclq = PodClique(metadata=ObjectMeta(name="storm-a", namespace=NS))
+        pclq.metadata.labels[namegen.LABEL_POD_TEMPLATE_HASH] = "h1"
+        pclq.spec.min_available = 2
+        via_counters = compute_status(ctx, pclq)  # pods=None → aggregate
+        via_scan = compute_status(
+            ctx,
+            pclq,
+            pods=list(
+                store.scan("Pod", NS, {namegen.LABEL_PODCLIQUE: "storm-a"})
+            ),
+        )
+        assert via_counters == via_scan

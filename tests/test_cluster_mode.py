@@ -630,6 +630,56 @@ class TestWatchStream:
         finally:
             server.stop()
 
+    def test_informer_old_retention_is_predicate_slim(self):
+        """The informer-local `last` map must not retain a second
+        fully-decoded copy of every live pod (ADVICE r5): WatchEvent.old
+        keeps only what the registered predicates compare — shared
+        metadata/status plus the scheduling-gate list — and drops the pod
+        template payload (containers/env), while gate-transition predicates
+        still fire."""
+        from grove_tpu.api.pod import Pod
+        from grove_tpu.api.types import Container, PODGANG_SCHEDULING_GATE
+        from grove_tpu.cluster.apiserver import APIServer
+        from grove_tpu.cluster.client import HttpStore, _OldView
+        from grove_tpu.controller.register import pod_status_transition
+
+        server = APIServer().start()
+        try:
+            client = HttpStore(server.address, watch_kinds=("Pod",))
+            events = []
+            client.subscribe(events.append)
+            client.start()
+            time.sleep(0.2)
+            pod = Pod()
+            pod.metadata.name = "slim-0"
+            pod.spec.containers = [Container(name="main", image="busybox")]
+            pod.spec.scheduling_gates = [PODGANG_SCHEDULING_GATE]
+            created = client.create(pod)
+            deadline = time.time() + 5
+            while time.time() < deadline and not events:
+                time.sleep(0.02)
+            created.spec.scheduling_gates = []
+            client.update(created)
+            deadline = time.time() + 5
+            while time.time() < deadline and not any(
+                ev.type == "Modified" for ev in events
+            ):
+                time.sleep(0.02)
+            mod = next(ev for ev in events if ev.type == "Modified")
+            old = mod.old
+            # memory shape: slim retention, no template payload on old
+            assert isinstance(old, _OldView)
+            assert not hasattr(old.spec, "containers")
+            # ...but every predicate-compared field is present
+            assert old.spec.scheduling_gates == [PODGANG_SCHEDULING_GATE]
+            assert old.metadata.name == "slim-0"
+            assert old.status is not None
+            # the gate-removal transition still passes the pod predicate
+            assert pod_status_transition(mod) is True
+            client.stop()
+        finally:
+            server.stop()
+
 
 class TestKubectlVerbs:
     """The CLI's kubectl-equivalent verbs against a LIVE apiserver:

@@ -521,6 +521,65 @@ class TestWaveSolver:
         assert outs[False]["chosen_level"][0] == -1
         assert outs[True]["chosen_level"][0] == -1
 
+    def test_lazy_rescue_deferral_at_max_waves_matches_eager(self):
+        """Budget-boundary edge (round-4 advisor #3 / verdict weak #6):
+        the eager path walks zone-0's levels and rescues cluster-wide on
+        wave 3 — so with max_waves=3 the lazy path DEFERS exactly on the
+        final wave and the loop exits with the sentinel pending. Without
+        the epilogue the gang is dropped while the eager path admits it
+        in-wave; with it, admissions/placements are byte-identical to the
+        eager path at budget exhaustion."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from grove_tpu.ops.packing import solve_waves_device
+        from grove_tpu.solver.kernel import pad_problem_for_waves
+
+        # same two-zone fragmentation shape as the parity test above: the
+        # level walk exhausts zone 0, only the cluster-wide fill fits
+        nodes = make_nodes(
+            5, capacity={"cpu": 4.0}, hosts_per_ici_block=1,
+            blocks_per_slice=3,
+        )
+        for i, n in enumerate(nodes):
+            z = 0 if i < 3 else 1
+            n.labels["topology.kubernetes.io/zone"] = f"zone-{z}"
+            n.labels["cloud.google.com/gke-cluster"] = f"cluster-{z}"
+        nodes[2].capacity["cpu"] = 1.0
+        nodes[3].capacity["cpu"] = 2.0
+        nodes[4].capacity["cpu"] = 2.0
+        gangs = [
+            gang(
+                "frag",
+                [
+                    group("frag-a", cpu=3.0, count=2),
+                    group("frag-tiny", cpu=1.0, count=1),
+                    group("frag-c", cpu=2.0, count=1),
+                ],
+            )
+        ]
+        problem = build_problem(nodes, gangs, TOPO)
+        raw, n_chunks, grouped, pinned, spread, uniform = (
+            pad_problem_for_waves(problem, 32)
+        )
+        assert uniform
+        args = tuple(jnp.asarray(a) for a in raw)
+        outs = {}
+        for lz in (False, True):
+            out = solve_waves_device(
+                *args, n_chunks=n_chunks, max_waves=3,  # deferral boundary
+                grouped=grouped, pinned=pinned, spread=spread,
+                uniform=uniform, lazy_rescue=lz,
+            )
+            outs[lz] = {k: np.asarray(v) for k, v in out.items()}
+        assert outs[False]["admitted"][0], "eager admits in the single wave"
+        for k in ("admitted", "placed", "score", "free_after"):
+            np.testing.assert_array_equal(
+                outs[False][k], outs[True][k], err_msg=k
+            )
+        # nothing left dangling on the sentinel
+        assert not outs[True]["pending"][0]
+
     def test_dedup_declines_when_rows_mostly_unique(self):
         """dedup_demand must hand back (None, None) when the shared table
         would not pay (U not far below the chunk's own row count)."""
