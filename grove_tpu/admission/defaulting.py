@@ -10,10 +10,12 @@ from __future__ import annotations
 
 from grove_tpu.api.types import (
     DEFAULT_TERMINATION_DELAY_SECONDS,
+    QUEUE_ROOT,
     SPREAD_DO_NOT_SCHEDULE,
     STARTUP_ANY_ORDER,
     HeadlessServiceConfig,
     PodCliqueSet,
+    Queue,
 )
 
 DEFAULT_TERMINATION_GRACE_PERIOD = 30
@@ -73,3 +75,12 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
             sg.scale_config.min_replicas = sg.replicas
 
     return pcs
+
+
+def default_queue(q: Queue) -> Queue:
+    """Queue defaulting (quota subsystem, docs/quota.md): cluster-scoped,
+    parent anchored at the implicit root (two-level tree)."""
+    q.metadata.namespace = ""
+    if not q.spec.parent:
+        q.spec.parent = QUEUE_ROOT
+    return q

@@ -673,3 +673,52 @@ def validate_cluster_topology(topo: ClusterTopology) -> ValidationResult:
             )
         prev_order = order
     return res
+
+
+# ---------------------------------------------------------------------------
+# Queue validation (quota subsystem — docs/quota.md)
+# ---------------------------------------------------------------------------
+
+
+def validate_queue(queue) -> ValidationResult:
+    """Webhook-equivalent Queue validation: DNS-label name, two-level tree
+    (parent must be the implicit root), non-negative shares, and per-resource
+    ceiling >= deserved (a ceiling below the deserved share is unsatisfiable:
+    the queue could never reach what fair-share ordering entitles it to)."""
+    from grove_tpu.api.types import QUEUE_ROOT
+
+    res = ValidationResult()
+    name = queue.metadata.name
+    if not name or not _DNS1123_RE.match(name) or len(name) > 63:
+        res.error("metadata.name", f"{name!r} is not a DNS-1123 label")
+    if name == QUEUE_ROOT:
+        res.error(
+            "metadata.name",
+            f"{QUEUE_ROOT!r} is the implicit tree root and cannot be a Queue",
+        )
+    if queue.spec.parent not in ("", QUEUE_ROOT):
+        res.error(
+            "spec.parent",
+            f"must be {QUEUE_ROOT!r} (the queue tree is two-level: "
+            "root -> tenant queues)",
+        )
+    for fname, shares in (
+        ("deserved", queue.spec.deserved),
+        ("ceiling", queue.spec.ceiling),
+    ):
+        for r, v in shares.items():
+            if v < 0:
+                res.error(f"spec.{fname}[{r}]", f"must be >= 0, got {v}")
+    for r, cap in queue.spec.ceiling.items():
+        deserved = queue.spec.deserved.get(r)
+        if deserved is not None and cap < deserved:
+            res.error(
+                f"spec.ceiling[{r}]",
+                f"ceiling {cap} is below deserved {deserved}",
+            )
+    if not queue.spec.deserved:
+        res.warn(
+            f"queue {name!r} has no deserved shares: it orders last whenever "
+            "it holds any usage and can never justify a reclaim"
+        )
+    return res

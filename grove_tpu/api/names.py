@@ -27,6 +27,11 @@ LABEL_PCSG = "grove.io/podcliquescalinggroup"
 LABEL_PCSG_REPLICA_INDEX = "grove.io/podcliquescalinggroup-replica-index"
 LABEL_POD_TEMPLATE_HASH = "grove.io/pod-template-hash"
 LABEL_POD_INDEX = "grove.io/pod-index"
+# tenant queue assignment (quota subsystem, docs/quota.md): set by users on
+# the PodCliqueSet, propagated by the operator to PodCliques (and through
+# them to Pods) and PodGangs so the scheduler and the usage accountant can
+# attribute every gang/pod to its queue without extra lookups
+LABEL_QUEUE = "scheduler.grove.io/queue"
 
 # component values set against LABEL_COMPONENT
 COMPONENT_HEADLESS_SERVICE = "pcs-headless-service"

@@ -610,6 +610,64 @@ class PodGang:
 
 
 # ---------------------------------------------------------------------------
+# Queue (multi-tenant quota & fair-share — scheduler contract extension)
+# ---------------------------------------------------------------------------
+
+# The implicit root of the two-level queue tree; every tenant Queue's
+# parent defaults to it. Not a CR — it exists only as the tree's anchor.
+QUEUE_ROOT = "root"
+# Queue gangs land in when their PodCliqueSet carries no queue label (and
+# the implicit catch-all when no Queue CR of this name exists).
+DEFAULT_QUEUE = "default"
+
+
+@dataclass
+class QueueSpec:
+    """grove-tpu extension of the scheduler contract (docs/quota.md): a
+    tenant capacity queue in a two-level tree (root → tenant queues),
+    borrowing the deserved-share/ceiling semantics of capacity schedulers
+    (Kueue ClusterQueue / KAI hierarchical queues — the feature set the
+    reference delegates to the external KAI scheduler).
+
+    ``deserved``: per-resource share the queue is entitled to; fair-share
+    ordering ranks queues by dominant share usage/deserved, and a queue
+    below its deserved share may RECLAIM capacity from queues above theirs.
+    ``ceiling``: per-resource hard cap — gangs that would push usage past
+    it are held pending (QueuePending) without consuming a solve slot."""
+
+    parent: str = ""  # defaulted to QUEUE_ROOT (two-level tree only)
+    deserved: Dict[str, float] = field(default_factory=dict)
+    ceiling: Dict[str, float] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "QueueSpec":
+        return QueueSpec(
+            parent=d.get("parent", ""),
+            deserved=parse_resource_map(d.get("deserved")),
+            ceiling=parse_resource_map(d.get("ceiling")),
+        )
+
+
+@dataclass
+class QueueStatus:
+    """Written by the gang scheduler each round (write-on-change)."""
+
+    usage: Dict[str, float] = field(default_factory=dict)
+    dominant_share: float = 0.0
+    admitted_gangs: int = 0
+    pending_gangs: int = 0
+    conditions: List[Condition] = field(default_factory=list)
+
+
+@dataclass
+class Queue:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: QueueSpec = field(default_factory=QueueSpec)
+    status: QueueStatus = field(default_factory=QueueStatus)
+    kind: str = "Queue"
+
+
+# ---------------------------------------------------------------------------
 # Generic child resources (Service / HPA / RBAC / Secret)
 # ---------------------------------------------------------------------------
 

@@ -34,6 +34,7 @@ from grove_tpu.api.types import (
     PodCliqueSet,
     PodCliqueSetTemplateSpec,
     PodGang,
+    Queue,
     parse_duration,
 )
 
@@ -76,6 +77,17 @@ _KINDS = [
     ),
     KindInfo(
         "PodGang", PodGang, "scheduler.grove.io", "v1alpha1", "podgangs"
+    ),
+    # multi-tenant quota queue (docs/quota.md) — cluster-scoped like
+    # ClusterTopology; lives in the scheduler group (fair-share ordering
+    # and reclaim are scheduler-side semantics)
+    KindInfo(
+        "Queue",
+        Queue,
+        "scheduler.grove.io",
+        "v1alpha1",
+        "queues",
+        namespaced=False,
     ),
     KindInfo("Pod", Pod, "", "v1", "pods"),
     # generic child kinds the operator materializes (sim-shaped spec dicts)

@@ -19,14 +19,18 @@ def _cmd_validate(args) -> int:
     defaulting+validation the operator's webhooks run, offline
     (PodCliqueSet and ClusterTopology — mirroring the reference's two
     validating-webhook targets)."""
-    from grove_tpu.admission.defaulting import default_podcliqueset
+    from grove_tpu.admission.defaulting import (
+        default_podcliqueset,
+        default_queue,
+    )
     from grove_tpu.admission.validation import (
         validate_cluster_topology,
         validate_podcliqueset,
+        validate_queue,
     )
     from grove_tpu.api.load import load_manifest_objects
     from grove_tpu.api.topology import ClusterTopology
-    from grove_tpu.api.types import PodCliqueSet
+    from grove_tpu.api.types import PodCliqueSet, Queue
 
     failed = 0
     for path in args.manifests:
@@ -34,7 +38,9 @@ def _cmd_validate(args) -> int:
             try:
                 objs = load_manifest_objects(f.read())
                 for obj in objs:
-                    if not isinstance(obj, (PodCliqueSet, ClusterTopology)):
+                    if not isinstance(
+                        obj, (PodCliqueSet, ClusterTopology, Queue)
+                    ):
                         raise ValueError(
                             f"kind {obj.kind!r} has no admission validator"
                         )
@@ -45,6 +51,9 @@ def _cmd_validate(args) -> int:
         for obj in objs:
             if isinstance(obj, ClusterTopology):
                 res = validate_cluster_topology(obj)
+            elif isinstance(obj, Queue):
+                default_queue(obj)
+                res = validate_queue(obj)
             else:
                 default_podcliqueset(obj)
                 res = validate_podcliqueset(obj, ClusterTopology())
@@ -572,6 +581,90 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _fmt_resource_map(m: dict) -> str:
+    return ",".join(f"{k}={g:g}" for k, g in sorted(m.items())) or "-"
+
+
+def _print_queue_table(items: list) -> None:
+    if not items:
+        print("no queues (and no queue-attributed usage)")
+        return
+    rows = [
+        (
+            it["name"] + ("" if it.get("defined", True) else " (implicit)"),
+            _fmt_resource_map(it.get("deserved", {})),
+            _fmt_resource_map(it.get("ceiling", {})),
+            _fmt_resource_map(it.get("usage", {})),
+            f"{it.get('dominantShare', 0.0):.3f}",
+            str(it.get("admittedGangs", 0)),
+            str(it.get("pendingGangs", 0)),
+        )
+        for it in items
+    ]
+    headers = (
+        "NAME", "DESERVED", "CEILING", "USAGE", "SHARE", "ADMITTED", "PENDING",
+    )
+    widths = [
+        max(len(headers[c]), max(len(r[c]) for r in rows))
+        for c in range(len(headers))
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+
+def _cmd_queues(args) -> int:
+    """Per-queue quota summary (docs/quota.md): deserved/ceiling/usage,
+    dominant share, admitted/pending gangs — from a live apiserver's
+    GET /queues, or after simulating manifests (Queue + PodCliqueSet docs)."""
+    import json as _json
+
+    if args.apiserver:
+        import urllib.request
+
+        url = args.apiserver
+        if "://" not in url:
+            url = f"http://{url}"
+        try:
+            with urllib.request.urlopen(f"{url}/queues", timeout=10) as r:
+                doc = _json.loads(r.read())
+        except (OSError, ValueError) as e:
+            print(f"queues: {url}: {e}", file=sys.stderr)
+            return 1
+        _print_queue_table(doc.get("items", []))
+        return 0
+
+    if not args.manifests:
+        print(
+            "queues: provide manifests to simulate (Queue + PodCliqueSet"
+            " docs), or --apiserver URL to read a live cluster",
+            file=sys.stderr,
+        )
+        return 2
+    _ensure_backend()
+    from grove_tpu.api.load import load_manifest_objects
+    from grove_tpu.quota.manager import quota_snapshot
+    from grove_tpu.sim.harness import SimHarness
+
+    from grove_tpu.api.types import PodCliqueSet, Queue
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            for obj in load_manifest_objects(f.read()):
+                if not isinstance(obj, (PodCliqueSet, Queue)):
+                    print(
+                        f"queues: {path}: kind {obj.kind!r} is not"
+                        " simulated here (Queue / PodCliqueSet only)",
+                        file=sys.stderr,
+                    )
+                    return 2
+                harness.apply(obj)
+    harness.converge()
+    _print_queue_table(quota_snapshot(harness.store))
+    return 0
+
+
 def _cmd_bench(args) -> int:
     import subprocess
 
@@ -813,6 +906,18 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--apiserver", help="read from a live apiserver instead")
     p.add_argument("--namespace", default="default")
     p.set_defaults(fn=_cmd_describe)
+
+    p = sub.add_parser(
+        "queues",
+        help=(
+            "per-queue quota summary (deserved/usage/share, gang counts) —"
+            " live with --apiserver URL or after simulating manifests"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="read GET /queues from a live server")
+    p.set_defaults(fn=_cmd_queues)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")

@@ -176,6 +176,7 @@ def render_api_reference() -> str:
         PodCliqueScalingGroup,
         PodCliqueSet,
         PodGang,
+        Queue,
     )
     from grove_tpu.config.operator import OperatorConfiguration
 
@@ -201,8 +202,10 @@ def render_api_reference() -> str:
     scheduler = _section(
         "Scheduler API (`scheduler.grove.io/v1alpha1`)",
         "The gang-scheduling contract consumed by the placement engine (the\n"
-        "in-tree TPU solver, the gRPC sidecar, or an external scheduler).",
-        [PodGang],
+        "in-tree TPU solver, the gRPC sidecar, or an external scheduler),\n"
+        "plus the cluster-scoped tenant `Queue` of the quota/fair-share\n"
+        "subsystem (docs/quota.md).",
+        [PodGang, Queue],
         skip=shared_types,
     )
     shared = _section(

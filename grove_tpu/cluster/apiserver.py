@@ -360,6 +360,17 @@ class APIServer:
                     from grove_tpu.observability.tracing import TRACER
 
                     return self._send_json(200, TRACER.chrome_trace())
+                if path == "/queues":
+                    # quota subsystem summary (docs/quota.md): per-queue
+                    # deserved/ceiling/usage/dominant-share + gang counts,
+                    # full-scan authoritative (includes implicit queues)
+                    from grove_tpu.quota.manager import quota_snapshot
+
+                    with server.lock:
+                        items = quota_snapshot(server.store)
+                    return self._send_json(
+                        200, {"kind": "QueueSummaryList", "items": items}
+                    )
                 if path == "/events":
                     # deduped k8s-style Events (count/first/lastTimestamp),
                     # filterable: ?namespace=...&reason=...&kind=...

@@ -28,6 +28,7 @@ CRD_KINDS = (
     "PodCliqueScalingGroup",
     "ClusterTopology",
     "PodGang",
+    "Queue",
 )
 
 

@@ -198,6 +198,10 @@ class PodCliqueScalingGroupReconciler:
         labels[namegen.LABEL_POD_TEMPLATE_HASH] = pod_template_hash_for(
             pcs, clique_name
         )
+        # tenant queue label flows PCS -> PCLQ -> pods (quota accounting)
+        queue = pcs.metadata.labels.get(namegen.LABEL_QUEUE)
+        if queue:
+            labels[namegen.LABEL_QUEUE] = queue
         if replica >= min_available:
             # scaled replica: points back at its base gang (podclique.go:423-449)
             labels[namegen.LABEL_BASE_PODGANG] = namegen.base_podgang_name(

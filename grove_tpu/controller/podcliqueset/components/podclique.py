@@ -82,6 +82,12 @@ def build_pclq(pcs: PodCliqueSet, replica: int, clique) -> PodClique:
     labels[namegen.LABEL_POD_TEMPLATE_HASH] = pod_template_hash_for(
         pcs, clique.name
     )
+    # tenant queue (quota subsystem): PCS label flows to the PCLQ, and from
+    # there to every pod (pods copy PCLQ labels wholesale), so the usage
+    # accountant can attribute bound capacity without store lookups
+    queue = pcs.metadata.labels.get(namegen.LABEL_QUEUE)
+    if queue:
+        labels[namegen.LABEL_QUEUE] = queue
     annotations = dict(clique.annotations)
     deps = resolve_starts_after(pcs, replica, clique.name)
     if deps:

@@ -336,6 +336,11 @@ def _create_or_update_podgang(
     if current is None:
         labels = dict(namegen.default_labels(pcs.metadata.name))
         labels[namegen.LABEL_COMPONENT] = namegen.COMPONENT_PODGANG
+        # tenant queue (quota subsystem): the scheduler reads the gang's
+        # queue assignment from this label at encode time
+        queue = pcs.metadata.labels.get(namegen.LABEL_QUEUE)
+        if queue:
+            labels[namegen.LABEL_QUEUE] = queue
         if not gang.base and gang.base_fqn:
             labels[namegen.LABEL_BASE_PODGANG] = gang.base_fqn
         ctx.store.create(

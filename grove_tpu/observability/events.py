@@ -31,6 +31,11 @@ REASON_GANG_DEFERRED = "GangDeferred"
 REASON_POD_BOUND = "PodBound"
 REASON_PREEMPTED = "Preempted"
 REASON_ROLLING_UPDATE_STARTED = "RollingUpdateStarted"
+# quota subsystem (docs/quota.md): a gang held back because its queue is at
+# its ceiling, and a scheduled gang evicted so a queue below its deserved
+# share can place (victim-side event naming the claimant)
+REASON_QUEUE_PENDING = "QueuePending"
+REASON_QUOTA_RECLAIM = "QuotaReclaim"
 
 
 @dataclass

@@ -34,6 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from grove_tpu.ops.packing import _pods_fit_per_node
 
+# jax moved shard_map out of experimental in 0.5; this image ships 0.4.x
+# where only the experimental spelling exists
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _ring_exclusive_shard_prefix(v: jnp.ndarray, axis: str, size: int):
     """Exclusive prefix sum of per-shard values around the ring: after hop s
@@ -79,7 +85,7 @@ def domain_aggregates_ring(
     ).astype(np.int32)  # [2*L*D]
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(axis, None), P(), P(), P()),
         out_specs=(P(), P()),

@@ -1,0 +1,23 @@
+"""Multi-tenant quota & fair-share queueing (docs/quota.md).
+
+The queue/quota semantics the reference delegates to the external KAI
+scheduler — hierarchical capacity queues, deserved-share fair ordering, and
+cross-queue reclaim — implemented in front of the gang solver:
+
+- ``api/types.py::Queue``: a cluster-scoped tenant queue in a two-level
+  tree (root → tenant queues) with per-resource ``deserved``/``ceiling``.
+- ``accountant``: incremental per-queue usage vectors folded from pod
+  watch deltas (the ``runtime/aggregate.py`` pattern).
+- ``ordering``: the vectorized fair-share ordering pass — dense
+  queues × resources tensors through a ``lax.scan`` producing the gang
+  solve order (DRF-style dominant-share argmin per step).
+- ``oracle``: the pure-Python reference implementation the vectorized pass
+  is equivalence-tested against (mirrors ``solver/oracle.py``'s role).
+- ``manager``: ties it together for the scheduler — queue tree lookup,
+  ceiling holds, ordering, status/gauges, and the reclaim predicate.
+"""
+
+from grove_tpu.quota.accountant import QuotaAccountant
+from grove_tpu.quota.manager import QuotaManager, quota_snapshot
+
+__all__ = ["QuotaAccountant", "QuotaManager", "quota_snapshot"]

@@ -1,0 +1,119 @@
+"""Incremental per-queue usage accounting from pod watch deltas.
+
+The quota analogue of ``runtime/aggregate.py``: the fair-share ordering
+pass needs per-queue usage vectors every scheduling round, and a full pod
+rescan per round is O(pods) at stress scale. This accountant folds each
+committed pod mutation into per-queue resource totals at event time, so a
+round reads its usage in O(queues).
+
+A pod contributes its ``spec.total_requests()`` to its queue (the
+``scheduler.grove.io/queue`` label the operator propagates from the
+PodCliqueSet; unlabeled pods land in the default queue) while it is BOUND
+and not terminating — exactly the capacity the cluster's node accounting
+charges, so queue shares and node free-capacity always agree about who is
+using what.
+
+Exactness contract: equal to a full rescan of the same store view
+(``quota/oracle.py::usage_oracle``) up to float-accumulation order;
+``tests/test_quota.py`` replays randomized event storms against both.
+Rows are garbage-collected by live-pod count, so a drained queue drops its
+row (and any accumulated float residue) entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.pod import is_scheduled, is_terminating
+from grove_tpu.api.types import DEFAULT_QUEUE
+
+
+def pod_quota_features(
+    pod, default_queue: str = DEFAULT_QUEUE
+) -> Optional[Tuple[str, Dict[str, float]]]:
+    """(queue, requests) the pod charges against its queue, or None while
+    it holds no capacity (unbound, terminating, or deleted)."""
+    if pod.metadata.deletion_timestamp is not None:
+        return None
+    if not is_scheduled(pod) or is_terminating(pod):
+        return None
+    queue = pod.metadata.labels.get(namegen.LABEL_QUEUE) or default_queue
+    return queue, pod.spec.total_requests()
+
+
+class QuotaAccountant:
+    """Per-queue usage rows folded from watch deltas. One instance mirrors
+    one store view (the committed view — the scheduler binds/evicts against
+    committed state, so its quota decisions must read the same view)."""
+
+    __slots__ = ("_usage", "_pods", "default_queue", "_built")
+
+    def __init__(self, default_queue: str = DEFAULT_QUEUE) -> None:
+        self._usage: Dict[str, Dict[str, float]] = {}
+        self._pods: Dict[str, int] = {}  # live bound pods per queue (row GC)
+        self.default_queue = default_queue
+        # lazy first build: an accountant attached to a store that already
+        # holds bound pods (operator failover) rebuilds on first read
+        self._built = False
+
+    # -- reads -----------------------------------------------------------
+
+    def usage(self, queue: str) -> Dict[str, float]:
+        """READ-ONLY view of one queue's usage vector."""
+        return self._usage.get(queue, {})
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Copy of every queue's usage vector (status/endpoint exports)."""
+        return {q: dict(v) for q, v in self._usage.items()}
+
+    def pod_count(self, queue: str) -> int:
+        return self._pods.get(queue, 0)
+
+    # -- maintenance -----------------------------------------------------
+
+    def _fold(self, pod, sign: int) -> None:
+        feats = pod_quota_features(pod, self.default_queue)
+        if feats is None:
+            return
+        queue, requests = feats
+        row = self._usage.get(queue)
+        if row is None:
+            row = self._usage[queue] = {}
+        for r, v in requests.items():
+            row[r] = row.get(r, 0.0) + sign * v
+        n = self._pods.get(queue, 0) + sign
+        if n > 0:
+            self._pods[queue] = n
+        else:
+            # count-based row GC: a drained queue drops its row AND any
+            # float residue the +/- accumulation left behind
+            self._pods.pop(queue, None)
+            self._usage.pop(queue, None)
+
+    def apply(self, type_: str, obj, old=None) -> None:
+        """Fold one committed-view mutation (Store watch callback shape)."""
+        if getattr(obj, "kind", None) != "Pod" or not self._built:
+            return
+        if type_ == "Deleted":
+            self._fold(old if old is not None else obj, -1)
+            return
+        if old is not None:
+            self._fold(old, -1)
+        self._fold(obj, +1)
+
+    def on_event(self, ev) -> None:
+        """Store.subscribe_system adapter."""
+        self.apply(ev.type, ev.obj, ev.old)
+
+    def rebuild(self, pods) -> None:
+        """Recompute from scratch (initial attach / full resync)."""
+        self._usage.clear()
+        self._pods.clear()
+        self._built = True
+        for pod in pods:
+            self._fold(pod, +1)
+
+    def ensure_built(self, store) -> None:
+        if not self._built:
+            self.rebuild(store.scan("Pod"))

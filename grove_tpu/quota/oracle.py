@@ -1,0 +1,92 @@
+"""Reference oracle: plain-NumPy fair-share ordering + usage accounting.
+
+The quota counterpart of ``solver/oracle.py``: written for clarity, looped
+exactly as the vectorized pass's math (``quota/ordering.py``), restricted to
+the same IEEE float32 elementwise ops so the two are BIT-IDENTICAL —
+``tests/test_quota.py`` replays ~200 randomized queue trees / usage states
+(ties, zero-deserved queues, drained queues) against both.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from grove_tpu.quota.ordering import BIG
+
+
+def dominant_share(usage: np.ndarray, deserved: np.ndarray) -> np.ndarray:
+    """[Q] dominant shares from [Q, R] float32 tensors — the shared share
+    formula (usage/deserved where entitled, usage*BIG where zero-deserved)."""
+    usage = np.asarray(usage, np.float32)
+    deserved = np.asarray(deserved, np.float32)
+    safe = np.where(deserved > 0, deserved, np.float32(1.0))
+    share = np.where(deserved > 0, usage / safe, usage * BIG)
+    if share.ndim == 2 and share.shape[1]:
+        return share.max(axis=1)
+    return np.zeros((share.shape[0],), np.float32)
+
+
+def dominant_share_of(
+    usage: Dict[str, float], deserved: Dict[str, float]
+) -> float:
+    """One queue's dominant share from resource dicts — the SINGLE home for
+    the dict→tensor conversion (ordering rows, CR status, /queues endpoint,
+    and the reclaim budget checks must never diverge on the resource-set
+    rule: the union of usage and deserved keys)."""
+    resources = sorted(set(usage) | set(deserved))
+    if not resources:
+        return 0.0
+    u = np.array([[usage.get(r, 0.0) for r in resources]], np.float32)
+    d = np.array([[deserved.get(r, 0.0) for r in resources]], np.float32)
+    return float(dominant_share(u, d)[0])
+
+
+def fair_order_oracle(
+    deserved: np.ndarray,  # [Q, R]
+    usage: np.ndarray,  # [Q, R]
+    demand: np.ndarray,  # [Q, G, R]
+    counts: np.ndarray,  # [Q]
+) -> np.ndarray:
+    """Sequential-greedy ordering, one queue pick per step. Returns the
+    same [T, 2] int32 (queue, slot) rows as ``ordering.fair_order``."""
+    deserved = np.asarray(deserved, np.float32)
+    u = np.array(usage, np.float32, copy=True)
+    demand = np.asarray(demand, np.float32)
+    counts = np.asarray(counts, np.int64)
+    q_dim = deserved.shape[0]
+    taken = np.zeros((q_dim,), np.int64)
+    out: List[Tuple[int, int]] = []
+    total = int(counts.sum())
+    for _ in range(total):
+        dom = dominant_share(u, deserved)
+        active = taken < counts
+        if not active.any():
+            break
+        key = np.where(active, dom, np.float32(np.inf))
+        q = int(np.argmin(key))
+        slot = int(taken[q])
+        out.append((q, slot))
+        if demand.ndim == 3 and demand.shape[2]:
+            u[q] = u[q] + demand[q, slot]  # charge ONLY the picked queue
+        taken[q] += 1
+    return np.array(out, dtype=np.int32).reshape(-1, 2)
+
+
+def usage_oracle(pods, default_queue: str) -> Dict[str, Dict[str, float]]:
+    """Full-rescan per-queue usage — what the incremental accountant must
+    always equal (modulo float-accumulation order): every bound,
+    non-terminating pod contributes its resource requests to its queue."""
+    from grove_tpu.api import names as namegen
+    from grove_tpu.api.pod import is_scheduled, is_terminating
+
+    out: Dict[str, Dict[str, float]] = {}
+    for pod in pods:
+        if not is_scheduled(pod) or is_terminating(pod):
+            continue
+        queue = pod.metadata.labels.get(namegen.LABEL_QUEUE) or default_queue
+        acc = out.setdefault(queue, {})
+        for r, v in pod.spec.total_requests().items():
+            acc[r] = acc.get(r, 0.0) + v
+    return out

@@ -1,0 +1,114 @@
+"""Vectorized fair-share ordering: dense queue tensors through a lax.scan.
+
+DRF-style dominant-share ordering (PAPERS.md: datacenter fair sharing) over
+the two-level queue tree, shaped to compose with the vmap-batched packing
+kernel: all queue state lives in dense ``[Q, R]`` float32 tensors, each scan
+step picks the queue with the lowest dominant share and emits its next
+pending gang, charging that gang's demand before the next step.
+
+The step function is deliberately restricted to elementwise IEEE float32
+ops (where / divide / max / add) plus first-occurrence ``argmin`` so the
+pure-NumPy oracle (``quota/oracle.py``) reproduces it BIT-IDENTICALLY —
+``tests/test_quota.py`` pins the two against each other across randomized
+queue trees, including share ties and zero-deserved queues.
+
+Semantics of one step, given usage U[Q,R], deserved D[Q,R], per-queue gang
+demand demand[Q,G,R] (queue-local priority order) and counts[Q]:
+
+    share[q,r] = U[q,r]/D[q,r]  where D>0, else U[q,r]*BIG  (zero-deserved
+                 queues order behind every queue with entitlement the
+                 moment they hold any usage; at zero usage they tie at 0)
+    dom[q]     = max_r share[q,r]
+    pick       = argmin over active queues of dom (ties -> lowest queue
+                 index; queues are pre-sorted by name, so ties break by
+                 queue name deterministically)
+    emit (pick, taken[pick]); U += demand[pick, taken[pick]]
+
+Steps after every queue drains emit (-1, -1); callers trim by counts.sum().
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+# float32-safe "worse than any entitled share" multiplier for zero-deserved
+# queues; overflow to inf is fine and identical in numpy and XLA
+BIG = np.float32(1e18)
+
+
+@lru_cache(maxsize=32)
+def _compiled(q_dim: int, g_dim: int, r_dim: int):
+    """jitted scan for one (Q, G, R) shape; the manager pads shapes so the
+    compile cache stays monotone-few (StickyGroupPad ethos)."""
+    import jax
+    import jax.numpy as jnp
+
+    t_dim = q_dim * g_dim
+
+    @jax.jit
+    def run(deserved, usage, demand, counts):
+        def step(carry, _):
+            u, taken = carry
+            safe = jnp.where(deserved > 0, deserved, jnp.float32(1.0))
+            share = jnp.where(deserved > 0, u / safe, u * jnp.float32(BIG))
+            dom = share.max(axis=1)
+            active = taken < counts
+            key = jnp.where(active, dom, jnp.inf)
+            q = jnp.argmin(key)
+            ok = active.any()
+            slot = taken[q]
+            out = jnp.where(
+                ok,
+                jnp.stack([q.astype(jnp.int32), slot]),
+                jnp.full((2,), -1, jnp.int32),
+            )
+            # charge the emitted gang's demand to ITS queue's row only
+            u = u.at[q].add(jnp.where(ok, demand[q, slot], jnp.float32(0.0)))
+            taken = taken.at[q].add(jnp.where(ok, 1, 0))
+            return (u, taken), out
+
+        (_, _), order = jax.lax.scan(
+            step,
+            (usage, jnp.zeros((q_dim,), jnp.int32)),
+            None,
+            length=t_dim,
+        )
+        return order
+
+    return run
+
+
+def fair_order(
+    deserved: np.ndarray,  # [Q, R] float32
+    usage: np.ndarray,  # [Q, R] float32
+    demand: np.ndarray,  # [Q, G, R] float32, queue-local priority order
+    counts: np.ndarray,  # [Q] int32 pending gangs per queue
+) -> np.ndarray:
+    """Vectorized ordering pass. Returns [T, 2] int32 (queue, slot) rows,
+    T = counts.sum(), in solve order."""
+    q_dim = deserved.shape[0]
+    r_dim = deserved.shape[1] if deserved.ndim == 2 else 0
+    g_dim = demand.shape[1] if demand.ndim == 3 else 0
+    total = int(counts.sum())
+    if total == 0 or q_dim == 0:
+        return np.zeros((0, 2), dtype=np.int32)
+    if r_dim == 0:
+        # degenerate: no resources anywhere -> every share is 0, ordering
+        # degrades to deterministic queue-index round-robin via zero tensors
+        r_dim = 1
+        deserved = np.zeros((q_dim, 1), np.float32)
+        usage = np.zeros((q_dim, 1), np.float32)
+        demand = np.zeros((q_dim, max(g_dim, 1), 1), np.float32)
+        g_dim = demand.shape[1]
+    run = _compiled(q_dim, g_dim, r_dim)
+    order = np.asarray(
+        run(
+            np.asarray(deserved, np.float32),
+            np.asarray(usage, np.float32),
+            np.asarray(demand, np.float32),
+            np.asarray(counts, np.int32),
+        )
+    )
+    return order[:total]

@@ -112,6 +112,10 @@ class SimHarness:
     # -- user actions ----------------------------------------------------
 
     def apply(self, pcs: PodCliqueSet) -> PodCliqueSet:
+        from grove_tpu.api.types import Queue
+
+        if isinstance(pcs, Queue):
+            return self.apply_queue(pcs)
         default_podcliqueset(pcs)
         existing = self.store.get(
             "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
@@ -123,6 +127,22 @@ class SimHarness:
         if not res.ok:
             raise ValidationError(res)
         existing.spec = pcs.spec
+        return self.store.update(existing)
+
+    def apply_queue(self, queue):
+        """Create-or-update a tenant Queue (quota subsystem, docs/quota.md)
+        through the same defaulting+validation the webhooks run."""
+        from grove_tpu.admission.defaulting import default_queue
+        from grove_tpu.admission.validation import validate_queue
+
+        default_queue(queue)
+        res = validate_queue(queue)
+        if not res.ok:
+            raise ValidationError(res)
+        existing = self.store.get("Queue", "", queue.metadata.name)
+        if existing is None:
+            return self.store.create(queue)
+        existing.spec = queue.spec
         return self.store.update(existing)
 
     def apply_yaml(self, text: str) -> List[PodCliqueSet]:

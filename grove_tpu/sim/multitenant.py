@@ -1,0 +1,218 @@
+"""Multi-tenant contended scenario (quota subsystem, docs/quota.md).
+
+The shared driver behind ``make quota-smoke`` (scripts/quota_smoke.py), the
+bench's ``"quota"`` artifact block, and tests/test_quota.py: N tenant
+queues with deserved shares that sum to the cluster's capacity, each tenant
+submitting more gangs than its share covers — so fair-share ordering and
+cross-queue reclaim must drive every queue to within ±1 gang of deserved.
+
+The scenario deliberately STAGGERS arrival (the first tenant converges
+alone and monopolizes the cluster) so convergence REQUIRES reclaim, not
+just fair admission ordering from an empty cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import PodCliqueSet, Queue, QueueSpec
+
+_TENANT_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: placeholder
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: worker
+        spec:
+          roleName: role-worker
+          replicas: 1
+          podSpec:
+            containers:
+              - name: worker
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 1
+"""
+
+
+def tenant_queue(
+    name: str,
+    deserved_cpu: float,
+    ceiling_cpu: Optional[float] = None,
+) -> Queue:
+    spec = QueueSpec(deserved={"cpu": float(deserved_cpu)})
+    if ceiling_cpu is not None:
+        spec.ceiling = {"cpu": float(ceiling_cpu)}
+    return Queue(metadata=ObjectMeta(name=name), spec=spec)
+
+
+def tenant_pcs(tenant: str, index: int, namespace: Optional[str] = None) -> PodCliqueSet:
+    """One 1-pod / 1-cpu gang for `tenant`, queue-labeled, in the tenant's
+    own namespace (exercises the cross-namespace event attribution the
+    QuotaReclaim tests pin)."""
+    from grove_tpu.api.meta import deep_copy
+
+    pcs = deep_copy(_TENANT_BASE)
+    pcs.metadata.name = f"{tenant}-{index:03d}"
+    pcs.metadata.namespace = namespace or tenant
+    pcs.metadata.labels[namegen.LABEL_QUEUE] = tenant
+    return pcs
+
+
+_TENANT_BASE = load_podcliquesets(_TENANT_YAML)[0]
+
+
+def build_contended_harness(
+    tenants: Sequence[Tuple[str, float, int]] = (
+        ("team-a", 6.0, 12),
+        ("team-b", 4.0, 12),
+        ("team-c", 2.0, 12),
+    ),
+    node_cpu: float = 2.0,
+    stagger: bool = True,
+):
+    """(harness, tenants): cluster capacity == sum of deserved shares; each
+    tenant submits `gangs` 1-cpu gangs. With ``stagger`` the first tenant
+    converges alone first (and hogs the cluster), forcing reclaim."""
+    from grove_tpu.sim.cluster import Node
+    from grove_tpu.sim.harness import SimHarness
+
+    total_cpu = sum(d for _, d, _ in tenants)
+    n_nodes = max(1, int(round(total_cpu / node_cpu)))
+    harness = SimHarness(num_nodes=1)
+    harness.cluster.nodes = [
+        Node(
+            name=f"node-{i}",
+            capacity={"cpu": node_cpu},
+            labels={"kubernetes.io/hostname": f"node-{i}"},
+        )
+        for i in range(n_nodes)
+    ]
+    for name, deserved, _ in tenants:
+        harness.apply_queue(tenant_queue(name, deserved))
+    # pre-compile the ordering scan for this workload's padded shape so the
+    # measured order_seconds reflect steady-state cost, not one XLA compile
+    harness.scheduler.quota.warm(
+        len(tenants) + 1, max(g for _, _, g in tenants)
+    )
+    first, rest = tenants[0], tenants[1:]
+    for i in range(first[2]):
+        harness.apply(tenant_pcs(first[0], i))
+    if stagger:
+        harness.converge(max_ticks=120)
+    for name, _, gangs in rest:
+        for i in range(gangs):
+            harness.apply(tenant_pcs(name, i))
+    return harness, list(tenants)
+
+
+def metrics_baseline() -> Dict[str, float]:
+    """Snapshot of the process-global counters the contended report deltas
+    against (the bench runs other workloads in the same process first)."""
+    from grove_tpu.observability.metrics import METRICS
+
+    return {
+        "order": METRICS.hist_sum.get("quota_order_seconds", 0.0),
+        "solver": METRICS.hist_sum.get("gang_solve_seconds", 0.0),
+        "reclaims": METRICS.counters.get("quota_reclaims_total", 0),
+    }
+
+
+def contended_report(harness, tenants, base: Optional[Dict] = None) -> Dict:
+    """Per-queue achieved vs deserved (in gangs), reclaim count, and the
+    ordering-overhead share of solver wall time (deltas vs `base`)."""
+    from grove_tpu.observability.metrics import METRICS
+    from grove_tpu.quota.manager import quota_snapshot
+
+    base = base or {"order": 0.0, "solver": 0.0, "reclaims": 0}
+    snap = {row["name"]: row for row in quota_snapshot(harness.store)}
+    per_queue = {}
+    converged = True
+    for name, deserved_cpu, _ in tenants:
+        achieved = snap.get(name, {}).get("admittedGangs", 0)
+        deserved_gangs = deserved_cpu  # 1 cpu per gang in this scenario
+        ok = abs(achieved - deserved_gangs) <= 1.0
+        converged = converged and ok
+        per_queue[name] = {
+            "deserved_gangs": deserved_gangs,
+            "achieved_gangs": achieved,
+            "dominant_share": round(snap.get(name, {}).get("dominantShare", 0.0), 4),
+            "within_one_gang": ok,
+        }
+    order_s = (
+        METRICS.hist_sum.get("quota_order_seconds", 0.0) - base["order"]
+    )
+    solver_s = (
+        METRICS.hist_sum.get("gang_solve_seconds", 0.0) - base["solver"]
+    )
+    return {
+        "tenants": per_queue,
+        "within_one_gang": converged,
+        "reclaims": int(
+            METRICS.counters.get("quota_reclaims_total", 0)
+            - base["reclaims"]
+        ),
+        "order_seconds": round(order_s, 4),
+        "solver_seconds": round(solver_s, 4),
+        "order_overhead_ratio": round(order_s / solver_s, 4) if solver_s else 0.0,
+    }
+
+
+def run_contended(
+    tenants: Sequence[Tuple[str, float, int]] = (
+        ("team-a", 6.0, 12),
+        ("team-b", 4.0, 12),
+        ("team-c", 2.0, 12),
+    ),
+    max_ticks: int = 200,
+) -> Tuple[object, Dict]:
+    base = metrics_baseline()
+    harness, tenants = build_contended_harness(tenants)
+    harness.converge(max_ticks=max_ticks)
+    return harness, contended_report(harness, tenants, base)
+
+
+def single_queue_ab(n_sets: int = 24, num_nodes: int = 16) -> Dict:
+    """A/B guard: the same workload with NO Queue CRs vs EVERYTHING in one
+    queue must produce identical admissions (pod -> node bindings), pinning
+    the single-queue bit-identical contract end to end."""
+    import time as _time
+
+    from grove_tpu.api.meta import deep_copy
+    from grove_tpu.sim.harness import SimHarness
+
+    def run(with_queue: bool):
+        harness = SimHarness(num_nodes=num_nodes)
+        if with_queue:
+            harness.apply_queue(tenant_queue("everyone", 1e9))
+        t0 = _time.perf_counter()
+        for i in range(n_sets):
+            pcs = deep_copy(_TENANT_BASE)
+            pcs.metadata.name = f"svc-{i:04d}"
+            if with_queue:
+                pcs.metadata.labels[namegen.LABEL_QUEUE] = "everyone"
+            harness.apply(pcs)
+        harness.converge(max_ticks=60 + n_sets)
+        wall = _time.perf_counter() - t0
+        bindings = sorted(
+            (ns, name, node)
+            for (ns, name), node in harness.cluster.bindings.items()
+        )
+        return bindings, wall
+
+    base_bindings, base_wall = run(False)
+    quota_bindings, quota_wall = run(True)
+    return {
+        "identical_admissions": base_bindings == quota_bindings,
+        "admitted_pods": len(base_bindings),
+        "base_wall_s": round(base_wall, 3),
+        "quota_wall_s": round(quota_wall, 3),
+    }

@@ -36,11 +36,14 @@ from grove_tpu.observability.events import (
     REASON_GANG_DEFERRED,
     REASON_POD_BOUND,
     REASON_PREEMPTED,
+    REASON_QUEUE_PENDING,
+    REASON_QUOTA_RECLAIM,
     TYPE_NORMAL,
     TYPE_WARNING,
 )
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.observability.tracing import TRACER
+from grove_tpu.quota.manager import QuotaManager, spec_demand
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.store import Store
 from grove_tpu.sim.cluster import SimCluster
@@ -76,6 +79,10 @@ class GangScheduler:
         # template seen, never shrinks — pending-mix churn must not force
         # per-shape recompiles of the wave program
         self._pad_groups = StickyGroupPad()
+        # multi-tenant quota & fair-share (grove_tpu/quota, docs/quota.md):
+        # with no Queue CRs the subsystem is inert — the solve order stays
+        # byte-identical to the flat (-priority, name) sort
+        self.quota = QuotaManager(store)
         self._sidecar_client = None
         # per-solve gRPC deadline; past it the sidecar aborts the solve
         # server-side (DEADLINE_EXCEEDED) and we fall back in-process
@@ -299,13 +306,18 @@ class GangScheduler:
                 gang_pods.update(pods)
                 loose_pods.extend((ns, p) for p in loose)
 
-        # global priority order across all namespaces (kernel admits in
-        # input order; ties broken by name for determinism)
-        order = sorted(
-            range(len(gang_specs)),
-            key=lambda i: (-gang_specs[i]["priority"], gang_specs[i]["name"]),
-        )
-        gang_specs = [gang_specs[i] for i in order]
+        # global solve order across all namespaces (kernel admits in input
+        # order): the quota manager's fair-share pass when Queue CRs exist,
+        # else the flat (-priority, name) sort — byte-identical to the
+        # pre-quota path (guard rail pinned in tests/test_quota.py)
+        gang_specs, held = self._order_with_quota(gang_specs)
+        for spec, reason in held:
+            EVENTS.record(
+                ("PodGang", spec["namespace"], spec["gang_name"]),
+                TYPE_WARNING,
+                REASON_QUEUE_PENDING,
+                reason,
+            )
 
         bound = 0
         if gang_specs:
@@ -319,7 +331,16 @@ class GangScheduler:
                 # paths; unadmitted gangs retry on the next control round)
                 result, problem = self._solve_batch(nodes, gang_specs, free)
                 METRICS.observe("gang_solve_seconds", result.solve_seconds)
-                preempted = self._maybe_preempt(gang_specs, result)
+                preempted, preempt_free = self._maybe_preempt(
+                    gang_specs, result
+                )
+                if self.quota.active():
+                    with TRACER.span("quota.reclaim") as rspan:
+                        reclaimed = self._maybe_reclaim(
+                            gang_specs, result, preempted, preempt_free
+                        )
+                        rspan.set("victims", len(reclaimed))
+                    preempted |= reclaimed
                 assignments = result.assignments(problem)
                 to_mark = []
                 with TRACER.span(
@@ -461,6 +482,76 @@ class GangScheduler:
                         required
                     )
         return False
+
+    # -- quota ordering & status (grove_tpu/quota, docs/quota.md) --------
+
+    def _order_with_quota(self, gang_specs: List[dict]):
+        """Fair-share solve order when Queue CRs exist; the flat
+        (-priority, name) sort otherwise. Returns (ordered_specs, held)."""
+        if not self.quota.active():
+            return (
+                sorted(
+                    gang_specs,
+                    key=lambda s: (-s["priority"], s["name"]),
+                ),
+                [],
+            )
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with TRACER.span(
+            "quota.order", gangs=len(gang_specs)
+        ) as span:
+            ordered, held = self.quota.order_specs(gang_specs)
+            span.set("held", len(held))
+            span.set("queues", len(self.quota.last_rows))
+        METRICS.observe("quota_order_seconds", _time.perf_counter() - t0)
+        self._write_queue_status()
+        return ordered, held
+
+    def _write_queue_status(self) -> None:
+        """Per-queue status + gauges after an ordering pass (write-on-
+        change: the copy-on-write commit suppresses no-op writes)."""
+        from grove_tpu.api.types import QueueStatus
+
+        rows = {row["name"]: row for row in self.quota.last_rows}
+        admitted: Dict[str, int] = {}
+        for gang in self.store.scan("PodGang"):
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is not None and cond.is_true():
+                queue = (
+                    gang.metadata.labels.get(namegen.LABEL_QUEUE)
+                    or self.quota.default_queue
+                )
+                admitted[queue] = admitted.get(queue, 0) + 1
+        for name, row in rows.items():
+            METRICS.set(
+                f"queue_dominant_share/{name}", row["dominant_share"]
+            )
+            METRICS.set(f"queue_pending_gangs/{name}", row["pending"])
+            METRICS.set(
+                f"queue_admitted_gangs/{name}", admitted.get(name, 0)
+            )
+            cr = row["cr"]
+            if cr is None:
+                continue  # implicit queue (no CR to carry status)
+            st = QueueStatus(
+                usage={r: round(v, 9) for r, v in row["usage"].items()},
+                dominant_share=round(row["dominant_share"], 6),
+                admitted_gangs=admitted.get(name, 0),
+                pending_gangs=row["pending"],
+                conditions=list(cr.status.conditions),
+            )
+            if (
+                st.usage == cr.status.usage
+                and st.dominant_share == cr.status.dominant_share
+                and st.admitted_gangs == cr.status.admitted_gangs
+                and st.pending_gangs == cr.status.pending_gangs
+            ):
+                continue
+            self._commit_status_tolerant(cr, st)
 
     # -- helpers ---------------------------------------------------------
 
@@ -649,6 +740,12 @@ class GangScheduler:
                     "priority": self.priority_map.get(
                         gang_cr.spec.priority_class_name, 0
                     ),
+                    # tenant queue (quota subsystem): operator-propagated
+                    # label; unlabeled gangs land in the default queue
+                    "queue": gang_cr.metadata.labels.get(
+                        namegen.LABEL_QUEUE
+                    )
+                    or self.quota.default_queue,
                 }
             )
             gang_pods[f"{namespace}/{gang_name}"] = dict(by_pclq)
@@ -747,7 +844,7 @@ class GangScheduler:
 
     # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----
 
-    def _maybe_preempt(self, gang_specs, result) -> set:
+    def _maybe_preempt(self, gang_specs, result):
         """Higher-priority pending gangs that the solver could not admit may
         evict strictly-lower-priority scheduled gangs: victims get the
         DisruptionTarget condition (scheduler podgang.go:157-165) and their
@@ -770,7 +867,12 @@ class GangScheduler:
         successful trial the victim set is PRUNED to an inclusion-minimal
         one: victims whose removal keeps the trial admitting are dropped,
         highest-priority candidates first, so a topology-constrained
-        preemptor never evicts gangs on nodes irrelevant to its pack."""
+        preemptor never evicts gangs on nodes irrelevant to its pack.
+
+        Returns (victim_keys, base_free) — base_free is the shared capacity
+        snapshot WITH every preemptor's planned placement debited, handed
+        to quota reclaim so it never double-spends preemptor-earmarked
+        capacity (None when no preemption round ran)."""
         rejected = sorted(
             (
                 spec
@@ -780,10 +882,10 @@ class GangScheduler:
             key=lambda s: (-s["priority"], s["name"]),
         )
         if not rejected:
-            return set()
+            return set(), None
         nodes = [n for n in self.cluster.nodes if not n.cordoned]
         if not nodes:
-            return set()
+            return set(), None
 
         # Snapshot free capacity ONCE: _evict_victim deletes victim pods from
         # the store, which would silently add the freed capacity to every
@@ -813,7 +915,7 @@ class GangScheduler:
                 acc = base_free.setdefault(node_name, {})
                 for r, q in caps.items():
                     acc[r] = acc.get(r, 0.0) + q
-        return all_victim_keys
+        return all_victim_keys, base_free
 
     @staticmethod
     def _placement_usage(result, problem, preemptor: dict) -> Dict:
@@ -871,7 +973,20 @@ class GangScheduler:
         victims.sort(
             key=lambda v: (v[0], v[1].metadata.namespace, v[1].metadata.name)
         )
+        return self._trial_victim_selection(
+            preemptor, nodes, base_free, [g for _, g in victims]
+        )
 
+    def _trial_victim_selection(
+        self, preemptor: dict, nodes: List, base_free: Dict, ordered_victims: List
+    ):
+        """Shared trial-solve machinery (priority preemption AND quota
+        reclaim): accumulate candidate victims in preference order until
+        their freed capacity covers the preemptor's aggregate floor demand,
+        verify with a trial solve against the hypothetically-freed cluster,
+        prune to an inclusion-minimal set (latest-accumulated dropped
+        first), and return (victims, free_delta) where free_delta = freed
+        capacity − the preemptor's planned placement."""
         demand_total: Dict[str, float] = {}
         for group in preemptor["groups"]:
             for r, q in group["demand"].items():
@@ -898,12 +1013,12 @@ class GangScheduler:
                         caps[r] = caps.get(r, 0.0) + q
             return per_node
 
-        # accumulate lowest-priority-first until cluster-total freed covers
+        # accumulate in preference order until cluster-total freed covers
         # the preemptor's aggregate floor demand (necessary condition)
         freed: Dict[str, float] = {}
         chosen: List = []
         chosen_freed: List[Dict[str, Dict[str, float]]] = []
-        for _, gang in victims:
+        for gang in ordered_victims:
             per_node = gang_freed_per_node(gang)
             if not per_node:
                 continue  # nothing bound → eviction frees nothing
@@ -961,11 +1076,244 @@ class GangScheduler:
                     acc[r] = acc.get(r, 0.0) + q
         return [chosen[i] for i in keep], delta
 
-    def _evict_victim(self, gang, preemptor: dict) -> None:
-        # retry-with-fresh-read: the Preempted status and the pod deletions
+    # -- quota reclaim (docs/quota.md "reclaim vs preemption") ------------
+
+    def _gang_requests_total(self, gang) -> Dict[str, float]:
+        """Cluster-total resources the gang's BOUND pods hold (what evicting
+        it returns to its queue)."""
+        out: Dict[str, float] = {}
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                if self.cluster.bindings.get((ref.namespace, ref.name)) is None:
+                    continue
+                pod = self.store.get(
+                    "Pod", ref.namespace, ref.name, readonly=True
+                )
+                if pod is None:
+                    continue
+                for r, v in pod.spec.total_requests().items():
+                    out[r] = out.get(r, 0.0) + v
+        return out
+
+    def _reclaim_pool(self, crs: Dict, exclude: set) -> List:
+        """ONE scan's worth of potential reclaim victims for the whole
+        round: every scheduled gang with bound capacity, tagged with its
+        queue, the queue's deserved shares, freed totals, and priority.
+        Per-claimant filtering (shares, budgets) happens against this pool
+        — the scan and the per-pod reads must not repeat per claimant."""
+        pool = []
+        for gang in self.store.scan("PodGang"):
+            key = (gang.metadata.namespace, gang.metadata.name)
+            if key in exclude:
+                continue
+            cond = get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+            if cond is None or not cond.is_true():
+                continue
+            queue = (
+                gang.metadata.labels.get(namegen.LABEL_QUEUE)
+                or self.quota.default_queue
+            )
+            freed = self._gang_requests_total(gang)
+            if not freed:
+                continue  # nothing bound -> eviction frees nothing
+            cr = crs.get(queue)
+            deserved = dict(cr.spec.deserved) if cr is not None else {}
+            priority = self.priority_map.get(gang.spec.priority_class_name, 0)
+            pool.append((gang, queue, deserved, freed, priority))
+        return pool
+
+    @staticmethod
+    def _reclaim_candidates(
+        pool: List, claimant: dict, usage_sim: Dict, exclude: set
+    ) -> List:
+        """Victim candidates for one claimant from the round's shared pool,
+        in eviction-preference order: scheduled gangs of OTHER queues
+        strictly above their deserved share, whose eviction keeps their
+        queue at/above deserved (zero-deserved queues are always
+        reclaimable — they are entitled to nothing). The stay-above-
+        deserved budget is applied sequentially against a running usage
+        sim, so multiple victims from one queue can't collectively drag it
+        below deserved; pruning only ever REMOVES victims, which keeps the
+        invariant. Returns [(gang, freed_totals)]."""
+        from grove_tpu.quota.oracle import dominant_share_of
+
+        scored = []
+        for gang, queue, deserved, freed, priority in pool:
+            if (gang.metadata.namespace, gang.metadata.name) in exclude:
+                continue
+            if queue == claimant["queue"]:
+                continue
+            share = dominant_share_of(usage_sim.get(queue, {}), deserved)
+            if deserved and share <= 1.0 + 1e-6:
+                continue  # at/below deserved: protected from reclaim
+            if not deserved and share <= 0.0:
+                continue  # zero-deserved queue with no usage
+            scored.append((share, priority, queue, deserved, freed, gang))
+        # most-over-deserved queue first; within it lowest priority, name
+        scored.sort(
+            key=lambda t: (
+                -t[0],
+                t[1],
+                t[5].metadata.namespace,
+                t[5].metadata.name,
+            )
+        )
+        out = []
+        sim = {q: dict(v) for q, v in usage_sim.items()}
+        for share, _prio, queue, deserved, freed, gang in scored:
+            row = sim.get(queue, {})
+            after = {r: row.get(r, 0.0) - freed.get(r, 0.0) for r in row}
+            if deserved and dominant_share_of(after, deserved) < 1.0 - 1e-6:
+                continue  # would drag the victim queue below deserved
+            out.append((gang, freed))
+            sim[queue] = after
+        return out
+
+    def _maybe_reclaim(
+        self,
+        gang_specs: List[dict],
+        result,
+        already_evicted: set,
+        preempt_free: Optional[Dict] = None,
+    ) -> set:
+        """Cross-queue quota reclaim: a pending gang whose queue sits BELOW
+        its deserved share may evict gangs from queues ABOVE theirs —
+        priority plays no part across queues (that is what distinguishes
+        reclaim from preemption; docs/quota.md). Reuses the preemption
+        trial-solve machinery, so reclaim never evicts without a feasible
+        placement for the claimant; victim queues never drop below their
+        deserved share (no reclaim ping-pong), and each claimant's planned
+        placement is debited from the shared capacity snapshot so later
+        claimants can't double-spend. Returns victim (ns, name) keys."""
+        crs = self.quota.queue_crs()
+        if not crs:
+            return set()
+        usage_sim = {
+            q: dict(v) for q, v in self.quota.accountant.snapshot().items()
+        }
+        claimants = []
+        for i, spec in enumerate(gang_specs):
+            if result.admitted[i]:
+                continue
+            if (spec["namespace"], spec["gang_name"]) in already_evicted:
+                continue
+            cr = crs.get(spec["queue"])
+            if cr is None or not cr.spec.deserved:
+                continue  # no entitlement -> nothing to reclaim toward
+            claimants.append(spec)
+        if not claimants:
+            return set()
+        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        if not nodes:
+            return set()
+        from grove_tpu.quota.oracle import dominant_share_of
+
+        # shared capacity snapshot across claimants (same double-spend
+        # guard as _maybe_preempt) — and when a preemption round ran this
+        # round, START from ITS snapshot: the priority preemptors' planned
+        # placements are already debited there, so reclaim trial solves
+        # can't clear on capacity a preemptor is about to consume
+        base_free = preempt_free or {
+            node.name: dict(self.cluster.node_free(node)) for node in nodes
+        }
+        # one PodGang scan + per-pod reads for the whole round; claimants
+        # re-filter this pool against the evolving usage sim
+        pool = self._reclaim_pool(crs, already_evicted)
+
+        def claimant_key(spec):
+            share = dominant_share_of(
+                usage_sim.get(spec["queue"], {}),
+                dict(crs[spec["queue"]].spec.deserved),
+            )
+            return (share, -spec["priority"], spec["name"])
+
+        evicted: set = set()
+        for claimant in sorted(claimants, key=claimant_key):
+            deserved = dict(crs[claimant["queue"]].spec.deserved)
+            share = dominant_share_of(
+                usage_sim.get(claimant["queue"], {}), deserved
+            )
+            if share >= 1.0 - 1e-6:
+                continue  # queue reached deserved (earlier claimant did it)
+            candidates = self._reclaim_candidates(
+                pool, claimant, usage_sim, evicted
+            )
+            # solo-fit short-circuit lives inside the shared machinery via
+            # the solo trial in _trial_victim_selection's caller — here the
+            # claimant failing this round's solve is the signal; still, a
+            # gang that fits current free capacity places next round on its
+            # own, so never evict for it (but debit its placement)
+            solo, solo_problem = self._solve_batch(
+                nodes, [claimant], base_free
+            )
+            if solo.admitted[0]:
+                delta = self._placement_usage(solo, solo_problem, claimant)
+                victims = []
+            elif candidates:
+                victims, delta = self._trial_victim_selection(
+                    claimant, nodes, base_free, [g for g, _ in candidates]
+                )
+            else:
+                continue
+            freed_by_key = {
+                (g.metadata.namespace, g.metadata.name): freed
+                for g, freed in candidates
+            }
+            for gang in victims:
+                key = (gang.metadata.namespace, gang.metadata.name)
+                self._evict_victim(
+                    gang,
+                    claimant,
+                    disruption_reason="QuotaReclaimed",
+                    sched_reason="Reclaimed",
+                    event_reason=REASON_QUOTA_RECLAIM,
+                    message=(
+                        f"reclaimed for {claimant['name']} "
+                        f"(queue {claimant['queue']} below deserved share)"
+                    ),
+                    metric="quota_reclaims_total",
+                )
+                evicted.add(key)
+                # return the victim's capacity to the usage sim so later
+                # budget checks see it gone
+                queue = (
+                    gang.metadata.labels.get(namegen.LABEL_QUEUE)
+                    or self.quota.default_queue
+                )
+                row = usage_sim.setdefault(queue, {})
+                for r, v in freed_by_key.get(key, {}).items():
+                    row[r] = row.get(r, 0.0) - v
+            if victims or solo.admitted[0]:
+                # charge the claimant's demand to its queue so a sibling
+                # claimant doesn't over-reclaim toward the same entitlement
+                row = usage_sim.setdefault(claimant["queue"], {})
+                for r, v in spec_demand(claimant).items():
+                    row[r] = row.get(r, 0.0) + v
+            for node_name, caps in delta.items():
+                acc = base_free.setdefault(node_name, {})
+                for r, q in caps.items():
+                    acc[r] = acc.get(r, 0.0) + q
+        return evicted
+
+    def _evict_victim(
+        self,
+        gang,
+        preemptor: dict,
+        *,
+        disruption_reason: str = "PreemptedByHigherPriority",
+        sched_reason: str = "Preempted",
+        event_reason: str = REASON_PREEMPTED,
+        message: Optional[str] = None,
+        metric: str = "gang_preemptions_total",
+    ) -> None:
+        """Evict a scheduled gang — shared by priority preemption (default
+        reasons) and quota reclaim (QuotaReclaimed / QuotaReclaim). The
+        victim-side Event names the claimant, in the VICTIM's namespace."""
+        # retry-with-fresh-read: the eviction status and the pod deletions
         # must land together, or a conflicted write would leave evicted pods
         # with a gang still claiming Scheduled=True
         ns, name = gang.metadata.namespace, gang.metadata.name
+        message = message or f"preempted by {preemptor['name']}"
         for _ in range(4):
             fresh = self.store.get("PodGang", ns, name)
             if fresh is None:
@@ -976,8 +1324,8 @@ class GangScheduler:
                 Condition(
                     type=COND_PODGANG_DISRUPTION_TARGET,
                     status="True",
-                    reason="PreemptedByHigherPriority",
-                    message=f"preempted by {preemptor['name']}",
+                    reason=disruption_reason,
+                    message=message,
                 ),
                 now,
             )
@@ -986,8 +1334,8 @@ class GangScheduler:
                 Condition(
                     type=COND_PODGANG_SCHEDULED,
                     status="False",
-                    reason="Preempted",
-                    message=f"preempted by {preemptor['name']}",
+                    reason=sched_reason,
+                    message=message,
                 ),
                 now,
             )
@@ -1007,10 +1355,12 @@ class GangScheduler:
         EVENTS.record(
             ("PodGang", ns, name),
             TYPE_WARNING,
-            REASON_PREEMPTED,
-            f"preempted by higher-priority gang {preemptor['name']}",
+            event_reason,
+            message
+            if event_reason == REASON_QUOTA_RECLAIM
+            else f"preempted by higher-priority gang {preemptor['name']}",
         )
-        METRICS.inc("gang_preemptions_total")
+        METRICS.inc(metric)
 
     def update_gang_health(self, namespace: str = "default") -> None:
         """Unhealthy condition: any constituent PCLQ currently breaching

@@ -67,6 +67,13 @@ def _module_stats(mlir_text: str) -> dict:
     }
 
 
+def _aval_str(a) -> str:
+    """Version-stable aval fingerprint: str(ShapedArray) flips between jax
+    releases ('float32[5120,2]' vs 'ShapedArray(float32[5120,2])'), so the
+    committed meta and the drift tests share this canonical form."""
+    return f"{a.dtype}[{','.join(str(d) for d in a.shape)}]"
+
+
 def _export_one(name: str, fn, args, kwargs, static, meta_extra=None):
     import jax
     from jax import export
@@ -82,7 +89,7 @@ def _export_one(name: str, fn, args, kwargs, static, meta_extra=None):
         "sha256": hashlib.sha256(data).hexdigest(),
         "platforms": list(exp.platforms),
         "nr_devices": exp.nr_devices,
-        "in_avals": [str(a) for a in exp.in_avals],
+        "in_avals": [_aval_str(a) for a in exp.in_avals],
         "module_ops": _module_stats(mlir),
         "static": {k: str(v) for k, v in static.items()},
     }

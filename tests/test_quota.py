@@ -1,0 +1,471 @@
+"""Quota & fair-share queueing subsystem (grove_tpu/quota, docs/quota.md).
+
+Pins, in order of importance:
+1. the vectorized fair-share ordering == the pure-Python oracle, BIT-exact,
+   across randomized queue trees (ties, zero-deserved queues, drained
+   queues, fractional demands);
+2. the guard rail: NO Queue CRs -> solve order and admissions byte-identical
+   to the flat (-priority, name) path (single-queue A/B included);
+3. the incremental usage accountant == a full rescan after randomized event
+   storms;
+4. reclaim end to end: a tenant below its deserved share evicts an
+   over-share tenant's gangs, with QuotaReclaim events carrying victim +
+   claimant identity in the VICTIM's namespace (PR 1 event-namespace
+   convention);
+5. ceilings hold gangs with QueuePending; GET /queues and Queue admission.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Queue, QueueSpec
+from grove_tpu.observability.events import (
+    EVENTS,
+    REASON_QUEUE_PENDING,
+    REASON_QUOTA_RECLAIM,
+)
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.quota.oracle import fair_order_oracle, usage_oracle
+from grove_tpu.quota.ordering import fair_order
+from grove_tpu.sim.harness import SimHarness
+from grove_tpu.sim.multitenant import (
+    run_contended,
+    single_queue_ab,
+    tenant_pcs,
+    tenant_queue,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    EVENTS.reset()
+    yield
+    EVENTS.reset()
+    EVENTS.clock = None
+
+
+# ---------------------------------------------------------------------------
+# 1. vectorized ordering == oracle
+# ---------------------------------------------------------------------------
+
+
+class TestOrderingEquivalence:
+    # ONE padded shape for every randomized case -> one XLA compile total
+    Q, G, R = 8, 16, 4
+
+    def _random_case(self, rng):
+        Q, G, R = self.Q, self.G, self.R
+        n_q = int(rng.integers(1, Q + 1))
+        n_r = int(rng.integers(1, R + 1))
+        deserved = np.zeros((Q, R), np.float32)
+        usage = np.zeros((Q, R), np.float32)
+        demand = np.zeros((Q, G, R), np.float32)
+        counts = np.zeros((Q,), np.int32)
+        for q in range(n_q):
+            # zero-deserved queues appear with probability ~1/4
+            if rng.random() > 0.25:
+                deserved[q, :n_r] = rng.integers(0, 5, n_r)
+            if rng.random() > 0.3:
+                usage[q, :n_r] = rng.integers(0, 9, n_r) * rng.choice(
+                    [0.25, 0.5, 1.0, 2.0]
+                )
+            counts[q] = rng.integers(0, G + 1)
+            demand[q, :, :n_r] = rng.integers(0, 4, (G, n_r)) * rng.choice(
+                [0.5, 1.0]
+            )
+        # engineered ties: clone a row onto a later queue ~half the time
+        if n_q >= 2 and rng.random() > 0.5:
+            src, dst = rng.choice(n_q, 2, replace=False)
+            deserved[dst] = deserved[src]
+            usage[dst] = usage[src]
+        return deserved, usage, demand, counts
+
+    def test_randomized_trees_match_oracle(self):
+        rng = np.random.default_rng(7)
+        for trial in range(200):
+            deserved, usage, demand, counts = self._random_case(rng)
+            got = fair_order(deserved, usage, demand, counts)
+            want = fair_order_oracle(deserved, usage, demand, counts)
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"trial {trial}"
+            )
+
+    def test_ties_break_by_queue_index(self):
+        # two identical queues: strict alternation starting at index 0
+        deserved = np.array([[2.0], [2.0]], np.float32)
+        usage = np.zeros((2, 1), np.float32)
+        demand = np.ones((2, 4, 1), np.float32)
+        counts = np.array([4, 4], np.int32)
+        order = fair_order(deserved, usage, demand, counts)
+        assert order[:, 0].tolist() == [0, 1, 0, 1, 0, 1, 0, 1]
+
+    def test_zero_deserved_queue_orders_last_once_used(self):
+        # q0 entitled, q1 zero-deserved: q1 goes first only while unused
+        # (share 0 ties, queue index breaks toward q0), then always last
+        deserved = np.array([[4.0], [0.0]], np.float32)
+        usage = np.zeros((2, 1), np.float32)
+        demand = np.ones((2, 3, 1), np.float32)
+        counts = np.array([3, 3], np.int32)
+        order = fair_order(deserved, usage, demand, counts)[:, 0].tolist()
+        # q0 at share 0 picks first; q1 (still zero usage) ties at 0 and
+        # follows; once q1 holds usage its share explodes -> q0 drains fully
+        assert order[0] == 0 and order[1] == 1
+        assert order[2:5] == [0, 0, 1] or order[2:] == [0, 0, 1, 1]
+        # the vectorized pass IS the contract — oracle agrees regardless
+        np.testing.assert_array_equal(
+            fair_order(deserved, usage, demand, counts),
+            fair_order_oracle(deserved, usage, demand, counts),
+        )
+
+    def test_empty_and_drained_inputs(self):
+        z = np.zeros((0, 2), np.float32)
+        assert fair_order(z, z, np.zeros((0, 4, 2), np.float32),
+                          np.zeros((0,), np.int32)).shape == (0, 2)
+        deserved = np.ones((2, 1), np.float32)
+        out = fair_order(
+            deserved,
+            np.zeros((2, 1), np.float32),
+            np.ones((2, 2, 1), np.float32),
+            np.array([0, 0], np.int32),
+        )
+        assert out.shape == (0, 2)
+
+
+# ---------------------------------------------------------------------------
+# 2. guard rail: no queues == the pre-quota path, byte for byte
+# ---------------------------------------------------------------------------
+
+
+class TestGuardRail:
+    def test_order_without_queues_is_flat_priority_sort(self):
+        harness = SimHarness(num_nodes=2)
+        specs = [
+            {"name": f"ns/g{i}", "priority": p, "queue": "default",
+             "namespace": "ns", "gang_name": f"g{i}", "groups": []}
+            for i, p in enumerate([0, 5, 5, 1, 0, 3])
+        ]
+        rng_order = [specs[i] for i in (3, 0, 5, 1, 4, 2)]
+        ordered, held = harness.scheduler._order_with_quota(list(rng_order))
+        assert held == []
+        assert ordered == sorted(
+            rng_order, key=lambda s: (-s["priority"], s["name"])
+        )
+
+    def test_single_queue_admissions_byte_identical(self):
+        """End-to-end A/B: same workload, no queues vs everything in ONE
+        queue -> identical (namespace, pod, node) bindings."""
+        report = single_queue_ab(n_sets=8, num_nodes=8)
+        assert report["identical_admissions"], report
+        assert report["admitted_pods"] == 8
+
+    def test_all_gangs_one_queue_order_matches_flat(self):
+        harness = SimHarness(num_nodes=2)
+        harness.apply_queue(tenant_queue("only", 100.0))
+        specs = [
+            {"name": f"ns/g{i}", "priority": p, "queue": "only",
+             "namespace": "ns", "gang_name": f"g{i}",
+             "groups": [{"demand": {"cpu": 1.0}, "count": 1,
+                         "min_count": 1, "name": f"ns/g{i}-m",
+                         "partial": False}]}
+            for i, p in enumerate([2, 0, 7, 7, 1])
+        ]
+        ordered, held = harness.scheduler._order_with_quota(list(specs))
+        assert held == []
+        assert ordered == sorted(
+            specs, key=lambda s: (-s["priority"], s["name"])
+        )
+
+
+# ---------------------------------------------------------------------------
+# 3. incremental accountant == full rescan
+# ---------------------------------------------------------------------------
+
+
+def _make_pod(store, ns, name, queue, cpu, extra=None):
+    from grove_tpu.api.pod import Pod
+    from grove_tpu.api.types import Container, PodSpec
+
+    labels = {namegen.LABEL_QUEUE: queue} if queue else {}
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels),
+        spec=PodSpec(
+            containers=[
+                Container(name="c", requests={"cpu": cpu, **(extra or {})})
+            ]
+        ),
+    )
+    return store.create(pod)
+
+
+def _bind(store, ns, name):
+    from grove_tpu.api.meta import Condition, set_condition
+    from grove_tpu.api.pod import COND_POD_SCHEDULED
+
+    pod = store.get("Pod", ns, name)
+    set_condition(
+        pod.status.conditions,
+        Condition(type=COND_POD_SCHEDULED, status="True", reason="Bound"),
+        store.clock.now(),
+    )
+    store.update_status(pod)
+
+
+class TestAccountant:
+    def test_randomized_event_storm_matches_rescan(self):
+        from grove_tpu.quota.accountant import QuotaAccountant
+        from grove_tpu.runtime.clock import Clock
+        from grove_tpu.runtime.store import Store
+
+        rng = np.random.default_rng(3)
+        store = Store(Clock())
+        acc = QuotaAccountant()
+        store.subscribe_system(acc.on_event)
+        acc.ensure_built(store)
+        queues = ["team-a", "team-b", "team-c", None]
+        live = []
+        for step in range(300):
+            op = rng.random()
+            if op < 0.45 or not live:
+                name = f"p{step}"
+                q = queues[int(rng.integers(0, len(queues)))]
+                _make_pod(
+                    store, "ns", name, q,
+                    float(rng.integers(1, 5)) * 0.25,
+                    {"tpu": float(rng.integers(0, 3))},
+                )
+                live.append(name)
+                if rng.random() < 0.8:
+                    _bind(store, "ns", name)
+            elif op < 0.8:
+                name = live[int(rng.integers(0, len(live)))]
+                _bind(store, "ns", name)  # re-bind (no-op update)
+            else:
+                name = live.pop(int(rng.integers(0, len(live))))
+                store.delete("Pod", "ns", name)
+            if step % 50 == 0:
+                want = usage_oracle(store.scan("Pod"), "default")
+                got = acc.snapshot()
+                assert set(got) == set(want), (step, got, want)
+                for queue in want:
+                    for r in set(want[queue]) | set(got[queue]):
+                        assert got[queue].get(r, 0.0) == pytest.approx(
+                            want[queue].get(r, 0.0), abs=1e-9
+                        ), (step, queue, r)
+        # final exactness + row GC: drain everything -> no rows at all
+        for name in list(live):
+            store.delete("Pod", "ns", name)
+        assert acc.snapshot() == {}
+
+    def test_unlabeled_pods_land_in_default_queue(self):
+        from grove_tpu.quota.accountant import QuotaAccountant
+        from grove_tpu.runtime.clock import Clock
+        from grove_tpu.runtime.store import Store
+
+        store = Store(Clock())
+        acc = QuotaAccountant()
+        store.subscribe_system(acc.on_event)
+        acc.ensure_built(store)
+        _make_pod(store, "ns", "p0", None, 1.0)
+        _bind(store, "ns", "p0")
+        assert acc.snapshot() == {"default": {"cpu": 1.0}}
+
+
+# ---------------------------------------------------------------------------
+# 4. reclaim end to end (+ event namespace correctness)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def contended():
+    """One staggered 3-tenant contended run shared by the reclaim tests
+    (team-a converges alone first and hogs the cluster; b/c arrive after).
+    Events are snapshotted here — the per-test autouse reset wipes the
+    process-global recorder between tests."""
+    EVENTS.reset()
+    saved_reclaims = METRICS.counters.get("quota_reclaims_total", 0)
+    harness, report = run_contended(
+        tenants=(("team-a", 3.0, 6), ("team-b", 2.0, 6), ("team-c", 1.0, 6))
+    )
+    reclaim_events = EVENTS.list(reason=REASON_QUOTA_RECLAIM)
+    yield harness, report, saved_reclaims, reclaim_events
+    EVENTS.reset()
+    EVENTS.clock = None
+
+
+class TestReclaim:
+    def test_converges_within_one_gang_of_deserved(self, contended):
+        _, report, _, _ = contended
+        assert report["within_one_gang"], report
+
+    def test_reclaim_happened_and_is_counted(self, contended):
+        _, report, saved, _ = contended
+        assert report["reclaims"] > saved
+
+    def test_quota_reclaim_event_names_victim_and_claimant(self, contended):
+        """PR 1 event-namespace convention: the event is recorded on the
+        VICTIM PodGang in the victim's namespace, naming the claimant."""
+        _, _, _, events = contended
+        assert events, "no QuotaReclaim events recorded"
+        assert all(e.kind == "PodGang" for e in events)
+        # victims are team-a gangs, living in team-a's namespace; a
+        # hard-defaulted namespace would cross-attribute them
+        assert {e.namespace for e in events} == {"team-a"}
+        assert all(e.type == "Warning" for e in events)
+        # claimant identity (namespace/name + queue) in the message
+        assert any(
+            "team-b/" in e.message or "team-c/" in e.message
+            for e in events
+        ), [e.message for e in events]
+        assert all("below deserved share" in e.message for e in events)
+
+    def test_victim_gangs_carry_reclaim_conditions(self, contended):
+        from grove_tpu.api.meta import get_condition
+        from grove_tpu.api.types import (
+            COND_PODGANG_DISRUPTION_TARGET,
+            COND_PODGANG_SCHEDULED,
+        )
+
+        harness, _, _, _ = contended
+        reclaimed = [
+            g
+            for g in harness.store.list("PodGang", "team-a")
+            if (
+                c := get_condition(
+                    g.status.conditions, COND_PODGANG_DISRUPTION_TARGET
+                )
+            )
+            is not None
+            and c.reason == "QuotaReclaimed"
+        ]
+        assert reclaimed
+        for gang in reclaimed:
+            sched = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            assert sched is not None and not sched.is_true()
+            assert sched.reason == "Reclaimed"
+
+    def test_queue_status_written(self, contended):
+        harness, _, _, _ = contended
+        q = harness.store.get("Queue", "", "team-a")
+        assert q.status.dominant_share == pytest.approx(1.0, abs=0.34)
+        assert q.status.usage.get("cpu", 0.0) > 0
+        assert q.status.admitted_gangs >= 2
+
+    def test_ordering_overhead_small(self, contended):
+        _, report, _, _ = contended
+        assert report["order_overhead_ratio"] <= 0.05, report
+
+
+# ---------------------------------------------------------------------------
+# 5. ceilings, GET /queues, admission
+# ---------------------------------------------------------------------------
+
+
+class TestCeiling:
+    def test_ceiling_holds_gang_with_queue_pending_event(self):
+        harness = SimHarness(num_nodes=4)
+        harness.apply_queue(tenant_queue("capped", 1.0, ceiling_cpu=1.0))
+        for i in range(3):
+            harness.apply(tenant_pcs("capped", i, namespace="default"))
+        harness.converge(max_ticks=80)
+        from grove_tpu.quota.manager import quota_snapshot
+
+        row = {r["name"]: r for r in quota_snapshot(harness.store)}["capped"]
+        assert row["admittedGangs"] == 1
+        assert row["pendingGangs"] == 2
+        held = EVENTS.list(reason=REASON_QUEUE_PENDING)
+        assert held and all(e.kind == "PodGang" for e in held)
+        assert all("at ceiling" in e.message for e in held)
+        assert all(e.type == "Warning" for e in held)
+
+
+class TestQueuesEndpoint:
+    def test_get_queues_summary(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        harness = SimHarness(num_nodes=4)
+        harness.apply_queue(tenant_queue("team-x", 4.0))
+        harness.apply(tenant_pcs("team-x", 0, namespace="default"))
+        harness.converge()
+        server = APIServer(store=harness.store).start()
+        try:
+            with urllib.request.urlopen(f"{server.address}/queues") as resp:
+                doc = json.loads(resp.read())
+        finally:
+            server.stop()
+        assert doc["kind"] == "QueueSummaryList"
+        by_name = {i["name"]: i for i in doc["items"]}
+        row = by_name["team-x"]
+        assert row["deserved"] == {"cpu": 4.0}
+        assert row["usage"]["cpu"] == pytest.approx(1.0)
+        assert row["dominantShare"] == pytest.approx(0.25)
+        assert row["admittedGangs"] == 1
+
+    def test_queue_wire_round_trip(self):
+        from grove_tpu.api.serialize import export_object
+        from grove_tpu.api.wire import decode_object
+
+        q = tenant_queue("team-y", 2.0, ceiling_cpu=4.0)
+        q.spec.parent = "root"
+        doc = export_object(q)
+        back = decode_object(doc)
+        assert isinstance(back, Queue)
+        assert back.spec.deserved == {"cpu": 2.0}
+        assert back.spec.ceiling == {"cpu": 4.0}
+        assert back.metadata.namespace == ""
+
+
+class TestQueueAdmission:
+    def test_defaulting_anchors_parent_at_root(self):
+        from grove_tpu.admission.defaulting import default_queue
+
+        q = Queue(metadata=ObjectMeta(name="t"))
+        default_queue(q)
+        assert q.spec.parent == "root"
+        assert q.metadata.namespace == ""
+
+    def test_validation_rules(self):
+        from grove_tpu.admission.validation import validate_queue
+
+        ok = tenant_queue("fine", 2.0, ceiling_cpu=3.0)
+        ok.spec.parent = "root"
+        assert validate_queue(ok).ok
+
+        bad_parent = tenant_queue("t", 1.0)
+        bad_parent.spec.parent = "other-queue"
+        assert not validate_queue(bad_parent).ok
+
+        root_name = tenant_queue("root", 1.0)
+        root_name.spec.parent = "root"
+        assert not validate_queue(root_name).ok
+
+        inverted = Queue(
+            metadata=ObjectMeta(name="t"),
+            spec=QueueSpec(
+                parent="root",
+                deserved={"cpu": 4.0},
+                ceiling={"cpu": 2.0},
+            ),
+        )
+        assert not validate_queue(inverted).ok
+
+        negative = Queue(
+            metadata=ObjectMeta(name="t"),
+            spec=QueueSpec(parent="root", deserved={"cpu": -1.0}),
+        )
+        assert not validate_queue(negative).ok
+
+    def test_harness_apply_rejects_invalid_queue(self):
+        from grove_tpu.admission.validation import ValidationError
+
+        harness = SimHarness(num_nodes=1)
+        bad = tenant_queue("t", 1.0)
+        bad.spec.parent = "nope"
+        with pytest.raises(ValidationError):
+            harness.apply_queue(bad)

@@ -109,6 +109,7 @@ class TestTPULowering:
 
         from grove_tpu.ops.packing import solve_waves_device
         from scripts.export_tpu_lowering import (
+            _aval_str,
             _module_stats,
             _stress_export_inputs,
         )
@@ -126,7 +127,7 @@ class TestTPULowering:
             "scripts/export_tpu_lowering.py and commit the refreshed "
             "artifacts"
         )
-        fresh_avals = [str(a) for a in exp.in_avals]
+        fresh_avals = [_aval_str(a) for a in exp.in_avals]
         assert fresh_avals == committed["in_avals"], (
             "sentinel input contract drifted — re-run "
             "scripts/export_tpu_lowering.py"
