@@ -318,6 +318,14 @@ spec:
 """
 
 
+def _chaos_artifact_block() -> dict:
+    """Seeded chaos run for the integrated artifact (fixed seed: the bench
+    must be reproducible run to run)."""
+    from grove_tpu.sim.chaos import chaos_artifact
+
+    return chaos_artifact(seed=1234)
+
+
 def _quota_artifact() -> dict:
     """3-tenant contended fair-share run + single-queue A/B, run after the
     main integrated population in the same process (metrics are deltas, so
@@ -390,6 +398,10 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # reclaim count, ordering overhead) + the single-queue A/B
             # control (admissions must be identical with quota inert)
             "quota": _quota_artifact(),
+            # robustness block (docs/robustness.md acceptance): one seeded
+            # chaos run — node losses, a flap, a store outage — with the
+            # per-tick invariants and the fault-free-tree convergence check
+            "chaos": _chaos_artifact_block(),
         }
 
     _run_population_bench(

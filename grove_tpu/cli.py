@@ -585,6 +585,33 @@ def _fmt_resource_map(m: dict) -> str:
     return ",".join(f"{k}={g:g}" for k, g in sorted(m.items())) or "-"
 
 
+def _print_table(headers: tuple, rows: list) -> None:
+    """Aligned-column table (kubectl-get style); shared by the queue and
+    node views."""
+    widths = [
+        max(len(headers[c]), max(len(r[c]) for r in rows))
+        for c in range(len(headers))
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+
+def _fetch_server_json(apiserver: str, path: str, label: str):
+    """GET a JSON document from a live apiserver (scheme-defaulted);
+    returns None after printing the error."""
+    import json as _json
+    import urllib.request
+
+    url = apiserver if "://" in apiserver else f"http://{apiserver}"
+    try:
+        with urllib.request.urlopen(f"{url}{path}", timeout=10) as r:
+            return _json.loads(r.read())
+    except (OSError, ValueError) as e:
+        print(f"{label}: {url}: {e}", file=sys.stderr)
+        return None
+
+
 def _print_queue_table(items: list) -> None:
     if not items:
         print("no queues (and no queue-attributed usage)")
@@ -601,35 +628,20 @@ def _print_queue_table(items: list) -> None:
         )
         for it in items
     ]
-    headers = (
-        "NAME", "DESERVED", "CEILING", "USAGE", "SHARE", "ADMITTED", "PENDING",
+    _print_table(
+        ("NAME", "DESERVED", "CEILING", "USAGE", "SHARE", "ADMITTED",
+         "PENDING"),
+        rows,
     )
-    widths = [
-        max(len(headers[c]), max(len(r[c]) for r in rows))
-        for c in range(len(headers))
-    ]
-    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
-    for r in rows:
-        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
 
 
 def _cmd_queues(args) -> int:
     """Per-queue quota summary (docs/quota.md): deserved/ceiling/usage,
     dominant share, admitted/pending gangs — from a live apiserver's
     GET /queues, or after simulating manifests (Queue + PodCliqueSet docs)."""
-    import json as _json
-
     if args.apiserver:
-        import urllib.request
-
-        url = args.apiserver
-        if "://" not in url:
-            url = f"http://{url}"
-        try:
-            with urllib.request.urlopen(f"{url}/queues", timeout=10) as r:
-                doc = _json.loads(r.read())
-        except (OSError, ValueError) as e:
-            print(f"queues: {url}: {e}", file=sys.stderr)
+        doc = _fetch_server_json(args.apiserver, "/queues", "queues")
+        if doc is None:
             return 1
         _print_queue_table(doc.get("items", []))
         return 0
@@ -662,6 +674,48 @@ def _cmd_queues(args) -> int:
                 harness.apply(obj)
     harness.converge()
     _print_queue_table(quota_snapshot(harness.store))
+    return 0
+
+
+def _print_node_table(items: list) -> None:
+    if not items:
+        print("no nodes")
+        return
+    rows = [
+        (
+            it.get("name", "?"),
+            it.get("state", "?")
+            + (" (cordoned)" if it.get("cordoned") else ""),
+            f"{it.get('heartbeatAgeSeconds', 0.0):.1f}s",
+            str(it.get("boundPods", 0)),
+            _fmt_resource_map(it.get("capacity", {})),
+        )
+        for it in items
+    ]
+    _print_table(("NAME", "STATE", "HEARTBEAT-AGE", "PODS", "CAPACITY"), rows)
+
+
+def _cmd_nodes(args) -> int:
+    """Node health table (docs/robustness.md): lifecycle state, heartbeat
+    age, bound pods, capacity — from a live apiserver's GET /nodes, or
+    after simulating manifests on a synthetic cluster."""
+    if args.apiserver:
+        doc = _fetch_server_json(args.apiserver, "/nodes", "nodes")
+        if doc is None:
+            return 1
+        _print_node_table(doc.get("items", []))
+        return 0
+
+    _ensure_backend()
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            harness.apply_yaml(f.read())
+    if args.manifests:
+        harness.converge()
+    _print_node_table(harness.node_monitor.node_snapshot())
     return 0
 
 
@@ -918,6 +972,18 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--nodes", type=int, default=32)
     p.add_argument("--apiserver", help="read GET /queues from a live server")
     p.set_defaults(fn=_cmd_queues)
+
+    p = sub.add_parser(
+        "nodes",
+        help=(
+            "node health table (state, heartbeat age, bound pods) — live"
+            " with --apiserver URL or after simulating manifests"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument("--apiserver", help="read GET /nodes from a live server")
+    p.set_defaults(fn=_cmd_nodes)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")

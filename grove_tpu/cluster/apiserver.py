@@ -136,9 +136,15 @@ class APIServer:
         port: int = 0,
         webhooks: Optional[List[WebhookRegistration]] = None,
         enable_profiling: bool = False,
+        node_provider: Optional[Callable[[], List[dict]]] = None,
     ) -> None:
         self.store = store or Store(Clock())
         self.lock = threading.RLock()
+        # GET /nodes source (docs/observability.md): wire-shape node rows
+        # — typically NodeHealthMonitor.node_snapshot. Nodes are cluster
+        # infrastructure, not store objects, so they arrive by callback;
+        # None → an empty list (server without a sim cluster attached).
+        self.node_provider = node_provider
         # config-gated like the reference pprof listener (manager.go:108-113)
         # and serialized: concurrent samplers would degrade the whole
         # control plane (every 100Hz stack walk contends on the GIL)
@@ -370,6 +376,19 @@ class APIServer:
                         items = quota_snapshot(server.store)
                     return self._send_json(
                         200, {"kind": "QueueSummaryList", "items": items}
+                    )
+                if path == "/nodes":
+                    # node health table (docs/robustness.md): name, state
+                    # (Ready/NotReady/Lost), cordon flag, heartbeat age,
+                    # capacity, labels, bound-pod count
+                    with server.lock:
+                        items = (
+                            server.node_provider()
+                            if server.node_provider is not None
+                            else []
+                        )
+                    return self._send_json(
+                        200, {"kind": "NodeList", "items": items}
                     )
                 if path == "/events":
                     # deduped k8s-style Events (count/first/lastTimestamp),

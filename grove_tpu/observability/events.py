@@ -36,6 +36,16 @@ REASON_ROLLING_UPDATE_STARTED = "RollingUpdateStarted"
 # share can place (victim-side event naming the claimant)
 REASON_QUEUE_PENDING = "QueuePending"
 REASON_QUOTA_RECLAIM = "QuotaReclaim"
+# node-failure lifecycle (docs/robustness.md, controller/nodehealth.py):
+# heartbeat transitions, and the two gang-recovery outcomes — rescued
+# (delta-solve rejoined the survivors' domain) vs. requeued (gang below
+# its floor, torn down and re-admitted whole under backoff)
+REASON_NODE_NOT_READY = "NodeNotReady"
+REASON_NODE_LOST = "NodeLost"
+REASON_NODE_READY = "NodeReady"
+REASON_GANG_RESCUED = "GangRescued"
+REASON_GANG_REQUEUED = "GangRequeued"
+REASON_GANG_RELEASED = "GangBackoffReleased"
 
 
 @dataclass

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Set, Tuple
@@ -17,7 +18,18 @@ from typing import Deque, Dict, List, Optional, Set, Tuple
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
 BASE_BACKOFF = 0.005
+# HARD cap on the rate-limited delay, applied AFTER jitter: no key ever
+# waits longer than this between retries, however many times it failed
+# (tests/test_runtime.py pins the cap and the monotone growth toward it)
 MAX_BACKOFF = 1000.0
+# multiplicative jitter span on the exponential backoff: many keys failing
+# in the same instant (a node loss requeueing every affected gang, a store
+# outage failing a whole drain round) must not retry in one synchronized
+# burst. DETERMINISTIC per (key, failures) — crc32, not random or hash():
+# virtual-time replays and cross-process runs (PYTHONHASHSEED) must see
+# identical schedules. <1.0 keeps growth strictly monotone: the worst case
+# 2^f*(1+J) vs 2^(f+1)*1 still grows since 1+J < 2.
+JITTER_FRAC = 0.1
 # a zero (or negative) requeue delay would make the key ready again within
 # the SAME engine drain round — `Engine.drain` freezes `now` per call and
 # drains each controller's whole ready set, so the re-add would livelock
@@ -37,7 +49,16 @@ class _Delayed:
 
 
 class WorkQueue:
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        base_backoff: float = BASE_BACKOFF,
+        max_backoff: float = MAX_BACKOFF,
+    ) -> None:
+        # per-instance rate-limiter curve: reconcile queues keep the
+        # client-go-style 5ms base, while coarser consumers (gang requeue
+        # after node failure) pick a second-scale base with a tighter cap
+        self.base_backoff = base_backoff
+        self.max_backoff = max_backoff
         self._ready: Deque[Key] = deque()
         self._pending: Set[Key] = set()
         self._delayed: List[_Delayed] = []
@@ -54,14 +75,40 @@ class WorkQueue:
         heapq.heappush(self._delayed, _Delayed(now + delay, next(self._seq), key))
 
     def add_rate_limited(self, key: Key, now: float) -> None:
-        """Exponential per-key backoff (client-go ItemExponentialFailureRateLimiter)."""
+        """Exponential per-key backoff with deterministic jitter, capped at
+        MAX_BACKOFF (client-go ItemExponentialFailureRateLimiter + the
+        bucket limiter's ceiling). delay = min(BASE·2^failures·(1+J·u),
+        MAX_BACKOFF) where u ∈ [0,1) is a crc32 of (key, failures) — stable
+        across processes and replays, monotone in failures, and desynced
+        across keys that fail together."""
         failures = self._failures.get(key, 0)
-        delay = min(BASE_BACKOFF * (2**failures), MAX_BACKOFF)
+        u = (
+            zlib.crc32(f"{key}:{failures}".encode()) & 0xFFFF
+        ) / float(1 << 16)
+        delay = min(
+            self.base_backoff * (2**failures) * (1.0 + JITTER_FRAC * u),
+            self.max_backoff,
+        )
         self._failures[key] = failures + 1
         self.add_after(key, delay, now)
 
+    def failures(self, key: Key) -> int:
+        """Consecutive rate-limited failures recorded for the key."""
+        return self._failures.get(key, 0)
+
     def forget(self, key: Key) -> None:
         self._failures.pop(key, None)
+
+    def discard_delayed(self, key: Key) -> int:
+        """Drop every not-yet-promoted delayed entry for `key` (O(delayed)).
+        For consumers that release a key out of band (e.g. the node-health
+        monitor when capacity returns): an orphaned heap entry would later
+        pop and grant the key an extra, unscheduled release."""
+        before = len(self._delayed)
+        self._delayed = [d for d in self._delayed if d.key != key]
+        if len(self._delayed) != before:
+            heapq.heapify(self._delayed)
+        return before - len(self._delayed)
 
     def _promote_delayed(self, now: float) -> None:
         while self._delayed and self._delayed[0].ready_at <= now:

@@ -1,0 +1,564 @@
+"""Seeded chaos harness: deterministic fault schedules over a mixed-gang sim.
+
+The acceptance driver for the node-failure & recovery subsystem
+(docs/robustness.md): a fixed seed expands into a fault schedule — node
+crashes (beyond the heartbeat grace window: real losses), a flap (crash +
+restart inside the window), and a transient store outage (the
+``Store.error_injectors`` hook) — replayed on virtual time over a workload
+that mixes rescuable gangs, topology-packed rescuable gangs, and strict
+(minAvailable == replicas) gangs that must gang-terminate and requeue.
+
+Every tick asserts the chaos invariants:
+
+1. **No binding targets a Lost node** (level-triggered, after the monitor's
+   sweep).
+2. **No scheduled gang sits below its MinReplicas floor past the grace
+   window** — breaches must resolve (rescue or gang-terminate) within
+   ``lost_after`` plus a small slack.
+3. **Capacity accounting stays exact**: the incremental quota accountant
+   equals a full recount (``quota/oracle.py::usage_oracle``), and no node's
+   bound requests exceed its capacity.
+
+After the last fault clears, the run must converge: every gang Running,
+every pod Ready, nothing on an unhealthy node, and the resource tree equal
+to a fault-free twin run of the same workload. Rescued packed gangs are
+verified — via actual placements — to have rejoined their survivors'
+topology domain (the packing kernel's recovery-pin path).
+
+Shared by ``make chaos-smoke`` (scripts/chaos_smoke.py), the bench's
+``"chaos"`` artifact block, and tests/test_node_failure.py.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.meta import deep_copy, get_condition
+from grove_tpu.api.pod import is_ready
+from grove_tpu.api.types import COND_PODGANG_SCHEDULED, PHASE_RUNNING
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.quota.oracle import usage_oracle
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.sim.cluster import NODE_LOST, NODE_READY
+from grove_tpu.sim.harness import SimHarness
+
+# Workload shapes (chaos_workload): pods are sized so a 3-pod packed gang
+# spans 3 distinct hosts of ONE ici-block (cpu 5 of 8 → one pod per node) —
+# crashing one host then exercises the recovery-pin delta-solve, visibly.
+_PLAIN_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: plain
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 3
+          minAvailable: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 2
+"""
+
+_PACKED_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: packed
+spec:
+  replicas: 1
+  template:
+    topologyConstraint:
+      packDomain: ici-block
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 3
+          minAvailable: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 5
+"""
+
+_STRICT_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: strict
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 3
+"""
+# minAvailable intentionally omitted in strict: defaulting pins it to
+# replicas, so ANY pod loss breaches the floor → gang-terminate + requeue.
+
+_SHAPES = {
+    "plain": load_podcliquesets(_PLAIN_YAML)[0],
+    "packed": load_podcliquesets(_PACKED_YAML)[0],
+    "strict": load_podcliquesets(_STRICT_YAML)[0],
+}
+
+
+def chaos_workload(n_each: int = 2) -> List:
+    """n_each PodCliqueSets of every shape (plain / packed / strict)."""
+    out = []
+    for shape, base in sorted(_SHAPES.items()):
+        for i in range(n_each):
+            pcs = deep_copy(base)
+            pcs.metadata.name = f"{shape}-{i:02d}"
+            out.append(pcs)
+    return out
+
+
+@dataclass
+class Fault:
+    at: float  # virtual seconds after the steady-state snapshot
+    kind: str  # crash | restart | outage_begin | outage_end
+    target: str = ""  # node name for crash/restart
+    note: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "at": round(self.at, 2),
+            "kind": self.kind,
+            "target": self.target,
+            "note": self.note,
+        }
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    ticks: int = 0
+    faults: List[dict] = field(default_factory=list)
+    node_losses: int = 0
+    flaps: int = 0
+    rescues: List[dict] = field(default_factory=list)
+    requeues: int = 0
+    scheduler_errors: int = 0
+    invariant_violations: List[str] = field(default_factory=list)
+    converged: bool = False
+    signature_matches_fault_free: bool = False
+    pin_verified_rescues: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.invariant_violations
+            and self.converged
+            and self.signature_matches_fault_free
+            and self.node_losses >= 2
+            and self.flaps >= 1
+            and self.requeues >= 1
+            and self.pin_verified_rescues >= 1
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "faults": self.faults,
+            "node_losses": self.node_losses,
+            "flaps": self.flaps,
+            "rescues": len(self.rescues),
+            "pin_verified_rescues": self.pin_verified_rescues,
+            "requeues": self.requeues,
+            "scheduler_errors": self.scheduler_errors,
+            "invariant_violations": self.invariant_violations,
+            "converged": self.converged,
+            "signature_matches_fault_free": self.signature_matches_fault_free,
+            "ok": self.ok,
+        }
+
+
+def resource_signature(store) -> List[tuple]:
+    """Placement-free shape of the converged resource tree: gangs with
+    phase + per-group (size, floor), cliques with replica/ready counts.
+    Node assignments are deliberately EXCLUDED — a post-failure cluster
+    legitimately places elsewhere; what must match a fault-free run is the
+    tree itself."""
+    sig: List[tuple] = []
+    for gang in sorted(
+        store.scan("PodGang"),
+        key=lambda g: (g.metadata.namespace, g.metadata.name),
+    ):
+        groups = tuple(
+            sorted(
+                (g.name, len(g.pod_references), g.min_replicas)
+                for g in gang.spec.pod_groups
+            )
+        )
+        sig.append(
+            (
+                "pg",
+                gang.metadata.namespace,
+                gang.metadata.name,
+                gang.status.phase,
+                groups,
+            )
+        )
+    for pclq in sorted(
+        store.scan("PodClique"),
+        key=lambda c: (c.metadata.namespace, c.metadata.name),
+    ):
+        sig.append(
+            (
+                "pclq",
+                pclq.metadata.namespace,
+                pclq.metadata.name,
+                pclq.status.replicas,
+                pclq.status.ready_replicas,
+            )
+        )
+    return sig
+
+
+class ChaosRunner:
+    """One seeded chaos run over a fresh SimHarness."""
+
+    def __init__(
+        self,
+        seed: int = 1234,
+        num_nodes: int = 16,
+        n_each: int = 2,
+        tick_seconds: float = 1.0,
+        not_ready_after: float = 5.0,
+        lost_after: float = 15.0,
+    ) -> None:
+        self.seed = seed
+        self.num_nodes = num_nodes
+        self.n_each = n_each
+        self.tick_seconds = tick_seconds
+        self.not_ready_after = not_ready_after
+        self.lost_after = lost_after
+        self.harness = self._build_harness()
+        self.report = ChaosReport(seed=seed)
+        self._breach_since: Dict[Tuple[str, str], float] = {}
+        self._outage_ops = ("create", "update")
+
+    def _build_harness(self) -> SimHarness:
+        h = SimHarness(num_nodes=self.num_nodes)
+        h.node_monitor.not_ready_after = self.not_ready_after
+        h.node_monitor.lost_after = self.lost_after
+        for pcs in chaos_workload(self.n_each):
+            h.apply(pcs)
+        return h
+
+    # -- schedule construction -------------------------------------------
+
+    def _node_of_one_pod(self, prefix: str, exclude: set) -> Optional[str]:
+        """A node hosting exactly one pod of a `prefix-*` gang whose gang
+        has survivors elsewhere — the cleanest rescue target. Falls back to
+        any node hosting a pod of that shape."""
+        per_node: Dict[str, int] = {}
+        candidates: List[str] = []
+        h = self.harness
+        for (ns, pod_name), node in sorted(h.cluster.bindings.items()):
+            if pod_name.startswith(prefix) and node not in exclude:
+                per_node[node] = per_node.get(node, 0) + 1
+        for node, count in sorted(per_node.items()):
+            if count == 1:
+                candidates.append(node)
+        return (candidates or sorted(per_node) or [None])[0]
+
+    def build_schedule(self, rng: random.Random) -> List[Fault]:
+        """Deterministic fault schedule against the converged steady state:
+        two real node losses (one hitting a packed gang → rescue via
+        recovery pin; one hitting a strict gang → gang requeue), one flap,
+        one transient store outage. Times jittered from the seed; targets
+        resolved from the (deterministic) steady-state placement."""
+        used: set = set()
+        loss1 = self._node_of_one_pod("packed-", used)
+        used.add(loss1)
+        loss2 = self._node_of_one_pod("strict-", used)
+        used.add(loss2)
+        flap = self._node_of_one_pod("plain-", used) or self._node_of_one_pod(
+            "packed-", used
+        )
+        used.add(flap)
+        assert loss1 and loss2 and flap, "steady state left shapes unplaced"
+        dead_dwell = self.lost_after + 6.0  # comfortably past the grace
+        faults = [
+            Fault(rng.uniform(1, 3), "crash", loss1, "loss→rescue (packed)"),
+            Fault(
+                rng.uniform(4, 6), "crash", loss2, "loss→requeue (strict)"
+            ),
+            Fault(rng.uniform(7, 9), "crash", flap, "flap begin"),
+        ]
+        # the flap restarts inside the grace window (NotReady, never Lost)
+        flap_start = faults[2].at
+        faults.append(
+            Fault(
+                flap_start
+                + self.not_ready_after
+                + rng.uniform(1.0, self.lost_after - self.not_ready_after - 2.0),
+                "restart",
+                flap,
+                "flap end (inside grace)",
+            )
+        )
+        # transient store outage while recovery is in flight
+        outage_at = rng.uniform(10, 14)
+        faults.append(Fault(outage_at, "outage_begin", note="store outage"))
+        faults.append(
+            Fault(outage_at + rng.uniform(2.0, 4.0), "outage_end")
+        )
+        # lost nodes come back late — capacity returns, requeued gangs must
+        # re-admit atomically
+        for i, node in enumerate((loss1, loss2)):
+            faults.append(
+                Fault(
+                    dead_dwell + rng.uniform(0, 3) + 2 * i,
+                    "restart",
+                    node,
+                    "capacity returns",
+                )
+            )
+        faults.sort(key=lambda f: f.at)
+        return faults
+
+    def _apply_fault(self, fault: Fault) -> None:
+        h = self.harness
+        if fault.kind == "crash":
+            h.cluster.crash_node(fault.target)
+        elif fault.kind == "restart":
+            h.cluster.restart_node(fault.target)
+        elif fault.kind == "outage_begin":
+
+            def inject(_obj):
+                return GroveError(
+                    "ERR_STORE_OUTAGE", "injected transient outage", "write"
+                )
+
+            for op in self._outage_ops:
+                h.store.error_injectors[op] = inject
+        elif fault.kind == "outage_end":
+            for op in self._outage_ops:
+                h.store.error_injectors.pop(op, None)
+        self.report.faults.append(fault.as_dict())
+
+    # -- invariants -------------------------------------------------------
+
+    def _check_invariants(self, rel_now: float) -> None:
+        h = self.harness
+        violations = self.report.invariant_violations
+        # 1. no binding to a Lost node
+        lost = {n.name for n in h.cluster.nodes if n.state == NODE_LOST}
+        for (ns, pod_name), node in sorted(h.cluster.bindings.items()):
+            if node in lost:
+                violations.append(
+                    f"t={rel_now:.0f}s: pod {ns}/{pod_name} still bound to "
+                    f"lost node {node}"
+                )
+        # 2. no scheduled gang below its floor past the grace window
+        now = h.clock.now()
+        slack = self.lost_after + 4 * self.tick_seconds
+        for gang in h.store.scan("PodGang"):
+            key = (gang.metadata.namespace, gang.metadata.name)
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is None or not cond.is_true():
+                self._breach_since.pop(key, None)
+                continue
+            below = any(
+                sum(
+                    1
+                    for ref in group.pod_references
+                    if (ref.namespace, ref.name) in h.cluster.bindings
+                )
+                < group.min_replicas
+                for group in gang.spec.pod_groups
+            )
+            if not below:
+                self._breach_since.pop(key, None)
+                continue
+            since = self._breach_since.setdefault(key, now)
+            if now - since > slack:
+                violations.append(
+                    f"t={rel_now:.0f}s: scheduled gang {key[0]}/{key[1]} "
+                    f"below MinReplicas for {now - since:.0f}s "
+                    f"(> grace {slack:.0f}s)"
+                )
+        # 3a. incremental quota accounting equals a full recount
+        acct = h.scheduler.quota.accountant
+        acct.ensure_built(h.store)
+        oracle = usage_oracle(h.store.scan("Pod"), acct.default_queue)
+        snap = acct.snapshot()
+        queues = set(snap) | set(oracle)
+        for q in sorted(queues):
+            a, b = snap.get(q, {}), oracle.get(q, {})
+            for r in sorted(set(a) | set(b)):
+                if abs(a.get(r, 0.0) - b.get(r, 0.0)) > 1e-6:
+                    violations.append(
+                        f"t={rel_now:.0f}s: queue {q} usage {r}: "
+                        f"accountant {a.get(r, 0.0)} != recount {b.get(r, 0.0)}"
+                    )
+        # 3b. no node is committed beyond its capacity
+        used = h.cluster._used_by_node()
+        for node in h.cluster.nodes:
+            for r, v in used.get(node.name, {}).items():
+                if v > node.capacity.get(r, 0.0) + 1e-6:
+                    violations.append(
+                        f"t={rel_now:.0f}s: node {node.name} overcommitted "
+                        f"on {r}: {v} > {node.capacity.get(r, 0.0)}"
+                    )
+
+    def _guarded(self, fn) -> int:
+        """Run one control-plane component; a transient store error models
+        that component's process crash-looping (it retries next tick)."""
+        try:
+            return fn() or 0
+        except GroveError:
+            self.report.scheduler_errors += 1
+            return 1  # counted as work: the loop must keep ticking
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, max_ticks: int = 400) -> ChaosReport:
+        h = self.harness
+        rng = random.Random(self.seed)
+        report = self.report
+        losses_before = METRICS.counters.get("node_lost_total", 0)
+        flaps_before = METRICS.counters.get("node_flaps_total", 0)
+        requeues_before = METRICS.counters.get("gang_requeues_total", 0)
+
+        # fault-free twin FIRST (same workload, converged, untouched): the
+        # convergence target the chaotic run must reproduce
+        twin = self._build_harness()
+        twin.converge(max_ticks=120)
+        twin_sig = resource_signature(twin.store)
+        # building a SimHarness re-points the process-global EVENTS/TRACER
+        # clocks ("newest harness wins"); the chaotic run is the one whose
+        # event timestamps must stay live — point them back
+        from grove_tpu.observability.events import EVENTS
+        from grove_tpu.observability.tracing import TRACER
+
+        EVENTS.clock = h.clock
+        TRACER.clock = h.clock
+
+        h.converge(max_ticks=120)  # steady state before the first fault
+        t0 = h.clock.now()
+        faults = self.build_schedule(rng)
+        i = 0
+        idle_ticks = 0
+        for _tick in range(max_ticks):
+            rel = h.clock.now() - t0
+            while i < len(faults) and faults[i].at <= rel:
+                self._apply_fault(faults[i])
+                i += 1
+            work = self._guarded(h.engine.drain)
+            work += self._guarded(h.autoscaler.tick)
+            work += self._guarded(h.node_monitor.tick)
+            bound = self._guarded(h.schedule)
+            started = self._guarded(h.cluster.kubelet_tick)
+            work += self._guarded(h.engine.drain)
+            self._check_invariants(rel)
+            report.ticks += 1
+            if i >= len(faults) and not work and not bound and not started:
+                idle_ticks += 1
+                wakes = [
+                    w
+                    for w in (
+                        h.engine.next_wakeup(),
+                        h.autoscaler.next_deadline(),
+                        h.node_monitor.next_deadline(),
+                    )
+                    if w is not None
+                ]
+                wake = min(wakes) if wakes else None
+                if wake is not None and wake - h.clock.now() <= 120.0:
+                    h.clock.advance(max(wake - h.clock.now(), 0.0))
+                    continue
+                if idle_ticks >= 2:
+                    break
+            else:
+                idle_ticks = 0
+            # never jump past the next scheduled fault
+            step = self.tick_seconds
+            if i < len(faults):
+                step = min(step, max(faults[i].at - rel, 1e-3))
+            h.clock.advance(step)
+
+        report.node_losses = int(
+            METRICS.counters.get("node_lost_total", 0) - losses_before
+        )
+        report.flaps = int(
+            METRICS.counters.get("node_flaps_total", 0) - flaps_before
+        )
+        report.requeues = int(
+            METRICS.counters.get("gang_requeues_total", 0) - requeues_before
+        )
+        report.rescues = list(h.node_monitor.rescues)
+        report.pin_verified_rescues = sum(
+            1 for r in report.rescues if r.get("rejoined_domain")
+        )
+
+        # convergence: every gang Running, every pod Ready, every node back
+        pods = h.store.list("Pod")
+        gangs = h.store.scan("PodGang")
+        unhealthy = {
+            n.name for n in h.cluster.nodes if n.state != NODE_READY
+        }
+        report.converged = (
+            bool(pods)
+            and all(is_ready(p) for p in pods)
+            and all(g.status.phase == PHASE_RUNNING for g in gangs)
+            and not any(
+                p.status.node_name in unhealthy for p in pods
+            )
+        )
+        report.signature_matches_fault_free = (
+            resource_signature(h.store) == twin_sig
+        )
+        return report
+
+
+def run_chaos(
+    seed: int = 1234,
+    num_nodes: int = 16,
+    n_each: int = 2,
+    max_ticks: int = 400,
+) -> ChaosReport:
+    """One seeded end-to-end chaos run (the `make chaos-smoke` core)."""
+    return ChaosRunner(seed=seed, num_nodes=num_nodes, n_each=n_each).run(
+        max_ticks=max_ticks
+    )
+
+
+def chaos_artifact(seed: int = 1234) -> dict:
+    """Compact chaos block for the integrated bench artifact."""
+    report = run_chaos(seed=seed)
+    doc = report.as_dict()
+    doc.pop("faults", None)
+    doc.pop("invariant_violations", None)
+    doc["invariant_violation_count"] = len(report.invariant_violations)
+    return doc

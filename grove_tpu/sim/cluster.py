@@ -28,12 +28,38 @@ from grove_tpu.initc.waiter import is_ready_to_start
 from grove_tpu.runtime.store import Store, commit_status
 
 
+# node lifecycle states (docs/robustness.md): Ready nodes heartbeat and
+# accept placements; NotReady nodes missed heartbeats but are inside the
+# grace window (pods stay bound, nothing new lands); Lost nodes exceeded
+# the grace window — the node-health monitor fails their pods and drives
+# gang rescue / requeue (controller/nodehealth.py)
+NODE_READY = "Ready"
+NODE_NOT_READY = "NotReady"
+NODE_LOST = "Lost"
+
+
 @dataclass
 class Node:
     name: str
     capacity: Dict[str, float] = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)  # topology keys
     cordoned: bool = False
+    # health lifecycle (maintained by NodeHealthMonitor from heartbeats)
+    state: str = NODE_READY
+    # virtual timestamp of the last kubelet heartbeat; a crashed node's
+    # kubelet stops ticking, so this freezes and the monitor's grace-period
+    # math drives Ready → NotReady → Lost
+    last_heartbeat: float = 0.0
+    # the node's kubelet process is down (crash_node): no heartbeats, no
+    # container starts. Restart (restart_node) resumes both.
+    crashed: bool = False
+
+    @property
+    def schedulable(self) -> bool:
+        """Eligible as a placement target: not cordoned AND healthy. This is
+        the single predicate every solve path masks nodes with — NotReady
+        and Lost nodes leave the dense tensors exactly like cordoned ones."""
+        return not self.cordoned and self.state == NODE_READY
 
 
 @dataclass
@@ -214,7 +240,7 @@ class SimCluster:
             ):
                 continue
             for node in self.nodes:
-                if node.cordoned or not self.fits(node, pod):
+                if not node.schedulable or not self.fits(node, pod):
                     continue
                 self.bind(pod, node.name)
                 bound += 1
@@ -230,9 +256,6 @@ class SimCluster:
         )
         if view is None:
             return
-        key = (view.metadata.namespace, view.metadata.name)
-        self.bindings[key] = node_name
-        self.last_node[key] = node_name
         st = clone_status(view.status)
         st.node_name = node_name
         set_condition(
@@ -240,14 +263,38 @@ class SimCluster:
             Condition(type=COND_POD_SCHEDULED, status="True", reason="Bound"),
             self.store.clock.now(),
         )
+        # commit FIRST, record the binding only on success: a transient
+        # store outage (chaos error injector, real apiserver hiccup) must
+        # not leave a phantom binding charging capacity for a pod that was
+        # never actually marked scheduled — the next round re-places it
         commit_status(self.store, view, st)
+        key = (view.metadata.namespace, view.metadata.name)
+        self.bindings[key] = node_name
+        self.last_node[key] = node_name
 
     # -- kubelet ---------------------------------------------------------
+
+    def heartbeat_tick(self) -> None:
+        """One kubelet heartbeat round: every node whose kubelet is alive
+        reports in. Crashed nodes stay silent — their last_heartbeat
+        freezes and the node-health monitor's grace-period math takes over
+        (virtual-time jumps between ticks therefore never fake a cluster-
+        wide heartbeat loss: a node only ages while actually crashed)."""
+        now = self.store.clock.now()
+        for node in self.nodes:
+            if not node.crashed:
+                node.last_heartbeat = now
 
     def kubelet_tick(self, namespace: Optional[str] = None) -> int:
         """Advance scheduled pods (all namespaces by default) toward Ready:
         run the init waiter, then start containers and flip Ready. Returns
         pods transitioned."""
+        self.heartbeat_tick()
+        # a dead kubelet starts nothing: pods bound to crashed or Lost
+        # nodes freeze until the monitor fails them or the node restarts
+        dead_nodes = {
+            n.name for n in self.nodes if n.crashed or n.state == NODE_LOST
+        }
         progressed = 0
         # Two-phase: decide against the tick-start state, then apply — so a
         # dependent pod never starts in the same tick its parent became Ready
@@ -261,6 +308,8 @@ class SimCluster:
         # in a startup cascade stay free)
         for view in self._not_ready_pods(namespace):
             if not is_scheduled(view) or is_ready(view) or is_terminating(view):
+                continue
+            if dead_nodes and view.status.node_name in dead_nodes:
                 continue
             waiter_cfg = view.spec.extra.get("groveInitWaiter")
             waiter_clears = bool(waiter_cfg) and not view.status.init_waiter_done
@@ -286,6 +335,38 @@ class SimCluster:
             if commit_status(self.store, view, st) is not None:
                 progressed += 1
         return progressed
+
+    # -- node lifecycle (docs/robustness.md) -----------------------------
+
+    def node(self, node_name: str) -> Optional[Node]:
+        return next((n for n in self.nodes if n.name == node_name), None)
+
+    def crash_node(self, node_name: str) -> bool:
+        """Kill the node's kubelet: heartbeats stop, containers freeze. The
+        node stays Ready (and keeps its pods bound) until the node-health
+        monitor's grace period expires — the realistic failure path, unlike
+        `fail_node`'s immediate cordon-and-evict."""
+        node = self.node(node_name)
+        if node is None:
+            return False
+        node.crashed = True
+        return True
+
+    def restart_node(self, node_name: str) -> bool:
+        """Bring the node's kubelet back: heartbeats resume (fresh from this
+        instant) and the monitor flips the node back to Ready on its next
+        tick. A restart inside the grace window is a harmless flap."""
+        node = self.node(node_name)
+        if node is None:
+            return False
+        node.crashed = False
+        node.last_heartbeat = self.store.clock.now()
+        return True
+
+    def unschedulable_names(self) -> set:
+        """Names of nodes no solve may target (cordoned or unhealthy) —
+        the set recovery-pin resolution avoids pinning to."""
+        return {n.name for n in self.nodes if not n.schedulable}
 
     def fail_node(self, node_name: str) -> int:
         """Node loss: cordon the node and evict (delete) every pod bound to

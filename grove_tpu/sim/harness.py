@@ -93,6 +93,13 @@ class SimHarness:
             max_waves=self.config.solver.max_waves,
             solver_sidecar=self.config.solver.sidecar_address or None,
         )
+        # node-health monitor (controller/nodehealth.py): heartbeat
+        # lifecycle, pod failure on Lost nodes, gang rescue vs. requeue.
+        # Inert while no node crashes (one O(nodes) pass per tick).
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+
+        self.node_monitor = NodeHealthMonitor(self.store, self.cluster)
+        self.scheduler.monitor = self.node_monitor
         # HPA controller equivalent (multi-level autoscaling)
         from grove_tpu.autoscale.hpa import (
             HorizontalAutoscaler,
@@ -160,19 +167,22 @@ class SimHarness:
         for _ in range(max_ticks):
             work = self.engine.drain()
             work += self.autoscaler.tick()
+            work += self.node_monitor.tick()
             bound = self.schedule()
             started = self.cluster.kubelet_tick()
             work += self.engine.drain()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
-                # idle now — but short-horizon requeues (gate retries) or a
-                # held HPA scale-down may be pending; jump to the earliest
+                # idle now — but short-horizon requeues (gate retries), a
+                # held HPA scale-down, a node-grace deadline, or a gang
+                # requeue backoff may be pending; jump to the earliest
                 # wakeup rather than stopping early
                 wakes = [
                     w
                     for w in (
                         self.engine.next_wakeup(),
                         self.autoscaler.next_deadline(),
+                        self.node_monitor.next_deadline(),
                     )
                     if w is not None
                 ]

@@ -96,6 +96,11 @@ class GangScheduler:
         # every round. Connectivity failures (restart) retry immediately.
         self.sidecar_backoff_s = 60.0
         self._sidecar_skip_until = 0.0
+        # node-health monitor (controller/nodehealth.py), wired by the
+        # harness: gangs it holds in requeue backoff are skipped from the
+        # solve until released (rate-limited re-admission after a gang
+        # termination). None → no holds (tests that build a bare scheduler).
+        self.monitor = None
 
     def _solve_batch(
         self,
@@ -321,7 +326,10 @@ class GangScheduler:
 
         bound = 0
         if gang_specs:
-            nodes = [n for n in self.cluster.nodes if not n.cordoned]
+            # mask cordoned AND unhealthy (NotReady/Lost) nodes out of the
+            # dense tensors: the encoder never sees them, so no placement,
+            # recovery pin, or preemption trial can target one
+            nodes = [n for n in self.cluster.nodes if n.schedulable]
             # one usage pass over bindings (node_free per node would be
             # O(nodes × bindings) per round at stress scale)
             free = self.cluster.node_free_all(nodes)
@@ -402,7 +410,7 @@ class GangScheduler:
         # pods not in any gang (shouldn't happen for grove pods): first-fit
         for _ns, pod in loose_pods:
             for node in self.cluster.nodes:
-                if not node.cordoned and self.cluster.fits(node, pod):
+                if node.schedulable and self.cluster.fits(node, pod):
                     self.cluster.bind(pod, node.name)
                     bound += 1
                     break
@@ -438,7 +446,7 @@ class GangScheduler:
                 and cond is not None
                 and cond.is_true()
                 and prev in nodes_by_name
-                and not nodes_by_name[prev].cordoned
+                and nodes_by_name[prev].schedulable
                 and self.cluster.fits(nodes_by_name[prev], pod)
                 and self._reuse_respects_pack_constraint(
                     namespace, gang, nodes_by_name, nodes_by_name[prev]
@@ -612,6 +620,13 @@ class GangScheduler:
         gang_specs: List[dict] = []
         gang_pods: Dict[str, Dict[str, List]] = {}
         for gang_name, pods in sorted(by_gang.items()):
+            if self.monitor is not None and self.monitor.gang_held(
+                namespace, gang_name
+            ):
+                # requeued gang in rate-limited backoff: keep its pods
+                # pending (NOT loose — they stay gang pods) and let the
+                # monitor release it into a later round
+                continue
             gang_cr = self.store.get(
                 "PodGang", namespace, gang_name, readonly=True
             )
@@ -710,9 +725,9 @@ class GangScheduler:
             gang_pinned_node = None
             if required_key is not None and any(g["partial"] for g in groups):
                 # scan ALL groups for a survivor on a live node before
-                # settling for a cordoned fallback (the encoder drops pins
-                # resolved to nodes outside the solve's node set)
-                cordoned = {n.name for n in self.cluster.nodes if n.cordoned}
+                # settling for an unschedulable fallback (the encoder drops
+                # pins resolved to nodes outside the solve's node set)
+                cordoned = self.cluster.unschedulable_names()
                 for grp in groups:
                     node = self._any_bound_node(namespace, grp["name"])
                     if node is None:
@@ -762,10 +777,11 @@ class GangScheduler:
         return a if order.get(a, -1) >= order.get(b, -1) else b
 
     def _any_bound_node(self, namespace: str, pclq_fqn: str) -> Optional[str]:
-        """A node hosting a bound pod of the clique — preferring non-cordoned
-        nodes (cordoned nodes are excluded from the solve's node set, so a
-        pin resolved to one would be silently dropped by the encoder)."""
-        cordoned = {n.name for n in self.cluster.nodes if n.cordoned}
+        """A node hosting a bound pod of the clique — preferring schedulable
+        nodes (cordoned/unhealthy nodes are excluded from the solve's node
+        set, so a pin resolved to one would be silently dropped by the
+        encoder)."""
+        cordoned = self.cluster.unschedulable_names()
         fallback = None
         for p in self.store.scan(
             "Pod", namespace, {namegen.LABEL_PODCLIQUE: pclq_fqn}
@@ -883,7 +899,7 @@ class GangScheduler:
         )
         if not rejected:
             return set(), None
-        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        nodes = [n for n in self.cluster.nodes if n.schedulable]
         if not nodes:
             return set(), None
 
@@ -1203,7 +1219,7 @@ class GangScheduler:
             claimants.append(spec)
         if not claimants:
             return set()
-        nodes = [n for n in self.cluster.nodes if not n.cordoned]
+        nodes = [n for n in self.cluster.nodes if n.schedulable]
         if not nodes:
             return set()
         from grove_tpu.quota.oracle import dominant_share_of

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Chaos smoke test: seeded fault schedule through the full control plane
+(the `make chaos-smoke` target; tests/test_node_failure.py pins the same
+flow at pytest speed).
+
+Asserts the robustness subsystem's acceptance bar (docs/robustness.md):
+- >= 2 real node losses, >= 1 heartbeat flap, >= 1 transient store outage
+  replayed deterministically from the seed;
+- every rescued gang lands back in its survivors' topology domain
+  (recovery-pin path, verified via actual placements);
+- every non-rescuable gang is requeued and re-admitted atomically after
+  capacity returns;
+- the chaos invariants hold EVERY tick (no binding to a Lost node, no
+  scheduled gang below MinReplicas past the grace window, capacity
+  accounting exact);
+- the cluster converges to the same resource tree as a fault-free run.
+
+On failure the seed is printed so the exact run replays:
+    python scripts/chaos_smoke.py --seed <N>
+
+Usage: python scripts/chaos_smoke.py [--seed N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# CPU pin before jax import: the smoke must not hang on a wedged accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from a checkout without an installed package (make chaos-smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--seed", type=int, default=1234,
+        help="fault-schedule seed (printed on failure for replay)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = parser.parse_args()
+
+    from grove_tpu.sim.chaos import run_chaos
+
+    report = run_chaos(seed=args.seed)
+    doc = report.as_dict()
+
+    problems = []
+    if report.node_losses < 2:
+        problems.append(f"only {report.node_losses} node losses (need >= 2)")
+    if report.flaps < 1:
+        problems.append("no heartbeat flap happened")
+    if report.requeues < 1:
+        problems.append("no gang was requeued (strict-shape loss missing)")
+    if report.pin_verified_rescues < 1:
+        problems.append(
+            "no rescue rejoined its survivors' domain (recovery-pin path "
+            "not exercised)"
+        )
+    if report.invariant_violations:
+        problems.append(
+            f"{len(report.invariant_violations)} invariant violation(s): "
+            + "; ".join(report.invariant_violations[:5])
+        )
+    if not report.converged:
+        problems.append("cluster did not converge after the last fault")
+    if not report.signature_matches_fault_free:
+        problems.append("resource tree differs from the fault-free run")
+
+    if args.json:
+        print(json.dumps({"chaos": doc, "ok": not problems}))
+    else:
+        print(
+            f"seed={report.seed} ticks={report.ticks} "
+            f"losses={report.node_losses} flaps={report.flaps} "
+            f"rescues={len(report.rescues)} "
+            f"(pin-verified {report.pin_verified_rescues}) "
+            f"requeues={report.requeues}"
+        )
+        for fault in doc["faults"]:
+            note = f" ({fault['note']})" if fault["note"] else ""
+            print(
+                f"  t={fault['at']:>6.2f}s {fault['kind']:<13}"
+                f" {fault['target']}{note}"
+            )
+        print(
+            f"converged={report.converged} "
+            f"tree_matches_fault_free={report.signature_matches_fault_free} "
+            f"violations={len(report.invariant_violations)}"
+        )
+
+    if problems:
+        print(
+            f"\nCHAOS SMOKE FAILED (replay with --seed {args.seed}):",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -469,3 +469,79 @@ class TestQueueAdmission:
         bad.spec.parent = "nope"
         with pytest.raises(ValidationError):
             harness.apply_queue(bad)
+
+
+class TestAccountantNodeLoss:
+    """Runs LAST on purpose: its converges warm the solver executables,
+    which would deflate the contended fixture's solver-seconds
+    denominator in TestReclaim.test_ordering_overhead_small."""
+
+    def test_node_failure_storm_stays_exact_mid_convergence(self):
+        """Satellite pin (PR 4): pods dying via NODE FAILURE — heartbeat
+        loss, monitor eviction, gang terminations, recreations — not
+        explicit deletes. Per-queue usage must equal a full recount at
+        EVERY tick of a seeded crash/restart storm, including half-evicted
+        mid-convergence states, and again after the cluster heals."""
+        import random
+
+        from grove_tpu.sim.multitenant import build_contended_harness
+
+        harness, _tenants = build_contended_harness(
+            tenants=(
+                ("team-a", 4.0, 4),
+                ("team-b", 4.0, 4),
+                ("team-c", 4.0, 4),
+            ),
+            stagger=False,
+        )
+        harness.node_monitor.not_ready_after = 2.0
+        harness.node_monitor.lost_after = 6.0
+        harness.converge(max_ticks=200)
+        acct = harness.scheduler.quota.accountant
+
+        def check_exact(tag):
+            acct.ensure_built(harness.store)
+            got = acct.snapshot()
+            want = usage_oracle(
+                harness.store.scan("Pod"), acct.default_queue
+            )
+            for q in set(got) | set(want):
+                a, b = got.get(q, {}), want.get(q, {})
+                for r in set(a) | set(b):
+                    assert a.get(r, 0.0) == pytest.approx(
+                        b.get(r, 0.0), abs=1e-6
+                    ), (tag, q, r, a, b)
+
+        check_exact("steady")
+        rng = random.Random(5)
+        crashed = []
+        for step in range(6):
+            alive = [
+                n.name for n in harness.cluster.nodes if not n.crashed
+            ]
+            if len(alive) > 2:
+                victim = rng.choice(sorted(alive))
+                harness.cluster.crash_node(victim)
+                crashed.append(victim)
+            # tick the control plane by hand: exactness must hold in the
+            # half-converged states, not just at quiescence
+            for tick in range(rng.randint(2, 5)):
+                harness.engine.drain()
+                harness.node_monitor.tick()
+                harness.schedule()
+                harness.cluster.kubelet_tick()
+                harness.engine.drain()
+                check_exact(f"step{step}.tick{tick}")
+                harness.advance(2.0)
+            if crashed and rng.random() < 0.5:
+                harness.cluster.restart_node(
+                    crashed.pop(rng.randrange(len(crashed)))
+                )
+        for name in crashed:
+            harness.cluster.restart_node(name)
+        harness.converge(max_ticks=300)
+        check_exact("healed")
+        # the cluster really went through failures and came back whole
+        assert METRICS.counters.get("node_lost_total", 0) >= 1
+        assert harness.store.list("Pod")
+

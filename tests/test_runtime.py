@@ -203,6 +203,62 @@ class TestWorkQueue:
         q.add_rate_limited(key, now=0.0)
         assert q.next_delayed_at() == t1  # reset after forget
 
+    @staticmethod
+    def _rate_limited_delays(q, key, n):
+        """Delay of each successive add_rate_limited (the newest heap entry
+        is always the largest: delays are monotone)."""
+        delays = []
+        for _ in range(n):
+            q.add_rate_limited(key, now=0.0)
+            delays.append(max(d.ready_at for d in q._delayed))
+        return delays
+
+    def test_backoff_monotone_jittered_and_capped(self):
+        """Satellite pin: the rate-limited delay grows monotonically, stays
+        inside [base·2^f, base·2^f·(1+JITTER_FRAC)], and is HARD-capped at
+        MAX_BACKOFF (after jitter) forever."""
+        from grove_tpu.runtime.workqueue import (
+            BASE_BACKOFF,
+            JITTER_FRAC,
+            MAX_BACKOFF,
+        )
+
+        q = WorkQueue()
+        key = ("PodClique", "default", "a")
+        delays = self._rate_limited_delays(q, key, 40)
+        for f, d in enumerate(delays):
+            raw = BASE_BACKOFF * (2**f)
+            assert d <= MAX_BACKOFF + 1e-9  # the cap is absolute
+            if raw * (1 + JITTER_FRAC) < MAX_BACKOFF:
+                assert raw <= d <= raw * (1 + JITTER_FRAC)
+        for a, b in zip(delays, delays[1:]):
+            assert b >= a  # monotone despite jitter
+        # far past the crossover every delay IS the cap
+        assert delays[-1] == MAX_BACKOFF
+        assert delays[-2] == MAX_BACKOFF
+
+    def test_backoff_jitter_is_deterministic_and_desyncs_keys(self):
+        """Same key + failure count → identical delay on every run/process
+        (virtual-time replays depend on it); different keys failing at the
+        same instant → different delays (no synchronized retry burst)."""
+        key_a = ("PodClique", "default", "a")
+        key_b = ("PodClique", "default", "b")
+        run1 = self._rate_limited_delays(WorkQueue(), key_a, 10)
+        run2 = self._rate_limited_delays(WorkQueue(), key_a, 10)
+        assert run1 == run2
+        other = self._rate_limited_delays(WorkQueue(), key_b, 10)
+        assert any(a != b for a, b in zip(run1, other))
+
+    def test_backoff_per_instance_curve(self):
+        """Coarse consumers (gang requeue after node failure) pick their own
+        base/cap without touching the reconcile queues' 5ms curve."""
+        q = WorkQueue(base_backoff=1.0, max_backoff=60.0)
+        key = ("PodGang", "default", "g")
+        delays = self._rate_limited_delays(q, key, 12)
+        assert delays[0] >= 1.0
+        assert delays[-1] == 60.0
+        assert all(d <= 60.0 for d in delays)
+
 
 class TestExpectations:
     def test_fold_and_self_heal(self):
