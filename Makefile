@@ -8,7 +8,8 @@ IMAGE ?= grove-tpu:0.2.0
 
 .PHONY: test test-fast check crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
-        chaos-smoke dryrun docker-build compose-up clean
+        chaos-smoke chaos-matrix drain-smoke dryrun docker-build \
+        compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -50,8 +51,14 @@ trace-smoke:     ## 100-gang traced sim; validates the Chrome trace export
 quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge to ±1 gang of deserved, with >=1 reclaim and <=5% ordering overhead
 	$(CPU_ENV) $(PY) scripts/quota_smoke.py
 
-chaos-smoke:     ## seeded node-failure chaos run: >=2 losses + flap + store outage, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
+chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
+
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds: catches schedule-dependent regressions the single-seed smoke misses
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026
+
+drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node drain with trial-solve pre-placement, breaker open/close under an eviction storm, inert-broker A/B
+	$(CPU_ENV) $(PY) scripts/drain_smoke.py
 
 dryrun:          ## multi-chip sharding dry run on the virtual 8-mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

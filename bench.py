@@ -326,6 +326,15 @@ def _chaos_artifact_block() -> dict:
     return chaos_artifact(seed=1234)
 
 
+def _drain_artifact_block() -> dict:
+    """Voluntary-disruption run for the integrated artifact: budget-checked
+    gang-whole drain with pre-placement, breaker storm open/close, and the
+    inert-broker A/B (docs/robustness.md acceptance)."""
+    from grove_tpu.sim.voluntary import drain_artifact
+
+    return drain_artifact()
+
+
 def _quota_artifact() -> dict:
     """3-tenant contended fair-share run + single-queue A/B, run after the
     main integrated population in the same process (metrics are deltas, so
@@ -399,9 +408,14 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # control (admissions must be identical with quota inert)
             "quota": _quota_artifact(),
             # robustness block (docs/robustness.md acceptance): one seeded
-            # chaos run — node losses, a flap, a store outage — with the
-            # per-tick invariants and the fault-free-tree convergence check
+            # chaos run — node losses, a flap, a store outage, a drain, a
+            # leader failover — with the per-tick invariants and the
+            # fault-free-tree convergence check
             "chaos": _chaos_artifact_block(),
+            # voluntary-disruption block: budget-checked gang-whole drain
+            # with trial-solve pre-placement, breaker storm open/close,
+            # and the inert-broker A/B
+            "drain": _drain_artifact_block(),
         }
 
     _run_population_bench(

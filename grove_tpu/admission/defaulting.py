@@ -56,6 +56,13 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
             "terminationGracePeriodSeconds", DEFAULT_TERMINATION_GRACE_PERIOD
         )
 
+    # disruption budget defaults (grove-tpu extension — see
+    # api/types.py DisruptionBudget): a budget block without an explicit
+    # cap means "one gang at a time", the PDB-ish conservative default
+    if tmpl.disruption_budget is not None:
+        if tmpl.disruption_budget.max_unavailable_gangs is None:
+            tmpl.disruption_budget.max_unavailable_gangs = 1
+
     # spread constraint defaults (grove-tpu extension — see
     # api/types.py TopologyConstraint)
     tc = tmpl.topology_constraint

@@ -204,6 +204,31 @@ def _validate_spec(
             "spec.template.terminationDelay", "terminationDelay must be greater than 0"
         )
 
+    # --- disruption budget (docs/robustness.md voluntary disruption) ----
+    db = tmpl.disruption_budget
+    if db is not None:
+        if db.max_unavailable_gangs is None:
+            res.error(
+                "spec.template.disruptionBudget.maxUnavailableGangs",
+                "field is required",
+            )
+        elif db.max_unavailable_gangs < 0:
+            res.error(
+                "spec.template.disruptionBudget.maxUnavailableGangs",
+                "must be non-negative (0 blocks all voluntary disruption)",
+            )
+        elif db.max_unavailable_gangs == 0:
+            res.warn(
+                "disruptionBudget.maxUnavailableGangs=0 blocks every"
+                " voluntary disruption, including rolling updates and"
+                " node drains, until the budget is raised"
+            )
+        if db.quiet_window is not None and db.quiet_window < 0:
+            res.error(
+                "spec.template.disruptionBudget.quietWindow",
+                "must be non-negative",
+            )
+
     # --- cliques --------------------------------------------------------
     if not tmpl.cliques:
         res.error("spec.template.cliques", "at least one PodClique must be defined")

@@ -47,6 +47,7 @@ _API_VERSIONS = {
     "RoleBinding": "rbac.authorization.k8s.io/v1",
     "HorizontalPodAutoscaler": "autoscaling/v2",
     "Lease": "coordination.k8s.io/v1",
+    "NodeDrain": "scheduler.grove.io/v1alpha1",
 }
 
 

@@ -389,6 +389,38 @@ class HeadlessServiceConfig:
 
 
 @dataclass
+class DisruptionBudget:
+    """grove-tpu extension (docs/robustness.md "voluntary disruption"): a
+    PodDisruptionBudget at GANG granularity, enforced by the
+    DisruptionBroker (grove_tpu/disruption) against every VOLUNTARY
+    disruptor — node drain, priority preemption, quota reclaim, rolling
+    update. Involuntary failures (node loss) bypass it but still count
+    toward the unavailable tally a voluntary request is checked against.
+
+    ``max_unavailable_gangs``: how many of the set's gangs may be
+    voluntarily unavailable at once (0 = block all voluntary disruption).
+    ``quiet_window``: minimum virtual seconds between granted voluntary
+    disruptions of this set (None = no pacing beyond the budget)."""
+
+    max_unavailable_gangs: Optional[int] = None  # defaulted to 1
+    quiet_window: Optional[float] = None  # seconds
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["DisruptionBudget"]:
+        if d is None:
+            return None
+        qw = d.get("quietWindow")
+        return DisruptionBudget(
+            max_unavailable_gangs=(
+                int(d["maxUnavailableGangs"])
+                if d.get("maxUnavailableGangs") is not None
+                else None
+            ),
+            quiet_window=parse_duration(qw) if qw is not None else None,
+        )
+
+
+@dataclass
 class PodCliqueSetTemplateSpec:
     """podcliqueset.go:123-156."""
 
@@ -398,6 +430,7 @@ class PodCliqueSetTemplateSpec:
     headless_service_config: Optional[HeadlessServiceConfig] = None
     topology_constraint: Optional[TopologyConstraint] = None
     termination_delay: Optional[float] = None  # seconds
+    disruption_budget: Optional[DisruptionBudget] = None
     pod_clique_scaling_group_configs: List[PodCliqueScalingGroupConfig] = field(
         default_factory=list
     )
@@ -438,6 +471,9 @@ class PodCliqueSetTemplateSpec:
                 d.get("topologyConstraint")
             ),
             termination_delay=parse_duration(td) if td is not None else None,
+            disruption_budget=DisruptionBudget.from_dict(
+                d.get("disruptionBudget")
+            ),
             pod_clique_scaling_group_configs=[
                 PodCliqueScalingGroupConfig.from_dict(g)
                 for g in d.get("podCliqueScalingGroups") or []

@@ -114,6 +114,16 @@ _KINDS = [
     ),
     # leader-election lease (coordination.k8s.io/v1, manager.go:84-98)
     KindInfo("Lease", GenericObject, "coordination.k8s.io", "v1", "leases"),
+    # persisted node-drain intent (grove_tpu/disruption/drain.py): stored —
+    # not controller memory — so a leader failover resumes in-flight drains
+    KindInfo(
+        "NodeDrain",
+        GenericObject,
+        "scheduler.grove.io",
+        "v1alpha1",
+        "nodedrains",
+        namespaced=False,
+    ),
 ]
 
 KIND_REGISTRY: Dict[str, KindInfo] = {k.kind: k for k in _KINDS}

@@ -686,13 +686,16 @@ def _print_node_table(items: list) -> None:
             it.get("name", "?"),
             it.get("state", "?")
             + (" (cordoned)" if it.get("cordoned") else ""),
+            it.get("drain") or "-",
             f"{it.get('heartbeatAgeSeconds', 0.0):.1f}s",
             str(it.get("boundPods", 0)),
             _fmt_resource_map(it.get("capacity", {})),
         )
         for it in items
     ]
-    _print_table(("NAME", "STATE", "HEARTBEAT-AGE", "PODS", "CAPACITY"), rows)
+    _print_table(
+        ("NAME", "STATE", "DRAIN", "HEARTBEAT-AGE", "PODS", "CAPACITY"), rows
+    )
 
 
 def _cmd_nodes(args) -> int:
@@ -716,6 +719,59 @@ def _cmd_nodes(args) -> int:
     if args.manifests:
         harness.converge()
     _print_node_table(harness.node_monitor.node_snapshot())
+    return 0
+
+
+def _post_server_json(apiserver: str, path: str, label: str):
+    """POST (no body) to a live apiserver; returns the JSON document or
+    None after printing the error."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = apiserver if "://" in apiserver else f"http://{apiserver}"
+    req = urllib.request.Request(f"{url}{path}", data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return _json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            doc = _json.loads(e.read())
+            msg = doc.get("message", str(e))
+        except ValueError:
+            msg = str(e)
+        print(f"{label}: {url}: {msg}", file=sys.stderr)
+        return None
+    except (OSError, ValueError) as e:
+        print(f"{label}: {url}: {e}", file=sys.stderr)
+        return None
+
+
+def _cmd_drain(args) -> int:
+    """Gang-aware node drain (docs/robustness.md): cordon the node and
+    evict its gangs whole, budget-checked, with trial-solved pre-placement
+    — POST /nodes/{name}/drain on a live apiserver."""
+    doc = _post_server_json(
+        args.apiserver, f"/nodes/{args.node}/drain", "drain"
+    )
+    if doc is None:
+        return 1
+    print(
+        f"node {doc.get('name', args.node)} draining; watch progress with"
+        f" `cli nodes --apiserver {args.apiserver}` (DRAIN column)"
+    )
+    return 0
+
+
+def _cmd_uncordon(args) -> int:
+    """Return a drained/cordoned node to service — POST
+    /nodes/{name}/uncordon on a live apiserver."""
+    doc = _post_server_json(
+        args.apiserver, f"/nodes/{args.node}/uncordon", "uncordon"
+    )
+    if doc is None:
+        return 1
+    print(f"node {doc.get('name', args.node)} uncordoned")
     return 0
 
 
@@ -984,6 +1040,29 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument("--nodes", type=int, default=32)
     p.add_argument("--apiserver", help="read GET /nodes from a live server")
     p.set_defaults(fn=_cmd_nodes)
+
+    p = sub.add_parser(
+        "drain",
+        help=(
+            "drain a node on a live apiserver: cordon + budget-checked"
+            " gang-whole eviction with pre-placement (docs/robustness.md)"
+        ),
+    )
+    p.add_argument("node", help="node name")
+    p.add_argument(
+        "--apiserver", required=True, help="apiserver URL (host:port)"
+    )
+    p.set_defaults(fn=_cmd_drain)
+
+    p = sub.add_parser(
+        "uncordon",
+        help="return a drained/cordoned node to service on a live apiserver",
+    )
+    p.add_argument("node", help="node name")
+    p.add_argument(
+        "--apiserver", required=True, help="apiserver URL (host:port)"
+    )
+    p.set_defaults(fn=_cmd_uncordon)
 
     p = sub.add_parser("bench", help="run the stress benchmark")
     p.add_argument("--small", action="store_true")

@@ -145,6 +145,11 @@ class APIServer:
         # infrastructure, not store objects, so they arrive by callback;
         # None → an empty list (server without a sim cluster attached).
         self.node_provider = node_provider
+        # POST /nodes/{name}/drain and /uncordon (docs/robustness.md):
+        # callbacks into the NodeDrainController — name -> wire row, or
+        # None for an unknown node. Unset → 404 (no drain controller).
+        self.drain_handler: Optional[Callable[[str], Optional[dict]]] = None
+        self.uncordon_handler: Optional[Callable[[str], Optional[dict]]] = None
         # config-gated like the reference pprof listener (manager.go:108-113)
         # and serialized: concurrent samplers would degrade the whole
         # control plane (every 100Hz stack walk contends on the GIL)
@@ -379,14 +384,18 @@ class APIServer:
                     )
                 if path == "/nodes":
                     # node health table (docs/robustness.md): name, state
-                    # (Ready/NotReady/Lost), cordon flag, heartbeat age,
-                    # capacity, labels, bound-pod count
-                    with server.lock:
-                        items = (
-                            server.node_provider()
-                            if server.node_provider is not None
-                            else []
-                        )
+                    # (Ready/NotReady/Lost), cordon flag, drain state,
+                    # heartbeat age, capacity, labels, bound-pod count.
+                    # NOT under server.lock: in real-cluster mode the
+                    # provider's store is an HttpStore pointed back at THIS
+                    # server (drain states live in NodeDrain objects), and
+                    # the nested request would deadlock on the held lock.
+                    # The provider serves from point-in-time copies.
+                    items = (
+                        server.node_provider()
+                        if server.node_provider is not None
+                        else []
+                    )
                     return self._send_json(
                         200, {"kind": "NodeList", "items": items}
                     )
@@ -526,6 +535,57 @@ class APIServer:
                             server._subs.remove(sub)
 
             def do_POST(self):
+                # node lifecycle actions (docs/robustness.md drain flow):
+                # POST /nodes/{name}/drain | /nodes/{name}/uncordon
+                parts = [
+                    urllib.parse.unquote(p)
+                    for p in urllib.parse.urlsplit(self.path).path.split("/")
+                    if p
+                ]
+                if len(parts) == 3 and parts[0] == "nodes" and parts[2] in (
+                    "drain",
+                    "uncordon",
+                ):
+                    handler = (
+                        server.drain_handler
+                        if parts[2] == "drain"
+                        else server.uncordon_handler
+                    )
+                    if handler is None:
+                        return self._error(
+                            404, "no drain controller attached to this server"
+                        )
+                    # node lifecycle actions are operator-tier: with the
+                    # authorizer enabled, only the operator identity or an
+                    # exempt service account may evict workloads this way
+                    # (the same principals the store guard trusts) — an
+                    # anonymous client must not drain a node it could not
+                    # delete a managed pod from
+                    guard = server.store.guard
+                    if guard is not None and guard.enabled:
+                        username = self._username()
+                        if (
+                            username != guard.operator_username
+                            and username not in guard.exempt
+                        ):
+                            return self._error(
+                                403,
+                                f"{parts[2]} of node {parts[1]!r} is denied"
+                                f" for user {username!r}: node lifecycle"
+                                " actions require the operator identity or"
+                                " an exempt service account",
+                                "Forbidden",
+                            )
+                    # not under server.lock — same nested-self-call rule as
+                    # GET /nodes: the controller persists the NodeDrain
+                    # intent through its own store, which in real-cluster
+                    # mode is an HttpStore calling back into this server
+                    row = handler(parts[1])
+                    if row is None:
+                        return self._error(
+                            404, f"node {parts[1]!r} not found", "NotFound"
+                        )
+                    return self._send_json(200, row)
                 route = self._route()
                 if route is None:
                     return self._error(404, f"unknown path {self.path}")

@@ -108,6 +108,13 @@ class OperatorRuntime:
     # components/hpa) — evaluated each control round like the kube HPA sync
     autoscaler: Optional[object] = None
     metrics_provider: Optional[object] = None
+    # node-health monitor + voluntary-disruption layer (docs/robustness.md):
+    # heartbeat lifecycle/gang recovery, the disruption broker every
+    # voluntary evictor consults, and the drain workflow behind
+    # POST /nodes/{name}/drain
+    node_monitor: Optional[object] = None
+    disruption: Optional[object] = None
+    drainer: Optional[object] = None
 
     def _drain(self) -> int:
         if self.threaded:
@@ -142,6 +149,16 @@ class OperatorRuntime:
                 work += self.autoscaler.tick()
             except GroveError:
                 pass  # conflicting writer; next tick re-reads
+        if self.node_monitor is not None:
+            try:
+                work += self.node_monitor.tick()
+            except GroveError:
+                pass  # transient apiserver blip; level-triggered retry
+        if self.drainer is not None:
+            try:
+                work += self.drainer.tick()
+            except GroveError:
+                pass  # intent is persisted; the drain resumes next round
         if self.scheduler is not None:
             try:
                 work += self.scheduler.schedule_pending()
@@ -172,6 +189,13 @@ class OperatorRuntime:
                     self.engine.requeue_all()
                     if self.cluster is not None:
                         self.cluster.rebuild_bindings()
+                    if self.node_monitor is not None:
+                        # re-prime gang holds/backoff from persisted
+                        # conditions: a failover landing mid-outage must
+                        # neither strand a held gang (hold without a
+                        # scheduled release) nor let every terminated gang
+                        # churn the solver unpaced
+                        self.node_monitor.resync()
                     continue
                 if self.converge_once() == 0:
                     stop.wait(poll)
@@ -287,7 +311,7 @@ def start_operator(
     # with_scheduler=False leaves binding entirely to an EXTERNAL scheduler
     # consuming the PodGang contract over the wire (the reference's KAI
     # deployment shape — grove_tpu.cluster.extscheduler is the stand-in)
-    cluster = scheduler = None
+    cluster = scheduler = node_monitor = disruption = drainer = None
     if with_scheduler:
         cluster = SimCluster(store=store, nodes=nodes or make_nodes(16))
         # restart path: account for pods a predecessor already bound (an
@@ -302,6 +326,28 @@ def start_operator(
             max_waves=config.solver.max_waves,
             solver_sidecar=config.solver.sidecar_address or None,
         )
+        # node-health + voluntary-disruption layer (docs/robustness.md):
+        # same wiring shape as the sim harness
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+        from grove_tpu.disruption import (
+            DisruptionBroker,
+            NodeDrainController,
+        )
+
+        node_monitor = NodeHealthMonitor(store, cluster)
+        scheduler.monitor = node_monitor
+        disruption = DisruptionBroker(store)
+        scheduler.broker = disruption
+        drainer = NodeDrainController(
+            store, cluster, scheduler, node_monitor, disruption
+        )
+        node_monitor.drain_states = drainer.states
+        node_monitor.resync()  # restart path: re-prime persisted requeues
+        ctx.disruption = disruption  # rolling update consults it too
+        if apiserver is not None:
+            apiserver.node_provider = node_monitor.node_snapshot
+            apiserver.drain_handler = drainer.request_drain
+            apiserver.uncordon_handler = drainer.uncordon
     from grove_tpu.autoscale.hpa import (
         HorizontalAutoscaler,
         StaticMetricsProvider,
@@ -348,4 +394,7 @@ def start_operator(
         threaded=threaded,
         autoscaler=autoscaler,
         metrics_provider=metrics_provider,
+        node_monitor=node_monitor,
+        disruption=disruption,
+        drainer=drainer,
     )

@@ -35,6 +35,10 @@ class OperatorContext:
     store: Store
     clock: Clock
     topology: Optional[ClusterTopology] = None
+    # disruption broker (grove_tpu/disruption): the rolling-update flow
+    # asks it before taking a replica's gangs down; None (bare tests) or an
+    # un-armed broker (no budgets/drains) allows everything untouched
+    disruption: Optional[object] = None
     pod_expectations: ExpectationsStore = field(
         default_factory=lambda: ExpectationsStore("pod")
     )

@@ -102,6 +102,11 @@ class NodeHealthMonitor:
         # `rescues` for the chaos harness's placement verification
         self._rescue_pending: Dict[GangKey, dict] = {}
         self.rescues: List[dict] = []
+        # GET /nodes drain column: () -> {node name: Draining|Drained},
+        # wired to NodeDrainController.states by the harness/manager (the
+        # drain workflow is a separate controller; the monitor only
+        # surfaces its state in the node table)
+        self.drain_states = None
 
     # -- scheduler contract ----------------------------------------------
 
@@ -109,6 +114,115 @@ class NodeHealthMonitor:
         """True while the gang sits in requeue backoff — the scheduler
         skips encoding it (its pods stay pending, untouched)."""
         return (namespace, name) in self._held
+
+    def hold_gang(self, key: GangKey) -> None:
+        """Put a gang into rate-limited requeue backoff from OUTSIDE the
+        node-failure triage — the drain controller's terminate-and-requeue
+        fallback uses the same pacing a NodeFailure termination gets.
+        Every hold is paired with a scheduled release (the workqueue's
+        delayed entry) — a hold without one would strand the gang, since
+        nothing else ever releases it."""
+        self._held.add(key)
+        self._probation.discard(key)
+        self.requeue.add_rate_limited(
+            ("PodGang",) + key, self.store.clock.now()
+        )
+
+    def resync(self) -> int:
+        """Fresh-leader re-prime (manager run-loop failover, chaos
+        ``leader_crash``): monitor holds and backoff counters live in
+        leader memory, so a standby that takes over mid-outage starts with
+        none — every gang the OLD leader had terminated-and-requeued would
+        re-enter the solve unpaced (churn), and a NAIVE re-prime that adds
+        holds without scheduled releases would strand them forever.
+
+        Re-derive from persisted state: a gang whose Scheduled condition
+        is False with a terminate-and-requeue reason (NodeFailure/Drained)
+        is re-held WITH a fresh rate-limited release while unhealthy
+        capacity is still missing; once every node is back there is
+        nothing to wait for — it goes to probation for an immediate solve
+        attempt instead. Also drops stale holds for gangs that vanished or
+        re-scheduled. Returns entries touched."""
+        now = self.store.clock.now()
+        # LIVE health, not the state label: `state` is maintained by monitor
+        # ticks (this monitor has run none), so a node restarted just
+        # before the failover still reads Lost — but its kubelet is up
+        # (crashed=False) and the first tick will flip it Ready. Only a
+        # dead kubelet means capacity is actually missing.
+        unhealthy = any(n.crashed for n in self.cluster.nodes)
+        touched = 0
+        for gang in self.store.scan("PodGang"):
+            key = (gang.metadata.namespace, gang.metadata.name)
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is None or cond.is_true():
+                continue
+            if cond.reason not in ("NodeFailure", "Drained"):
+                continue
+            if key in self._held or key in self._probation:
+                continue
+            if unhealthy:
+                self._held.add(key)
+                self.requeue.add_rate_limited(("PodGang",) + key, now)
+            else:
+                # capacity is all back: pacing a placeable gang would only
+                # idle it — one immediate solve attempt, then normal
+                # probation re-arming if it still does not fit
+                self._probation.add(key)
+            touched += 1
+        for key in sorted(self._held):
+            gang = self.store.get("PodGang", key[0], key[1], readonly=True)
+            cond = (
+                get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+                if gang is not None
+                else None
+            )
+            if gang is None or (cond is not None and cond.is_true()):
+                self._held.discard(key)
+                wq_key = ("PodGang",) + key
+                self.requeue.forget(wq_key)
+                self.requeue.discard_delayed(wq_key)
+                touched += 1
+        touched += self._resync_rescues(now)
+        return touched
+
+    def _resync_rescues(self, now: float) -> int:
+        """Rescue tracking is leader memory too: a gang mid-rescue at
+        failover (Scheduled=True, replacement pods not yet bound) would
+        complete silently — no GangRescued, no domain verification. Re-prime
+        a pending-rescue record for every scheduled gang with unbound pod
+        references; the survivors' domain is recomputed from live bindings
+        (the lost node's name is gone with the old leader)."""
+        primed = 0
+        for gang in self.store.scan("PodGang"):
+            key = (gang.metadata.namespace, gang.metadata.name)
+            if key in self._rescue_pending or key in self._held:
+                continue
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is None or not cond.is_true():
+                continue
+            whole = all(
+                (ref.namespace, ref.name) in self.cluster.bindings
+                for group in gang.spec.pod_groups
+                for ref in group.pod_references
+            )
+            if whole:
+                continue
+            domain_key, domain = self._survivor_domain(gang)
+            self._rescue_pending[key] = {
+                "namespace": key[0],
+                "gang": key[1],
+                "lost_node": "(pre-failover)",
+                "survivors": dict(self._group_survivors(gang)),
+                "domain_key": domain_key,
+                "domain": domain,
+                "since": now,
+            }
+            primed += 1
+        return primed
 
     def next_deadline(self) -> Optional[float]:
         """Earliest future moment this monitor will act: a crashed node
@@ -556,12 +670,15 @@ class NodeHealthMonitor:
         # the live dict would race ("dict changed size during iteration")
         for _key, bound in list(self.cluster.bindings.items()):
             bound_counts[bound] = bound_counts.get(bound, 0) + 1
+        drains = self.drain_states() if self.drain_states is not None else {}
         return [
             {
                 "name": n.name,
                 "state": n.state,
                 "cordoned": n.cordoned,
                 "schedulable": n.schedulable,
+                # "" | Draining | Drained (docs/robustness.md drain flow)
+                "drain": drains.get(n.name, ""),
                 "heartbeatAgeSeconds": round(max(0.0, now - n.last_heartbeat), 3),
                 "capacity": dict(n.capacity),
                 "labels": dict(n.labels),

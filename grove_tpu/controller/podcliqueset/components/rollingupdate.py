@@ -60,6 +60,13 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
         progress = pcs.status.rolling_update_progress
 
     next_replica = _pick_next_replica(ctx, pcs)
+    if next_replica is not None and not _disruption_granted(
+        ctx, pcs, next_replica
+    ):
+        # the replica's gangs are protected right now (disruptionBudget /
+        # quiet window / storm breaker — grove_tpu/disruption): keep the
+        # update pending and retry; the broker emitted DisruptionThrottled
+        return 2.0
     if next_replica is None:
         progress.update_ended_at = ctx.clock.now()
         progress.currently_updating = None
@@ -85,6 +92,75 @@ def sync(ctx: OperatorContext, pcs: PodCliqueSet) -> Optional[float]:
     )
     _push_template_to_replica(ctx, pcs, next_replica)
     return 2.0
+
+
+# ---------------------------------------------------------------------------
+# disruption gate (grove_tpu/disruption, docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+
+def _replica_gangs(ctx: OperatorContext, pcs: PodCliqueSet, replica: int) -> List:
+    """Every PodGang the replica owns: the base gang `{pcs}-{replica}` plus
+    scaled gangs named under its PCSGs (`{pcs}-{replica}-{sg}-{i}`)."""
+    base = namegen.base_podgang_name(pcs.metadata.name, replica)
+    prefix = f"{base}-"
+    return [
+        g
+        for g in ctx.store.list(
+            "PodGang",
+            pcs.metadata.namespace,
+            namegen.default_labels(pcs.metadata.name),
+        )
+        if g.metadata.name == base or g.metadata.name.startswith(prefix)
+    ]
+
+
+def _disruption_granted(
+    ctx: OperatorContext, pcs: PodCliqueSet, replica: int
+) -> bool:
+    """Rolling updates are voluntary disruptions: before the replica's
+    cliques get the new template (and their pods die), the whole replica's
+    gang set must clear the broker in one grant — and the granted gangs
+    are marked DisruptionTarget=RollingUpdate, so the per-PCS budget
+    invariant and gauges see a mid-update replica exactly like a drained
+    one (a concurrent drain on the same set is then denied)."""
+    if ctx.disruption is None or not ctx.disruption.active():
+        return True
+    gangs = _replica_gangs(ctx, pcs, replica)
+    if not gangs:
+        return True
+    if not ctx.disruption.grant(gangs, "rolling-update"):
+        return False
+    from grove_tpu.api.meta import Condition, set_condition
+    from grove_tpu.api.types import COND_PODGANG_DISRUPTION_TARGET
+    from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
+
+    for gang in gangs:
+        # conflict-tolerant: the scheduler flips this back to False
+        # (reason Rescheduled) once the updated gang re-places
+        for _ in range(4):
+            fresh = ctx.store.get(
+                "PodGang", gang.metadata.namespace, gang.metadata.name
+            )
+            if fresh is None:
+                break
+            set_condition(
+                fresh.status.conditions,
+                Condition(
+                    type=COND_PODGANG_DISRUPTION_TARGET,
+                    status="True",
+                    reason="RollingUpdate",
+                    message=f"replica {replica} selected for rolling update",
+                ),
+                ctx.clock.now(),
+            )
+            try:
+                ctx.store.update_status(fresh)
+                break
+            except GroveError as e:
+                if e.code != ERR_CONFLICT:
+                    raise
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,28 @@
+"""Voluntary-disruption layer (docs/robustness.md).
+
+PR 4 gave the control plane an involuntary-failure story (node loss, gang
+rescue); this package is the VOLUNTARY counterpart: every disruptor that
+chooses to evict — node drain, priority preemption, quota reclaim, rolling
+update — consults one ``DisruptionBroker`` that enforces per-PodCliqueSet
+``disruptionBudget``s and a cluster-wide disruption-storm circuit breaker,
+and the ``NodeDrainController`` runs the cordon → budget-checked,
+trial-solved, gang-whole eviction workflow.
+"""
+
+from grove_tpu.disruption.broker import (
+    VOLUNTARY_REASONS,
+    DisruptionBroker,
+)
+from grove_tpu.disruption.drain import (
+    DRAIN_DRAINED,
+    DRAIN_DRAINING,
+    NodeDrainController,
+)
+
+__all__ = [
+    "DisruptionBroker",
+    "NodeDrainController",
+    "VOLUNTARY_REASONS",
+    "DRAIN_DRAINING",
+    "DRAIN_DRAINED",
+]

@@ -1,0 +1,396 @@
+"""Gang-aware node drain: cordon → budget-checked, trial-solved, gang-whole
+eviction (docs/robustness.md "draining a node").
+
+The maintenance path PR 4's involuntary lifecycle had no answer for:
+taking a node out of service WITHOUT simulating a crash. The workflow per
+draining node, one monitor-style tick at a time:
+
+1. **Cordon** — the node stops being a placement target immediately
+   (``Node.cordoned`` feeds ``Node.schedulable``, the single solve mask).
+2. For every scheduled gang with a pod on the node, in deterministic
+   order:
+   a. **Budget check** — the DisruptionBroker must grant the eviction
+      (per-PCS ``disruptionBudget``, quiet window, storm breaker). A
+      denial leaves the gang bound; the drain retries next tick.
+   b. **Trial-solve pre-placement** — the WHOLE gang is trial-solved
+      against the remaining schedulable nodes with its own current usage
+      credited back (the scheduler's trial machinery, same kernel the
+      preemption/reclaim paths use). Admitted ⇒ a placement exists
+      BEFORE any pod dies; the planned nodes are recorded and the normal
+      solve re-places the gang right after eviction.
+   c. **Gang-whole eviction** — all of the gang's pods are deleted
+      together (gang semantics: pods of one gang never dribble away
+      one by one), ``DisruptionTarget=True``/``Scheduled=False`` reason
+      ``Drained``. With a verified pre-placement the gang re-enters the
+      very next solve; WITHOUT one (cluster too full) it falls back to
+      terminate-and-requeue under the node-health monitor's rate-limited
+      backoff — the same pacing a node-failure termination gets — and the
+      failure feeds the storm breaker.
+3. When no bound pods remain the node reports **Drained**
+   (``NodeDrained``); ``uncordon`` returns it to service.
+
+Drain INTENT is persisted as a cluster-scoped ``NodeDrain`` object in the
+store, not broker/controller memory: a leader failover mid-drain resumes
+the workflow from the store (chaos ``leader_crash`` fault pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.meta import ObjectMeta, get_condition
+from grove_tpu.api.types import (
+    COND_PODGANG_SCHEDULED,
+    SPREAD_SCHEDULE_ANYWAY,
+    GenericObject,
+)
+from grove_tpu.observability.events import (
+    EVENTS,
+    REASON_GANG_DRAINED,
+    REASON_NODE_DRAINED,
+    REASON_NODE_DRAINING,
+    REASON_NODE_UNCORDONED,
+    TYPE_NORMAL,
+    TYPE_WARNING,
+)
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.tracing import TRACER
+from grove_tpu.runtime.errors import GroveError
+
+DRAIN_DRAINING = "Draining"
+DRAIN_DRAINED = "Drained"
+
+GangKey = Tuple[str, str]
+
+
+class NodeDrainController:
+    """Tick-driven drain workflow over one store/cluster/scheduler triple.
+
+    Level-triggered off the persisted ``NodeDrain`` intents — the
+    controller itself keeps no drain state, so a fresh instance (leader
+    failover) resumes every in-flight drain from the store.
+    """
+
+    def __init__(self, store, cluster, scheduler, monitor, broker) -> None:
+        self.store = store
+        self.cluster = cluster
+        self.scheduler = scheduler
+        self.monitor = monitor
+        self.broker = broker
+        # archive of completed gang evictions for smokes/benches:
+        # {gang, node, pre_placed, planned_nodes, at}
+        self.drained_gangs: List[dict] = []
+
+    # -- operator actions --------------------------------------------------
+
+    def request_drain(self, node_name: str) -> Optional[dict]:
+        """Cordon the node and persist the drain intent. Returns the wire
+        row (as in GET /nodes) or None when the node does not exist.
+        Idempotent: re-requesting an active drain is a no-op."""
+        node = self.cluster.node(node_name)
+        if node is None:
+            return None
+        self.broker.arm()
+        node.cordoned = True
+        if self.store.get("NodeDrain", "", node_name) is None:
+            try:
+                self.store.create(
+                    GenericObject(
+                        kind="NodeDrain",
+                        metadata=ObjectMeta(name=node_name, namespace=""),
+                        spec={
+                            "state": DRAIN_DRAINING,
+                            "requestedAt": self.store.clock.now(),
+                        },
+                    )
+                )
+            except GroveError:
+                pass  # lost a create race / transient outage: intent-only
+        EVENTS.record(
+            ("Node", "", node_name),
+            TYPE_NORMAL,
+            REASON_NODE_DRAINING,
+            "drain requested: node cordoned; evicting its gangs whole,"
+            " budget-checked",
+        )
+        METRICS.inc("node_drains_requested_total")
+        return {"name": node_name, "drain": DRAIN_DRAINING}
+
+    def uncordon(self, node_name: str) -> Optional[dict]:
+        """Return the node to service: clear the cordon and drop any drain
+        intent. Returns the wire row or None when the node is unknown."""
+        node = self.cluster.node(node_name)
+        if node is None:
+            return None
+        node.cordoned = False
+        try:
+            self.store.delete("NodeDrain", "", node_name)
+        except GroveError:
+            pass  # absent or transient outage; cordon flag is cleared
+        EVENTS.record(
+            ("Node", "", node_name),
+            TYPE_NORMAL,
+            REASON_NODE_UNCORDONED,
+            "node uncordoned; schedulable again",
+        )
+        return {"name": node_name, "drain": ""}
+
+    # -- surfacing ---------------------------------------------------------
+
+    def states(self) -> Dict[str, str]:
+        """node name -> Draining|Drained (absent = not draining); feeds the
+        GET /nodes drain column."""
+        return {
+            d.metadata.name: d.spec.get("state", DRAIN_DRAINING)
+            for d in self.store.scan("NodeDrain")
+        }
+
+    def drain_state(self, node_name: str) -> str:
+        d = self.store.get("NodeDrain", "", node_name, readonly=True)
+        return d.spec.get("state", DRAIN_DRAINING) if d is not None else ""
+
+    def next_deadline(self) -> Optional[float]:
+        """Drains in flight progress on ticks; a denied eviction (quiet
+        window, backoff) needs the harness to keep virtual time moving.
+        One second is the drain's retry cadence."""
+        for d in self.store.scan("NodeDrain"):
+            if d.spec.get("state") == DRAIN_DRAINING:
+                return self.store.clock.now() + 1.0
+        return None
+
+    # -- tick --------------------------------------------------------------
+
+    def tick(self) -> int:
+        """One drain round over every persisted intent. Returns actions
+        taken (evictions + completions) so harness quiescence sees drain
+        work as progress."""
+        # per-tick disruption gauges (breaker state, tokens, per-PCS
+        # disrupted counts) — a no-op while the broker is un-armed
+        self.broker.export_gauges()
+        intents = sorted(
+            self.store.scan("NodeDrain"), key=lambda d: d.metadata.name
+        )
+        if not intents:
+            return 0
+        actions = 0
+        with TRACER.span("drain.tick", nodes=len(intents)) as span:
+            for intent in intents:
+                actions += self._drain_node(intent)
+            span.set("actions", actions)
+        return actions
+
+    def _drain_node(self, intent) -> int:
+        node_name = intent.metadata.name
+        node = self.cluster.node(node_name)
+        if node is None:
+            # node left the cluster: the drain is moot
+            try:
+                self.store.delete("NodeDrain", "", node_name)
+            except GroveError:
+                pass
+            return 1
+        # re-assert the cordon level-triggered: a failover may have rebuilt
+        # the Node objects from infra state without the cordon flag
+        node.cordoned = True
+        gangs = self._bound_gangs(node_name)
+        if not gangs:
+            if intent.spec.get("state") != DRAIN_DRAINED:
+                fresh = self.store.get("NodeDrain", "", node_name)
+                if fresh is not None:
+                    fresh.spec = dict(
+                        fresh.spec,
+                        state=DRAIN_DRAINED,
+                        drainedAt=self.store.clock.now(),
+                    )
+                    try:
+                        self.store.update(fresh, bump_generation=False)
+                    except GroveError:
+                        return 0  # retry next tick
+                EVENTS.record(
+                    ("Node", "", node_name),
+                    TYPE_NORMAL,
+                    REASON_NODE_DRAINED,
+                    "no bound pods remain; node drained (still cordoned"
+                    " until uncordon)",
+                )
+                METRICS.inc("node_drains_completed_total")
+                return 1
+            return 0
+        evicted = 0
+        for key in gangs:
+            gang = self.store.get("PodGang", key[0], key[1], readonly=True)
+            if gang is None:
+                continue
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is None or not cond.is_true():
+                continue  # already being disrupted/re-placed; wait
+            if not self.broker.grant([gang], "drain"):
+                # budget/quiet-window/breaker denial for THIS gang: keep
+                # walking — other sets' gangs on the node may still be
+                # drainable (a budget-0 set must not starve its neighbors);
+                # the denied gang retries next tick
+                continue
+            pre_placed, planned = self._trial_preplacement(gang)
+            self._evict_gang_whole(gang, node_name, pre_placed)
+            if not pre_placed:
+                # terminate-and-requeue fallback: pace re-admission like a
+                # node-failure termination, and feed the storm breaker
+                self.monitor.hold_gang(key)
+                self.broker.note_failure(
+                    reason=f"drained gang {key[0]}/{key[1]} has no placement"
+                    " on the remaining nodes"
+                )
+            self.drained_gangs.append(
+                {
+                    "namespace": key[0],
+                    "gang": key[1],
+                    "node": node_name,
+                    "pre_placed": pre_placed,
+                    "planned_nodes": planned,
+                    "at": self.store.clock.now(),
+                }
+            )
+            evicted += 1
+        return evicted
+
+    # -- internals ---------------------------------------------------------
+
+    def _bound_gangs(self, node_name: str) -> List[GangKey]:
+        """Gangs with >=1 pod bound to the node, deterministic order."""
+        out = set()
+        for (ns, pod_name), bound in list(self.cluster.bindings.items()):
+            if bound != node_name:
+                continue
+            pod = self.store.get("Pod", ns, pod_name, readonly=True)
+            if pod is None:
+                continue
+            gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
+            if gang_name:
+                out.add((ns, gang_name))
+        return sorted(out)
+
+    def _gang_spec(self, gang) -> dict:
+        """Whole-gang solver spec from the CR (the drain analogue of the
+        scheduler's _encode_pending, without recovery pins — the entire
+        gang relocates, nothing anchors it)."""
+        groups = []
+        for group in gang.spec.pod_groups:
+            demand: Dict[str, float] = {}
+            for ref in group.pod_references:
+                pod = self.store.get(
+                    "Pod", ref.namespace, ref.name, readonly=True
+                )
+                if pod is not None:
+                    demand = pod.spec.total_requests()
+                    break
+            groups.append(
+                {
+                    "name": group.name,
+                    "demand": demand,
+                    "count": len(group.pod_references),
+                    "min_count": group.min_replicas,
+                    "partial": False,
+                    "required_key": (
+                        group.topology_constraint.pack_constraint.required
+                        if group.topology_constraint is not None
+                        and group.topology_constraint.pack_constraint
+                        is not None
+                        else None
+                    ),
+                    "pinned_node": None,
+                }
+            )
+        tc = gang.spec.topology_constraint
+        required = preferred = spread_key = None
+        spread_min, spread_required = 2, False
+        if tc is not None and tc.pack_constraint is not None:
+            required = tc.pack_constraint.required
+            preferred = tc.pack_constraint.preferred
+        if tc is not None and tc.spread_constraint is not None:
+            sc = tc.spread_constraint
+            spread_key = sc.topology_key
+            spread_min = sc.min_domains
+            spread_required = sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
+        ns = gang.metadata.namespace
+        return {
+            "name": f"{ns}/{gang.metadata.name}",
+            "gang_name": gang.metadata.name,
+            "namespace": ns,
+            "groups": groups,
+            "required_key": required,
+            "preferred_key": preferred,
+            "spread_key": spread_key,
+            "spread_min_domains": spread_min,
+            "spread_required": spread_required,
+            "spread_survivor_nodes": [],
+            "gang_pinned_node": None,
+            "priority": self.scheduler.priority_map.get(
+                gang.spec.priority_class_name, 0
+            ),
+            "queue": gang.metadata.labels.get(namegen.LABEL_QUEUE)
+            or self.scheduler.quota.default_queue,
+        }
+
+    def _trial_preplacement(self, gang) -> Tuple[bool, List[str]]:
+        """Trial-solve the whole gang on the remaining schedulable nodes
+        with its own bound usage credited back (it is about to be evicted
+        everywhere). Returns (placement exists, planned node list)."""
+        nodes = [n for n in self.cluster.nodes if n.schedulable]
+        if not nodes:
+            return False, []
+        free = self.cluster.node_free_all(nodes)
+        trial_free = {name: dict(caps) for name, caps in free.items()}
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                bound = self.cluster.bindings.get((ref.namespace, ref.name))
+                if bound is None or bound not in trial_free:
+                    continue  # on the drained (cordoned) node: not credited
+                pod = self.store.get(
+                    "Pod", ref.namespace, ref.name, readonly=True
+                )
+                if pod is None:
+                    continue
+                caps = trial_free[bound]
+                for r, q in pod.spec.total_requests().items():
+                    caps[r] = caps.get(r, 0.0) + q
+        spec = self._gang_spec(gang)
+        with TRACER.span(
+            "drain.trial", gang=spec["name"], nodes=len(nodes)
+        ) as span:
+            result, problem = self.scheduler._solve_batch(
+                nodes, [spec], trial_free
+            )
+            admitted = bool(result.admitted[0])
+            span.set("admitted", admitted)
+        if not admitted:
+            return False, []
+        planned: List[str] = []
+        assignments = result.assignments(problem)
+        for _group, node_names in sorted(
+            assignments.get(spec["name"], {}).items()
+        ):
+            planned.extend(node_names)
+        return True, planned
+
+    def _evict_gang_whole(self, gang, node_name: str, pre_placed: bool) -> None:
+        message = (
+            f"node {node_name} draining; gang evicted whole"
+            + (
+                " (placement on remaining nodes verified before eviction)"
+                if pre_placed
+                else " (no placement on remaining nodes: terminate-and-"
+                "requeue under backoff)"
+            )
+        )
+        self.scheduler._evict_victim(
+            gang,
+            {"name": f"drain/{node_name}"},
+            disruption_reason="Drained",
+            sched_reason="Drained",
+            event_reason=REASON_GANG_DRAINED,
+            message=message,
+            metric="gang_drains_total",
+        )

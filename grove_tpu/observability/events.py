@@ -46,6 +46,15 @@ REASON_NODE_READY = "NodeReady"
 REASON_GANG_RESCUED = "GangRescued"
 REASON_GANG_REQUEUED = "GangRequeued"
 REASON_GANG_RELEASED = "GangBackoffReleased"
+# voluntary-disruption layer (docs/robustness.md, grove_tpu/disruption):
+# drain lifecycle, budget/breaker denials, and the breaker's state flips
+REASON_NODE_DRAINING = "NodeDraining"
+REASON_NODE_DRAINED = "NodeDrained"
+REASON_NODE_UNCORDONED = "NodeUncordoned"
+REASON_GANG_DRAINED = "GangDrained"
+REASON_DISRUPTION_THROTTLED = "DisruptionThrottled"
+REASON_BREAKER_OPEN = "BreakerOpen"
+REASON_BREAKER_CLOSED = "BreakerClosed"
 
 
 @dataclass

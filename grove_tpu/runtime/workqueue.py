@@ -126,6 +126,12 @@ class WorkQueue:
     def next_delayed_at(self) -> Optional[float]:
         return self._delayed[0].ready_at if self._delayed else None
 
+    def has_delayed(self, key: Key) -> bool:
+        """True while a not-yet-promoted delayed entry exists for the key —
+        the chaos harness asserts every monitor-held gang keeps one (a hold
+        with no scheduled release would be stranded forever)."""
+        return any(d.key == key for d in self._delayed)
+
     def __len__(self) -> int:
         return len(self._ready)
 

@@ -3,9 +3,15 @@
 The acceptance driver for the node-failure & recovery subsystem
 (docs/robustness.md): a fixed seed expands into a fault schedule — node
 crashes (beyond the heartbeat grace window: real losses), a flap (crash +
-restart inside the window), and a transient store outage (the
-``Store.error_injectors`` hook) — replayed on virtual time over a workload
-that mixes rescuable gangs, topology-packed rescuable gangs, and strict
+restart inside the window), a transient store outage (the
+``Store.error_injectors`` hook), a gang-aware node **drain** (the
+voluntary-disruption layer: budget-checked, trial-solved, gang-whole
+eviction), and a **leader crash** mid-drain (LeaseElector failover: the
+standby takes the lease, rebuilds every piece of leader memory — engine
+requeue_all, binding map, monitor holds via ``resync()``, drain intents
+from the persisted NodeDrain objects — and the run continues) — replayed
+on virtual time over a workload that mixes rescuable gangs (with a
+``disruptionBudget``), topology-packed rescuable gangs, and strict
 (minAvailable == replicas) gangs that must gang-terminate and requeue.
 
 Every tick asserts the chaos invariants:
@@ -18,6 +24,12 @@ Every tick asserts the chaos invariants:
 3. **Capacity accounting stays exact**: the incremental quota accountant
    equals a full recount (``quota/oracle.py::usage_oracle``), and no node's
    bound requests exceed its capacity.
+4. **No disruptionBudget is ever exceeded**: per budgeted PodCliqueSet, the
+   gangs unavailable due to a VOLUNTARY disruption never outnumber
+   ``maxUnavailableGangs`` — across drain, failover, everything.
+5. **No stranded hold**: every gang the monitor holds in requeue backoff
+   has a scheduled release in the workqueue (a hold without one would wait
+   forever — the failover-resync bug class).
 
 After the last fault clears, the run must converge: every gang Running,
 every pod Ready, nothing on an unhealthy node, and the resource tree equal
@@ -25,8 +37,9 @@ to a fault-free twin run of the same workload. Rescued packed gangs are
 verified — via actual placements — to have rejoined their survivors'
 topology domain (the packing kernel's recovery-pin path).
 
-Shared by ``make chaos-smoke`` (scripts/chaos_smoke.py), the bench's
-``"chaos"`` artifact block, and tests/test_node_failure.py.
+Shared by ``make chaos-smoke`` / ``make chaos-matrix``
+(scripts/chaos_smoke.py), the bench's ``"chaos"`` artifact block, and
+tests/test_node_failure.py.
 """
 
 from __future__ import annotations
@@ -56,6 +69,8 @@ metadata:
 spec:
   replicas: 1
   template:
+    disruptionBudget:
+      maxUnavailableGangs: 1
     cliques:
       - name: worker
         spec:
@@ -163,6 +178,9 @@ class ChaosReport:
     flaps: int = 0
     rescues: List[dict] = field(default_factory=list)
     requeues: int = 0
+    drain_evictions: int = 0
+    drains_completed: int = 0
+    failovers: int = 0
     scheduler_errors: int = 0
     invariant_violations: List[str] = field(default_factory=list)
     converged: bool = False
@@ -179,6 +197,9 @@ class ChaosReport:
             and self.flaps >= 1
             and self.requeues >= 1
             and self.pin_verified_rescues >= 1
+            and self.drain_evictions >= 1
+            and self.drains_completed >= 1
+            and self.failovers >= 1
         )
 
     def as_dict(self) -> dict:
@@ -191,6 +212,9 @@ class ChaosReport:
             "rescues": len(self.rescues),
             "pin_verified_rescues": self.pin_verified_rescues,
             "requeues": self.requeues,
+            "drain_evictions": self.drain_evictions,
+            "drains_completed": self.drains_completed,
+            "failovers": self.failovers,
             "scheduler_errors": self.scheduler_errors,
             "invariant_violations": self.invariant_violations,
             "converged": self.converged,
@@ -263,6 +287,10 @@ class ChaosRunner:
         self.report = ChaosReport(seed=seed)
         self._breach_since: Dict[Tuple[str, str], float] = {}
         self._outage_ops = ("create", "update")
+        # rescue archives of deposed leaders (the monitor is leader memory;
+        # a failover swaps it — completed-rescue records must survive for
+        # the report's pin verification)
+        self._archived_rescues: List[dict] = []
 
     def _build_harness(self) -> SimHarness:
         h = SimHarness(num_nodes=self.num_nodes)
@@ -331,6 +359,26 @@ class ChaosRunner:
         faults.append(
             Fault(outage_at + rng.uniform(2.0, 4.0), "outage_end")
         )
+        # voluntary disruption: drain a node hosting a BUDGETED (plain)
+        # gang after the outage has cleared — cordon, budget-checked
+        # gang-whole eviction with trial-solve pre-placement
+        drain = self._node_of_one_pod("plain-", used)
+        assert drain, "no drainable node hosts a plain pod"
+        used.add(drain)
+        drain_at = rng.uniform(18.5, 19.5)
+        faults.append(
+            Fault(drain_at, "drain", drain, "voluntary drain (budgeted)")
+        )
+        # kill the leader mid-drain: the standby takes the lease, rebuilds
+        # leader memory from the store (requeue_all, rebuild_bindings,
+        # monitor resync, persisted NodeDrain intents) and finishes the job
+        faults.append(
+            Fault(
+                drain_at + rng.uniform(0.5, 1.5),
+                "leader_crash",
+                note="failover mid-drain",
+            )
+        )
         # lost nodes come back late — capacity returns, requeued gangs must
         # re-admit atomically
         for i, node in enumerate((loss1, loss2)):
@@ -342,6 +390,15 @@ class ChaosRunner:
                     "capacity returns",
                 )
             )
+        # the drained node rejoins the pool once everything else is back
+        faults.append(
+            Fault(
+                dead_dwell + rng.uniform(6.0, 8.0),
+                "uncordon",
+                drain,
+                "drained node returns to service",
+            )
+        )
         faults.sort(key=lambda f: f.at)
         return faults
 
@@ -363,7 +420,98 @@ class ChaosRunner:
         elif fault.kind == "outage_end":
             for op in self._outage_ops:
                 h.store.error_injectors.pop(op, None)
+        elif fault.kind == "drain":
+            h.drainer.request_drain(fault.target)
+        elif fault.kind == "uncordon":
+            h.drainer.uncordon(fault.target)
+        elif fault.kind == "leader_crash":
+            self._leader_failover()
         self.report.faults.append(fault.as_dict())
+
+    # -- leader failover (satellite: leader_crash fault kind) -------------
+
+    def _leader_failover(self) -> None:
+        """Crash the leader and promote a standby through the REAL
+        LeaseElector protocol, then rebuild every piece of leader memory
+        the way cluster/manager.py's run loop does on takeover: fresh
+        engine (+ requeue_all), fresh binding map (rebuild_bindings),
+        fresh monitor re-primed from persisted conditions (resync), fresh
+        scheduler/broker/drainer. Cluster INFRASTRUCTURE — the Node
+        objects and the store — carries over; leader memory does not."""
+        import time as _time
+
+        from grove_tpu.autoscale.hpa import HorizontalAutoscaler
+        from grove_tpu.cluster.lease import LeaseElector
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+        from grove_tpu.controller.register import register_controllers
+        from grove_tpu.disruption import (
+            DisruptionBroker,
+            NodeDrainController,
+        )
+        from grove_tpu.runtime.engine import Engine
+        from grove_tpu.sim.cluster import SimCluster
+        from grove_tpu.solver.scheduler import GangScheduler
+
+        h = self.harness
+        timings = dict(
+            lease_duration=0.3, renew_deadline=0.2, retry_period=0.05
+        )
+        leader = LeaseElector(
+            h.store, identity="chaos-leader", **timings
+        )
+        assert leader.try_acquire(), "incumbent failed to take the lease"
+        leader.stop_renewing()  # crash: the lease ages out un-renewed
+        standby = LeaseElector(
+            h.store, identity="chaos-standby", **timings
+        )
+        deadline = _time.monotonic() + 15.0
+        while not standby.try_acquire():
+            assert (
+                _time.monotonic() < deadline
+            ), "standby never took over the lease"
+            _time.sleep(0.05)
+
+        # deposed leader's engine stops draining; the standby builds fresh
+        h.engine.close()
+        engine = Engine(h.store, h.clock)
+        register_controllers(engine, h.ctx, h.config)
+        engine.requeue_all()
+        cluster = SimCluster(store=h.store, nodes=h.cluster.nodes)
+        cluster.rebuild_bindings()
+        scheduler = GangScheduler(
+            h.store,
+            cluster,
+            h.topology,
+            priority_map=h.config.solver.priority_classes,
+            chunk_size=min(h.config.solver.chunk_size, 64),
+            max_waves=h.config.solver.max_waves,
+        )
+        monitor = NodeHealthMonitor(
+            h.store,
+            cluster,
+            not_ready_after=self.not_ready_after,
+            lost_after=self.lost_after,
+        )
+        scheduler.monitor = monitor
+        broker = DisruptionBroker(h.store)
+        scheduler.broker = broker
+        h.ctx.disruption = broker
+        drainer = NodeDrainController(
+            h.store, cluster, scheduler, monitor, broker
+        )
+        monitor.drain_states = drainer.states
+        monitor.resync()
+        self._archived_rescues.extend(h.node_monitor.rescues)
+        h.engine = engine
+        h.cluster = cluster
+        h.scheduler = scheduler
+        h.node_monitor = monitor
+        h.disruption = broker
+        h.drainer = drainer
+        h.autoscaler = HorizontalAutoscaler(
+            h.store, h.metrics_provider, scale_down_stabilization=60.0
+        )
+        self.report.failovers += 1
 
     # -- invariants -------------------------------------------------------
 
@@ -431,6 +579,30 @@ class ChaosRunner:
                         f"t={rel_now:.0f}s: node {node.name} overcommitted "
                         f"on {r}: {v} > {node.capacity.get(r, 0.0)}"
                     )
+        # 4. no disruptionBudget ever exceeded (voluntary disruptions only)
+        for pcs in h.store.scan("PodCliqueSet"):
+            budget = pcs.spec.template.disruption_budget
+            if budget is None:
+                continue
+            key = (pcs.metadata.namespace, pcs.metadata.name)
+            disrupted = h.disruption.voluntarily_disrupted_gangs(key)
+            cap = budget.max_unavailable_gangs or 0
+            if disrupted > cap:
+                violations.append(
+                    f"t={rel_now:.0f}s: PCS {key[0]}/{key[1]} has "
+                    f"{disrupted} voluntarily-disrupted gang(s), budget "
+                    f"allows {cap}"
+                )
+        # 5. no stranded hold: every monitor-held gang keeps a scheduled
+        # release (a hold with no delayed workqueue entry waits forever)
+        for gang_key in sorted(h.node_monitor._held):
+            wq_key = ("PodGang",) + gang_key
+            if not h.node_monitor.requeue.has_delayed(wq_key):
+                violations.append(
+                    f"t={rel_now:.0f}s: held gang {gang_key[0]}/"
+                    f"{gang_key[1]} has no scheduled backoff release "
+                    "(stranded)"
+                )
 
     def _guarded(self, fn) -> int:
         """Run one control-plane component; a transient store error models
@@ -450,6 +622,10 @@ class ChaosRunner:
         losses_before = METRICS.counters.get("node_lost_total", 0)
         flaps_before = METRICS.counters.get("node_flaps_total", 0)
         requeues_before = METRICS.counters.get("gang_requeues_total", 0)
+        drains_before = METRICS.counters.get("gang_drains_total", 0)
+        drains_done_before = METRICS.counters.get(
+            "node_drains_completed_total", 0
+        )
 
         # fault-free twin FIRST (same workload, converged, untouched): the
         # convergence target the chaotic run must reproduce
@@ -478,6 +654,7 @@ class ChaosRunner:
             work = self._guarded(h.engine.drain)
             work += self._guarded(h.autoscaler.tick)
             work += self._guarded(h.node_monitor.tick)
+            work += self._guarded(h.drainer.tick)
             bound = self._guarded(h.schedule)
             started = self._guarded(h.cluster.kubelet_tick)
             work += self._guarded(h.engine.drain)
@@ -491,6 +668,7 @@ class ChaosRunner:
                         h.engine.next_wakeup(),
                         h.autoscaler.next_deadline(),
                         h.node_monitor.next_deadline(),
+                        h.drainer.next_deadline(),
                     )
                     if w is not None
                 ]
@@ -517,7 +695,14 @@ class ChaosRunner:
         report.requeues = int(
             METRICS.counters.get("gang_requeues_total", 0) - requeues_before
         )
-        report.rescues = list(h.node_monitor.rescues)
+        report.drain_evictions = int(
+            METRICS.counters.get("gang_drains_total", 0) - drains_before
+        )
+        report.drains_completed = int(
+            METRICS.counters.get("node_drains_completed_total", 0)
+            - drains_done_before
+        )
+        report.rescues = self._archived_rescues + list(h.node_monitor.rescues)
         report.pin_verified_rescues = sum(
             1 for r in report.rescues if r.get("rejoined_domain")
         )

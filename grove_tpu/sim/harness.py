@@ -100,6 +100,23 @@ class SimHarness:
 
         self.node_monitor = NodeHealthMonitor(self.store, self.cluster)
         self.scheduler.monitor = self.node_monitor
+        # voluntary-disruption layer (grove_tpu/disruption): one broker
+        # gates every voluntary evictor — preemption/reclaim (scheduler),
+        # rolling update (ctx), node drain (the controller below). Inert
+        # until a disruptionBudget exists or a drain is requested.
+        from grove_tpu.disruption import DisruptionBroker, NodeDrainController
+
+        self.disruption = DisruptionBroker(self.store)
+        self.scheduler.broker = self.disruption
+        self.ctx.disruption = self.disruption
+        self.drainer = NodeDrainController(
+            self.store,
+            self.cluster,
+            self.scheduler,
+            self.node_monitor,
+            self.disruption,
+        )
+        self.node_monitor.drain_states = self.drainer.states
         # HPA controller equivalent (multi-level autoscaling)
         from grove_tpu.autoscale.hpa import (
             HorizontalAutoscaler,
@@ -168,21 +185,23 @@ class SimHarness:
             work = self.engine.drain()
             work += self.autoscaler.tick()
             work += self.node_monitor.tick()
+            work += self.drainer.tick()
             bound = self.schedule()
             started = self.cluster.kubelet_tick()
             work += self.engine.drain()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a
-                # held HPA scale-down, a node-grace deadline, or a gang
-                # requeue backoff may be pending; jump to the earliest
-                # wakeup rather than stopping early
+                # held HPA scale-down, a node-grace deadline, a gang
+                # requeue backoff, or an in-flight drain may be pending;
+                # jump to the earliest wakeup rather than stopping early
                 wakes = [
                     w
                     for w in (
                         self.engine.next_wakeup(),
                         self.autoscaler.next_deadline(),
                         self.node_monitor.next_deadline(),
+                        self.drainer.next_deadline(),
                     )
                     if w is not None
                 ]

@@ -101,6 +101,12 @@ class GangScheduler:
         # solve until released (rate-limited re-admission after a gang
         # termination). None → no holds (tests that build a bare scheduler).
         self.monitor = None
+        # disruption broker (grove_tpu/disruption, docs/robustness.md):
+        # preemption and quota reclaim must be GRANTED their victim sets
+        # before evicting — per-PCS disruptionBudgets and the storm breaker
+        # gate every voluntary eviction. None → ungated (bare schedulers);
+        # an un-armed broker (no budgets, no drains) is inert either way.
+        self.broker = None
 
     def _solve_batch(
         self,
@@ -902,6 +908,10 @@ class GangScheduler:
         nodes = [n for n in self.cluster.nodes if n.schedulable]
         if not nodes:
             return set(), None
+        # resolve the broker ONCE per round: active() scans PodCliqueSets
+        # while un-armed, and would_allow runs per candidate victim — at
+        # bench scale the inert path must not pay O(victims × sets)
+        broker = self._active_broker()
 
         # Snapshot free capacity ONCE: _evict_victim deletes victim pods from
         # the store, which would silently add the freed capacity to every
@@ -920,8 +930,18 @@ class GangScheduler:
         all_victim_keys: set = set()
         for preemptor in rejected:
             victims_chosen, free_delta = self._select_preemption_victims(
-                preemptor, nodes, base_free, exclude=all_victim_keys
+                preemptor, nodes, base_free, exclude=all_victim_keys,
+                broker=broker,
             )
+            if (
+                victims_chosen
+                and broker is not None
+                and not broker.grant(victims_chosen, "preemption")
+            ):
+                # budget/breaker denied the victim set: nothing is evicted
+                # and nothing folds into the snapshot — the preemptor
+                # simply stays pending and retries a later round
+                continue
             for gang in victims_chosen:
                 self._evict_victim(gang, preemptor)
                 all_victim_keys.add(
@@ -952,8 +972,21 @@ class GangScheduler:
                     caps[r] = caps.get(r, 0.0) - q * k  # negative = consumed
         return usage
 
+    def _active_broker(self):
+        """The disruption broker when it is ACTIVE (budgets exist or a
+        drain armed it), else None — callers resolve once per round so the
+        inert path costs one scan, not one per candidate victim."""
+        if self.broker is not None and self.broker.active():
+            return self.broker
+        return None
+
     def _select_preemption_victims(
-        self, preemptor: dict, nodes: List, base_free: Dict, exclude: set
+        self,
+        preemptor: dict,
+        nodes: List,
+        base_free: Dict,
+        exclude: set,
+        broker=None,
     ):
         """Choose an inclusion-minimal set of scheduled lower-priority gangs
         (any namespace, not already in `exclude`) whose eviction makes the
@@ -982,8 +1015,14 @@ class GangScheduler:
             victim_priority = self.priority_map.get(
                 gang.spec.priority_class_name, 0
             )
-            if victim_priority < preemptor["priority"]:
-                victims.append((victim_priority, gang))
+            if victim_priority >= preemptor["priority"]:
+                continue
+            if broker is not None and not broker.would_allow(gang):
+                # its set's disruptionBudget (or the storm breaker) would
+                # deny the eviction: keep it out of the trial so a doomed
+                # victim set is never selected
+                continue
+            victims.append((victim_priority, gang))
         if not victims:
             return [], {}
         victims.sort(
@@ -1235,6 +1274,8 @@ class GangScheduler:
         # one PodGang scan + per-pod reads for the whole round; claimants
         # re-filter this pool against the evolving usage sim
         pool = self._reclaim_pool(crs, already_evicted)
+        # one broker-activity resolution per round (see _maybe_preempt)
+        broker = self._active_broker()
 
         def claimant_key(spec):
             share = dominant_share_of(
@@ -1254,6 +1295,13 @@ class GangScheduler:
             candidates = self._reclaim_candidates(
                 pool, claimant, usage_sim, evicted
             )
+            if broker is not None and candidates:
+                # disruptionBudget-protected gangs are not reclaim fodder:
+                # filter before the trial so the selection never builds a
+                # victim set the broker would refuse to grant
+                candidates = [
+                    (g, f) for g, f in candidates if broker.would_allow(g)
+                ]
             # solo-fit short-circuit lives inside the shared machinery via
             # the solo trial in _trial_victim_selection's caller — here the
             # claimant failing this round's solve is the signal; still, a
@@ -1270,6 +1318,14 @@ class GangScheduler:
                     claimant, nodes, base_free, [g for g, _ in candidates]
                 )
             else:
+                continue
+            if (
+                victims
+                and broker is not None
+                and not broker.grant(victims, "quota-reclaim")
+            ):
+                # denied between filter and trial (budgets recount live
+                # state): evict nothing, fold nothing, next claimant
                 continue
             freed_by_key = {
                 (g.metadata.namespace, g.metadata.name): freed
@@ -1372,9 +1428,9 @@ class GangScheduler:
             ("PodGang", ns, name),
             TYPE_WARNING,
             event_reason,
-            message
-            if event_reason == REASON_QUOTA_RECLAIM
-            else f"preempted by higher-priority gang {preemptor['name']}",
+            f"preempted by higher-priority gang {preemptor['name']}"
+            if event_reason == REASON_PREEMPTED
+            else message,
         )
         METRICS.inc(metric)
 

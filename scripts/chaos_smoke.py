@@ -18,7 +18,12 @@ Asserts the robustness subsystem's acceptance bar (docs/robustness.md):
 On failure the seed is printed so the exact run replays:
     python scripts/chaos_smoke.py --seed <N>
 
-Usage: python scripts/chaos_smoke.py [--seed N] [--json]
+`--seeds A,B,C` replays the smoke across a fixed seed matrix
+(`make chaos-matrix`): schedule-dependent regressions — a fault landing one
+tick earlier, a drain racing a failover differently — hide from any single
+seed.
+
+Usage: python scripts/chaos_smoke.py [--seed N | --seeds A,B,C] [--json]
 """
 
 from __future__ import annotations
@@ -41,12 +46,32 @@ def main() -> int:
         "--seed", type=int, default=1234,
         help="fault-schedule seed (printed on failure for replay)",
     )
+    parser.add_argument(
+        "--seeds",
+        help="comma-separated seed list: replay the smoke once per seed and"
+        " fail on the first failing seed (the `make chaos-matrix` mode —"
+        " schedule-dependent regressions hide from any single seed)",
+    )
     parser.add_argument("--json", action="store_true", help="emit one JSON line")
     args = parser.parse_args()
 
+    if args.seeds:
+        rc = 0
+        for raw in args.seeds.split(","):
+            seed = int(raw.strip())
+            print(f"=== chaos seed {seed} ===", flush=True)
+            rc = run_one(seed, args.json)
+            if rc:
+                return rc
+        return rc
+
+    return run_one(args.seed, args.json)
+
+
+def run_one(seed: int, as_json: bool) -> int:
     from grove_tpu.sim.chaos import run_chaos
 
-    report = run_chaos(seed=args.seed)
+    report = run_chaos(seed=seed)
     doc = report.as_dict()
 
     problems = []
@@ -61,6 +86,13 @@ def main() -> int:
             "no rescue rejoined its survivors' domain (recovery-pin path "
             "not exercised)"
         )
+    if report.drain_evictions < 1 or report.drains_completed < 1:
+        problems.append(
+            "the voluntary drain never evicted/completed (drain fault "
+            "missing)"
+        )
+    if report.failovers < 1:
+        problems.append("no leader failover happened (leader_crash missing)")
     if report.invariant_violations:
         problems.append(
             f"{len(report.invariant_violations)} invariant violation(s): "
@@ -71,7 +103,7 @@ def main() -> int:
     if not report.signature_matches_fault_free:
         problems.append("resource tree differs from the fault-free run")
 
-    if args.json:
+    if as_json:
         print(json.dumps({"chaos": doc, "ok": not problems}))
     else:
         print(
@@ -79,7 +111,9 @@ def main() -> int:
             f"losses={report.node_losses} flaps={report.flaps} "
             f"rescues={len(report.rescues)} "
             f"(pin-verified {report.pin_verified_rescues}) "
-            f"requeues={report.requeues}"
+            f"requeues={report.requeues} "
+            f"drains={report.drain_evictions} "
+            f"failovers={report.failovers}"
         )
         for fault in doc["faults"]:
             note = f" ({fault['note']})" if fault["note"] else ""
@@ -95,13 +129,13 @@ def main() -> int:
 
     if problems:
         print(
-            f"\nCHAOS SMOKE FAILED (replay with --seed {args.seed}):",
+            f"\nCHAOS SMOKE FAILED (replay with --seed {seed}):",
             file=sys.stderr,
         )
         for p in problems:
             print(f"  - {p}", file=sys.stderr)
         return 1
-    if not args.json:
+    if not as_json:
         print("chaos smoke OK")
     return 0
 

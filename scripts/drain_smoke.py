@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Drain smoke test: the voluntary-disruption layer end to end
+(the `make drain-smoke` target; tests/test_disruption.py pins the same
+flows at pytest speed).
+
+Asserts the acceptance bar (docs/robustness.md "voluntary disruption"):
+- draining a loaded node evicts every affected gang WHOLE, budget-checked
+  (the per-PCS disruptionBudget is never exceeded at any tick);
+- >= 1 gang is re-placed via the trial-solve BEFORE its pods are evicted
+  (pre-placement path exercised);
+- all drained gangs are re-admitted and the node reaches Drained;
+- the disruption-storm circuit breaker OPENS under an injected eviction
+  storm, denies while open, and CLOSES after the quiet window;
+- with no budgets and no drains the broker is inert: admissions are
+  byte-identical to a broker-less run (A/B).
+
+Usage: python scripts/drain_smoke.py [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# CPU pin before jax import: the smoke must not hang on a wedged accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from a checkout without an installed package (make drain-smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = parser.parse_args()
+
+    from grove_tpu.sim.voluntary import drain_artifact
+
+    report = drain_artifact()
+
+    problems = []
+    if report["drain_evictions"] < 1:
+        problems.append("the drain evicted no gangs")
+    if report["pre_placed"] < 1:
+        problems.append(
+            "no gang was trial-placed before eviction (pre-placement path"
+            " not exercised)"
+        )
+    if report["budget_exceeded"]:
+        problems.append(
+            f"disruptionBudget exceeded (max observed"
+            f" {report['budget_max_observed']} > cap {report['budget_cap']})"
+        )
+    if report["gang_whole_violations"]:
+        problems.append(
+            f"{report['gang_whole_violations']} tick(s) saw a PARTIALLY"
+            " evicted drained gang (gang-whole contract broken)"
+        )
+    if not report["node_drained"] or not report["node_empty"]:
+        problems.append("the drained node never reached Drained/empty")
+    if not report["readmitted"]:
+        problems.append("not every drained gang was re-admitted")
+    breaker = report["breaker"]
+    if not breaker["opened"]:
+        problems.append("the breaker never opened under the eviction storm")
+    if not breaker["denied_while_open"]:
+        problems.append("an eviction was granted while the breaker was open")
+    if not breaker["closed_after_quiet"]:
+        problems.append("the breaker never closed after the quiet window")
+    if not report["ab"]["identical_admissions"]:
+        problems.append(
+            "A/B FAILED: an unconfigured broker changed admissions"
+        )
+
+    if args.json:
+        print(json.dumps({"drain": report, "ok": not problems}))
+    else:
+        print(
+            f"drained {report['drained_node']}"
+            f" ({report['gangs_on_node']} gang(s) aboard):"
+            f" {report['drain_evictions']} eviction(s),"
+            f" {report['pre_placed']} pre-placed,"
+            f" budget max {report['budget_max_observed']}/"
+            f"{report['budget_cap']},"
+            f" drained after {report['ticks_to_drained']} tick(s),"
+            f" readmitted={report['readmitted']}"
+        )
+        print(
+            f"breaker: granted={breaker['granted']}"
+            f" denied={breaker['denied']} opened={breaker['opened']}"
+            f" closed_after_quiet={breaker['closed_after_quiet']}"
+        )
+        print(
+            f"A/B identical admissions: {report['ab']['identical_admissions']}"
+            f" ({report['ab']['admitted_pods']} pods)"
+        )
+
+    if problems:
+        print("\nDRAIN SMOKE FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("drain smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,539 @@
+"""Voluntary-disruption layer: budgets, broker, breaker, and node drain.
+
+The pytest tier of docs/robustness.md "voluntary disruption"
+(`make drain-smoke` is the bigger sibling): the disruptionBudget API
+surface, the DisruptionBroker's budget/quiet-window/breaker arbitration,
+enforcement inside priority preemption and rolling update, the drain
+workflow (pre-placement and terminate-and-requeue fallback), the apiserver
+drain endpoints, and the fresh-leader monitor resync."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.pod import is_ready, is_scheduled
+from grove_tpu.api.types import (
+    COND_PODGANG_DISRUPTION_TARGET,
+    COND_PODGANG_SCHEDULED,
+)
+from grove_tpu.observability.events import EVENTS
+from grove_tpu.sim.harness import SimHarness
+
+BUDGETED_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: svc
+spec:
+  replicas: 2
+  template:
+    disruptionBudget:
+      maxUnavailableGangs: 1
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 2
+"""
+
+
+def budgeted_pcs(name="svc", max_unavailable=1, quiet=None, replicas=2):
+    pcs = load_podcliquesets(BUDGETED_YAML)[0]
+    pcs.metadata.name = name
+    pcs.spec.replicas = replicas
+    db = pcs.spec.template.disruption_budget
+    db.max_unavailable_gangs = max_unavailable
+    db.quiet_window = quiet
+    return pcs
+
+
+def _ready_harness(*pcss, num_nodes=8):
+    h = SimHarness(num_nodes=num_nodes)
+    for pcs in pcss:
+        h.apply(pcs)
+    h.converge()
+    pods = h.store.list("Pod")
+    assert pods and all(is_ready(p) for p in pods), h.tree()
+    return h
+
+
+class TestBudgetAPI:
+    def test_yaml_parse_default_and_export(self):
+        from grove_tpu.admission.defaulting import default_podcliqueset
+        from grove_tpu.api.serialize import export_object
+
+        text = BUDGETED_YAML.replace(
+            "      maxUnavailableGangs: 1\n", ""
+        ).replace(
+            "    disruptionBudget:\n",
+            "    disruptionBudget:\n      quietWindow: 30s\n",
+        )
+        pcs = load_podcliquesets(text)[0]
+        db = pcs.spec.template.disruption_budget
+        assert db is not None
+        assert db.max_unavailable_gangs is None  # not yet defaulted
+        assert db.quiet_window == 30.0  # duration string parsed
+        default_podcliqueset(pcs)
+        assert db.max_unavailable_gangs == 1  # webhook default
+        doc = export_object(pcs)
+        exported = doc["spec"]["template"]["disruptionBudget"]
+        assert exported == {"maxUnavailableGangs": 1, "quietWindow": 30.0}
+
+    def test_validation(self):
+        from grove_tpu.admission.defaulting import default_podcliqueset
+        from grove_tpu.admission.validation import validate_podcliqueset
+
+        pcs = default_podcliqueset(budgeted_pcs(max_unavailable=-1))
+        res = validate_podcliqueset(pcs)
+        assert not res.ok
+        assert any("maxUnavailableGangs" in e for e in res.errors)
+
+        pcs = default_podcliqueset(budgeted_pcs(max_unavailable=1, quiet=-5.0))
+        res = validate_podcliqueset(pcs)
+        assert not res.ok
+        assert any("quietWindow" in e for e in res.errors)
+
+        # 0 is legal (block everything) but warns loudly
+        pcs = default_podcliqueset(budgeted_pcs(max_unavailable=0))
+        res = validate_podcliqueset(pcs)
+        assert res.ok
+        assert any("blocks every" in w for w in res.warnings)
+
+    def test_no_budget_stays_absent(self):
+        from grove_tpu.admission.defaulting import default_podcliqueset
+        from grove_tpu.api.serialize import export_object
+
+        pcs = budgeted_pcs()
+        pcs.spec.template.disruption_budget = None
+        default_podcliqueset(pcs)
+        assert pcs.spec.template.disruption_budget is None
+        assert "disruptionBudget" not in export_object(pcs)["spec"]["template"]
+
+
+class TestBrokerInertness:
+    def test_unconfigured_broker_is_inert(self):
+        pcs = budgeted_pcs()
+        pcs.spec.template.disruption_budget = None
+        h = _ready_harness(pcs)
+        broker = h.disruption
+        assert not broker.active()
+        gangs = h.store.scan("PodGang")
+        tokens_before = broker._tokens
+        assert broker.grant(gangs, "drain") is True
+        assert broker._tokens == tokens_before  # nothing consumed
+        assert not EVENTS.list(reason="DisruptionThrottled")
+
+    def test_budget_arms_the_broker(self):
+        h = _ready_harness(budgeted_pcs())
+        assert h.disruption.active()
+
+    def test_inert_ab_identical_admissions(self):
+        from grove_tpu.sim.voluntary import inert_ab
+
+        ab = inert_ab(n_sets=2, num_nodes=6)
+        assert ab["identical_admissions"]
+        assert ab["admitted_pods"] > 0
+
+
+class TestBudgetEnforcement:
+    def test_all_or_nothing_same_set(self):
+        """Two scheduled gangs of one budget-1 set in a single victim set:
+        denied together, nothing consumed."""
+        h = _ready_harness(budgeted_pcs())
+        broker = h.disruption
+        gangs = sorted(
+            h.store.scan("PodGang"), key=lambda g: g.metadata.name
+        )
+        assert len(gangs) == 2
+        tokens_before = broker._tokens
+        assert broker.grant(gangs, "drain") is False
+        assert broker._tokens == tokens_before
+        assert EVENTS.list(reason="DisruptionThrottled")
+        # one at a time is fine
+        assert broker.grant([gangs[0]], "drain") is True
+
+    def test_unavailable_gang_consumes_budget(self):
+        """With one gang of the set already down (any cause), a budget-1
+        grant for the OTHER gang is denied — but re-disrupting the downed
+        gang itself is not double-counted."""
+        h = _ready_harness(budgeted_pcs())
+        broker = h.disruption
+        down, up = sorted(
+            h.store.scan("PodGang"), key=lambda g: g.metadata.name
+        )
+        h.scheduler._evict_victim(down, {"name": "test"})  # now unavailable
+        assert broker.grant([up], "drain") is False
+        assert broker.grant([down], "drain") is True  # not double-counted
+
+    def test_quiet_window_paces_grants(self):
+        h = _ready_harness(budgeted_pcs(quiet=10.0))
+        broker = h.disruption
+        gangs = sorted(
+            h.store.scan("PodGang"), key=lambda g: g.metadata.name
+        )
+        assert broker.grant([gangs[0]], "drain") is True
+        # same SET again inside the window: denied (even the other gang)
+        assert broker.grant([gangs[1]], "drain") is False
+        h.advance(11.0)
+        assert broker.grant([gangs[1]], "drain") is True
+
+
+class TestBreaker:
+    def test_storm_opens_denies_then_quiet_closes(self):
+        from grove_tpu.disruption import DisruptionBroker
+
+        h = _ready_harness(budgeted_pcs("a"), budgeted_pcs("b"))
+        broker = DisruptionBroker(
+            h.store, bucket_capacity=2, refill_per_second=0.0, close_after=5.0
+        )
+        broker.arm()
+        gangs = sorted(
+            h.store.scan("PodGang"), key=lambda g: g.metadata.name
+        )
+        assert broker.grant([gangs[0]], "storm")
+        assert broker.grant([gangs[2]], "storm")  # other set: budget ok
+        assert not broker.grant([gangs[1]], "storm")  # bucket empty → OPEN
+        assert broker.breaker_open
+        assert EVENTS.list(reason="BreakerOpen")
+        assert not broker.grant([gangs[3]], "storm")  # denied while open
+        h.advance(6.0)
+        assert broker.grant([gangs[3]], "storm")  # quiet window → closed
+        assert not broker.breaker_open
+        assert EVENTS.list(reason="BreakerClosed")
+
+    def test_note_failure_opens_breaker(self):
+        from grove_tpu.disruption import DisruptionBroker
+
+        h = _ready_harness(budgeted_pcs())
+        broker = DisruptionBroker(
+            h.store, bucket_capacity=3, refill_per_second=0.0
+        )
+        broker.arm()
+        assert not broker.breaker_open
+        broker.note_failure(weight=2.0, reason="placement failed")
+        assert not broker.breaker_open
+        broker.note_failure(weight=2.0, reason="placement failed")
+        assert broker.breaker_open
+
+
+class TestPreemptionRespectsBudget:
+    def _harness(self):
+        from grove_tpu.config.operator import load_operator_configuration
+
+        cfg = load_operator_configuration(
+            "solver: {priorityClasses: {critical: 100, batch: 1}}"
+        )
+        h = SimHarness(num_nodes=2, config=cfg)
+        for n in h.cluster.nodes:
+            n.capacity = {"cpu": 8.0}
+        return h
+
+    def _small(self, name, priority_class, budget=None):
+        from tests.test_preemption import small_pcs
+
+        pcs = small_pcs(name, cpu=4, priority_class=priority_class)
+        if budget is not None:
+            from grove_tpu.api.types import DisruptionBudget
+
+            pcs.spec.template.disruption_budget = DisruptionBudget(
+                max_unavailable_gangs=budget
+            )
+        return pcs
+
+    def test_budget_zero_blocks_preemption(self):
+        h = self._harness()
+        h.apply(self._small("low", "batch", budget=0))
+        h.converge()
+        assert all(is_ready(p) for p in h.store.list("Pod"))
+        h.apply(self._small("high", "critical"))
+        h.converge()
+        # the protected victim keeps running; the preemptor stays pending
+        low_gang = h.store.get("PodGang", "default", "low-0")
+        cond = get_condition(low_gang.status.conditions, COND_PODGANG_SCHEDULED)
+        assert cond is not None and cond.is_true()
+        high_pods = h.store.list(
+            "Pod", "default", {namegen.LABEL_PART_OF: "high"}
+        )
+        assert not any(is_scheduled(p) for p in high_pods)
+
+    def test_no_budget_preempts_as_before(self):
+        h = self._harness()
+        h.apply(self._small("low", "batch"))
+        h.converge()
+        h.apply(self._small("high", "critical"))
+        h.converge()
+        high_pods = h.store.list(
+            "Pod", "default", {namegen.LABEL_PART_OF: "high"}
+        )
+        assert high_pods and all(is_ready(p) for p in high_pods), h.tree()
+
+
+class TestRollingUpdateGated:
+    def _converge_update(self, h, max_rounds=60):
+        for _ in range(max_rounds):
+            h.engine.drain()
+            h.schedule()
+            h.cluster.kubelet_tick()
+            h.engine.drain()
+            pcs = h.store.list("PodCliqueSet")[0]
+            progress = pcs.status.rolling_update_progress
+            if progress is not None and progress.update_ended_at is not None:
+                return True
+            h.advance(2.0)
+        return False
+
+    def test_budget_zero_blocks_rolling_update(self):
+        h = _ready_harness(budgeted_pcs(max_unavailable=0, replicas=1))
+        old_uids = {p.metadata.uid for p in h.store.list("Pod")}
+        updated = budgeted_pcs(max_unavailable=0, replicas=1)
+        updated.spec.template.cliques[0].spec.pod_spec.containers[
+            0
+        ].image = "busybox:new"
+        h.apply(updated)
+        assert not self._converge_update(h, max_rounds=12)
+        assert {p.metadata.uid for p in h.store.list("Pod")} == old_uids
+        assert EVENTS.list(reason="DisruptionThrottled")
+
+    def test_budget_one_allows_rolling_update(self):
+        h = _ready_harness(budgeted_pcs(max_unavailable=1, replicas=1))
+        old_uids = {p.metadata.uid for p in h.store.list("Pod")}
+        updated = budgeted_pcs(max_unavailable=1, replicas=1)
+        updated.spec.template.cliques[0].spec.pod_spec.containers[
+            0
+        ].image = "busybox:new"
+        h.apply(updated)
+        assert self._converge_update(h), h.tree()
+        h.converge()
+        pods = h.store.list("Pod")
+        assert all(is_ready(p) for p in pods), h.tree()
+        assert not ({p.metadata.uid for p in pods} & old_uids)
+
+
+class TestDrainWorkflow:
+    def test_drain_evicts_whole_and_readmits(self):
+        h = _ready_harness(budgeted_pcs())
+        pods_before = len(h.store.list("Pod"))
+        target = sorted(h.cluster.bindings.values())[0]
+        row = h.drainer.request_drain(target)
+        assert row == {"name": target, "drain": "Draining"}
+        assert h.cluster.node(target).cordoned
+        h.converge(max_ticks=200)
+        assert h.drainer.drain_state(target) == "Drained"
+        assert target not in set(h.cluster.bindings.values())
+        pods = h.store.list("Pod")
+        assert len(pods) == pods_before and all(is_ready(p) for p in pods)
+        assert h.drainer.drained_gangs
+        assert all(d["pre_placed"] for d in h.drainer.drained_gangs)
+        assert EVENTS.list(reason="GangDrained")
+        assert EVENTS.list(reason="NodeDrained")
+        # uncordon returns the node to service
+        h.drainer.uncordon(target)
+        assert not h.cluster.node(target).cordoned
+        assert h.drainer.drain_state(target) == ""
+
+    def test_drain_without_capacity_falls_back_to_requeue(self):
+        """No spare capacity: the trial finds no placement, the gang is
+        terminated-and-requeued under monitor backoff, and re-admits once
+        the node is uncordoned."""
+        pcs = budgeted_pcs(replicas=1)
+        pcs.spec.template.cliques[0].spec.replicas = 3
+        pcs.spec.template.cliques[0].spec.pod_spec.containers[
+            0
+        ].requests = {"cpu": 5.0}
+        h = _ready_harness(pcs, num_nodes=3)  # 5cpu pods: one per node
+        target = sorted(h.cluster.bindings.values())[0]
+        h.drainer.request_drain(target)
+        for _ in range(4):
+            h.node_monitor.tick()
+            h.drainer.tick()
+            h.schedule()
+            h.advance(1.0)
+        drained = h.drainer.drained_gangs
+        assert drained and not drained[0]["pre_placed"]
+        gang = h.store.get("PodGang", "default", "svc-0")
+        dt = get_condition(
+            gang.status.conditions, COND_PODGANG_DISRUPTION_TARGET
+        )
+        assert dt is not None and dt.is_true() and dt.reason == "Drained"
+        assert h.node_monitor.gang_held("default", "svc-0")
+        # the hold has a scheduled release — never stranded
+        assert h.node_monitor.requeue.has_delayed(
+            ("PodGang", "default", "svc-0")
+        )
+        h.drainer.uncordon(target)
+        h.converge(max_ticks=200)
+        pods = h.store.list("Pod")
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+
+    def test_drain_endpoints_wire_shape(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        h = _ready_harness(budgeted_pcs(), num_nodes=4)
+        server = APIServer(
+            store=h.store, node_provider=h.node_monitor.node_snapshot
+        )
+        server.drain_handler = h.drainer.request_drain
+        server.uncordon_handler = h.drainer.uncordon
+        server.start()
+        try:
+            target = sorted(h.cluster.bindings.values())[0]
+
+            def post(path):
+                req = urllib.request.Request(
+                    f"{server.address}{path}", data=b"", method="POST"
+                )
+                with urllib.request.urlopen(req) as r:
+                    return json.loads(r.read())
+
+            doc = post(f"/nodes/{target}/drain")
+            assert doc == {"name": target, "drain": "Draining"}
+            with urllib.request.urlopen(f"{server.address}/nodes") as r:
+                nodes = json.loads(r.read())["items"]
+            row = next(n for n in nodes if n["name"] == target)
+            assert row["drain"] == "Draining"
+            assert row["cordoned"] is True
+            assert all("drain" in n for n in nodes)
+            doc = post(f"/nodes/{target}/uncordon")
+            assert doc == {"name": target, "drain": ""}
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                post("/nodes/no-such-node/drain")
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_drain_denied_for_non_operator_user(self):
+        """With the authorizer enabled, node lifecycle actions are
+        operator-tier: an impersonated non-exempt user gets 403 and the
+        node is untouched."""
+        from grove_tpu.admission.authorization import AuthorizationGuard
+        from grove_tpu.cluster.apiserver import APIServer
+
+        h = _ready_harness(budgeted_pcs(), num_nodes=4)
+        h.store.guard = AuthorizationGuard(enabled=True)
+        server = APIServer(
+            store=h.store, node_provider=h.node_monitor.node_snapshot
+        )
+        server.drain_handler = h.drainer.request_drain
+        server.uncordon_handler = h.drainer.uncordon
+        server.start()
+        try:
+            target = sorted(h.cluster.bindings.values())[0]
+            req = urllib.request.Request(
+                f"{server.address}/nodes/{target}/drain",
+                data=b"",
+                method="POST",
+                headers={"Impersonate-User": "mallory"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 403
+            assert not h.cluster.node(target).cordoned
+            assert h.drainer.drain_state(target) == ""
+        finally:
+            h.store.guard = None
+            server.stop()
+
+    def test_endpoints_without_handler_404(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        server = APIServer().start()
+        try:
+            req = urllib.request.Request(
+                f"{server.address}/nodes/x/drain", data=b"", method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestMonitorResync:
+    """Satellite bugfix: a fresh leader's monitor re-primes holds/backoff
+    from persisted conditions — no stranded holds, no unpaced churn."""
+
+    def _terminated_harness(self):
+        """Strict gang terminated by a node loss, still held, nodes down."""
+        pcs = budgeted_pcs(replicas=1)
+        pcs.spec.template.disruption_budget = None
+        pcs.spec.template.cliques[0].spec.replicas = 3
+        pcs.spec.template.cliques[0].spec.pod_spec.containers[
+            0
+        ].requests = {"cpu": 5.0}
+        h = _ready_harness(pcs, num_nodes=3)
+        h.node_monitor.not_ready_after = 5.0
+        h.node_monitor.lost_after = 15.0
+        for n in h.cluster.nodes:
+            h.cluster.crash_node(n.name)
+        h.converge(max_ticks=60)
+        assert h.node_monitor.gang_held("default", "svc-0")
+        return h
+
+    def test_resync_mid_outage_re_primes_hold_with_release(self):
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+
+        h = self._terminated_harness()
+        # failover: a FRESH monitor (new leader) over the same store/nodes
+        fresh = NodeHealthMonitor(
+            h.store, h.cluster, not_ready_after=5.0, lost_after=15.0
+        )
+        assert not fresh.gang_held("default", "svc-0")
+        touched = fresh.resync()
+        assert touched >= 1
+        assert fresh.gang_held("default", "svc-0")
+        # THE bug class: the re-primed hold must carry a scheduled release
+        assert fresh.requeue.has_delayed(("PodGang", "default", "svc-0"))
+        # swap the monitor in and recover
+        h.node_monitor = fresh
+        h.scheduler.monitor = fresh
+        for n in h.cluster.nodes:
+            h.cluster.restart_node(n.name)
+        h.converge(max_ticks=200)
+        pods = h.store.list("Pod")
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+        assert not fresh.gang_held("default", "svc-0")
+
+    def test_resync_after_recovery_releases_immediately(self):
+        """Failover landing AFTER capacity returned: nothing to wait for —
+        the gang goes to probation (one immediate solve attempt), not a
+        fresh 1s backoff."""
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+
+        h = self._terminated_harness()
+        for n in h.cluster.nodes:
+            h.cluster.restart_node(n.name)
+        fresh = NodeHealthMonitor(
+            h.store, h.cluster, not_ready_after=5.0, lost_after=15.0
+        )
+        fresh.resync()
+        assert not fresh.gang_held("default", "svc-0")
+        assert ("default", "svc-0") in fresh._probation
+        h.node_monitor = fresh
+        h.scheduler.monitor = fresh
+        h.converge(max_ticks=200)
+        pods = h.store.list("Pod")
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+
+    def test_resync_drops_stale_holds(self):
+        from grove_tpu.controller.nodehealth import NodeHealthMonitor
+
+        h = _ready_harness(budgeted_pcs())
+        monitor = NodeHealthMonitor(h.store, h.cluster)
+        monitor.hold_gang(("default", "gone-0"))  # gang does not exist
+        monitor.resync()
+        assert not monitor.gang_held("default", "gone-0")
+        assert not monitor.requeue.has_delayed(
+            ("PodGang", "default", "gone-0")
+        )

@@ -218,6 +218,54 @@ class TestGangRescue:
         assert gang.status.phase == "Running"
         assert not h.node_monitor.gang_held("default", "strict-0")
 
+    def test_simultaneous_multi_node_rejoin_releases_once(self):
+        """Satellite: ALL lost nodes rejoin in the same tick — every
+        monitor hold is released exactly once, backoff counters reset, and
+        no orphaned delayed entry remains to grant a duplicate release
+        (which would buy the gang an extra, unpaced solve attempt)."""
+        h = _harness(STRICT_YAML, num_nodes=3)
+        victims = sorted({p.status.node_name for p in h.store.list("Pod")})
+        assert len(victims) == 3
+        for v in victims:
+            h.cluster.crash_node(v)
+        h.converge(max_ticks=60)
+        key = ("default", "strict-0")
+        wq_key = ("PodGang",) + key
+        assert h.node_monitor.gang_held(*key)
+        assert h.node_monitor.requeue.failures(wq_key) >= 1
+        admitted_before = sum(
+            e.count
+            for e in EVENTS.list(reason="GangAdmitted")
+            if e.name == "strict-0"
+        )
+        # all three rejoin in one tick
+        for v in victims:
+            h.cluster.restart_node(v)
+        h.node_monitor.tick()
+        # released exactly once: hold gone, counters reset, and the old
+        # delayed entry DISCARDED (it would otherwise pop later and grant
+        # an extra release outside the pacing)
+        assert not h.node_monitor.gang_held(*key)
+        assert h.node_monitor.requeue.failures(wq_key) == 0
+        assert not h.node_monitor.requeue.has_delayed(wq_key)
+        h.converge(max_ticks=200)
+        pods = h.store.list("Pod")
+        assert len(pods) == 3 and all(is_ready(p) for p in pods), h.tree()
+        assert h.store.get(
+            "PodGang", "default", "strict-0"
+        ).status.phase == "Running"
+        # exactly ONE re-admission solve succeeded (no duplicate attempts)
+        admitted_after = sum(
+            e.count
+            for e in EVENTS.list(reason="GangAdmitted")
+            if e.name == "strict-0"
+        )
+        assert admitted_after == admitted_before + 1
+        # nothing left behind: no hold, no probation, no delayed entries
+        assert not h.node_monitor._held
+        assert not h.node_monitor._probation
+        assert not h.node_monitor.requeue.has_delayed(wq_key)
+
     def test_requeued_gang_released_when_capacity_returns(self):
         """With NO surviving capacity the gang waits in backoff; the moment
         a lost node rejoins, the hold is released and the gang re-admits
@@ -348,8 +396,10 @@ class TestNodesEndpoint:
 
 class TestChaosHarness:
     def test_seeded_chaos_run_meets_acceptance(self):
-        """The ISSUE acceptance bar at pytest scale: >=2 losses, >=1 flap,
-        >=1 store outage, per-tick invariants, rescue in survivors' domain,
+        """The acceptance bar at pytest scale: >=2 losses, >=1 flap,
+        >=1 store outage, a budget-checked voluntary drain, a leader
+        failover mid-drain, per-tick invariants (incl. the disruption
+        budget and no-stranded-hold checks), rescue in survivors' domain,
         requeue re-admission, convergence to the fault-free tree."""
         from grove_tpu.sim.chaos import run_chaos
 
@@ -359,6 +409,9 @@ class TestChaosHarness:
         assert report.flaps >= 1
         assert report.requeues >= 1
         assert report.pin_verified_rescues >= 1
+        assert report.drain_evictions >= 1
+        assert report.drains_completed >= 1
+        assert report.failovers == 1
         assert report.converged
         assert report.signature_matches_fault_free
         assert report.ok
