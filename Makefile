@@ -6,7 +6,7 @@ PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 IMAGE ?= grove-tpu:0.2.0
 
-.PHONY: test test-fast check crds api-docs bench bench-small \
+.PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke dryrun docker-build \
         compose-up clean
@@ -19,11 +19,14 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check:           ## drift gates: CRDs, api-docs, wire fixtures, CRD conformance
+check: lint      ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
+
+lint:            ## grovelint static analysis (GL001..GL010) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
 	$(CPU_ENV) $(PY) -m grove_tpu.cli crds --output-dir deploy/crds
@@ -54,8 +57,8 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds: catches schedule-dependent regressions the single-seed smoke misses
-	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds): catches schedule-dependent regressions the single-seed smoke misses
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42
 
 drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node drain with trial-solve pre-placement, breaker open/close under an eviction storm, inert-broker A/B
 	$(CPU_ENV) $(PY) scripts/drain_smoke.py

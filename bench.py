@@ -335,6 +335,25 @@ def _drain_artifact_block() -> dict:
     return drain_artifact()
 
 
+def _lint_artifact_block() -> dict:
+    """grovelint block for the integrated artifact: rule counts and the
+    suppression inventory (docs/static-analysis.md). Pure-AST pass over
+    grove_tpu/ — a few seconds, no jax."""
+    from grove_tpu.analysis.engine import run_repo_lint
+
+    report = run_repo_lint()
+    return {
+        "ok": report.ok,
+        "files_scanned": report.files_scanned,
+        "violations": len(report.violations),
+        "counts": report.counts(),
+        "suppression_count": len(report.suppressed),
+        "suppressed_rules": sorted(
+            {v.rule for v in report.suppressed}
+        ),
+    }
+
+
 def _quota_artifact() -> dict:
     """3-tenant contended fair-share run + single-queue A/B, run after the
     main integrated population in the same process (metrics are deltas, so
@@ -416,6 +435,10 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # with trial-solve pre-placement, breaker storm open/close,
             # and the inert-broker A/B
             "drain": _drain_artifact_block(),
+            # static-analysis block (docs/static-analysis.md): grovelint
+            # rule counts + suppression inventory over the exact tree
+            # this artifact was produced from
+            "lint": _lint_artifact_block(),
         }
 
     _run_population_bench(

@@ -1,0 +1,32 @@
+"""grovelint: project-invariant static analysis + runtime sanitizer.
+
+Two halves, one subsystem (docs/static-analysis.md):
+
+- **Static analyzer** (`engine.py` + `rules/`): an AST-based rule engine
+  enforcing the invariants this codebase's correctness rests on — virtual
+  clock everywhere the sim/solver/control plane runs, every voluntary
+  eviction behind a DisruptionBroker grant, every solve masked through
+  ``Node.schedulable``, store writes through the copy-on-write path, JAX
+  hygiene inside jitted kernels, registered event reasons, closed spans,
+  non-blocking reconcile bodies, consistent lock order, and wire-decodable
+  public API types. Run it via ``make lint`` / ``scripts/lint.py``.
+
+- **Runtime sanitizer** (`sanitize.py`, ``GROVE_TPU_SANITIZE=1``): dynamic
+  twins of the invariants static analysis cannot prove — lock-acquisition
+  order observed at runtime, the store's byte-compare write guard,
+  accountant-vs-recount drift, and leaked spans / stranded holds at
+  harness teardown. One ``make chaos-matrix`` seed runs under it.
+
+The package is stdlib-only at import time (ast/re/json/threading): linting
+never drags in jax, and the sanitizer is importable from the observability
+singletons without cycles.
+"""
+
+from grove_tpu.analysis.engine import (  # noqa: F401
+    LintReport,
+    Rule,
+    Violation,
+    lint_paths,
+    lint_source,
+    run_repo_lint,
+)

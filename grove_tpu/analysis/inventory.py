@@ -1,0 +1,136 @@
+"""AST inventory collectors: what the codebase actually emits.
+
+Feeds tests/test_docs_drift.py (emitted event reasons ⊆ the
+observability/events.py registry ⊆ the docs/observability.md catalog;
+metric names in code ⇄ the docs table) and is reusable anywhere the
+"what does the code emit" question comes up. Pure-AST — no imports of
+the scanned modules, so collection can't be skewed by runtime state.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, Set, Tuple
+
+from grove_tpu.analysis.engine import (
+    dotted,
+    event_record_reason,
+    repo_python_files,
+)
+
+_METRIC_METHODS = {"inc", "set", "observe"}
+
+
+def _literal_prefix(node: ast.AST) -> str:
+    """Literal text of a metric-name argument: a plain string, or the
+    leading constant of an f-string (names label with `/{...}` suffixes —
+    the base name is everything before the first '/')."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split("/", 1)[0]
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value.split("/", 1)[0].rstrip("/")
+    return ""
+
+
+def emitted_event_reasons(
+    root: Path,
+) -> Dict[str, Set[Tuple[str, int]]]:
+    """reason -> {(path, line)} for every record()/record_event() call
+    site with a resolvable reason (literal or REASON_ constant)."""
+    out: Dict[str, Set[Tuple[str, int]]] = {}
+    # resolve REASON_* constant values without importing
+    events_src = (root / "grove_tpu/observability/events.py").read_text()
+    constants: Dict[str, str] = {}
+    for node in ast.walk(ast.parse(events_src)):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("REASON_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            constants[node.targets[0].id] = node.value.value
+    for rel in repo_python_files(root):
+        tree = ast.parse((root / rel).read_text())
+        for node in ast.walk(tree):
+            # a REASON_* constant referenced anywhere outside events.py
+            # counts as emittable: several sites thread reasons through an
+            # `event_reason` parameter into one shared record() call
+            if rel != "grove_tpu/observability/events.py":
+                name = (
+                    node.id
+                    if isinstance(node, ast.Name)
+                    else node.attr
+                    if isinstance(node, ast.Attribute)
+                    else None
+                )
+                if name in constants:
+                    out.setdefault(constants[name], set()).add(
+                        (rel, node.lineno)
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            reason_node = event_record_reason(node)
+            if reason_node is None:
+                continue
+            value = None
+            if isinstance(reason_node, ast.Constant) and isinstance(
+                reason_node.value, str
+            ):
+                value = reason_node.value
+            else:
+                name = (
+                    reason_node.id
+                    if isinstance(reason_node, ast.Name)
+                    else reason_node.attr
+                    if isinstance(reason_node, ast.Attribute)
+                    else None
+                )
+                if name in constants:
+                    value = constants[name]
+            if value is not None:
+                out.setdefault(value, set()).add((rel, node.lineno))
+    return out
+
+
+def emitted_metric_names(root: Path) -> Dict[str, Set[Tuple[str, int]]]:
+    """metric base name -> {(path, line)} for every METRICS.inc/set/
+    observe call with a literal (or f-string-prefixed) name."""
+    out: Dict[str, Set[Tuple[str, int]]] = {}
+    for rel in repo_python_files(root):
+        tree = ast.parse((root / rel).read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and dotted(node.func.value).split(".")[-1].upper()
+                == "METRICS"
+                and node.args
+            ):
+                continue
+            name = _literal_prefix(node.args[0])
+            if name:
+                out.setdefault(name, set()).add((rel, node.lineno))
+    return out
+
+
+def all_string_literals(root: Path, rels: Iterable[str]) -> Set[str]:
+    """Every string constant in the given files (docs→code direction of
+    the metric drift check: a documented name must exist in code)."""
+    out: Set[str] = set()
+    for rel in rels:
+        for node in ast.walk(ast.parse((root / rel).read_text())):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                out.add(node.value)
+            elif isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.Constant) and isinstance(
+                        part.value, str
+                    ):
+                        out.add(part.value)
+    return out

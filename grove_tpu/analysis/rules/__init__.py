@@ -1,0 +1,26 @@
+"""grovelint rule registry. Each module holds one theme's rules; ALL_RULES
+is the set `make lint` runs (docs/static-analysis.md is the catalog)."""
+
+from grove_tpu.analysis.rules.apiwire import WireRoundTripRule
+from grove_tpu.analysis.rules.clocks import BlockingTickRule, ClockDisciplineRule
+from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
+from grove_tpu.analysis.rules.locks import LockOrderRule
+from grove_tpu.analysis.rules.observability import EventReasonRule, SpanLeakRule
+from grove_tpu.analysis.rules.scheduling import (
+    BrokerGrantRule,
+    SchedulableMaskRule,
+)
+from grove_tpu.analysis.rules.storepath import StoreWritePathRule
+
+ALL_RULES = (
+    ClockDisciplineRule,  # GL001
+    BrokerGrantRule,  # GL002
+    SchedulableMaskRule,  # GL003
+    StoreWritePathRule,  # GL004
+    JitHygieneRule,  # GL005
+    EventReasonRule,  # GL006
+    SpanLeakRule,  # GL007
+    BlockingTickRule,  # GL008
+    LockOrderRule,  # GL009
+    WireRoundTripRule,  # GL010
+)

@@ -1,0 +1,100 @@
+"""GL005 JAX hygiene inside jitted kernels.
+
+The packing kernel, the fair-share scan, and the ops package are the hot
+compiled core; three classes of bug creep in silently during refactors:
+
+- **Python side effects** traced into the jaxpr: `print(...)` runs at
+  trace time only (lies during execution), `global` mutation desyncs
+  host state from device state.
+- **dtype creep**: a stray `float64` literal/dtype flips the whole
+  lattice off the float32 contract the NumPy oracles are pinned against
+  (bit-identical DRF ordering, packing parity) — and TPUs don't do f64.
+- **Host round-trips**: `io_callback`/`pure_callback`/`jax.debug.*` and
+  `.item()` force a device sync inside the compiled region.
+
+Scope: `ops/`, `solver/kernel.py`, `quota/ordering.py` — functions
+decorated with `jax.jit`/`partial(jax.jit, ...)` and everything nested
+inside them (scan/cond bodies are closures).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from grove_tpu.analysis.engine import FileContext, Rule, Violation, dotted
+
+_HOST_CALLBACKS = {"io_callback", "pure_callback", "print", "callback"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / jax.jit(...) shapes."""
+    if isinstance(dec, ast.Call):
+        name = dotted(dec.func)
+        if name.endswith("jit"):
+            return True
+        if name in ("partial", "functools.partial") and dec.args:
+            return dotted(dec.args[0]).endswith("jit")
+        return False
+    return dotted(dec).endswith("jit")
+
+
+class JitHygieneRule(Rule):
+    id = "GL005"
+    name = "jit-hygiene"
+    description = (
+        "jitted kernels must be pure float32 device code: no print/global,"
+        " no float64 literals or dtype creep, no host callbacks or .item()"
+    )
+    paths = (
+        "grove_tpu/ops/",
+        "grove_tpu/solver/kernel.py",
+        "grove_tpu/quota/ordering.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        jitted: List[ast.AST] = []
+        for fn in ctx.functions():
+            if any(_is_jit_decorator(d) for d in fn.decorator_list):
+                jitted.append(fn)
+        seen: Set[int] = set()
+        for fn in jitted:
+            for node in ast.walk(fn):
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                msg = self._classify(node)
+                if msg is not None:
+                    yield Violation(
+                        rule=self.id,
+                        path=ctx.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=f"{msg} inside jitted `{fn.name}()`",
+                    )
+
+    @staticmethod
+    def _classify(node: ast.AST):
+        if isinstance(node, ast.Global):
+            return "`global` mutation (host side effect traced away)"
+        if isinstance(node, ast.Constant) and node.value == "float64":
+            return "'float64' dtype literal (float32 contract; no f64 on TPU)"
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            return (
+                f"`{dotted(node)}` dtype (float32 contract; no f64 on TPU)"
+            )
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "print":
+                return "`print()` (trace-time only — use jax.debug outside the kernel)"
+            if isinstance(fn, ast.Attribute):
+                src = dotted(fn)
+                if fn.attr == "item":
+                    return "`.item()` host sync"
+                if fn.attr in _HOST_CALLBACKS and (
+                    "debug" in src or fn.attr in ("io_callback", "pure_callback")
+                ):
+                    return f"host callback `{src}()`"
+                if src.startswith("time."):
+                    return f"wall-clock `{src}()`"
+        return None

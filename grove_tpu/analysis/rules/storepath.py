@@ -1,0 +1,131 @@
+"""GL004 store write-path discipline.
+
+PR 2's copy-on-write store removed pickling/deep-copying from the
+control-plane write path; the contract is: reads are zero-copy readonly
+views, writes go through `commit_cow` / the sanctioned `Store` methods
+(create/update/update_status/delete/commit_status/commit_spec/
+commit_finalizer_add). Two regressions this rule catches statically:
+
+- **Serialization creep**: `copy.deepcopy` / `pickle.dumps|loads` back in
+  control-plane packages (the sanctioned structural helper is
+  `api.meta.deep_copy`, and only OFF the per-write path).
+- **Private-state bypass**: reaching into the store's internals
+  (`_committed`, `_blob`, ...) from outside runtime/store.py skips
+  resourceVersion bumps, watch events, aggregates, and the byte-compare
+  guard — the silent-corruption class `verify_readonly_integrity` exists
+  to catch at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from grove_tpu.analysis.engine import FileContext, Rule, Violation, dotted
+
+_STORE_PRIVATE = {
+    "_committed",
+    "_cache",
+    "_blob",
+    "_cache_blob",
+    "_index",
+    "_cache_index",
+    "_rv",
+    "_agg_committed",
+    "_agg_cached",
+    "_guard_blobs",
+}
+
+_SERIALIZERS = {
+    "deepcopy": "copy.deepcopy",
+    "dumps": "pickle.dumps",
+    "loads": "pickle.loads",
+}
+
+
+class StoreWritePathRule(Rule):
+    id = "GL004"
+    name = "store-write-path"
+    description = (
+        "store mutation only via commit_cow/sanctioned Store methods — no"
+        " pickling/deepcopy on the control-plane write path, no private"
+        " store-state access outside runtime/store.py"
+    )
+    paths = (
+        "grove_tpu/runtime/",
+        "grove_tpu/controller/",
+        "grove_tpu/solver/",
+        "grove_tpu/sim/",
+        "grove_tpu/disruption/",
+        "grove_tpu/quota/",
+        "grove_tpu/autoscale/",
+    )
+    exclude = ("grove_tpu/runtime/store.py",)
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        pickle_aliases = set()
+        copy_aliases = set()
+        from_names = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "pickle":
+                        pickle_aliases.add(local)
+                    elif alias.name == "copy":
+                        copy_aliases.add(local)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module in ("pickle", "copy"):
+                    for alias in node.names:
+                        if alias.name in _SERIALIZERS:
+                            from_names[alias.asname or alias.name] = (
+                                f"{node.module}.{alias.name}"
+                            )
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                full = None
+                if isinstance(fn, ast.Attribute) and isinstance(
+                    fn.value, ast.Name
+                ):
+                    if (
+                        fn.value.id in pickle_aliases
+                        and fn.attr in ("dumps", "loads")
+                    ) or (fn.value.id in copy_aliases and fn.attr == "deepcopy"):
+                        full = f"{fn.value.id}.{fn.attr}"
+                elif isinstance(fn, ast.Name) and fn.id in from_names:
+                    full = from_names[fn.id]
+                if full is not None:
+                    yield Violation(
+                        rule=self.id,
+                        path=ctx.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"`{full}()` on the control-plane path — use"
+                            " the copy-on-write store commits"
+                            " (commit_cow/commit_status) or"
+                            " api.meta.deep_copy off the write path"
+                        ),
+                    )
+        # private store-state access: `<...>store.<_private>`
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _STORE_PRIVATE
+            ):
+                base = dotted(node.value)
+                leaf = base.split(".")[-1] if base else ""
+                if "store" in leaf.lower():
+                    yield Violation(
+                        rule=self.id,
+                        path=ctx.rel,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"private store state `{base}.{node.attr}`"
+                            " accessed outside runtime/store.py — writes"
+                            " must go through the sanctioned Store API"
+                            " (commit_cow, create, update, delete)"
+                        ),
+                    )

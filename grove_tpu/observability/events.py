@@ -55,6 +55,33 @@ REASON_GANG_DRAINED = "GangDrained"
 REASON_DISRUPTION_THROTTLED = "DisruptionThrottled"
 REASON_BREAKER_OPEN = "BreakerOpen"
 REASON_BREAKER_CLOSED = "BreakerClosed"
+# operator-component lifecycle reasons (controller/podcliqueset components,
+# rolling update, gang termination) — emitted as literals at the call
+# sites; registered here so grovelint GL006 and the docs-drift test keep
+# the emitted set ⊆ this registry ⊆ docs/observability.md's catalog
+REASON_GANG_TERMINATED = "GangTerminated"
+REASON_SCALED_REPLICA_GANG_TERMINATED = "ScaledReplicaGangTerminated"
+REASON_ROLLING_UPDATE_REPLICA_STARTED = "RollingUpdateReplicaStarted"
+REASON_ROLLING_UPDATE_REPLICA_COMPLETED = "RollingUpdateReplicaCompleted"
+REASON_ROLLING_UPDATE_COMPLETED = "RollingUpdateCompleted"
+REASON_POD_CREATE_SUCCESSFUL = "PodCreateSuccessful"
+REASON_POD_DELETE_SUCCESSFUL = "PodDeleteSuccessful"
+REASON_POD_UPDATE_DELETE_SUCCESSFUL = "PodUpdateDeleteSuccessful"
+REASON_POD_CLIQUE_CREATE_SUCCESSFUL = "PodCliqueCreateSuccessful"
+REASON_POD_CLIQUE_DELETE_SUCCESSFUL = "PodCliqueDeleteSuccessful"
+REASON_PCSG_CREATE_SUCCESSFUL = "PCSGCreateSuccessful"
+REASON_PCSG_DELETE_SUCCESSFUL = "PCSGDeleteSuccessful"
+REASON_PODGANG_CREATE_SUCCESSFUL = "PodGangCreateSuccessful"
+REASON_PODGANG_DELETE_SUCCESSFUL = "PodGangDeleteSuccessful"
+
+# The closed set of event reasons this codebase may emit. grovelint's
+# GL006 rule checks every record()/record_event() call site against it,
+# and tests/test_docs_drift.py pins it against docs/observability.md.
+REGISTERED_REASONS = frozenset(
+    v
+    for k, v in list(globals().items())
+    if k.startswith("REASON_") and isinstance(v, str)
+)
 
 
 @dataclass

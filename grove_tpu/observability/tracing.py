@@ -54,6 +54,12 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+# Sanitizer hook (grove_tpu.analysis.sanitize): an object with
+# span_opened(span)/span_closed(span), installed only under
+# GROVE_TPU_SANITIZE=1 for leaked-span detection. One global load per
+# span lifecycle when tracing is on; no cost while tracing is off.
+SPAN_HOOK = None
+
 
 class Span:
     __slots__ = (
@@ -79,6 +85,8 @@ class Span:
         if tracer.clock is not None:
             attrs["vt"] = round(tracer.clock.now(), 3)
         self._done = False
+        if SPAN_HOOK is not None:
+            SPAN_HOOK.span_opened(self)
         self._t0 = time.perf_counter()
         self.ts_us = int((self._t0 - tracer._origin) * 1e6)
         self.dur_us = 0
@@ -90,6 +98,8 @@ class Span:
         if self._done:
             return
         self._done = True
+        if SPAN_HOOK is not None:
+            SPAN_HOOK.span_closed(self)
         self.dur_us = int((time.perf_counter() - self._t0) * 1e6)
         tracer = self._tracer
         stack = tracer._stack()

@@ -19,7 +19,6 @@ controllers rely on:
 from __future__ import annotations
 
 import copy as _copy
-import os
 import pickle
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -207,11 +206,12 @@ class Store:
         self._agg_committed = PodAggregate()
         self._agg_cached = PodAggregate() if cache_lag else self._agg_committed
         # copy-on-write commits skip the canonical pickle blob; under the
-        # test-mode store guard they compute it eagerly anyway so
-        # verify_readonly_integrity keeps its byte-compare coverage
-        self._guard_blobs = os.environ.get(
-            "GROVE_TPU_STORE_GUARD", ""
-        ).lower() not in ("", "0", "false")
+        # test-mode store guard (GROVE_TPU_STORE_GUARD, or sanitizer mode
+        # GROVE_TPU_SANITIZE which generalizes it) they compute it eagerly
+        # anyway so verify_readonly_integrity keeps byte-compare coverage
+        from grove_tpu.analysis.sanitize import store_guard_enabled
+
+        self._guard_blobs = store_guard_enabled()
         # optional admission guard (grove_tpu.admission.authorization):
         # writes are checked against the current actor; in-process
         # controllers act as the operator identity

@@ -48,12 +48,13 @@ import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from grove_tpu.analysis.sanitize import accountant_drift, stranded_holds
+from grove_tpu.analysis import sanitize
 from grove_tpu.api.load import load_podcliquesets
 from grove_tpu.api.meta import deep_copy, get_condition
 from grove_tpu.api.pod import is_ready
 from grove_tpu.api.types import COND_PODGANG_SCHEDULED, PHASE_RUNNING
 from grove_tpu.observability.metrics import METRICS
-from grove_tpu.quota.oracle import usage_oracle
 from grove_tpu.runtime.errors import GroveError
 from grove_tpu.sim.cluster import NODE_LOST, NODE_READY
 from grove_tpu.sim.harness import SimHarness
@@ -469,6 +470,7 @@ class ChaosRunner:
             assert (
                 _time.monotonic() < deadline
             ), "standby never took over the lease"
+            # grovelint: disable=GL001 -- real wall-clock wait: the LeaseElector protocol ages the lease on real time (cluster/lease.py is wall-clock by design); bounded by the deadline above
             _time.sleep(0.05)
 
         # deposed leader's engine stops draining; the standby builds fresh
@@ -556,20 +558,12 @@ class ChaosRunner:
                     f"below MinReplicas for {now - since:.0f}s "
                     f"(> grace {slack:.0f}s)"
                 )
-        # 3a. incremental quota accounting equals a full recount
-        acct = h.scheduler.quota.accountant
-        acct.ensure_built(h.store)
-        oracle = usage_oracle(h.store.scan("Pod"), acct.default_queue)
-        snap = acct.snapshot()
-        queues = set(snap) | set(oracle)
-        for q in sorted(queues):
-            a, b = snap.get(q, {}), oracle.get(q, {})
-            for r in sorted(set(a) | set(b)):
-                if abs(a.get(r, 0.0) - b.get(r, 0.0)) > 1e-6:
-                    violations.append(
-                        f"t={rel_now:.0f}s: queue {q} usage {r}: "
-                        f"accountant {a.get(r, 0.0)} != recount {b.get(r, 0.0)}"
-                    )
+        # 3a. incremental quota accounting equals a full recount (the
+        # tick-boundary exactness check shared with the sanitizer)
+        for problem in accountant_drift(
+            h.scheduler.quota.accountant, h.store
+        ):
+            violations.append(f"t={rel_now:.0f}s: {problem}")
         # 3b. no node is committed beyond its capacity
         used = h.cluster._used_by_node()
         for node in h.cluster.nodes:
@@ -594,15 +588,10 @@ class ChaosRunner:
                     f"allows {cap}"
                 )
         # 5. no stranded hold: every monitor-held gang keeps a scheduled
-        # release (a hold with no delayed workqueue entry waits forever)
-        for gang_key in sorted(h.node_monitor._held):
-            wq_key = ("PodGang",) + gang_key
-            if not h.node_monitor.requeue.has_delayed(wq_key):
-                violations.append(
-                    f"t={rel_now:.0f}s: held gang {gang_key[0]}/"
-                    f"{gang_key[1]} has no scheduled backoff release "
-                    "(stranded)"
-                )
+        # release (a hold with no delayed workqueue entry waits forever —
+        # same check the sanitizer reruns at teardown)
+        for problem in stranded_holds(h.node_monitor):
+            violations.append(f"t={rel_now:.0f}s: {problem}")
 
     def _guarded(self, fn) -> int:
         """Run one control-plane component; a transient store error models
@@ -724,6 +713,14 @@ class ChaosRunner:
         report.signature_matches_fault_free = (
             resource_signature(h.store) == twin_sig
         )
+        # sanitizer teardown sweep (GROVE_TPU_SANITIZE=1): lock-order
+        # inversions, leaked spans, stranded holds, accountant drift, and
+        # the store's byte-compare guard — recorded as invariant
+        # violations so the smoke's verdict covers them
+        if sanitize.active():
+            report.invariant_violations.extend(
+                f"sanitizer: {p}" for p in sanitize.harness_problems(h)
+            )
         return report
 
 

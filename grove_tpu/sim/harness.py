@@ -6,7 +6,6 @@ The end-to-end driver mirroring the reference quickstart flow
 
 from __future__ import annotations
 
-import os
 from typing import List, Optional
 
 from grove_tpu.admission.defaulting import default_podcliqueset
@@ -211,15 +210,13 @@ class SimHarness:
                     continue
                 break
             self.clock.advance(tick_seconds)
-        if os.environ.get("GROVE_TPU_STORE_GUARD", "").lower() not in (
-            "",
-            "0",
-            "false",
-        ):
+        from grove_tpu.analysis.sanitize import store_guard_enabled
+
+        if store_guard_enabled():
             # test-mode write barrier: a reconciler that mutated a zero-copy
             # readonly view during this converge fails loudly here (the
-            # suite sets the flag in conftest; production converges don't
-            # pay the re-pickle)
+            # suite sets the flag in conftest, sanitizer mode implies it;
+            # production converges don't pay the re-pickle)
             self.store.verify_readonly_integrity()
         return ticks
 

@@ -53,26 +53,57 @@ def main() -> int:
         " schedule-dependent regressions hide from any single seed)",
     )
     parser.add_argument("--json", action="store_true", help="emit one JSON line")
+    parser.add_argument(
+        "--sanitize",
+        action="store_true",
+        help="run under the runtime sanitizer (GROVE_TPU_SANITIZE=1):"
+        " lock-order assertions, store byte-compare guard, accountant"
+        " recounts, leaked-span/stranded-hold teardown checks",
+    )
+    parser.add_argument(
+        "--sanitize-seed",
+        type=int,
+        help="with --seeds: the one seed of the matrix to run sanitized"
+        " (the sanitizer exercises every dynamic check in anger on each"
+        " matrix run without taxing all seeds)",
+    )
     args = parser.parse_args()
 
     if args.seeds:
         rc = 0
         for raw in args.seeds.split(","):
             seed = int(raw.strip())
-            print(f"=== chaos seed {seed} ===", flush=True)
-            rc = run_one(seed, args.json)
+            sanitized = args.sanitize or seed == args.sanitize_seed
+            tag = " [sanitize]" if sanitized else ""
+            print(f"=== chaos seed {seed}{tag} ===", flush=True)
+            rc = run_one(seed, args.json, sanitized)
             if rc:
                 return rc
         return rc
 
-    return run_one(args.seed, args.json)
+    return run_one(
+        args.seed,
+        args.json,
+        args.sanitize or args.seed == args.sanitize_seed,
+    )
 
 
-def run_one(seed: int, as_json: bool) -> int:
+def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
     from grove_tpu.sim.chaos import run_chaos
 
-    report = run_chaos(seed=seed)
+    if sanitized:
+        from grove_tpu.analysis import sanitize
+
+        sanitize.install()
+    try:
+        report = run_chaos(seed=seed)
+    finally:
+        if sanitized:
+            from grove_tpu.analysis import sanitize
+
+            sanitize.uninstall()
     doc = report.as_dict()
+    doc["sanitized"] = sanitized
 
     problems = []
     if report.node_losses < 2:

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""grovelint CLI: project-invariant static analysis + generated-artifact
+drift checks (the `make lint` target; docs/static-analysis.md is the rule
+catalog).
+
+Two stages, both on by default:
+
+1. **Static analysis** — the grovelint rule engine over every .py in
+   grove_tpu/ (GL001..GL010; suppressions require `-- justification`).
+2. **Drift checks** (skip with --no-check) — `deploy/crds/*.yaml`, the
+   chart copies under `deploy/charts/grove-tpu/crds/`, and
+   `docs/api-reference.md` must be byte-identical to what
+   `make crds` / `make api-docs` would regenerate from api/types.py
+   (the PR-3/PR-5 regeneration path).
+
+Exit-code contract: 0 clean, 1 violations/drift, 2 internal error.
+
+Usage: python scripts/lint.py [--json] [--no-check] [--rules GL001,GL007]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+# CPU pin before any grove import can drag jax in (the drift check loads
+# the typed model; the analyzer itself is stdlib-only)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+
+
+def drift_problems() -> list:
+    """Byte-compare generated artifacts against their generators."""
+    from grove_tpu.cluster.apidocs import render_api_reference
+    from grove_tpu.cluster.crdgen import CRD_KINDS, generate_crd
+
+    import yaml
+
+    problems = []
+    # CRDs: deploy/crds/<name>.yaml (+ the helm chart copies)
+    for kind in CRD_KINDS:
+        crd = generate_crd(kind)
+        want = yaml.safe_dump(crd, sort_keys=False, default_flow_style=False)
+        name = f"{crd['metadata']['name']}.yaml"
+        for rel in (
+            Path("deploy/crds") / name,
+            Path("deploy/charts/grove-tpu/crds") / name,
+        ):
+            path = ROOT / rel
+            if not path.exists():
+                if "charts" in str(rel) and not path.parent.exists():
+                    continue  # chart copies are optional in a trimmed tree
+                problems.append(f"{rel}: missing (run `make crds`)")
+                continue
+            if path.read_text() != want:
+                problems.append(
+                    f"{rel}: stale — not regenerable byte-identical from"
+                    " api/types.py (run `make crds`)"
+                )
+    # API reference
+    ref = ROOT / "docs/api-reference.md"
+    want_ref = render_api_reference()
+    if not ref.exists():
+        problems.append("docs/api-reference.md: missing (run `make api-docs`)")
+    elif ref.read_text() != want_ref:
+        problems.append(
+            "docs/api-reference.md: stale — run `make api-docs`"
+        )
+    return problems
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--json", action="store_true", help="emit the JSON report"
+    )
+    parser.add_argument(
+        "--no-check",
+        action="store_true",
+        help="skip the CRD/api-docs drift checks (analysis only)",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    args = parser.parse_args()
+
+    from grove_tpu.analysis.engine import default_rules, run_repo_lint
+
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in wanted]
+        if not rules:
+            print(f"no rules match {args.rules!r}", file=sys.stderr)
+            return 2
+
+    report = run_repo_lint(ROOT, rules)
+    drift = [] if args.no_check else drift_problems()
+
+    if args.json:
+        doc = report.as_json()
+        doc["drift"] = drift
+        doc["ok"] = doc["ok"] and not drift
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(report.render_human())
+        for p in drift:
+            print(f"drift: {p}")
+        if not args.no_check:
+            print(
+                f"drift checks: {len(drift)} problem(s)"
+                if drift
+                else "drift checks: clean"
+            )
+    return 0 if (report.ok and not drift) else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:  # internal error — distinct exit code
+        print(f"grovelint internal error: {e}", file=sys.stderr)
+        sys.exit(2)

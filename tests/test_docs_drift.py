@@ -1,0 +1,116 @@
+"""Docs-drift gates (grovelint satellite, docs/static-analysis.md):
+
+1. Event reasons: every reason the code can emit (AST inventory over
+   record()/record_event() call sites) ⊆ the registry in
+   observability/events.py ⊆ the catalog table in docs/observability.md.
+2. Metric names: every literal metric name passed to
+   METRICS.inc/set/observe ⊆ the docs/observability.md metrics table, and
+   every documented metric exists as a string literal in the code (the
+   variable-assigned emitters like `metric="gang_preemptions_total"`
+   resolve through the literal inventory).
+
+These pin the three layers against each other so a new event/metric
+cannot ship undocumented, and a doc row cannot outlive its emitter.
+"""
+
+import pathlib
+import re
+
+import pytest
+
+from grove_tpu.analysis.inventory import (
+    all_string_literals,
+    emitted_event_reasons,
+    emitted_metric_names,
+)
+from grove_tpu.analysis.engine import repo_python_files
+from grove_tpu.observability.events import REGISTERED_REASONS
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+OBS_DOC = ROOT / "docs" / "observability.md"
+
+
+def _table_first_cells(section: str):
+    """All code spans from the FIRST column of a markdown table section
+    (cells may hold several names: `A` / `B` / `C`)."""
+    names = set()
+    for line in section.splitlines():
+        line = line.strip()
+        if not line.startswith("|"):
+            continue
+        first = line.split("|")[1]
+        if set(first.strip()) <= {"-", ":", " "}:
+            continue  # separator row
+        names.update(re.findall(r"`([A-Za-z0-9_]+)`", first))
+    return names
+
+
+def _doc_section(title: str) -> str:
+    doc = OBS_DOC.read_text()
+    assert f"## {title}" in doc, f"docs/observability.md lost its '{title}' section"
+    return doc.split(f"## {title}", 1)[1].split("\n## ", 1)[0]
+
+
+class TestEventReasonDrift:
+    def test_emitted_subset_of_registry(self):
+        emitted = emitted_event_reasons(ROOT)
+        unregistered = set(emitted) - set(REGISTERED_REASONS)
+        assert not unregistered, (
+            "event reasons emitted but not registered in"
+            f" observability/events.py: {sorted(unregistered)} (sites:"
+            f" {[sorted(emitted[r]) for r in sorted(unregistered)]})"
+        )
+
+    def test_registry_subset_of_docs(self):
+        documented = _table_first_cells(_doc_section("Event reasons"))
+        undocumented = set(REGISTERED_REASONS) - documented
+        assert not undocumented, (
+            "registered event reasons missing from the"
+            " docs/observability.md catalog table:"
+            f" {sorted(undocumented)}"
+        )
+
+    def test_docs_not_stale(self):
+        """Every documented reason is still registered (rows outliving
+        their emitters read as live signals to operators)."""
+        documented = _table_first_cells(_doc_section("Event reasons"))
+        stale = documented - set(REGISTERED_REASONS)
+        assert not stale, (
+            "docs/observability.md documents reasons no longer in the"
+            f" registry: {sorted(stale)}"
+        )
+
+    def test_registry_is_emittable(self):
+        """Registered but never-emitted reasons are dead registry weight
+        (catches renames that orphan a constant)."""
+        emitted = set(emitted_event_reasons(ROOT))
+        dead = set(REGISTERED_REASONS) - emitted
+        assert not dead, (
+            "registered reasons with no emitting call site:"
+            f" {sorted(dead)}"
+        )
+
+
+class TestMetricNameDrift:
+    @pytest.fixture(scope="class")
+    def documented(self):
+        return _table_first_cells(_doc_section("Metrics catalog"))
+
+    def test_code_metrics_documented(self, documented):
+        emitted = emitted_metric_names(ROOT)
+        undocumented = set(emitted) - documented
+        assert not undocumented, (
+            "metrics emitted but missing from the docs/observability.md"
+            f" table: {sorted(undocumented)} (sites:"
+            f" {[sorted(emitted[m]) for m in sorted(undocumented)]})"
+        )
+
+    def test_documented_metrics_exist_in_code(self, documented):
+        literals = all_string_literals(ROOT, repo_python_files(ROOT))
+        # f-string heads keep their '/label' tail — normalize to base names
+        bases = {lit.split("/", 1)[0] for lit in literals}
+        missing = {m for m in documented if m not in bases}
+        assert not missing, (
+            "docs/observability.md documents metrics with no emitting"
+            f" literal in grove_tpu/: {sorted(missing)}"
+        )

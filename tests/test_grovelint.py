@@ -1,0 +1,404 @@
+"""grovelint: the analyzer's own acceptance tests.
+
+Three layers (docs/static-analysis.md):
+
+1. **Fixture teeth** — for every rule GL001..GL010, a known-bad snippet
+   must fire and its known-good twin must pass. This is what pins
+   "deleting any single enforced invariant makes `make lint` fail".
+2. **Live-tree mutations** — the real invariants (the `schedulable`
+   mask in the solve path, the broker grant in preemption and rolling
+   update) are deleted from the actual sources in memory; lint must
+   fail on the mutated tree.
+3. **Engine contract** — pragma semantics (justified suppression works,
+   bare suppression is GL000), path scoping, JSON report shape, and the
+   repo itself lints clean.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from grove_tpu.analysis.engine import (
+    default_rules,
+    lint_source,
+    run_repo_lint,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def rules_of(report):
+    return sorted({v.rule for v in report.violations})
+
+
+# ---------------------------------------------------------------------------
+# 1. fixture teeth: bad fires, good twin passes
+# ---------------------------------------------------------------------------
+
+FIXTURES = {
+    "GL001": {
+        "rel": "grove_tpu/sim/fixture.py",
+        "bad": (
+            "import time\nimport random\n\n"
+            "def tick(self):\n"
+            "    now = time.time()\n"
+            "    jitter = random.random()\n"
+        ),
+        "good": (
+            "import random\n\n"
+            "def tick(self):\n"
+            "    now = self.store.clock.now()\n"
+            "    rng = random.Random(self.seed)\n"
+            "    jitter = rng.random()\n"
+        ),
+    },
+    "GL002": {
+        "rel": "grove_tpu/solver/fixture.py",
+        "bad": (
+            "def _maybe_preempt(self, gang, preemptor):\n"
+            "    self._evict_victim(gang, preemptor)\n"
+        ),
+        "good": (
+            "def _maybe_preempt(self, gang, preemptor):\n"
+            "    if not self.broker.grant([gang], 'preemption'):\n"
+            "        return\n"
+            "    self._evict_victim(gang, preemptor)\n"
+        ),
+    },
+    "GL003": {
+        "rel": "grove_tpu/solver/fixture.py",
+        "bad": (
+            "def _schedule(self, specs, free):\n"
+            "    nodes = list(self.cluster.nodes)\n"
+            "    return self._solve_batch(nodes, specs, free)\n"
+        ),
+        "good": (
+            "def _schedule(self, specs, free):\n"
+            "    nodes = [n for n in self.cluster.nodes if n.schedulable]\n"
+            "    return self._solve_batch(nodes, specs, free)\n"
+        ),
+    },
+    "GL004": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "import copy\n\n"
+            "def write(self, view):\n"
+            "    fresh = copy.deepcopy(view)\n"
+            "    self.store._committed['Pod'] = {}\n"
+        ),
+        "good": (
+            "from grove_tpu.runtime.store import commit_status\n\n"
+            "def write(self, view, status):\n"
+            "    commit_status(self.store, view, status)\n"
+        ),
+    },
+    "GL005": {
+        "rel": "grove_tpu/ops/fixture.py",
+        "bad": (
+            "import jax\nimport jax.numpy as jnp\n\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    print('tracing', x)\n"
+            "    return x.astype(jnp.float64)\n"
+        ),
+        "good": (
+            "import jax\nimport jax.numpy as jnp\n\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return x.astype(jnp.float32)\n"
+        ),
+    },
+    "GL006": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def emit(self, ref):\n"
+            "    EVENTS.record(ref, 'Warning', 'NotARegisteredReason', 'm')\n"
+        ),
+        "good": (
+            "def emit(self, ref):\n"
+            "    EVENTS.record(ref, 'Warning', 'GangDeferred', 'm')\n"
+        ),
+    },
+    "GL007": {
+        "rel": "grove_tpu/runtime/fixture.py",
+        "bad": (
+            "def work(self):\n"
+            "    span = TRACER.span('work')\n"
+            "    self.do()\n"
+        ),
+        "good": (
+            "def work(self):\n"
+            "    span = TRACER.span('work') if TRACER.enabled else None\n"
+            "    try:\n"
+            "        self.do()\n"
+            "    finally:\n"
+            "        if span is not None:\n"
+            "            span.end()\n"
+            "\n"
+            "def work2(self):\n"
+            "    with TRACER.span('work2'):\n"
+            "        self.do()\n"
+        ),
+    },
+    "GL008": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "import time\nimport subprocess\n\n"
+            "def tick(self):\n"
+            "    time.sleep(0.1)\n"
+            "    subprocess.run(['sync'])\n"
+        ),
+        "good": (
+            "def tick(self):\n"
+            "    self.queue.add_after(self.key, 0.1)\n"
+        ),
+    },
+    "GL009": {
+        "rel": "grove_tpu/runtime/fixture.py",
+        "bad": (
+            "class Pool:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            with self._sub_lock:\n"
+            "                pass\n"
+            "    def b(self):\n"
+            "        with self._sub_lock:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        ),
+        "good": (
+            "class Pool:\n"
+            "    def a(self):\n"
+            "        with self._lock:\n"
+            "            with self._sub_lock:\n"
+            "                pass\n"
+            "    def b(self):\n"
+            "        with self._lock:\n"
+            "            with self._sub_lock:\n"
+            "                pass\n"
+        ),
+    },
+    "GL010": {
+        "rel": "grove_tpu/api/types.py",
+        "bad": (
+            "from dataclasses import dataclass\n"
+            "from typing import Dict, Tuple\n\n"
+            "@dataclass\n"
+            "class Widget:\n"
+            "    shape: Tuple[int, int] = (0, 0)\n"
+            "    by_id: Dict[int, str] = None\n"
+        ),
+        "good": (
+            "from dataclasses import dataclass\n"
+            "from typing import Dict, List, Optional\n\n"
+            "@dataclass\n"
+            "class Widget:\n"
+            "    name: str = ''\n"
+            "    sizes: List[float] = None\n"
+            "    labels: Dict[str, str] = None\n"
+            "    parent: Optional['Widget'] = None\n"
+        ),
+    },
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES), ids=sorted(FIXTURES))
+def test_rule_fires_on_bad_and_passes_good(rule_id):
+    fx = FIXTURES[rule_id]
+    bad = lint_source(fx["bad"], fx["rel"])
+    assert rule_id in rules_of(bad), (
+        f"{rule_id} must fire on its known-bad fixture; got"
+        f" {[(v.rule, v.message) for v in bad.violations]}"
+    )
+    good = lint_source(fx["good"], fx["rel"])
+    assert rule_id not in rules_of(good), (
+        f"{rule_id} false-positives on its known-good fixture:"
+        f" {[v.message for v in good.violations if v.rule == rule_id]}"
+    )
+
+
+def test_rules_are_path_scoped():
+    """A GL001 violation in an allowlisted real-cluster path is ignored
+    (cluster/lease.py et al. legitimately read wall time)."""
+    src = "import time\n\ndef renew(self):\n    return time.time()\n"
+    for rel in (
+        "grove_tpu/cluster/lease.py",
+        "grove_tpu/cluster/cert.py",
+        "grove_tpu/cluster/manager.py",
+        "grove_tpu/utils/platform.py",
+    ):
+        report = lint_source(src, rel)
+        assert "GL001" not in rules_of(report), rel
+    report = lint_source(src, "grove_tpu/sim/anything.py")
+    assert "GL001" in rules_of(report)
+
+
+# ---------------------------------------------------------------------------
+# 2. live-tree mutations: deleting a real invariant fails lint
+# ---------------------------------------------------------------------------
+
+
+def _mutated(rel: str, old: str, new: str):
+    src = (ROOT / rel).read_text()
+    assert old in src, f"mutation anchor vanished from {rel}: {old!r}"
+    return lint_source(src.replace(old, new), rel)
+
+
+def test_deleting_schedulable_mask_fails_lint():
+    report = _mutated(
+        "grove_tpu/solver/scheduler.py",
+        "nodes = [n for n in self.cluster.nodes if n.schedulable]",
+        "nodes = list(self.cluster.nodes)",
+    )
+    assert "GL003" in rules_of(report)
+
+
+def test_deleting_preemption_grant_fails_lint():
+    report = _mutated(
+        "grove_tpu/solver/scheduler.py",
+        'and not broker.grant(victims_chosen, "preemption")',
+        "and False",
+    )
+    assert "GL002" in rules_of(report)
+
+
+def test_deleting_rolling_update_grant_fails_lint():
+    report = _mutated(
+        "grove_tpu/controller/podcliqueset/components/rollingupdate.py",
+        "_disruption_granted",
+        "_always_true",
+    )
+    assert "GL002" in rules_of(report)
+
+
+def test_unregistering_reason_fails_lint():
+    """Un-registering an emitted reason makes its call sites violations
+    (the registry is rebuilt per rule instantiation)."""
+    src = (
+        "def emit(self, ref):\n"
+        "    EVENTS.record(ref, 'Warning', 'GangDeferred', 'm')\n"
+    )
+    report = lint_source(src, "grove_tpu/solver/fixture.py")
+    assert "GL006" not in rules_of(report)
+    # the same literal, not in the registry -> fires (per-value check)
+    src2 = src.replace("GangDeferred", "GangDeferredX")
+    report2 = lint_source(src2, "grove_tpu/solver/fixture.py")
+    assert "GL006" in rules_of(report2)
+
+
+# ---------------------------------------------------------------------------
+# 3. engine contract
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_justification():
+    src = (
+        "import time\n\n"
+        "def tick(self):\n"
+        "    t = time.time()  # grovelint: disable=GL001 -- boot anchor\n"
+    )
+    report = lint_source(src, "grove_tpu/sim/fixture.py")
+    assert not report.violations
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0].justification == "boot anchor"
+
+
+def test_pragma_on_preceding_line():
+    src = (
+        "import time\n\n"
+        "def tick(self):\n"
+        "    # grovelint: disable=GL001 -- boot anchor\n"
+        "    t = time.time()\n"
+    )
+    report = lint_source(src, "grove_tpu/sim/fixture.py")
+    assert not report.violations
+    assert len(report.suppressed) == 1
+
+
+def test_bare_pragma_is_gl000():
+    src = (
+        "import time\n\n"
+        "def tick(self):\n"
+        "    t = time.time()  # grovelint: disable=GL001\n"
+    )
+    report = lint_source(src, "grove_tpu/sim/fixture.py")
+    assert rules_of(report) == ["GL000"]
+
+
+def test_bare_wildcard_pragma_cannot_suppress_itself():
+    """`disable=*` with no justification must still fail as GL000 — a
+    blanket bare pragma may not suppress the rule flagging its bareness."""
+    src = (
+        "import time\n\n"
+        "def tick(self):\n"
+        "    t = time.time()  # grovelint: disable=*\n"
+    )
+    report = lint_source(src, "grove_tpu/sim/fixture.py")
+    assert "GL000" in rules_of(report)
+    assert not report.ok
+
+
+def test_pragma_does_not_cover_other_rules():
+    src = (
+        "import time\n\n"
+        "def tick(self):\n"
+        "    t = time.time()  # grovelint: disable=GL007 -- wrong rule\n"
+    )
+    report = lint_source(src, "grove_tpu/sim/fixture.py")
+    assert "GL001" in rules_of(report)
+
+
+def test_json_report_shape():
+    report = lint_source(
+        "import time\nt = time.time()\n", "grove_tpu/sim/fixture.py"
+    )
+    doc = report.as_json()
+    assert set(doc) >= {
+        "ok",
+        "violations",
+        "suppressed",
+        "counts",
+        "suppression_count",
+        "files_scanned",
+        "rules",
+    }
+    assert doc["ok"] is False
+    assert doc["counts"] == {"GL001": 1}
+    v = doc["violations"][0]
+    assert set(v) >= {"rule", "path", "line", "col", "message"}
+    json.dumps(doc)  # must be serializable as-is
+
+
+def test_repo_lints_clean():
+    """The tree itself: zero violations, every suppression justified."""
+    report = run_repo_lint(ROOT)
+    assert report.ok, "\n" + report.render_human()
+    for s in report.suppressed:
+        assert s.justification, f"bare suppression at {s.path}:{s.line}"
+
+
+def test_lock_order_summary_extracted():
+    report = run_repo_lint(ROOT, [r for r in default_rules() if r.id == "GL009"])
+    assert "GL009" in report.rule_summaries
+    # the apiserver's profile/subscriber nesting is a known edge
+    assert any(
+        "lock" in e for e in report.rule_summaries["GL009"]["edges"]
+    )
+
+
+@pytest.mark.slow
+def test_cli_exit_codes():
+    """scripts/lint.py exit-code contract (0 clean on the real tree)."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/lint.py", "--no-check", "--json"],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True

@@ -1,0 +1,212 @@
+"""Runtime sanitizer (grove_tpu/analysis/sanitize.py) unit tests: each
+dynamic check must detect its failure class, install/uninstall must be
+clean, and a sanitized harness converge must stay green."""
+
+import threading
+
+import pytest
+
+from grove_tpu.analysis import sanitize
+
+
+class TestLockOrderTracker:
+    def test_consistent_order_is_clean(self):
+        t = sanitize.LockOrderTracker()
+        a = sanitize.TrackingLock(threading.Lock(), "A", t)
+        b = sanitize.TrackingLock(threading.Lock(), "B", t)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert t.violations == []
+        assert t.observed_order() == ["A -> B"]
+
+    def test_inversion_detected(self):
+        t = sanitize.LockOrderTracker()
+        a = sanitize.TrackingLock(threading.Lock(), "A", t)
+        b = sanitize.TrackingLock(threading.Lock(), "B", t)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(t.violations) == 1
+        assert "inversion" in t.violations[0]
+
+    def test_transitive_inversion_detected(self):
+        t = sanitize.LockOrderTracker()
+        locks = {
+            n: sanitize.TrackingLock(threading.Lock(), n, t)
+            for n in "ABC"
+        }
+        with locks["A"]:
+            with locks["B"]:
+                pass
+        with locks["B"]:
+            with locks["C"]:
+                pass
+        with locks["C"]:
+            with locks["A"]:  # closes the A->B->C cycle
+                pass
+        assert t.violations, "A->B->C->A cycle must be detected"
+
+    def test_reentrant_same_lock_ignored(self):
+        t = sanitize.LockOrderTracker()
+        inner = threading.RLock()
+        a = sanitize.TrackingLock(inner, "A", t)
+        with a:
+            with a:
+                pass
+        assert t.violations == []
+
+    def test_cross_thread_order_is_global(self):
+        """The partial order is process-global: thread 1 establishing
+        A->B makes thread 2's B->A an inversion."""
+        t = sanitize.LockOrderTracker()
+        a = sanitize.TrackingLock(threading.Lock(), "A", t)
+        b = sanitize.TrackingLock(threading.Lock(), "B", t)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        with b:
+            with a:
+                pass
+        assert len(t.violations) == 1
+
+
+class TestInstallUninstall:
+    def test_span_leak_detection(self, monkeypatch):
+        monkeypatch.delenv("GROVE_TPU_SANITIZE", raising=False)
+        san = sanitize.install()
+        try:
+            from grove_tpu.observability.tracing import TRACER
+
+            leaky = TRACER.span("leaky-span")
+            with TRACER.span("closed-span"):
+                pass
+            assert san.spans.leaked() == ["leaky-span"]
+            assert any("leaked span" in p for p in san.problems())
+            leaky.end()
+            assert san.spans.leaked() == []
+            assert san.problems() == []
+        finally:
+            sanitize.uninstall()
+        assert not sanitize.active()
+
+    def test_install_wraps_singleton_locks(self, monkeypatch):
+        monkeypatch.delenv("GROVE_TPU_SANITIZE", raising=False)
+        from grove_tpu.observability.events import EVENTS
+        from grove_tpu.observability.metrics import METRICS
+
+        sanitize.install()
+        try:
+            assert isinstance(EVENTS._lock, sanitize.TrackingLock)
+            assert isinstance(METRICS._lock, sanitize.TrackingLock)
+            # the wrapped singletons still work end to end
+            EVENTS.record(("Pod", "default", "p"), "Normal", "PodBound", "x")
+            METRICS.inc("sanitize_test_counter")
+        finally:
+            sanitize.uninstall()
+        assert not isinstance(EVENTS._lock, sanitize.TrackingLock)
+        assert not isinstance(METRICS._lock, sanitize.TrackingLock)
+
+    def test_install_is_idempotent(self, monkeypatch):
+        monkeypatch.delenv("GROVE_TPU_SANITIZE", raising=False)
+        first = sanitize.install()
+        try:
+            assert sanitize.install() is first
+        finally:
+            sanitize.uninstall()
+
+    def test_uninstall_restores_externally_set_env(self, monkeypatch):
+        """A user-set GROVE_TPU_SANITIZE=1 must survive an
+        install()/uninstall() cycle (e.g. one sanitized matrix seed must
+        not strip the guard from the seeds after it)."""
+        monkeypatch.setenv("GROVE_TPU_SANITIZE", "1")
+        sanitize.install()
+        sanitize.uninstall()
+        import os
+
+        assert os.environ.get("GROVE_TPU_SANITIZE") == "1"
+        assert sanitize.store_guard_enabled()
+
+    def test_enabled_env_gates_store_guard(self, monkeypatch):
+        monkeypatch.delenv("GROVE_TPU_STORE_GUARD", raising=False)
+        monkeypatch.delenv("GROVE_TPU_SANITIZE", raising=False)
+        assert not sanitize.store_guard_enabled()
+        monkeypatch.setenv("GROVE_TPU_SANITIZE", "1")
+        assert sanitize.store_guard_enabled()
+        monkeypatch.delenv("GROVE_TPU_SANITIZE", raising=False)
+        monkeypatch.setenv("GROVE_TPU_STORE_GUARD", "1")
+        assert sanitize.store_guard_enabled()
+
+
+class TestHarnessChecks:
+    @pytest.fixture()
+    def harness(self):
+        from grove_tpu.sim.harness import SimHarness
+
+        h = SimHarness(num_nodes=4)
+        h.apply_yaml(
+            """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: tiny
+spec:
+  template:
+    cliques:
+      - name: w
+        spec:
+          roleName: w
+          replicas: 1
+          podSpec:
+            containers:
+              - name: c
+                resources:
+                  requests:
+                    cpu: 1
+"""
+        )
+        h.converge(max_ticks=40)
+        return h
+
+    def test_accountant_drift_clean_after_converge(self, harness):
+        assert (
+            sanitize.accountant_drift(
+                harness.scheduler.quota.accountant, harness.store
+            )
+            == []
+        )
+
+    def test_accountant_drift_detects_skew(self, harness):
+        acct = harness.scheduler.quota.accountant
+        acct.ensure_built(harness.store)
+        snap = acct.snapshot()
+        assert snap, "converged harness must account some usage"
+        queue = next(iter(snap))
+        resource = next(iter(snap[queue]))
+        # skew the incremental ledger: the recount must catch it
+        acct._usage[queue][resource] += 1.5
+        problems = sanitize.accountant_drift(acct, harness.store)
+        assert problems and "!= recount" in problems[0]
+        acct._usage[queue][resource] -= 1.5
+
+    def test_stranded_hold_detected(self, harness):
+        monitor = harness.node_monitor
+        assert sanitize.stranded_holds(monitor) == []
+        # a hold with no scheduled release is the failover bug class
+        monitor._held.add(("default", "phantom-gang"))
+        problems = sanitize.stranded_holds(monitor)
+        assert problems and "stranded" in problems[0]
+        monitor._held.discard(("default", "phantom-gang"))
+
+    def test_harness_problems_green_on_healthy_tree(self, harness):
+        assert sanitize.harness_problems(harness) == []
