@@ -8,8 +8,8 @@ IMAGE ?= grove-tpu:0.2.0
 
 .PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
-        chaos-smoke chaos-matrix drain-smoke dryrun docker-build \
-        compose-up clean
+        chaos-smoke chaos-matrix drain-smoke recovery-smoke dryrun \
+        docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -57,8 +57,11 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds): catches schedule-dependent regressions the single-seed smoke misses
-	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail): catches schedule-dependent regressions the single-seed smoke misses
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7
+
+recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tail (prints replayed records + recovery wall time), acked-prefix audit, inert WAL A/B
+	$(CPU_ENV) $(PY) scripts/recovery_smoke.py
 
 drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node drain with trial-solve pre-placement, breaker open/close under an eviction storm, inert-broker A/B
 	$(CPU_ENV) $(PY) scripts/drain_smoke.py

@@ -22,6 +22,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 # XLA:CPU logs a spurious machine-feature ERROR on every persistent-cache
 # AOT load: the compiler records synthetic tuning features
@@ -81,22 +82,30 @@ class ProbeLog:
         self.healthy = threading.Event()
 
     def probe(self, timeout_s: float, where: str) -> bool:
-        from grove_tpu.utils.platform import probe_device_health
+        from grove_tpu.utils.platform import (
+            last_probe_detail,
+            probe_device_health,
+        )
 
         t0 = time.time()
         ok = probe_device_health(
             timeout_s, env=_ORIG_ENV, require_accelerator=_WANT_ACCELERATOR
         )
+        attempt = {
+            "at_s": round(t0 - _T_START, 1),
+            "took_s": round(time.time() - t0, 1),
+            "timeout_s": timeout_s,
+            "where": where,
+            "ok": ok,
+        }
+        detail = last_probe_detail()
+        if not ok and detail is not None:
+            # failure diagnostics ride along: reason + the child's
+            # traceback tail, so a CPU-fallback artifact says WHY
+            attempt["reason"] = detail.get("reason", "")
+            attempt["output_tail"] = detail.get("output_tail", "")
         with self._lock:
-            self.attempts.append(
-                {
-                    "at_s": round(t0 - _T_START, 1),
-                    "took_s": round(time.time() - t0, 1),
-                    "timeout_s": timeout_s,
-                    "where": where,
-                    "ok": ok,
-                }
-            )
+            self.attempts.append(attempt)
         if ok:
             self.healthy.set()
         return ok
@@ -113,6 +122,21 @@ class ProbeLog:
             },
         }
 
+    def failure_detail(self) -> Optional[dict]:
+        """The NEWEST attempt's diagnostics when it failed (None when it
+        passed or none ran): a probe that succeeded later supersedes any
+        earlier failure — the bench ran on the recovered backend, and a
+        stale failure block would misread as a degraded run."""
+        with self._lock:
+            if self.attempts and not self.attempts[-1]["ok"]:
+                attempt = self.attempts[-1]
+                return {
+                    "reason": attempt.get("reason", ""),
+                    "output_tail": attempt.get("output_tail", ""),
+                    "where": attempt["where"],
+                }
+        return None
+
     def background_prober(self, stop: threading.Event, interval_s: float = 20.0):
         """Keep probing while the CPU-fallback bench runs on the main thread —
         a chip that wakes mid-bench is caught and exploited at the end."""
@@ -128,6 +152,24 @@ class ProbeLog:
 
 
 PROBE_LOG = ProbeLog()
+
+
+def _backend_block(note: str) -> dict:
+    """The artifact's "backend" block: which backend actually ran, why,
+    and — on a CPU fallback — the probe's failure reason + child
+    traceback tail (previously swallowed; every BENCH round so far ran on
+    the fallback without saying why)."""
+    import jax
+
+    block = {
+        "selected": jax.default_backend(),
+        "note": note,
+        "accelerator_expected": _WANT_ACCELERATOR,
+    }
+    failure = PROBE_LOG.failure_detail()
+    if failure is not None:
+        block["probe_failure"] = failure
+    return block
 
 
 def _enable_tracing_unless_opted_out() -> bool:
@@ -335,6 +377,17 @@ def _drain_artifact_block() -> dict:
     return drain_artifact()
 
 
+def _durability_artifact_block() -> dict:
+    """Durability block (docs/robustness.md): WAL overhead (measured
+    group-commit cost as a share of the enabled run's wall, plus the
+    cross-run A/B delta), recovery wall time + replay rate with a torn
+    tail, and the inert-A/B verdict (durability off ⇒ byte-identical
+    store path)."""
+    from grove_tpu.sim.recovery import durability_artifact
+
+    return durability_artifact()
+
+
 def _lint_artifact_block() -> dict:
     """grovelint block for the integrated artifact: rule counts and the
     suppression inventory (docs/static-analysis.md). Pure-AST pass over
@@ -435,6 +488,17 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # with trial-solve pre-placement, breaker storm open/close,
             # and the inert-broker A/B
             "drain": _drain_artifact_block(),
+            # durability block (docs/robustness.md): WAL overhead %,
+            # crash-recovery wall time + replay rate, torn-tail handling,
+            # and the inert durability-off A/B
+            "durability": _durability_artifact_block(),
+            # backend block: the integrated bench is hardware-independent
+            # by design (pinned to host CPU before any jax work)
+            "backend": {
+                "selected": "cpu",
+                "note": "cpu-pinned (integrated bench is"
+                " hardware-independent)",
+            },
             # static-analysis block (docs/static-analysis.md): grovelint
             # rule counts + suppression inventory over the exact tree
             # this artifact was produced from
@@ -534,10 +598,17 @@ def main() -> None:
         if not PROBE_LOG.probe(90.0, "start"):
             force_cpu_platform()
             backend_note = "cpu-fallback (accelerator probe failed)"
+            failure = PROBE_LOG.failure_detail() or {}
             print(
-                "WARNING: accelerator health probe failed; benchmarking on CPU",
+                "WARNING: accelerator health probe failed; benchmarking on"
+                f" CPU. Reason: {failure.get('reason', 'unknown')}",
                 file=sys.stderr,
             )
+            if failure.get("output_tail"):
+                print(
+                    "probe child output tail:\n" + failure["output_tail"],
+                    file=sys.stderr,
+                )
             prober_stop = threading.Event()
             PROBE_LOG.background_prober(prober_stop)
 
@@ -631,7 +702,7 @@ def main() -> None:
                 "min_s": round(times[0], 4),
                 "max_s": round(times[-1], 4),
                 "runs_n": len(times),
-                "backend": f"{jax.default_backend()} ({backend_note})",
+                "backend": _backend_block(backend_note),
                 "probe": PROBE_LOG.as_json(),
                 "trace": _trace_artifact(),
             }

@@ -10,7 +10,10 @@ from grove_tpu.analysis.rules.scheduling import (
     BrokerGrantRule,
     SchedulableMaskRule,
 )
-from grove_tpu.analysis.rules.storepath import StoreWritePathRule
+from grove_tpu.analysis.rules.storepath import (
+    StoreLoggedCommitRule,
+    StoreWritePathRule,
+)
 
 ALL_RULES = (
     ClockDisciplineRule,  # GL001
@@ -23,4 +26,5 @@ ALL_RULES = (
     BlockingTickRule,  # GL008
     LockOrderRule,  # GL009
     WireRoundTripRule,  # GL010
+    StoreLoggedCommitRule,  # GL011
 )

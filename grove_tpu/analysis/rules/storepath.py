@@ -1,4 +1,4 @@
-"""GL004 store write-path discipline.
+"""GL004 store write-path discipline + GL011 logged-commit mutations.
 
 PR 2's copy-on-write store removed pickling/deep-copying from the
 control-plane write path; the contract is: reads are zero-copy readonly
@@ -14,6 +14,17 @@ commit_finalizer_add). Two regressions this rule catches statically:
   resourceVersion bumps, watch events, aggregates, and the byte-compare
   guard — the silent-corruption class `verify_readonly_integrity` exists
   to catch at runtime.
+
+GL011 (durability layer, docs/robustness.md) tightens the same contract
+repo-wide for MUTATIONS: every store mutation must flow through the
+logged commit APIs (create/update/update_status/delete/commit_cow/
+restore_objects and the commit_* helpers). The write-ahead log observes
+commits through the watch fanout — a direct mutation of store internals
+(`store._committed[...] = obj`, `store._rv += 1`,
+`store._blob.pop(...)`) would be invisible to the WAL, so a crash-restart
+recovery would silently diverge from the live state it replaced. Only
+`runtime/store.py` itself and the durability module (which replays
+through `restore_objects`) are exempt.
 """
 
 from __future__ import annotations
@@ -42,6 +53,36 @@ _SERIALIZERS = {
     "loads": "pickle.loads",
 }
 
+# methods that mutate a container in place — called on store-private
+# state they bypass the logged commit path (GL011)
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "pop",
+    "popitem",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "discard",
+}
+
+
+def _store_private_attr(node: ast.AST):
+    """(base dotted path, private attr) when `node`'s attribute chain
+    passes through `<...store>.<_private>`, else None."""
+    probe = node
+    while isinstance(probe, (ast.Attribute, ast.Subscript)):
+        if isinstance(probe, ast.Attribute) and probe.attr in _STORE_PRIVATE:
+            base = dotted(probe.value)
+            leaf = base.split(".")[-1] if base else ""
+            if "store" in leaf.lower():
+                return base, probe.attr
+        probe = probe.value
+    return None
+
 
 class StoreWritePathRule(Rule):
     id = "GL004"
@@ -59,6 +100,9 @@ class StoreWritePathRule(Rule):
         "grove_tpu/disruption/",
         "grove_tpu/quota/",
         "grove_tpu/autoscale/",
+        # the WAL serializes every commit: pickle creeping in here would
+        # tie the on-disk log to one code version
+        "grove_tpu/durability/",
     )
     exclude = ("grove_tpu/runtime/store.py",)
 
@@ -129,3 +173,74 @@ class StoreWritePathRule(Rule):
                             " (commit_cow, create, update, delete)"
                         ),
                     )
+
+
+class StoreLoggedCommitRule(Rule):
+    id = "GL011"
+    name = "store-logged-commits"
+    description = (
+        "store mutations must flow through the logged commit APIs"
+        " (create/update/commit_cow/delete/restore_objects) — direct"
+        " mutation of store internals outside runtime/store.py and the"
+        " durability module is invisible to the write-ahead log, so"
+        " crash-restart recovery would silently diverge"
+    )
+    # repo-wide: GL004 only covers the control-plane packages, but an
+    # un-logged mutation ANYWHERE corrupts recovery
+    paths = ("grove_tpu/",)
+    exclude = (
+        "grove_tpu/runtime/store.py",
+        "grove_tpu/durability/",
+    )
+
+    def _violation(self, ctx: FileContext, node, base, attr, what) -> Violation:
+        return Violation(
+            rule=self.id,
+            path=ctx.rel,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"{what} of store state `{base}.{attr}` bypasses the"
+                " logged commit APIs — the WAL never sees it, so a"
+                " crash-restart recovery diverges from the state it"
+                " replaces (use create/update/commit_cow/delete, or"
+                " restore_objects on the recovery path)"
+            ),
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Violation]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    hit = _store_private_attr(target)
+                    if hit is not None:
+                        yield self._violation(
+                            ctx, node, hit[0], hit[1], "direct assignment"
+                        )
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    hit = _store_private_attr(target)
+                    if hit is not None:
+                        yield self._violation(
+                            ctx, node, hit[0], hit[1], "`del`"
+                        )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATORS
+                ):
+                    hit = _store_private_attr(fn.value)
+                    if hit is not None:
+                        yield self._violation(
+                            ctx,
+                            node,
+                            hit[0],
+                            hit[1],
+                            f"in-place `.{fn.attr}()` mutation",
+                        )

@@ -875,6 +875,13 @@ def _cmd_run(args) -> int:
             " apiserver (--apiserver URL) for the election to exclude them",
             file=sys.stderr,
         )
+    if args.durability_dir and args.apiserver:
+        print(
+            "warning: --durability-dir applies to the EMBEDDED apiserver's"
+            " store; an external apiserver owns its own durability —"
+            " ignoring it",
+            file=sys.stderr,
+        )
     rt = start_operator(
         nodes=nodes,
         topology=topology,
@@ -885,9 +892,12 @@ def _cmd_run(args) -> int:
         apiserver_url=args.apiserver,
         leader_lock_path=args.leader_lock,
         leader_election=True if args.leader_election else None,
+        durability_dir=args.durability_dir,
     )
     if rt.apiserver is not None:
         print(f"apiserver:  {rt.apiserver.address}")
+    if args.durability_dir:
+        print(f"durability: {args.durability_dir} (WAL + snapshots)")
     if rt.webhooks is not None:
         print(f"webhooks:   {rt.webhooks.address}")
     print("operator running; Ctrl-C to stop", flush=True)
@@ -1141,6 +1151,13 @@ def main(argv: List[str] | None = None) -> int:
         "--threaded",
         action="store_true",
         help="run concurrent reconciles in real threads (concurrentSyncs)",
+    )
+    p.add_argument(
+        "--durability-dir",
+        help="durable control plane (docs/robustness.md): recover the"
+        " embedded apiserver's store from this directory's snapshot+WAL"
+        " at boot and log every commit to it (WAL + periodic snapshots,"
+        " background group-commit thread)",
     )
     p.add_argument(
         "--auto-detect-topology",

@@ -115,6 +115,11 @@ class OperatorRuntime:
     node_monitor: Optional[object] = None
     disruption: Optional[object] = None
     drainer: Optional[object] = None
+    # durability attachment of the EMBEDDED apiserver's store (WAL +
+    # snapshots + background committer; grove_tpu/durability): set when
+    # start_operator ran with durability_dir — shutdown() must stop the
+    # committer and drain the final group commit
+    durability: Optional[object] = None
 
     def _drain(self) -> int:
         if self.threaded:
@@ -208,6 +213,8 @@ class OperatorRuntime:
     def shutdown(self) -> None:
         self.engine.close()
         self.store.stop()
+        if self.durability is not None:
+            self.durability.close()  # stop the committer, final flush
         if self.webhooks is not None:
             self.webhooks.stop()
         if self.apiserver is not None:
@@ -232,15 +239,31 @@ def start_operator(
     leader_election: Optional[bool] = None,
     leader_identity: Optional[str] = None,
     metrics_provider=None,
+    durability_dir: Optional[str] = None,
 ) -> OperatorRuntime:
     """Boot the full real-cluster operator (embedded apiserver unless
     `apiserver_url` points at an external one), mirroring main.go startup:
-    config → topology check → certs → webhooks → controllers → run."""
+    config → topology check → certs → webhooks → controllers → run.
+
+    `durability_dir` (embedded apiserver only): recover the store from
+    the directory's snapshot + WAL tail before serving — a crash-restart
+    then converges like a failover, via the same resync machinery the
+    lease-takeover path runs (requeue_all / rebuild_bindings / monitor
+    resync) — and attach the WAL with a background group-commit thread."""
     from grove_tpu.config.operator import OperatorConfiguration
     from grove_tpu.sim.cluster import make_nodes
 
     config = config or OperatorConfiguration()
     topology = topology or ClusterTopology()
+
+    durability = None
+    backing_store = None
+    recovered_objects = 0
+    if durability_dir is not None and apiserver_url is None:
+        from grove_tpu.durability import recover_store
+
+        backing_store, recovery = recover_store(durability_dir)
+        recovered_objects = recovery.restored_objects
 
     webhooks = None
     registrations = []
@@ -268,9 +291,23 @@ def start_operator(
     apiserver = None
     if apiserver_url is None:
         apiserver = APIServer(
+            store=backing_store,
             webhooks=registrations,
             enable_profiling=config.server.profiling_enabled,
-        ).start()
+        )
+        if durability_dir is not None:
+            from grove_tpu.durability import StoreDurability
+
+            # attach AFTER recovery, BEFORE the apiserver starts serving:
+            # a commit from an early HTTP client must be logged too, or
+            # its ack would not survive the next crash-restart; the
+            # apiserver's request lock serializes snapshot scans against
+            # concurrent handlers
+            durability = StoreDurability(
+                apiserver.store, durability_dir, lock=apiserver.lock
+            )
+            durability.start_committer()
+        apiserver.start()
         apiserver_url = apiserver.address
 
     leader_lock = None
@@ -308,6 +345,12 @@ def start_operator(
     engine = Engine(store, store.clock)
     ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
     register_controllers(engine, ctx, config)
+    if recovered_objects:
+        # recovered state predates every watch: enqueue it all once — the
+        # informer ListAndWatch-restart a fresh process performs (the same
+        # resync a lease takeover runs; rebuild_bindings/monitor resync
+        # below complete the machinery)
+        engine.requeue_all()
     # with_scheduler=False leaves binding entirely to an EXTERNAL scheduler
     # consuming the PodGang contract over the wire (the reference's KAI
     # deployment shape — grove_tpu.cluster.extscheduler is the stand-in)
@@ -397,4 +440,5 @@ def start_operator(
         node_monitor=node_monitor,
         disruption=disruption,
         drainer=drainer,
+        durability=durability,
     )

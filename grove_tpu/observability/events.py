@@ -55,6 +55,13 @@ REASON_GANG_DRAINED = "GangDrained"
 REASON_DISRUPTION_THROTTLED = "DisruptionThrottled"
 REASON_BREAKER_OPEN = "BreakerOpen"
 REASON_BREAKER_CLOSED = "BreakerClosed"
+# durability layer (docs/robustness.md, grove_tpu/durability): periodic
+# store snapshot + WAL truncation, crash-restart recovery finishing its
+# snapshot-load + tail replay, and a torn WAL tail truncated at the first
+# bad CRC during that replay
+REASON_SNAPSHOT_TAKEN = "SnapshotTaken"
+REASON_RECOVERY_COMPLETED = "RecoveryCompleted"
+REASON_WAL_TORN_TAIL = "WalTornTail"
 # operator-component lifecycle reasons (controller/podcliqueset components,
 # rolling update, gang termination) — emitted as literals at the call
 # sites; registered here so grovelint GL006 and the docs-drift test keep

@@ -363,6 +363,49 @@ class Store:
             return self._cache
         return self._committed
 
+    # -- durability (grove_tpu/durability, docs/robustness.md) -----------
+
+    @property
+    def resource_version(self) -> int:
+        """Highest resourceVersion committed so far (the WAL/snapshot
+        watermark; reads only — writes bump it through commits)."""
+        return self._rv
+
+    def kinds(self) -> List[str]:
+        """Kinds with at least one committed object (snapshot scans pair
+        this with `scan(kind)` to enumerate the whole population)."""
+        return sorted(k for k, v in self._committed.items() if v)
+
+    def restore_objects(self, objects, rv: int) -> int:
+        """Recovery-path bulk load: commit `objects` VERBATIM — identity
+        (uid/resourceVersion/generation/timestamps) preserved, no watch
+        events (recovery precedes every subscriber; the boot resync
+        machinery — engine.requeue_all, rebuild_bindings, monitor resync —
+        covers delivery), aggregates/caches rebuilt, and the version
+        counter resumed at `rv` so resourceVersion monotonicity survives
+        the restart. Only valid on a store with no prior commits."""
+        if self._rv:
+            raise GroveError(
+                ERR_CONFLICT,
+                "restore_objects requires a fresh store (writes already"
+                f" committed up to rv {self._rv})",
+                "restore",
+            )
+        n = 0
+        for obj in objects:
+            self._commit(obj)
+            n += 1
+        self._rv = max(self._rv, int(rv))
+        self._agg_committed.rebuild(
+            self._committed.get("Pod", {}).values()
+        )
+        if self.cache_lag:
+            # warm informer caches (the initial LIST a restarted process
+            # serves its informers); per-kind sync also rebuilds the
+            # cached pod aggregate
+            self.sync_cache()
+        return n
+
     # -- CRUD -----------------------------------------------------------
 
     def _commit(

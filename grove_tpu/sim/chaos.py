@@ -187,6 +187,15 @@ class ChaosReport:
     converged: bool = False
     signature_matches_fault_free: bool = False
     pin_verified_rescues: int = 0
+    # controlplane_crash mode (docs/robustness.md durability section):
+    # crash-restart recoveries performed, WAL records replayed, whether a
+    # torn tail was truncated, and the unacked records the crash lost
+    recoveries: int = 0
+    require_recoveries: int = 0
+    replayed_records: int = 0
+    torn_tails: int = 0
+    lost_unacked_records: int = 0
+    recovery_wall_seconds: float = 0.0
 
     @property
     def ok(self) -> bool:
@@ -201,6 +210,7 @@ class ChaosReport:
             and self.drain_evictions >= 1
             and self.drains_completed >= 1
             and self.failovers >= 1
+            and self.recoveries >= self.require_recoveries
         )
 
     def as_dict(self) -> dict:
@@ -216,6 +226,11 @@ class ChaosReport:
             "drain_evictions": self.drain_evictions,
             "drains_completed": self.drains_completed,
             "failovers": self.failovers,
+            "recoveries": self.recoveries,
+            "replayed_records": self.replayed_records,
+            "torn_tails": self.torn_tails,
+            "lost_unacked_records": self.lost_unacked_records,
+            "recovery_wall_seconds": round(self.recovery_wall_seconds, 4),
             "scheduler_errors": self.scheduler_errors,
             "invariant_violations": self.invariant_violations,
             "converged": self.converged,
@@ -277,6 +292,8 @@ class ChaosRunner:
         tick_seconds: float = 1.0,
         not_ready_after: float = 5.0,
         lost_after: float = 15.0,
+        controlplane_crash: bool = False,
+        durability_dir: Optional[str] = None,
     ) -> None:
         self.seed = seed
         self.num_nodes = num_nodes
@@ -284,8 +301,20 @@ class ChaosRunner:
         self.tick_seconds = tick_seconds
         self.not_ready_after = not_ready_after
         self.lost_after = lost_after
-        self.harness = self._build_harness()
-        self.report = ChaosReport(seed=seed)
+        # controlplane_crash: run the store durably (WAL + snapshots) and
+        # kill store+engine mid-convergence — recovery must rebuild the
+        # control plane from disk (docs/robustness.md durability section)
+        self.controlplane_crash = controlplane_crash
+        self._own_durability_dir = controlplane_crash and durability_dir is None
+        if self._own_durability_dir:
+            import tempfile
+
+            durability_dir = tempfile.mkdtemp(prefix="grove-chaos-wal-")
+        self.durability_dir = durability_dir
+        self.harness = self._build_harness(durable=controlplane_crash)
+        self.report = ChaosReport(
+            seed=seed, require_recoveries=1 if controlplane_crash else 0
+        )
         self._breach_since: Dict[Tuple[str, str], float] = {}
         self._outage_ops = ("create", "update")
         # rescue archives of deposed leaders (the monitor is leader memory;
@@ -293,8 +322,17 @@ class ChaosRunner:
         # the report's pin verification)
         self._archived_rescues: List[dict] = []
 
-    def _build_harness(self) -> SimHarness:
-        h = SimHarness(num_nodes=self.num_nodes)
+    def _build_harness(self, durable: bool = False) -> SimHarness:
+        h = SimHarness(
+            num_nodes=self.num_nodes,
+            durability_dir=self.durability_dir if durable else None,
+        )
+        if h.durability is not None:
+            # chaos-sized knobs: force segment rotation AND a mid-run
+            # snapshot+truncation, so recovery replays a snapshot base
+            # plus a multi-segment tail — under fire, not just in units
+            h.durability.wal.segment_max_bytes = 64 * 1024
+            h.durability.snapshot_every_bytes = 256 * 1024
         h.node_monitor.not_ready_after = self.not_ready_after
         h.node_monitor.lost_after = self.lost_after
         for pcs in chaos_workload(self.n_each):
@@ -391,6 +429,18 @@ class ChaosRunner:
                     "capacity returns",
                 )
             )
+        if self.controlplane_crash:
+            # kill store+engine after capacity returned, while re-admission
+            # is still converging: recovery must rebuild the whole control
+            # plane from the WAL/snapshot (torn tail injected at the crash)
+            # and the rehydrated holds/backoff must finish the job
+            faults.append(
+                Fault(
+                    dead_dwell + rng.uniform(5.2, 5.8),
+                    "controlplane_crash",
+                    note="store+engine crash, recover from disk",
+                )
+            )
         # the drained node rejoins the pool once everything else is back
         faults.append(
             Fault(
@@ -427,7 +477,59 @@ class ChaosRunner:
             h.drainer.uncordon(fault.target)
         elif fault.kind == "leader_crash":
             self._leader_failover()
+        elif fault.kind == "controlplane_crash":
+            self._controlplane_crash()
         self.report.faults.append(fault.as_dict())
+
+    # -- control-plane crash (tentpole: durability + recovery) -------------
+
+    def _controlplane_crash(self) -> None:
+        """Kill the store process itself — the one fault PR 5's failover
+        cannot model (there the store survives; here NOTHING in memory
+        does). The WAL's unflushed buffer dies with the process and the
+        interrupted write leaves a torn frame on disk. Recovery: rebuild
+        the store from snapshot + WAL tail (truncate at the first bad
+        CRC), audit the acked prefix (no acked commit lost, no phantom
+        state), then cold-boot a full control plane over it with the PR-5
+        resync machinery (requeue_all / rebuild_bindings / monitor
+        resync / fresh broker+drainer). Node kubelets are separate
+        processes — the Node objects carry over with their live state."""
+        from grove_tpu.durability import recover_store, verify_acked_prefix
+
+        h = self.harness
+        report = self.report
+        self._archived_rescues.extend(h.node_monitor.rescues)
+        h.engine.close()
+        report.lost_unacked_records += h.durability.simulate_crash(
+            torn_tail_bytes=41
+        )
+        store, recovery = recover_store(
+            self.durability_dir, clock=h.clock, cache_lag=True
+        )
+        report.replayed_records += recovery.replayed_records
+        report.recovery_wall_seconds += recovery.wall_seconds
+        if recovery.torn_tail:
+            report.torn_tails += 1
+        # recovery invariant 6: the recovered store IS the durable prefix —
+        # audited independently against the on-disk log, before any new
+        # commit can blur the comparison
+        for problem in verify_acked_prefix(self.durability_dir, store):
+            report.invariant_violations.append(f"recovery: {problem}")
+        restarted = SimHarness.cold_restart(
+            store,
+            h.cluster.nodes,
+            config=h.config,
+            durability_dir=self.durability_dir,
+        )
+        restarted.durability.wal.segment_max_bytes = 64 * 1024
+        restarted.durability.snapshot_every_bytes = 256 * 1024
+        restarted.node_monitor.not_ready_after = self.not_ready_after
+        restarted.node_monitor.lost_after = self.lost_after
+        # the rebuilt monitor re-primes holds from persisted conditions
+        # with the chaos-speed grace windows in place
+        restarted.node_monitor.resync()
+        self.harness = restarted
+        report.recoveries += 1
 
     # -- leader failover (satellite: leader_crash fault kind) -------------
 
@@ -592,6 +694,23 @@ class ChaosRunner:
         # same check the sanitizer reruns at teardown)
         for problem in stranded_holds(h.node_monitor):
             violations.append(f"t={rel_now:.0f}s: {problem}")
+        # 7. no phantom binding after a recovery: every binding the
+        # scheduler charges capacity for must be backed by a committed
+        # pod actually scheduled to that node (a recovery that resurrected
+        # leader memory without store backing would overcommit silently)
+        if self.report.recoveries:
+            from grove_tpu.api.pod import is_scheduled
+
+            for (ns, pod_name), node in sorted(h.cluster.bindings.items()):
+                pod = h.store.get("Pod", ns, pod_name, readonly=True)
+                if pod is None or not is_scheduled(pod) or (
+                    pod.status.node_name != node
+                ):
+                    violations.append(
+                        f"t={rel_now:.0f}s: phantom binding after recovery:"
+                        f" pod {ns}/{pod_name} charged to {node} without a"
+                        " matching committed binding"
+                    )
 
     def _guarded(self, fn) -> int:
         """Run one control-plane component; a transient store error models
@@ -636,10 +755,14 @@ class ChaosRunner:
         i = 0
         idle_ticks = 0
         for _tick in range(max_ticks):
+            # refetch every tick: a controlplane_crash fault swaps the
+            # WHOLE harness (store included) for the recovered one
+            h = self.harness
             rel = h.clock.now() - t0
             while i < len(faults) and faults[i].at <= rel:
                 self._apply_fault(faults[i])
                 i += 1
+                h = self.harness
             work = self._guarded(h.engine.drain)
             work += self._guarded(h.autoscaler.tick)
             work += self._guarded(h.node_monitor.tick)
@@ -647,6 +770,9 @@ class ChaosRunner:
             bound = self._guarded(h.schedule)
             started = self._guarded(h.cluster.kubelet_tick)
             work += self._guarded(h.engine.drain)
+            if h.durability is not None:
+                # group commit at the tick boundary (the sim committer)
+                h.durability.pump()
             self._check_invariants(rel)
             report.ticks += 1
             if i >= len(faults) and not work and not bound and not started:
@@ -721,6 +847,12 @@ class ChaosRunner:
             report.invariant_violations.extend(
                 f"sanitizer: {p}" for p in sanitize.harness_problems(h)
             )
+        if h.durability is not None:
+            h.durability.close()
+        if self._own_durability_dir:
+            import shutil
+
+            shutil.rmtree(self.durability_dir, ignore_errors=True)
         return report
 
 
@@ -729,11 +861,15 @@ def run_chaos(
     num_nodes: int = 16,
     n_each: int = 2,
     max_ticks: int = 400,
+    controlplane_crash: bool = False,
 ) -> ChaosReport:
     """One seeded end-to-end chaos run (the `make chaos-smoke` core)."""
-    return ChaosRunner(seed=seed, num_nodes=num_nodes, n_each=n_each).run(
-        max_ticks=max_ticks
-    )
+    return ChaosRunner(
+        seed=seed,
+        num_nodes=num_nodes,
+        n_each=n_each,
+        controlplane_crash=controlplane_crash,
+    ).run(max_ticks=max_ticks)
 
 
 def chaos_artifact(seed: int = 1234) -> dict:

@@ -32,12 +32,28 @@ class SimHarness:
         cache_lag: bool = True,
         topology: Optional[ClusterTopology] = None,
         config=None,  # Optional[OperatorConfiguration]
+        store: Optional[Store] = None,
+        nodes=None,  # Optional[List[Node]] — carried over on cold restart
+        durability_dir: Optional[str] = None,
     ) -> None:
         from grove_tpu.config.operator import OperatorConfiguration
 
         self.config = config or OperatorConfiguration()
-        self.clock = VirtualClock()
-        self.store = Store(self.clock, cache_lag=cache_lag)
+        # `store`: a pre-built (typically disk-recovered) store — the cold
+        # restart path; its clock is the harness clock so recovered
+        # timestamps stay coherent
+        self.clock = store.clock if store is not None else VirtualClock()
+        self.store = store if store is not None else Store(
+            self.clock, cache_lag=cache_lag
+        )
+        # durability (grove_tpu/durability, docs/robustness.md): attach the
+        # WAL BEFORE any commit below so the log covers the store from rv 1
+        # (on a recovered store: before any post-recovery commit). converge
+        # pumps the group-commit buffer at tick boundaries — off the
+        # reconcile path, deterministic.
+        self.durability = None
+        if durability_dir is not None:
+            self.attach_durability(durability_dir)
         # ClusterTopology lives in the store like any CR; when the config
         # enables it, startup requires the named CR to exist (the reference
         # crashes at boot if enabled-but-missing — cmd/main.go:72-75)
@@ -54,8 +70,15 @@ class SimHarness:
         # cluster-scoped CR: no namespace, matching the wire/CRD scope and
         # the real-cluster manager's lookup (cluster/manager.py)
         self.topology.metadata.namespace = ""
-        # the stored CR is the source of truth — keep its identity (uid/rv)
-        self.topology = self.store.create(self.topology)
+        # the stored CR is the source of truth — keep its identity (uid/rv);
+        # a recovered store already carries it (cold restart)
+        existing = self.store.get(
+            "ClusterTopology", "", self.topology.metadata.name
+        )
+        if existing is not None:
+            self.topology = existing
+        else:
+            self.topology = self.store.create(self.topology)
         if self.config.authorizer.enabled:
             from grove_tpu.admission.authorization import AuthorizationGuard
 
@@ -78,7 +101,10 @@ class SimHarness:
             store=self.store, clock=self.clock, topology=self.topology
         )
         register_controllers(self.engine, self.ctx, self.config)
-        self.cluster = SimCluster(store=self.store, nodes=make_nodes(num_nodes))
+        self.cluster = SimCluster(
+            store=self.store,
+            nodes=nodes if nodes is not None else make_nodes(num_nodes),
+        )
         # TPU-solver-backed gang scheduler (the KAI-replacement); set to None
         # to fall back to the cluster's naive first-fit binder.
         from grove_tpu.solver.scheduler import GangScheduler
@@ -131,6 +157,54 @@ class SimHarness:
         if self.scheduler is not None:
             return self.scheduler.schedule_pending()
         return self.cluster.schedule_pending()
+
+    # -- durability (docs/robustness.md) ---------------------------------
+
+    def attach_durability(
+        self,
+        directory: str,
+        segment_max_bytes: int = 4 * 2**20,
+        snapshot_every_bytes: int = 32 * 2**20,
+    ):
+        """Attach a WAL + snapshot writer to this harness's store.
+        Defaults are production-shaped (snapshots amortized over tens of
+        MB of log — a snapshot scans the whole population, so a tight
+        cadence would dominate small-sim wall time); the chaos/recovery
+        scenarios dial the knobs down to exercise rotation + truncation."""
+        from grove_tpu.durability import StoreDurability
+
+        self.durability = StoreDurability(
+            self.store,
+            directory,
+            segment_max_bytes=segment_max_bytes,
+            snapshot_every_bytes=snapshot_every_bytes,
+        )
+        return self.durability
+
+    @classmethod
+    def cold_restart(
+        cls,
+        store: Store,
+        nodes,
+        config=None,
+        durability_dir: Optional[str] = None,
+    ) -> "SimHarness":
+        """Boot a fresh control plane over a recovered store — the
+        crash-restart path (docs/robustness.md): every piece of leader
+        memory is rebuilt from persisted state exactly like a failover,
+        so a cold restart converges the way a lease takeover does."""
+        h = cls(
+            num_nodes=len(nodes),
+            cache_lag=store.cache_lag,
+            config=config,
+            store=store,
+            nodes=nodes,
+            durability_dir=durability_dir,
+        )
+        h.engine.requeue_all()
+        h.cluster.rebuild_bindings()
+        h.node_monitor.resync()
+        return h
 
     # -- user actions ----------------------------------------------------
 
@@ -188,6 +262,10 @@ class SimHarness:
             bound = self.schedule()
             started = self.cluster.kubelet_tick()
             work += self.engine.drain()
+            if self.durability is not None:
+                # group commit at the tick boundary — the sim's committer
+                # cadence (real-cluster mode uses the background thread)
+                self.durability.pump()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a

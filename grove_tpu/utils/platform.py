@@ -11,6 +11,20 @@ import os
 from typing import Dict, Optional
 
 
+# Diagnostics of the most recent probe_device_health call: verdict, the
+# human-readable failure reason, and the child's output tail (the actual
+# traceback when the accelerator plugin blew up). The probe has silently
+# fallen back to CPU in every bench round so far — this record is what the
+# bench's "backend" artifact block and the startup log surface instead of
+# swallowing it.
+_last_probe: Optional[dict] = None
+
+
+def last_probe_detail() -> Optional[dict]:
+    """Diagnostics of the most recent probe (None before any probe)."""
+    return _last_probe
+
+
 def probe_device_health(
     timeout_s: float = 60.0,
     env: Optional[dict] = None,
@@ -26,12 +40,30 @@ def probe_device_health(
     the child inherits os.environ by default, and a scrubbed parent would
     make the probe vacuously test CPU (the bug behind round 3's phantom
     'chip wake windows'). `require_accelerator` additionally rejects a
-    successful probe whose default backend is cpu."""
+    successful probe whose default backend is cpu.
+
+    Every call records its verdict + failure reason + the child's output
+    tail (its traceback) in :func:`last_probe_detail`."""
     import pathlib
     import subprocess
     import sys
     import tempfile
     import time
+
+    global _last_probe
+
+    def _record(ok: bool, reason: str, output: str = "") -> bool:
+        global _last_probe
+        tail = output.strip()
+        if len(tail) > 2000:
+            tail = "...(truncated)...\n" + tail[-2000:]
+        _last_probe = {
+            "ok": ok,
+            "reason": reason,
+            "output_tail": tail,
+            "require_accelerator": require_accelerator,
+        }
+        return ok
 
     out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
     out_path = out.name
@@ -57,14 +89,39 @@ def probe_device_health(
             time.sleep(0.5)
         else:
             proc.kill()
-            return False  # abandoned child may still hold the temp file
+            # abandoned child may still hold the temp file; read what it
+            # managed to write — a wedged init usually logged WHERE first
+            partial = ""
+            try:
+                out.seek(0)
+                partial = out.read()
+            except OSError:
+                pass
+            return _record(
+                False,
+                f"probe child hung past {timeout_s:.0f}s (killed and"
+                " abandoned — accelerator wedged in device init?)",
+                partial,
+            )
         out.seek(0)
         text = out.read()
         if proc.returncode != 0 or "OK" not in text:
-            return False
+            return _record(
+                False,
+                f"probe child exited rc={proc.returncode} without OK"
+                " (backend crashed during import/jit — see output_tail"
+                " for the traceback)",
+                text,
+            )
         if require_accelerator and "OK cpu" in text:
-            return False
-        return True
+            return _record(
+                False,
+                "probe succeeded but on the CPU backend while an"
+                " accelerator was configured (plugin failed to register"
+                " its devices — see output_tail)",
+                text,
+            )
+        return _record(True, "", text)
     finally:
         out.close()
         if proc.poll() is not None:  # only unlink when the child is gone
@@ -120,6 +177,19 @@ def ensure_healthy_backend(
         else:
             force_cpu_platform()
             _backend_note = "cpu-fallback (accelerator probe failed)"
+            # surface WHY at startup instead of swallowing it: the probe
+            # fell back silently in every bench round before this
+            detail = last_probe_detail() or {}
+            print(
+                "WARNING: accelerator probe failed — falling back to CPU."
+                f" Reason: {detail.get('reason', 'unknown')}",
+                file=sys.stderr,
+            )
+            if detail.get("output_tail"):
+                print(
+                    "probe child output tail:\n" + detail["output_tail"],
+                    file=sys.stderr,
+                )
     return _backend_note
 
 

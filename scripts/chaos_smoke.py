@@ -67,6 +67,20 @@ def main() -> int:
         " (the sanitizer exercises every dynamic check in anger on each"
         " matrix run without taxing all seeds)",
     )
+    parser.add_argument(
+        "--cp-crash",
+        action="store_true",
+        help="run the store durably (WAL + snapshots) and add the"
+        " controlplane_crash fault: kill store+engine mid-convergence,"
+        " recover from disk with a torn tail, and hold the two recovery"
+        " invariants (no acked commit lost, no phantom bindings)",
+    )
+    parser.add_argument(
+        "--cp-crash-seed",
+        type=int,
+        help="with --seeds: the one seed of the matrix that runs the"
+        " controlplane_crash fault (the `make chaos-matrix` mode)",
+    )
     args = parser.parse_args()
 
     if args.seeds:
@@ -74,9 +88,11 @@ def main() -> int:
         for raw in args.seeds.split(","):
             seed = int(raw.strip())
             sanitized = args.sanitize or seed == args.sanitize_seed
+            cp_crash = args.cp_crash or seed == args.cp_crash_seed
             tag = " [sanitize]" if sanitized else ""
+            tag += " [cp-crash]" if cp_crash else ""
             print(f"=== chaos seed {seed}{tag} ===", flush=True)
-            rc = run_one(seed, args.json, sanitized)
+            rc = run_one(seed, args.json, sanitized, cp_crash)
             if rc:
                 return rc
         return rc
@@ -85,10 +101,13 @@ def main() -> int:
         args.seed,
         args.json,
         args.sanitize or args.seed == args.sanitize_seed,
+        args.cp_crash or args.seed == args.cp_crash_seed,
     )
 
 
-def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
+def run_one(
+    seed: int, as_json: bool, sanitized: bool = False, cp_crash: bool = False
+) -> int:
     from grove_tpu.sim.chaos import run_chaos
 
     if sanitized:
@@ -96,7 +115,7 @@ def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
 
         sanitize.install()
     try:
-        report = run_chaos(seed=seed)
+        report = run_chaos(seed=seed, controlplane_crash=cp_crash)
     finally:
         if sanitized:
             from grove_tpu.analysis import sanitize
@@ -104,6 +123,7 @@ def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
             sanitize.uninstall()
     doc = report.as_dict()
     doc["sanitized"] = sanitized
+    doc["cp_crash"] = cp_crash
 
     problems = []
     if report.node_losses < 2:
@@ -124,6 +144,18 @@ def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
         )
     if report.failovers < 1:
         problems.append("no leader failover happened (leader_crash missing)")
+    if cp_crash:
+        if report.recoveries < 1:
+            problems.append(
+                "no crash-restart recovery happened (controlplane_crash"
+                " missing)"
+            )
+        if report.replayed_records < 1:
+            problems.append("recovery replayed zero WAL records")
+        if report.torn_tails < 1:
+            problems.append(
+                "the injected torn WAL tail was never detected/truncated"
+            )
     if report.invariant_violations:
         problems.append(
             f"{len(report.invariant_violations)} invariant violation(s): "
@@ -144,7 +176,14 @@ def run_one(seed: int, as_json: bool, sanitized: bool = False) -> int:
             f"(pin-verified {report.pin_verified_rescues}) "
             f"requeues={report.requeues} "
             f"drains={report.drain_evictions} "
-            f"failovers={report.failovers}"
+            f"failovers={report.failovers} "
+            f"recoveries={report.recoveries}"
+            + (
+                f" (replayed {report.replayed_records} records,"
+                f" {report.recovery_wall_seconds:.3f}s)"
+                if report.recoveries
+                else ""
+            )
         )
         for fault in doc["faults"]:
             note = f" ({fault['note']})" if fault["note"] else ""

@@ -537,3 +537,61 @@ class TestMonitorResync:
         assert not monitor.requeue.has_delayed(
             ("PodGang", "default", "gone-0")
         )
+
+    def test_hold_rehydration_survives_cold_restart_from_disk(self):
+        """Durability satellite: an in-process leader takeover re-primes
+        holds from the SURVIVING store; a full process restart gets only
+        the DISK. The recovered store's persisted Scheduled=False
+        conditions must rehydrate the same holds — each WITH a scheduled
+        release (`WorkQueue.has_delayed`), the stranded-hold bug class —
+        and the restarted control plane must finish the recovery."""
+        import shutil
+        import tempfile
+
+        from grove_tpu.durability import recover_store, verify_acked_prefix
+
+        wal_dir = tempfile.mkdtemp(prefix="grove-holds-")
+        try:
+            pcs = budgeted_pcs(replicas=1)
+            pcs.spec.template.disruption_budget = None
+            pcs.spec.template.cliques[0].spec.replicas = 3
+            pcs.spec.template.cliques[0].spec.pod_spec.containers[
+                0
+            ].requests = {"cpu": 5.0}
+            h = SimHarness(num_nodes=3, durability_dir=wal_dir)
+            h.node_monitor.not_ready_after = 5.0
+            h.node_monitor.lost_after = 15.0
+            h.apply(pcs)
+            h.converge()
+            for n in h.cluster.nodes:
+                h.cluster.crash_node(n.name)
+            h.converge(max_ticks=60)
+            assert h.node_monitor.gang_held("default", "svc-0")
+            # the whole process dies — store memory included
+            h.durability.simulate_crash(torn_tail_bytes=17)
+            store, _report = recover_store(
+                wal_dir, clock=h.clock, cache_lag=True
+            )
+            assert not verify_acked_prefix(wal_dir, store)
+            restarted = SimHarness.cold_restart(
+                store, h.cluster.nodes, config=h.config,
+                durability_dir=wal_dir,
+            )
+            restarted.node_monitor.not_ready_after = 5.0
+            restarted.node_monitor.lost_after = 15.0
+            # rehydrated from persisted conditions: held AND released
+            assert restarted.node_monitor.gang_held("default", "svc-0")
+            assert restarted.node_monitor.requeue.has_delayed(
+                ("PodGang", "default", "svc-0")
+            )
+            for n in restarted.cluster.nodes:
+                restarted.cluster.restart_node(n.name)
+            restarted.converge(max_ticks=200)
+            pods = restarted.store.list("Pod")
+            assert len(pods) == 3 and all(is_ready(p) for p in pods), (
+                restarted.tree()
+            )
+            assert not restarted.node_monitor.gang_held("default", "svc-0")
+            restarted.durability.close()
+        finally:
+            shutil.rmtree(wal_dir, ignore_errors=True)

@@ -181,6 +181,22 @@ FIXTURES = {
             "                pass\n"
         ),
     },
+    "GL011": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def rollback(self, obj, key):\n"
+            "    self.store._committed['Pod'][key] = obj\n"
+            "    self.store._rv += 1\n"
+            "    self.store._blob['Pod'].pop(key, None)\n"
+        ),
+        "good": (
+            "def rollback(self, objs, rv):\n"
+            "    self.store.restore_objects(objs, rv)\n"
+            "\n"
+            "def write(self, obj):\n"
+            "    self.store.update(obj)\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -272,6 +288,26 @@ def test_deleting_rolling_update_grant_fails_lint():
         "_always_true",
     )
     assert "GL002" in rules_of(report)
+
+
+def test_injecting_direct_store_mutation_fails_lint():
+    """GL011 live-tree teeth: grafting a direct store-internal mutation
+    onto a real controller source must fail lint — an un-logged mutation
+    is invisible to the WAL, so crash-restart recovery would diverge."""
+    rel = "grove_tpu/controller/nodehealth.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_fast_path(store, kind, key):\n"
+        "    store._committed[kind].pop(key, None)\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL011" in rules_of(report)
+    # the durability module itself (the replay path) is exempt
+    report2 = lint_source(
+        "def replay(store):\n    store._rv += 1\n",
+        "grove_tpu/durability/recovery.py",
+    )
+    assert "GL011" not in rules_of(report2)
 
 
 def test_unregistering_reason_fails_lint():
