@@ -8,8 +8,8 @@ IMAGE ?= grove-tpu:0.2.0
 
 .PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
-        chaos-smoke chaos-matrix drain-smoke recovery-smoke dryrun \
-        docker-build compose-up clean
+        chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
+        probe-debug dryrun docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -65,6 +65,12 @@ recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tai
 
 drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node drain with trial-solve pre-placement, breaker open/close under an eviction storm, inert-broker A/B
 	$(CPU_ENV) $(PY) scripts/drain_smoke.py
+
+delta-smoke:     ## incremental delta-solve smoke: churn loop with the per-tick A/B selfcheck armed (delta problem + admissions bit-identical to the from-scratch solve), warm-start/reuse/fallback counters printed against floors
+	$(CPU_ENV) $(PY) scripts/delta_smoke.py
+
+probe-debug:     ## accelerator-probe debugger: availability precheck + subprocess jit probe against the REAL env (no CPU scrub), full child traceback printed; rc 0 healthy / 2 retryable / 3 config error
+	$(PY) scripts/probe_debug.py
 
 dryrun:          ## multi-chip sharding dry run on the virtual 8-mesh
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -80,6 +80,11 @@ class ProbeLog:
         self.attempts = []
         self._lock = threading.Lock()
         self.healthy = threading.Event()
+        # a non-retryable verdict (JAX_PLATFORMS names a platform with no
+        # PJRT factory, "Unknown backend"): every later probe round would
+        # deterministically fail the same way — skip them instead of the
+        # historical 90s+60s+60s triple timeout (ISSUE 8)
+        self.fatal = threading.Event()
 
     def probe(self, timeout_s: float, where: str) -> bool:
         from grove_tpu.utils.platform import (
@@ -87,6 +92,19 @@ class ProbeLog:
             probe_device_health,
         )
 
+        if self.fatal.is_set():
+            with self._lock:
+                self.attempts.append(
+                    {
+                        "at_s": round(time.time() - _T_START, 1),
+                        "took_s": 0.0,
+                        "timeout_s": timeout_s,
+                        "where": where,
+                        "ok": False,
+                        "skipped": "prior non-retryable probe failure",
+                    }
+                )
+            return False
         t0 = time.time()
         ok = probe_device_health(
             timeout_s, env=_ORIG_ENV, require_accelerator=_WANT_ACCELERATOR
@@ -104,6 +122,9 @@ class ProbeLog:
             # traceback tail, so a CPU-fallback artifact says WHY
             attempt["reason"] = detail.get("reason", "")
             attempt["output_tail"] = detail.get("output_tail", "")
+            attempt["retryable"] = detail.get("retryable", True)
+            if not attempt["retryable"]:
+                self.fatal.set()
         with self._lock:
             self.attempts.append(attempt)
         if ok:
@@ -407,6 +428,21 @@ def _lint_artifact_block() -> dict:
     }
 
 
+def _delta_artifact_block(harness) -> dict:
+    """Incremental delta-solve block (docs/solver.md), run LAST on the
+    already-converged integrated harness so the churn measures the REAL
+    10k-gang × 5k-node steady state: schedule p50/p99 under seeded churn,
+    re-encode fraction, warm-start hit rate, whole-solve reuses, full
+    fallback count, drift (must be 0), the sampled per-tick A/B verdict,
+    and a from-scratch comparison segment on the same harness. The
+    acceptance gate is `p99_lt_1s` (sub-second steady-state admission)."""
+    from grove_tpu.sim.deltachurn import delta_artifact
+
+    if harness.scheduler.delta is None:  # GROVE_TPU_NO_DELTA run
+        return {"enabled": False}
+    return delta_artifact(harness)
+
+
 def _quota_artifact() -> dict:
     """3-tenant contended fair-share run + single-queue A/B, run after the
     main integrated population in the same process (metrics are deltas, so
@@ -503,6 +539,11 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # rule counts + suppression inventory over the exact tree
             # this artifact was produced from
             "lint": _lint_artifact_block(),
+            # delta-solve block LAST: it churns the main harness (the
+            # other blocks run isolated harnesses, and the headline
+            # convergence metrics above were already computed), measuring
+            # steady-state admission latency at the real bench shape
+            "delta": _delta_artifact_block(harness),
         }
 
     _run_population_bench(

@@ -45,6 +45,57 @@ _INT_CAP = 1 << 20  # cap on pods-per-node fit counts (avoid inf→int wrap)
 # fill only" — distinct from -1 ("no broader level; done for good")
 _CLUSTER_RETRY = -2
 
+# Segment count of the deterministic prefix sums below. 64 comfortably
+# exceeds any mesh axis we shard the node dimension over (8-way today,
+# headroom for a 64-chip slice), so every shard owns whole segments and the
+# local scans never cross a shard boundary.
+_SCAN_SEGMENTS = 64
+
+
+def _seg_cumsum(a: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Deterministic, partition-safe inclusive prefix sum.
+
+    ``jnp.cumsum`` over an axis the GSPMD partitioner has sharded is
+    miscompiled on this image's XLA rev: the partitioned scan folds every
+    replica of an UNUSED mesh axis into the result (measured: the
+    node-axis capped-fit prefix sums came back exactly ``dp``-times too
+    large under the (dp=4, tp=2) solver mesh — the root cause of the
+    sharded-vs-single-device alloc/score divergence, PARITY.md). Instead
+    of relying on that rewrite, restructure the scan into the textbook
+    per-shard form XLA partitions mechanically and deterministically:
+
+    - reshape the axis into ``[S, n/S]`` segments (S static, a multiple of
+      any shard count we use, so segments never straddle shards),
+    - local cumsum inside each segment (no cross-shard communication),
+    - segment offsets via a strictly-upper-triangular matmul over the
+      tiny ``[S]`` totals (dot-general partitioning is exact and
+      deterministic — the "per-shard reduce" commit).
+
+    Integer inputs (the capped-fit tables, pod counts, commit usage in
+    quantized units) are EXACTLY associative, so the result is
+    bit-identical to ``jnp.cumsum`` on any mesh including a single
+    device. Float inputs get a fixed association that no mesh shape can
+    change (the quantized solver tensors are integer-valued floats, so
+    they too are exact). Axes not divisible by any power-of-two segment
+    count fall back to the plain cumsum (such shapes cannot be evenly
+    sharded in the first place)."""
+    a = jnp.moveaxis(a, axis, -1)
+    n = a.shape[-1]
+    s = _SCAN_SEGMENTS
+    while s > 1 and n % s:
+        s //= 2
+    if s <= 1:
+        out = jnp.cumsum(a, axis=-1)
+    else:
+        parts = a.reshape(a.shape[:-1] + (s, n // s))
+        local = jnp.cumsum(parts, axis=-1)
+        totals = parts.sum(axis=-1)  # [..., S]
+        # offs[t] = sum of totals of EARLIER segments (exclusive prefix)
+        tri = jnp.triu(jnp.ones((s, s), a.dtype), k=1)
+        offs = jnp.einsum("...s,st->...t", totals, tri)
+        out = (local + offs[..., None]).reshape(a.shape)
+    return jnp.moveaxis(out, -1, axis)
+
 
 class GangInputs(NamedTuple):
     demand: jnp.ndarray  # [P, R]
@@ -158,7 +209,7 @@ def _fill_grouped(
         """Domain choice for group p at its required level (inside mask)."""
         k = _pods_fit_per_node(free_c, demand[p])
         k = jnp.minimum(jnp.where(mask, k, 0), jnp.maximum(floors[p], 1))
-        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), jnp.cumsum(k)])
+        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), _seg_cumsum(k)])
         any_req = group_req[p] >= 0
         lvl = jnp.where(any_req, group_req[p], 0)
         starts = seg_starts[lvl]
@@ -167,7 +218,7 @@ def _fill_grouped(
         feas = (K >= floors[p]) & (ends > starts)
         # capacity-weighted strided pick (seed 0 → deterministic first-best)
         w = jnp.where(feas, K, 0).astype(jnp.float32)
-        cum_w = jnp.cumsum(w)
+        cum_w = _seg_cumsum(w)
         h = jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32) / (
             1 << 16
         )
@@ -234,7 +285,7 @@ def _fill(free, mask, demand, count, unroll=False):
         # count*N (a zero-demand group would otherwise contribute _INT_CAP
         # per node and wrap the prefix sum negative)
         k = jnp.minimum(jnp.where(mask, k, 0), count_p)
-        cum = jnp.cumsum(k) - k  # exclusive prefix
+        cum = _seg_cumsum(k) - k  # exclusive prefix
         take = jnp.clip(count_p - cum, 0, k)
         free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
         return free_c, (take, take.sum())
@@ -368,14 +419,14 @@ def _fill_spread(
         demand_p, count_p = inputs
         k = _pods_fit_per_node(free_c, demand_p)
         k = jnp.minimum(jnp.where(mask, k, 0), count_p)
-        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), jnp.cumsum(k)])
+        cs = jnp.concatenate([jnp.zeros((1,), k.dtype), _seg_cumsum(k)])
         K = cs[ends_l] - cs[starts_l]  # [D] per-domain fit counts
         q = _spread_quota(K, count_p, load)
         # in-domain exclusive prefix: node n's fill position inside its slab
         in_dom = cs[:-1] - cs[starts_l[topo_col]]
         take = jnp.clip(q[topo_col] - in_dom, 0, k)
         free_c = free_c - take[:, None].astype(free_c.dtype) * demand_p[None, :]
-        cs_t = jnp.concatenate([jnp.zeros((1,), take.dtype), jnp.cumsum(take)])
+        cs_t = jnp.concatenate([jnp.zeros((1,), take.dtype), _seg_cumsum(take)])
         load = load + (cs_t[ends_l] - cs_t[starts_l])
         return (free_c, load), (take, take.sum())
 
@@ -533,12 +584,15 @@ def _aggregate_tables(free: jnp.ndarray, gang: GangInputs, cs_pair=None):
         # comparison (sum-of-mins bound) while keeping int32 prefix sums exact
         k_all = jnp.minimum(k_all, gang.count[:, None])
         zero_col = jnp.zeros((k_all.shape[0], 1), dtype=k_all.dtype)
-        cs_k = jnp.concatenate([zero_col, jnp.cumsum(k_all, axis=1)], axis=1)
+        cs_k = jnp.concatenate([zero_col, _seg_cumsum(k_all, axis=1)], axis=1)
     min_demand = jnp.sum(
         gang.min_count[:, None].astype(free.dtype) * gang.demand, axis=0
     )  # [R]
     cs_free = jnp.concatenate(
-        [jnp.zeros((1, free.shape[1]), dtype=free.dtype), jnp.cumsum(free, axis=0)],
+        [
+            jnp.zeros((1, free.shape[1]), dtype=free.dtype),
+            _seg_cumsum(free, axis=0),
+        ],
         axis=0,
     )
     # float32 prefix sums of byte-scale capacity accumulate rounding error;
@@ -562,7 +616,7 @@ def _coloc_score(
     pods_per_node = alloc.sum(axis=0)
     total = jnp.maximum(placed_total.sum(), 1)
     cs_pods = jnp.concatenate(
-        [jnp.zeros((1,), dtype=pods_per_node.dtype), jnp.cumsum(pods_per_node)]
+        [jnp.zeros((1,), dtype=pods_per_node.dtype), _seg_cumsum(pods_per_node)]
     )
 
     def bounds(l):
@@ -1000,7 +1054,7 @@ def wave_chunk_core(
         cs_pair = jnp.concatenate(
             [
                 jnp.zeros((fit_pair.shape[0], 1), dtype=fit_pair.dtype),
-                jnp.cumsum(fit_pair, axis=1),
+                _seg_cumsum(fit_pair, axis=1),
             ],
             axis=1,
         )  # [U, N+1]
@@ -1020,11 +1074,11 @@ def wave_chunk_core(
     usage = jnp.einsum("cpn,cpr->cnr", alloc.astype(free.dtype), dem)  # [C,N,R]
     accept = ok
     for _ in range(commit_iters):
-        cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+        cum = _seg_cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
         fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
         accept = ok & fits
     # final guarantee: with this accept set, every accepted prefix fits
-    cum = jnp.cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
+    cum = _seg_cumsum(jnp.where(accept[:, None, None], usage, 0), axis=0)
     fits = jnp.all(cum <= free[None] + 1e-6, axis=(1, 2))
     accept &= fits
     free = free - jnp.sum(jnp.where(accept[:, None, None], usage, 0), axis=0)
@@ -1129,7 +1183,7 @@ def gang_select_single(
         # proportion to how many copies of this gang each domain can host —
         # commits per wave then approach the capacity-limited maximum.
         w = jnp.where(pool, jnp.sum(K, axis=0), 0).astype(jnp.float32)
-        cum_w = jnp.cumsum(w)
+        cum_w = _seg_cumsum(w)
         total_w = cum_w[-1]
         h = (
             jnp.mod(seed * jnp.int32(40503), 1 << 16).astype(jnp.float32)

@@ -29,6 +29,19 @@ def initialize(
     """Join the distributed runtime. Arguments default to the standard
     JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars
     (auto-populated on GKE TPU slices)."""
+    # CPU multiprocess needs an explicit collectives backend: jax's default
+    # is 'none' and the first cross-process collective then dies with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Earlier images exported JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo;
+    # don't depend on the ambient env for correctness — pin it here,
+    # BEFORE the backend client is created (env override still wins).
+    if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu") and not os.environ.get(
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION"
+    ):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # pragma: no cover — older jax
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address
         or os.environ.get("JAX_COORDINATOR_ADDRESS"),

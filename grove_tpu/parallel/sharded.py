@@ -46,6 +46,37 @@ def make_solver_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(mesh_devices, ("dp", "tp"))
 
 
+def make_node_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-axis node-sharding mesh over ALL requested devices.
+
+    The single-problem stress solve has exactly one shardable tensor axis
+    (nodes), so every device goes on one ``tp`` axis — 8-way at the bench
+    shape, not the 2-way slice the (dp=4, tp=2) solver mesh used to give
+    it. The 1-axis shape is also a CORRECTNESS requirement on this image's
+    XLA rev: under a mesh with an idle axis, the partitioner's
+    partial-replication bookkeeping miscompiles the kernel's node-axis
+    prefix sums — every element comes back multiplied by the idle axis
+    size (dp=4), which is what drove the sharded-vs-single-device
+    alloc/score divergence (PARITY.md). With no idle axis there is nothing
+    to mis-account, and the wave loop is bit-identical to the
+    single-device run (tests/test_solver.py::TestMultiChip)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(
+        mesh_utils.create_device_mesh((n,), devices[:n]), ("tp",)
+    )
+
+
+def _as_node_mesh(mesh: Mesh) -> Mesh:
+    """Flatten any mesh into the 1-axis node mesh over the same devices
+    (same order), so callers holding a (dp, tp) solver mesh — every
+    pre-existing entry point — get the idle-axis-free shape the stress
+    solve requires (see make_node_mesh)."""
+    if len(mesh.axis_names) == 1 and mesh.axis_names[0] == "tp":
+        return mesh
+    return Mesh(mesh.devices.reshape(-1), ("tp",))
+
+
 def batch_solve_sharded(
     mesh: Mesh,
     capacity: np.ndarray,  # [S, N, R] — S scenarios
@@ -109,17 +140,25 @@ def solve_stress_sharded(
     chunk_size: int = 128,
     max_waves: int = 32,
 ):
-    """ONE large placement problem with the NODE axis sharded across every
-    device of the mesh's ``tp`` axis — the flagship multi-chip path: each
-    chip holds a slab of the 5k-node cluster's capacity/topology tensors and
-    the whole device-resident wave loop (lax.while_loop over chunked
-    vmap+commit waves) runs under GSPMD, with XLA inserting the ICI
-    collectives for the node-axis prefix sums, boundary gathers, and
-    reductions.
+    """ONE large placement problem with the NODE axis sharded across EVERY
+    device of the mesh — the flagship multi-chip path: each chip holds a
+    slab of the 5k-node cluster's capacity/topology tensors and the whole
+    device-resident wave loop (lax.while_loop over chunked vmap+commit
+    waves) runs under GSPMD, with XLA inserting the ICI collectives for
+    the node-axis prefix sums, boundary gathers, and reductions.
 
-    Deterministic: admissions are bit-identical to the single-device
-    solve_waves_device run (asserted in tests/test_solver.py), so sharding
-    is purely a throughput/memory choice, never a semantics one.
+    The given mesh is flattened to the 1-axis node mesh over the same
+    devices (``_as_node_mesh``): an idle mesh axis miscompiles the
+    node-axis prefix sums on this XLA rev, and the single tensor axis
+    wants all the chips anyway (8-way at the bench shape).
+
+    Deterministic: admissions, allocations (placed), score, and free_after
+    are all BIT-identical to the single-device solve_waves_device run at
+    matched wave budget (tests/test_solver.py::TestMultiChip), so sharding
+    is purely a throughput/memory choice, never a semantics one — the
+    kernel's prefix sums use the fixed-association segmented scan
+    (ops.packing._seg_cumsum) whose per-shard reduce no mesh shape can
+    reassociate.
     """
     from grove_tpu.ops.packing import solve_waves_device
     from grove_tpu.solver.kernel import (
@@ -128,6 +167,7 @@ def solve_stress_sharded(
         pad_problem_for_waves,
     )
 
+    mesh = _as_node_mesh(mesh)
     g = problem.num_gangs
     raw_args, n_chunks, grouped, pinned, spread, uniform = (
         pad_problem_for_waves(problem, chunk_size)

@@ -74,6 +74,10 @@ class SimCluster:
     start_delay: float = 0.0  # container start latency (virtual seconds)
 
     def __post_init__(self) -> None:
+        # epoch of out-of-band rewrites of `bindings` (rebuild_bindings on
+        # failover/cold restart): incremental consumers folding the watch
+        # stream (solver/deltastate.py) resync their mirrors when it moves
+        self.bindings_epoch = 0
         # kubelet working set: (ns, name) of pods that exist and are not
         # Ready — maintained from watch events so kubelet_tick iterates
         # O(not-ready) instead of rescanning the whole pod population each
@@ -144,6 +148,10 @@ class SimCluster:
                 self.bindings[key] = node
                 self.last_node.setdefault(key, node)
                 n += 1
+        # out-of-band binding-map rewrite (no store events fire for it):
+        # bump the epoch so the scheduler's delta-solve state rebuilds its
+        # binding mirror instead of trusting a pre-failover fold
+        self.bindings_epoch += 1
         return n
 
     def _gc_bindings(self) -> None:
@@ -171,6 +179,12 @@ class SimCluster:
         if reqs is None:
             reqs = self._requests_by_uid[uid] = pod.spec.total_requests()
         return reqs
+
+    def pod_requests(self, pod) -> Dict[str, float]:
+        """Memoized ``total_requests`` per pod uid (specs are immutable
+        once committed) — shared with the delta state's row recounts so
+        both sides sum the SAME dict objects."""
+        return self._pod_requests(pod)
 
     def _used_by_node(self) -> Dict[str, Dict[str, float]]:
         """Committed resource usage per node in ONE pass over bindings —

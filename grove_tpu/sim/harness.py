@@ -118,6 +118,21 @@ class SimHarness:
             max_waves=self.config.solver.max_waves,
             solver_sidecar=self.config.solver.sidecar_address or None,
         )
+        # incremental delta-solve (solver/deltastate.py, docs/solver.md):
+        # cluster tensors + gang specs folded from the watch stream instead
+        # of per-tick full repasses — bit-identical to the from-scratch
+        # path (GROVE_TPU_NO_DELTA=1 opts a run out for A/B measurement).
+        # Under the runtime sanitizer every tick ALSO re-derives the
+        # problem from scratch and asserts bit-equality (delta_selfcheck),
+        # so sanitized chaos runs pin the equivalence continuously.
+        import os as _os
+
+        from grove_tpu.analysis.sanitize import enabled as _sanitize_enabled
+
+        if _os.environ.get("GROVE_TPU_NO_DELTA", "") not in ("1", "true"):
+            self.scheduler.enable_delta()
+            if _sanitize_enabled():
+                self.scheduler.delta_selfcheck = True
         # node-health monitor (controller/nodehealth.py): heartbeat
         # lifecycle, pod failure on Lost nodes, gang rescue vs. requeue.
         # Inert while no node crashes (one O(nodes) pass per tick).

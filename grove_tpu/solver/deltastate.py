@@ -1,0 +1,546 @@
+"""Incremental delta-solve state: resident cluster tensors folded from
+watch deltas instead of re-derived per tick.
+
+Every ``GangScheduler._schedule_pending`` tick used to re-derive the whole
+solver input from scratch: one pass over ALL bindings (``node_free_all``,
+O(bound pods) store reads), a full topology re-sort/re-id of every node
+(``encode_nodes``), and a per-gang re-read of every pending gang's CR,
+pods, and scheduled counts (``_encode_pending``). At production churn the
+per-tick delta is tiny — a few gangs arrive, a few pods bind, a node flaps
+— which is exactly the regime this module exploits (the scheduler analogue
+of ``runtime/aggregate.py`` and the quota accountant, folded from the same
+``subscribe_system`` watch fanout).
+
+State maintained (all dirty-masked):
+
+- **Binding mirror** — per-node insertion-ordered pod sets mirroring
+  ``SimCluster.bindings``. The per-node order equals the restriction of
+  the global binding order, so a dirty node's usage recount sums requests
+  in EXACTLY the order ``node_free_all`` would — float accumulation and
+  the float32 rows are bit-identical, not merely close.
+- **Free-capacity matrix** ``[N, R]`` — rows recomputed only for dirty
+  nodes; clean rows carried across ticks. The encode-side analogue of the
+  "warm-start from the previous tick's surviving placements": every
+  surviving placement is already debited, nothing is recounted.
+- **Node encoding** (``encode.NodeEncoding``) — topology sort, dense ids,
+  domain boundaries, reusable static tensors. Invalidated only by a
+  node-signature change (set/labels/capacity/schedulability): a topology
+  change falls back to a FULL re-encode, counted in
+  ``delta_full_fallbacks_total``.
+- **Gang-spec cache** — encoded specs reused for gangs with no relevant
+  pod/PodGang delta since they were built (``delta_warm_start_hits_total``).
+
+Fallback ladder: topology change, resource-name-space change, or drift
+detection (periodic exact recount audit) ⇒ full re-encode through the very
+same assembly code — so the delta and full paths can never diverge
+semantically, and the A/B equivalence (delta problem bit-identical to a
+from-scratch ``build_problem``; admissions bit-identical) is pinned by
+tests/test_deltastate.py, ``make delta-smoke``, and the bench ``"delta"``
+block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.pod import is_schedule_gated, is_scheduled, is_terminating
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.store import Store
+from grove_tpu.solver.encode import NodeEncoding, build_problem_cached
+
+
+_PROBLEM_TENSORS = (
+    "capacity", "topo", "seg_starts", "seg_ends", "demand", "count",
+    "min_count", "req_level", "pref_level", "priority", "group_req",
+    "group_pin", "gang_pin", "spread_level", "spread_min",
+    "spread_required", "spread_seed",
+)
+_PROBLEM_NAMES = (
+    "node_names", "gang_names", "group_names", "resource_names",
+    "level_keys",
+)
+
+
+def problems_identical(a, b) -> Optional[str]:
+    """BIT-equality check of two PackingProblems (every tensor, every name
+    list). Returns None when identical, else the first mismatching field —
+    the delta-solve A/B contract (GangScheduler._delta_ab_check, tests,
+    `make delta-smoke`)."""
+    for field in _PROBLEM_TENSORS:
+        x, y = getattr(a, field), getattr(b, field)
+        if (x is None) != (y is None):
+            return field
+        if x is not None and (
+            x.shape != y.shape
+            or x.dtype != y.dtype
+            or not np.array_equal(x, y)
+        ):
+            return field
+    for field in _PROBLEM_NAMES:
+        if getattr(a, field) != getattr(b, field):
+            return field
+    return None
+
+
+def _binding_feature(pod) -> Optional[str]:
+    """The node this pod charges capacity to, or None while it charges
+    nothing — mirrors the ``bindings`` + ``_used_by_node`` contract
+    (bound, not terminating)."""
+    if pod is None or pod.metadata.deletion_timestamp is not None:
+        return None
+    if not is_scheduled(pod):
+        return None
+    return pod.status.node_name or None
+
+
+def _gang_feature(pod) -> Optional[tuple]:
+    """The pod's contribution to its gang's encoded spec: existence,
+    pending-set membership inputs (gates / scheduled / terminating), and
+    its binding. Readiness is deliberately absent — a Ready flip changes
+    neither the pending set (ready pods are bound) nor scheduled counts,
+    so it must not dirty the gang (the steady-state common case)."""
+    if pod is None or pod.metadata.deletion_timestamp is not None:
+        return None
+    return (
+        is_scheduled(pod),
+        is_schedule_gated(pod),
+        pod.status.node_name,
+    )
+
+
+class DeltaSolveState:
+    """Dirty-masked incremental encode state for one GangScheduler.
+
+    Attach via ``GangScheduler.enable_delta()`` (in-memory :class:`Store`
+    only — its watch events fire synchronously at commit, so the fold is
+    always exact; the HTTP client's watch threads lag live reads and keep
+    the full path).
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        cluster,
+        topology,
+        drift_check_every: int = 64,
+    ) -> None:
+        self.store = store
+        self.cluster = cluster
+        self.topology = topology
+        self.drift_check_every = drift_check_every
+        # node-side state
+        self._enc: Optional[NodeEncoding] = None
+        # encodings retired by a signature change, keyed by
+        # (node signature, resource names): a flap BACK to a previously
+        # seen signature (cordon/uncordon, node rejoin) reuses the
+        # retired encoding instead of re-sorting and re-deriving 5k
+        # nodes — NodeEncoding is deterministic in (nodes, topology,
+        # resource_names), so an equal key IS the identical encoding
+        self._enc_cache: Dict[tuple, NodeEncoding] = {}
+        self._node_sig: Optional[tuple] = None
+        self._node_resources: frozenset = frozenset()
+        self._free: Optional[np.ndarray] = None
+        self._free_version = 0
+        self._enc_epoch = 0
+        # binding mirror: node -> {(ns, name): None} insertion-ordered
+        self._node_pods: Dict[str, Dict[Tuple[str, str], None]] = {}
+        self._pod_node: Dict[Tuple[str, str], str] = {}
+        self._dirty_nodes: set = set()
+        self._mirror_built = False
+        # gang-spec cache: (ns, gang) -> {"spec", "pods", "names", "rev"}
+        self._specs: Dict[Tuple[str, str], dict] = {}
+        self._dirty_gangs: set = set()
+        self._spec_rev = 0
+        # bookkeeping / observability
+        self._ticks = 0
+        self._bindings_epoch = getattr(cluster, "bindings_epoch", 0)
+        self.warm_start_hits = 0  # specs served from cache (lifetime)
+        self.solve_reuses = 0  # whole solves skipped (identical tick)
+        self.full_fallbacks = 0
+        self.drift_detected = 0
+        self.last_reencoded = 0  # specs rebuilt THIS tick
+        self.last_reused = 0  # specs served from cache THIS tick
+        store.subscribe_system(self._on_event)
+
+    # -- watch-delta fold ------------------------------------------------
+
+    def _on_event(self, ev) -> None:
+        if ev.kind == "PodGang":
+            if (
+                ev.type == "Updated"
+                and ev.old is not None
+                and ev.old.spec is ev.obj.spec
+                and ev.old.metadata.labels == ev.obj.metadata.labels
+            ):
+                # STATUS-only write (copy-on-write commits share the spec
+                # subtree structurally — the O(1) identity check the WAL
+                # patch op uses): phase/condition upserts happen every
+                # round at steady state and change no encode input, so
+                # they must not cost every gang its warm start
+                return
+            key = (ev.obj.metadata.namespace, ev.obj.metadata.name)
+            self._dirty_gangs.add(key)
+            if ev.type == "Deleted":
+                self._specs.pop(key, None)
+            return
+        if ev.kind != "Pod":
+            return
+        old = ev.old if ev.old is not None else (
+            ev.obj if ev.type == "Deleted" else None
+        )
+        new = None if ev.type == "Deleted" else ev.obj
+        key = (ev.obj.metadata.namespace, ev.obj.metadata.name)
+        # usage fold: the MIRROR is the authority for where the pod was
+        # charged (the event's old view says where the pod THOUGHT it was,
+        # which disagrees once a pod turns terminating-then-deleted — two
+        # events, one charge release). A pod charges capacity while bound
+        # and not terminating; any transition in or out of that state, or
+        # a node move, dirties the affected rows.
+        if self._mirror_built:
+            new_node = _binding_feature(new)
+            mirrored = self._pod_node.get(key)
+            if new_node != mirrored:
+                if mirrored is not None:
+                    pods = self._node_pods.get(mirrored)
+                    if pods is not None:
+                        pods.pop(key, None)
+                    self._pod_node.pop(key, None)
+                    self._dirty_nodes.add(mirrored)
+                if new_node is not None:
+                    self._node_pods.setdefault(new_node, {})[key] = None
+                    self._pod_node[key] = new_node
+                    self._dirty_nodes.add(new_node)
+        # spec fold: dirty the gang when pending-set inputs changed
+        if _gang_feature(old) != _gang_feature(new):
+            for side in (old, new):
+                if side is None:
+                    continue
+                gang = side.metadata.labels.get(namegen.LABEL_PODGANG)
+                if gang:
+                    self._dirty_gangs.add((side.metadata.namespace, gang))
+
+    # -- node signature / topology-change detection ----------------------
+
+    def _signature(self, nodes) -> Tuple[tuple, frozenset]:
+        """Signature of the solve's node set: name, topology path, and
+        capacity of every schedulable node (in the caller's order — the
+        encoder re-sorts, so order changes are harmless but cheap to
+        include). Any change is a TOPOLOGY change: the dense ids, domain
+        slabs, and pin resolutions may all shift, so the delta state falls
+        back to a full re-encode."""
+        level_keys = [lvl.key for lvl in self.topology.spec.levels]
+        sig = []
+        rset = set()
+        for n in nodes:
+            caps = tuple(sorted(n.capacity.items()))
+            rset.update(n.capacity)
+            sig.append(
+                (n.name, tuple(n.labels.get(k, "") for k in level_keys), caps)
+            )
+        return tuple(sig), frozenset(rset)
+
+    # -- full resync ------------------------------------------------------
+
+    def _resync_mirror(self) -> None:
+        """Rebuild the binding mirror from ``cluster.bindings`` in its own
+        (global insertion) order, so per-node restriction order matches the
+        recount order ``node_free_all`` would use."""
+        self._node_pods = {}
+        self._pod_node = {}
+        for key, node_name in self.cluster.bindings.items():
+            self._node_pods.setdefault(node_name, {})[key] = None
+            self._pod_node[key] = node_name
+        self._mirror_built = True
+
+    def invalidate(self, reason: str = "manual") -> None:
+        """Registration API for out-of-band mutations (grovelint GL012):
+        code that must touch cluster-tensor inputs outside the watched
+        channels (store commits, node attributes seen by the signature)
+        calls this so the next tick re-derives everything."""
+        self._enc = None
+        self._enc_cache.clear()
+        self._node_sig = None
+        self._free = None
+        self._mirror_built = False
+        self._specs.clear()
+        self._dirty_gangs.clear()
+        self._dirty_nodes.clear()
+        if reason != "init":
+            self.full_fallbacks += 1
+            METRICS.inc("delta_full_fallbacks_total")
+
+    def mark_node_dirty(self, node_name: str) -> None:
+        """Registration API (GL012): a node's free capacity was changed
+        outside the store-watched channels — recount its row next tick."""
+        self._dirty_nodes.add(node_name)
+
+    def mark_gang_dirty(self, namespace: str, gang_name: str) -> None:
+        """Registration API (GL012): a gang's encode inputs were changed
+        outside the watched channels — re-encode its spec next tick."""
+        self._dirty_gangs.add((namespace, gang_name))
+
+    # -- drift audit -------------------------------------------------------
+
+    def check_drift(self, nodes) -> bool:
+        """Exact audit: recount every node's free capacity from the live
+        binding map and compare to the incrementally-maintained rows.
+        O(bound pods) — run periodically (and per-tick under the runtime
+        sanitizer), not per solve. Returns True when drift was found (the
+        state then resyncs itself and counts a fallback)."""
+        if self._enc is None or self._free is None:
+            return False
+        oracle = self.cluster.node_free_all(nodes)
+        expect = np.zeros_like(self._free)
+        for name, i in self._enc.node_index.items():
+            caps = oracle.get(name, {})
+            for r, rname in enumerate(self._enc.resource_names):
+                expect[i, r] = caps.get(rname, 0.0)
+        if np.array_equal(expect, self._free):
+            return False
+        self.drift_detected += 1
+        METRICS.inc("delta_drift_detected_total")
+        self.invalidate(reason="drift")
+        return True
+
+    # -- spec cache --------------------------------------------------------
+
+    def cached_spec(
+        self, namespace: str, gang_name: str, pods: List
+    ) -> Optional[tuple]:
+        """The cached (spec, gang_pods) for a clean gang whose pending pod
+        set is unchanged; None forces a re-encode. ``pods`` is this tick's
+        pending pod list for the gang (pre-grouping)."""
+        key = (namespace, gang_name)
+        if key in self._dirty_gangs:
+            return None
+        entry = self._specs.get(key)
+        if entry is None:
+            return None
+        # SORTED name tuple: the encoded spec is canonical in the pod-name
+        # set (group members are name-sorted), while the incoming list's
+        # order follows working-set iteration — order changes must not
+        # miss, content changes must
+        names = tuple(sorted(p.metadata.name for p in pods))
+        if entry["names"] != names:
+            # pod-set change the dirty tracking missed (belt and braces —
+            # re-encode rather than trust a stale spec)
+            return None
+        self.warm_start_hits += 1
+        self.last_reused += 1
+        METRICS.inc("delta_warm_start_hits_total")
+        return entry["spec"], entry["pods"]
+
+    def store_spec(
+        self,
+        namespace: str,
+        gang_name: str,
+        pods: List,
+        spec: dict,
+        gang_pods: dict,
+    ) -> None:
+        key = (namespace, gang_name)
+        self._spec_rev += 1
+        self.last_reencoded += 1
+        self._specs[key] = {
+            "spec": spec,
+            "pods": gang_pods,
+            "names": tuple(sorted(p.metadata.name for p in pods)),
+            "rev": self._spec_rev,
+        }
+        self._dirty_gangs.discard(key)
+
+    def spec_rev(self, spec: dict) -> int:
+        """Cache revision of an encoded spec (0 for uncached) — one
+        component of the warm-start solve fingerprint."""
+        entry = self._specs.get((spec["namespace"], spec["gang_name"]))
+        if entry is not None and entry["spec"] is spec:
+            return entry["rev"]
+        return 0
+
+    # -- per-tick refresh + encode ----------------------------------------
+
+    def _recount_row(self, node, resource_names: List[str]) -> None:
+        """Recompute one node's free row exactly as ``node_free_all`` would:
+        accumulate a usage dict in binding order, subtract once per
+        resource, then fill the float32 row."""
+        used: Dict[str, float] = {}
+        for key in self._node_pods.get(node.name, ()):  # insertion order
+            pod = self.store.get("Pod", key[0], key[1], readonly=True)
+            if pod is None or is_terminating(pod):
+                continue
+            for k, v in self.cluster.pod_requests(pod).items():
+                used[k] = used.get(k, 0.0) + v
+        free = dict(node.capacity)
+        for k, v in used.items():
+            free[k] = free.get(k, 0.0) - v
+        i = self._enc.node_index[node.name]
+        for r, rname in enumerate(resource_names):
+            self._free[i, r] = free.get(rname, 0.0)
+
+    def _fold_dirty(self, nodes) -> int:
+        """Recount every dirty node's free row against the current encoding
+        (O(dirty), not O(nodes)). Idle ticks fold eagerly via refresh so
+        dirt never accumulates across quiet rounds; encode folds again for
+        any rows dirtied mid-tick (e.g. gang-teardown pod deletes inside
+        the pending scan)."""
+        if self._enc is None or self._free is None:
+            return 0
+        dirty = self._dirty_nodes & set(self._enc.node_index)
+        if dirty:
+            if len(dirty) * 4 >= len(self._enc.node_index):
+                # full-re-derive regime (fallback tick dirtied every row):
+                # one global usage pass beats per-node store walks. Same
+                # bits — node_free_all accumulates per node in global
+                # binding order, the restriction of which IS the mirror's
+                # per-node order (see _resync_mirror)
+                free_all = self.cluster.node_free_all(nodes)
+                rn = self._enc.resource_names
+                for node in nodes:
+                    if node.name not in dirty:
+                        continue
+                    caps = free_all[node.name]
+                    i = self._enc.node_index[node.name]
+                    for r, rname in enumerate(rn):
+                        self._free[i, r] = caps.get(rname, 0.0)
+            else:
+                by_name = {n.name: n for n in nodes}
+                for name in dirty:
+                    node = by_name.get(name)
+                    if node is not None:
+                        self._recount_row(node, self._enc.resource_names)
+            self._free_version += 1
+        self._dirty_nodes.clear()
+        return len(dirty)
+
+    def refresh(self, nodes) -> None:
+        """Per-tick maintenance BEFORE an encode: detect topology change,
+        run the periodic drift audit, lazily (re)build the mirror, and fold
+        any dirty free-capacity rows."""
+        from grove_tpu.analysis.sanitize import enabled as sanitize_enabled
+
+        self._ticks += 1
+        self.last_reencoded = 0
+        self.last_reused = 0
+        epoch = getattr(self.cluster, "bindings_epoch", 0)
+        if epoch != self._bindings_epoch:
+            # rebuild_bindings rewrote the binding map out-of-band
+            # (failover/cold restart) — the mirror's fold no longer covers
+            # it; resync rather than trust pre-rewrite state
+            self._bindings_epoch = epoch
+            self.invalidate(reason="bindings-rebuilt")
+        sig, rset = self._signature(nodes)
+        if sig != self._node_sig:
+            had = self._enc is not None
+            self._enc = None
+            self._free = None
+            self._specs.clear()  # pins/survivor seeds resolve against the
+            self._dirty_gangs.clear()  # new node set — rebuild every spec
+            self._node_sig = sig
+            self._node_resources = rset
+            if had:
+                self.full_fallbacks += 1
+                METRICS.inc("delta_full_fallbacks_total")
+        if not self._mirror_built:
+            self._resync_mirror()
+            return
+        # fold BEFORE the audit: rows dirtied by the previous tick's binds
+        # are folded lazily here, so auditing first would read legitimately
+        # pending dirt as drift and pay a spurious full re-derive (observed
+        # at bench scale: every audit after a bind tick false-positived)
+        self._fold_dirty(nodes)
+        every = 1 if sanitize_enabled() else self.drift_check_every
+        if every and self._ticks % every == 0:
+            if self.check_drift(nodes):
+                self._resync_mirror()
+                # the drift invalidate nulled the signature, but the
+                # TOPOLOGY did not change — restore it so the next tick
+                # doesn't misread the unchanged node set as a second
+                # fallback, and so the rebuilt encoding caches under its
+                # true signature (drift is a usage-rows problem; the
+                # encoding is usage-independent)
+                self._node_sig = sig
+                self._node_resources = rset
+
+    def encode(
+        self,
+        nodes,
+        gang_specs: List[dict],
+        pad_gangs: Optional[int] = None,
+        pad_groups: Optional[int] = None,
+    ):
+        """Build this tick's PackingProblem incrementally. Returns
+        (problem, fingerprint) where the fingerprint identifies the exact
+        solver input — two ticks with equal fingerprints are guaranteed to
+        produce identical solver results (the warm-start reuse key)."""
+        resource_names = sorted(
+            self._node_resources.union(
+                *(
+                    grp["demand"].keys()
+                    for spec in gang_specs
+                    for grp in spec["groups"]
+                )
+            )
+            if gang_specs
+            else self._node_resources
+        )
+        if self._enc is None or self._enc.resource_names != resource_names:
+            # first build, topology fallback, or a new resource axis: the
+            # matrix width changes, so every row re-derives. A signature
+            # seen before (flap-back) reuses the retired encoding — only
+            # the free matrix re-derives. A None signature (encode before
+            # the next refresh re-signs, e.g. right after a manual
+            # invalidate) must not key the cache: it would alias distinct
+            # node sets
+            key = (self._node_sig, tuple(resource_names))
+            enc = (
+                self._enc_cache.get(key)
+                if self._node_sig is not None
+                else None
+            )
+            if enc is None:
+                enc = NodeEncoding(nodes, self.topology, resource_names)
+                if self._node_sig is not None:
+                    self._enc_cache[key] = enc
+                    while len(self._enc_cache) > 4:  # oldest-first bound
+                        self._enc_cache.pop(next(iter(self._enc_cache)))
+            self._enc = enc
+            self._free = self._enc.base_capacity.copy()
+            self._dirty_nodes = {n.name for n in nodes}
+            self._enc_epoch += 1
+        dirty = self._fold_dirty(nodes)
+        METRICS.set("delta_dirty_nodes", dirty)
+        METRICS.set("delta_dirty_gangs", len(self._dirty_gangs))
+        problem = build_problem_cached(
+            self._enc, self._free, gang_specs, pad_gangs, pad_groups
+        )
+        fingerprint = (
+            self._enc_epoch,
+            self._free_version,
+            tuple(
+                (spec["name"], self.spec_rev(spec)) for spec in gang_specs
+            ),
+            pad_gangs,
+            pad_groups,
+        )
+        return problem, fingerprint
+
+    def free_dicts(self, nodes) -> Dict[str, Dict[str, float]]:
+        """Per-node free-capacity dicts from the maintained matrix — the
+        gRPC sidecar path's request builder consumes dicts, so delta state
+        survives ``_solve_remote`` without a bindings repass."""
+        out: Dict[str, Dict[str, float]] = {}
+        if self._enc is None or self._free is None:
+            return self.cluster.node_free_all(nodes)
+        rn = self._enc.resource_names
+        for node in nodes:
+            i = self._enc.node_index.get(node.name)
+            if i is None:
+                out[node.name] = dict(node.capacity)
+                continue
+            out[node.name] = {
+                r: float(self._free[i, j]) for j, r in enumerate(rn)
+            }
+        return out

@@ -107,33 +107,166 @@ class GangScheduler:
         # gate every voluntary eviction. None → ungated (bare schedulers);
         # an un-armed broker (no budgets, no drains) is inert either way.
         self.broker = None
+        # incremental delta-solve state (solver/deltastate.py,
+        # docs/solver.md): cluster tensors + gang specs folded from watch
+        # deltas instead of per-tick full repasses. None → the from-scratch
+        # path; enable_delta() attaches it (in-memory stores only). The two
+        # paths are BIT-identical — pinned by the delta_selfcheck A/B.
+        self.delta = None
+        # debug/A-B mode: after every delta solve, re-derive the identical
+        # problem from scratch and assert problem + admissions bit-equality
+        # (tests, `make delta-smoke`, and the bench "delta" block set it)
+        self.delta_selfcheck = False
+        # seconds the A/B selfcheck itself spent inside schedule() since
+        # the caller last reset this — the check is a verification harness
+        # (never on in production), so latency reporters subtract it from
+        # the admission path's timing and account for it separately
+        self.last_selfcheck_seconds = 0.0
+        # (fingerprint + solve opts, result) of the previous delta solve:
+        # equal fingerprints ⇒ identical solver input ⇒ the whole device
+        # dispatch is skipped and the result reused (_solve_batch_delta)
+        self._delta_last = None
+        # True while the most recent batch "solve" was a fingerprint reuse
+        # (no dispatch ran): gates the gang_solve_seconds observation
+        self._solve_reused = False
+
+    def enable_delta(self) -> bool:
+        """Attach the incremental delta-solve state. In-memory stores only:
+        the fold consumes the synchronous ``subscribe_system`` watch fanout
+        (the HTTP client's watch threads lag live reads — those deployments
+        keep the from-scratch path). Safe to call twice."""
+        if self.delta is not None:
+            return True
+        if not isinstance(self.store, Store) or not isinstance(
+            self.cluster, SimCluster
+        ):
+            return False
+        from grove_tpu.solver.deltastate import DeltaSolveState
+
+        self.delta = DeltaSolveState(self.store, self.cluster, self.topology)
+        return True
+
+    def _solve_batch_delta(self, nodes: List, gang_specs: List[dict]):
+        """Delta-solve hot path: assemble this tick's problem from the
+        dirty-masked cluster state (no bindings repass, no topology
+        re-sort), and skip the device dispatch entirely when the solver
+        input is IDENTICAL to the previous tick's (equal fingerprints ⇒
+        equal tensors ⇒ the deterministic wave solve returns the same
+        result — the steady-state "pending backlog, nothing changed"
+        spin). Returns (PackingResult, PackingProblem)."""
+        with TRACER.span(
+            "solve.delta_encode", gangs=len(gang_specs), nodes=len(nodes)
+        ) as span:
+            problem, fingerprint = self.delta.encode(
+                nodes,
+                gang_specs,
+                pad_groups=self._pad_groups.grow(gang_specs),
+            )
+            span.set("reencoded", self.delta.last_reencoded)
+        key = (fingerprint, self.chunk_size, self.max_waves)
+        if self._delta_last is not None and self._delta_last[0] == key:
+            self.delta.solve_reuses += 1
+            METRICS.inc("delta_solve_reuses_total")
+            # the cached result's solve_seconds describes the ORIGINAL
+            # dispatch — no solve ran this tick, so the latency histogram
+            # must not re-observe it (flag checked at the observe site)
+            self._solve_reused = True
+            return self._delta_last[1], problem
+        # the sidecar request is built from free-capacity DICTS — serve
+        # them from the maintained matrix so delta state survives
+        # _solve_remote without an O(bindings) repass (in-process solves
+        # consume the problem tensors directly and need no dicts)
+        free = (
+            self.delta.free_dicts(nodes)
+            if self.solver_sidecar is not None
+            else None
+        )
+        result, problem = self._solve_batch(
+            nodes, gang_specs, free, problem=problem
+        )
+        self._delta_last = (key, result)
+        return result, problem
+
+    def _delta_ab_check(self, nodes, gang_specs, problem, result) -> None:
+        """A/B equivalence pin (delta_selfcheck): re-derive the identical
+        solver input from scratch — full bindings repass, full topology
+        re-encode — and assert the problem tensors AND the solve outcome
+        are bit-identical to what the delta path produced. Tests, `make
+        delta-smoke`, the bench "delta" block, and sanitized chaos runs
+        enable this; steady-state production pays only the `if`."""
+        import time as _time
+
+        import numpy as np
+
+        from grove_tpu.solver.deltastate import problems_identical
+
+        t0 = _time.perf_counter()
+        free = self.cluster.node_free_all(nodes)
+        full = build_problem(
+            nodes,
+            gang_specs,
+            self.topology,
+            free_capacity=free,
+            pad_groups=self._pad_groups.grow(gang_specs),
+        )
+        mismatch = problems_identical(problem, full)
+        if mismatch:
+            raise AssertionError(
+                f"delta-solve problem diverged from the from-scratch "
+                f"encode: {mismatch}"
+            )
+        full_result = solve_waves(
+            full,
+            chunk_size=self.chunk_size,
+            max_waves=self.max_waves,
+            with_alloc=True,
+        )
+        for field in ("admitted", "placed", "score", "chosen_level", "alloc"):
+            a = getattr(result, field)
+            b = getattr(full_result, field)
+            if (a is None) != (b is None) or (
+                a is not None and not np.array_equal(a, b)
+            ):
+                raise AssertionError(
+                    f"delta-solve result diverged from the full solve on "
+                    f"{field!r}"
+                )
+        self.last_selfcheck_seconds += _time.perf_counter() - t0
 
     def _solve_batch(
         self,
         nodes: List,
         gang_specs: List[dict],
-        free_capacity: Dict[str, Dict[str, float]],
+        free_capacity: Optional[Dict[str, Dict[str, float]]],
         with_alloc: bool = True,
+        problem=None,
     ):
         """One batch solve against a free-capacity snapshot. In-process by
         default; with ``solver_sidecar`` set, the identical request goes
         over gRPC (cluster/grpcsolver.py) and the response is mapped back
         onto the locally-encoded problem's index space, so every downstream
         consumer (binding, preemption trials, recovery pins) is agnostic to
-        where the kernel ran. Returns (PackingResult, PackingProblem)."""
+        where the kernel ran. Returns (PackingResult, PackingProblem).
+
+        ``problem``: a pre-built encode (the delta-solve path) — the
+        from-scratch encode is skipped, and ``free_capacity`` is then only
+        consumed by the sidecar request builder (None is fine in-process)."""
+        self._solve_reused = False  # a real dispatch (or sidecar call) runs
         # STICKY group padding: the encoder pads the group axis exactly
         # (wide pow2 padding wastes fill scans), but the PENDING mix's max
         # group count flips as multi-group gangs drain and re-arrive — and
         # every distinct padded shape is a fresh XLA compile. Remember the
         # widest template seen and keep padding there: compiles stay
         # monotone-few, executables keep getting reused.
-        with TRACER.span(
-            "scheduler.encode", gangs=len(gang_specs), nodes=len(nodes)
-        ):
-            problem = build_problem(
-                nodes, gang_specs, self.topology, free_capacity=free_capacity,
-                pad_groups=self._pad_groups.grow(gang_specs),
-            )
+        if problem is None:
+            with TRACER.span(
+                "scheduler.encode", gangs=len(gang_specs), nodes=len(nodes)
+            ):
+                problem = build_problem(
+                    nodes, gang_specs, self.topology,
+                    free_capacity=free_capacity,
+                    pad_groups=self._pad_groups.grow(gang_specs),
+                )
         import time as _time
 
         if (
@@ -297,6 +430,13 @@ class GangScheduler:
         else:
             namespaces = [namespace]
         self.cluster._gc_bindings()
+        if self.delta is not None:
+            # BEFORE the pending scan: a topology change (cordon, flap,
+            # capacity) must invalidate the spec cache before any spec is
+            # served from it (pins/survivor seeds resolve against nodes)
+            self.delta.refresh(
+                [n for n in self.cluster.nodes if n.schedulable]
+            )
         sticky_bound = 0
         gang_specs: List[dict] = []
         gang_pods: Dict[str, Dict[str, List]] = {}
@@ -336,15 +476,27 @@ class GangScheduler:
             # dense tensors: the encoder never sees them, so no placement,
             # recovery pin, or preemption trial can target one
             nodes = [n for n in self.cluster.nodes if n.schedulable]
-            # one usage pass over bindings (node_free per node would be
-            # O(nodes × bindings) per round at stress scale)
-            free = self.cluster.node_free_all(nodes)
             if nodes:
                 # wave solver with allocations: cheap-to-compile vmapped
                 # decisions (the exact scan kernel stays on the parity/bench
                 # paths; unadmitted gangs retry on the next control round)
-                result, problem = self._solve_batch(nodes, gang_specs, free)
-                METRICS.observe("gang_solve_seconds", result.solve_seconds)
+                if self.delta is not None:
+                    result, problem = self._solve_batch_delta(
+                        nodes, gang_specs
+                    )
+                else:
+                    # one usage pass over bindings (node_free per node would
+                    # be O(nodes × bindings) per round at stress scale)
+                    free = self.cluster.node_free_all(nodes)
+                    result, problem = self._solve_batch(
+                        nodes, gang_specs, free
+                    )
+                if self.delta is not None and self.delta_selfcheck:
+                    self._delta_ab_check(nodes, gang_specs, problem, result)
+                if not self._solve_reused:
+                    METRICS.observe(
+                        "gang_solve_seconds", result.solve_seconds
+                    )
                 preempted, preempt_free = self._maybe_preempt(
                     gang_specs, result
                 )
@@ -633,6 +785,20 @@ class GangScheduler:
                 # pending (NOT loose — they stay gang pods) and let the
                 # monitor release it into a later round
                 continue
+            if self.delta is not None:
+                # warm start: a gang with no relevant pod/PodGang delta
+                # since its spec was built (and the same pending pod set)
+                # reuses the encoded spec — the spec content is canonical
+                # in the pod-name SET (members are name-sorted), so the
+                # cache key is exact, and every input beyond the watched
+                # events (cordons, node changes) clears the whole cache
+                # via the topology signature in DeltaSolveState.refresh
+                hit = self.delta.cached_spec(namespace, gang_name, pods)
+                if hit is not None:
+                    spec, pods_by_pclq = hit
+                    gang_specs.append(spec)
+                    gang_pods[spec["name"]] = dict(pods_by_pclq)
+                    continue
             gang_cr = self.store.get(
                 "PodGang", namespace, gang_name, readonly=True
             )
@@ -742,7 +908,7 @@ class GangScheduler:
                         gang_pinned_node = node
                         break
                     gang_pinned_node = gang_pinned_node or node
-            gang_specs.append(
+            spec = (
                 {
                     # globally-unique solver key (gangs from different
                     # namespaces meet in one solve); the bare CR name stays
@@ -769,7 +935,12 @@ class GangScheduler:
                     or self.quota.default_queue,
                 }
             )
+            gang_specs.append(spec)
             gang_pods[f"{namespace}/{gang_name}"] = dict(by_pclq)
+            if self.delta is not None:
+                self.delta.store_spec(
+                    namespace, gang_name, pods, spec, dict(by_pclq)
+                )
         return gang_specs, gang_pods, loose
 
     def _narrower_key(self, a: Optional[str], b: Optional[str]) -> Optional[str]:

@@ -25,10 +25,78 @@ def last_probe_detail() -> Optional[dict]:
     return _last_probe
 
 
+def check_platform_available(
+    env: Optional[dict] = None, timeout_s: float = 20.0
+) -> Optional[str]:
+    """Fast-fail precheck: does every platform named by ``JAX_PLATFORMS``
+    have a registered PJRT factory at all?
+
+    Returns None when the pin is satisfiable (or nothing/cpu is pinned),
+    else a human-readable reason. Runs ``import jax`` + plugin discovery in
+    a subprocess — discovery mutates global registries and a pinned parent
+    must stay pristine — but never INITIALIZES a backend, so it cannot
+    wedge in device init the way the full probe can. A missing factory is a
+    deterministic config error: retrying the 60-90s jit probe against it is
+    how past bench rounds burned three timeout rounds on a platform that
+    was never going to appear (the ``JAX_PLATFORMS=axon`` runs, BENCH_r01+).
+    """
+    import subprocess
+    import sys
+
+    want = [
+        p.strip()
+        for p in (env or os.environ).get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    ]
+    if not want or all(p == "cpu" for p in want):
+        return None
+    code = (
+        "import os, sys\n"
+        "import jax\n"
+        "from jax._src import xla_bridge as xb\n"
+        "try:\n"
+        "    xb.discover_pjrt_plugins()\n"
+        "except Exception as e:\n"
+        "    print('DISCOVER-ERR', e)\n"
+        "known = sorted(xb._backend_factories)\n"
+        "want = [p.strip() for p in"
+        " os.environ.get('JAX_PLATFORMS', '').split(',') if p.strip()]\n"
+        "missing = [w for w in want if w not in known]\n"
+        "print('KNOWN', ','.join(known))\n"
+        "if missing:\n"
+        "    print('MISSING', ','.join(missing))\n"
+        "    sys.exit(3)\n"
+        "print('OK')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None  # can't conclude — let the full probe decide
+    if proc.returncode == 3:
+        lines = dict(
+            ln.split(" ", 1) for ln in proc.stdout.splitlines() if " " in ln
+        )
+        return (
+            f"JAX_PLATFORMS names unavailable platform(s)"
+            f" [{lines.get('MISSING', '?')}] — registered factories:"
+            f" [{lines.get('KNOWN', '?')}]. A platform with no PJRT"
+            " factory can never come up; fix the pin or the plugin"
+            " install instead of retrying the probe."
+        )
+    return None  # factory exists (or check itself broke) — full probe decides
+
+
 def probe_device_health(
     timeout_s: float = 60.0,
     env: Optional[dict] = None,
     require_accelerator: bool = False,
+    precheck: bool = True,
 ) -> bool:
     """Run a trivial jit in a detached subprocess; on timeout the child is
     killed and ABANDONED (a child wedged in uninterruptible device sleep
@@ -43,7 +111,11 @@ def probe_device_health(
     successful probe whose default backend is cpu.
 
     Every call records its verdict + failure reason + the child's output
-    tail (its traceback) in :func:`last_probe_detail`."""
+    tail (its traceback) in :func:`last_probe_detail`; the record carries
+    ``retryable`` — False for deterministic config errors (an unregistered
+    platform, an unknown backend) where re-probing can never help, so
+    callers with retry loops (ensure_healthy_backend, the bench ProbeLog)
+    fast-fail instead of burning their remaining timeout rounds."""
     import pathlib
     import subprocess
     import sys
@@ -52,7 +124,9 @@ def probe_device_health(
 
     global _last_probe
 
-    def _record(ok: bool, reason: str, output: str = "") -> bool:
+    def _record(
+        ok: bool, reason: str, output: str = "", retryable: bool = True
+    ) -> bool:
         global _last_probe
         tail = output.strip()
         if len(tail) > 2000:
@@ -62,8 +136,14 @@ def probe_device_health(
             "reason": reason,
             "output_tail": tail,
             "require_accelerator": require_accelerator,
+            "retryable": retryable,
         }
         return ok
+
+    if precheck:
+        unavailable = check_platform_available(env)
+        if unavailable is not None:
+            return _record(False, unavailable, retryable=False)
 
     out = tempfile.NamedTemporaryFile(mode="w+", delete=False)
     out_path = out.name
@@ -112,6 +192,9 @@ def probe_device_health(
                 " (backend crashed during import/jit — see output_tail"
                 " for the traceback)",
                 text,
+                # "Unknown backend" is jax rejecting the JAX_PLATFORMS pin
+                # itself — deterministic, retries can never succeed
+                retryable="Unknown backend" not in text,
             )
         if require_accelerator and "OK cpu" in text:
             return _record(
@@ -168,12 +251,20 @@ def ensure_healthy_backend(
             if jax.config.jax_platforms == "cpu":
                 _backend_note = "default"
                 return _backend_note
+        ok = False
         for attempt in range(max(retries, 1)):
             if attempt and retry_wait_s:
                 _time.sleep(retry_wait_s)
             if probe_device_health(timeout_s):
-                _backend_note = "default"
+                ok = True
                 break
+            detail = last_probe_detail() or {}
+            if not detail.get("retryable", True):
+                # deterministic config error (unavailable platform):
+                # further timeout rounds can never succeed — fast-fail
+                break
+        if ok:
+            _backend_note = "default"
         else:
             force_cpu_platform()
             _backend_note = "cpu-fallback (accelerator probe failed)"

@@ -146,7 +146,7 @@ def main() -> int:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from grove_tpu.ops.packing import solve_waves_device
-    from grove_tpu.parallel.sharded import make_solver_mesh
+    from grove_tpu.parallel.sharded import make_node_mesh
 
     OUT_DIR.mkdir(parents=True, exist_ok=True)
     meta = {"jax_version": jax.__version__, "programs": []}
@@ -182,9 +182,11 @@ def main() -> int:
         )
     )
 
-    # 2) the GSPMD node-sharded variant on an 8-device mesh — what
-    #    parallel.sharded.solve_stress_sharded runs (full-size shape)
-    mesh = make_solver_mesh(8)
+    # 2) the GSPMD node-sharded variant on the 1-axis 8-device node mesh —
+    #    what parallel.sharded.solve_stress_sharded runs (full-size shape;
+    #    a mesh with an idle axis miscompiles the node-axis prefix sums on
+    #    this XLA rev — see parallel/sharded.py make_node_mesh)
+    mesh = make_node_mesh(8)
     node_sh = NamedSharding(mesh, P("tp", None))
     rep = NamedSharding(mesh, P())
     shardings = (node_sh, node_sh) + (rep,) * (len(args) - 2)
@@ -202,7 +204,7 @@ def main() -> int:
                 static,
                 {
                     "shape": "10240 gangs x 5120 nodes, bench-default chunk, "
-                    "node axis sharded over mesh tp=2 (8 devices)",
+                    "node axis sharded 8-way (1-axis node mesh)",
                 },
             )
         )

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Accelerator-probe debugger (`make probe-debug`).
+
+The probe/axon path failed silently for five straight bench rounds
+(90s+60s+60s timeout triples, `JAX_PLATFORMS=axon`). This script makes it
+a first-class debug target: it runs the SAME machinery the bench and
+`ensure_healthy_backend` use — the fast platform-availability precheck,
+then the subprocess jit probe — against the REAL (un-scrubbed) process
+environment, and prints every diagnostic the probe records: verdict,
+reason, retryability, and the child's captured traceback tail.
+
+Exit codes: 0 probe healthy · 2 unhealthy but retryable (wedged/crashed
+backend — a retry might see it recover) · 3 non-retryable config error
+(JAX_PLATFORMS names a platform with no PJRT factory; fix the pin or the
+plugin install — no amount of retrying helps).
+
+Usage: python scripts/probe_debug.py [--timeout S] [--platform P] [--json]
+
+`--platform P` overrides JAX_PLATFORMS for the probed child only — e.g.
+`--platform axon` reproduces the bench-round failures from a CPU shell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# NO JAX_PLATFORMS pin here — unlike every smoke script, this one exists
+# to test the environment exactly as given (the probe children are
+# subprocesses; this parent never imports jax, so it cannot wedge)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="override JAX_PLATFORMS for the probed child (e.g. axon)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = parser.parse_args()
+
+    from grove_tpu.utils.platform import (
+        check_platform_available,
+        last_probe_detail,
+        probe_device_health,
+    )
+
+    env = dict(os.environ)
+    if args.platform is not None:
+        env["JAX_PLATFORMS"] = args.platform
+    want_accel = bool(env.get("PALLAS_AXON_POOL_IPS")) or env.get(
+        "JAX_PLATFORMS", ""
+    ) not in ("", "cpu")
+
+    report = {
+        "env": {
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", ""),
+            "axon_pool": bool(env.get("PALLAS_AXON_POOL_IPS")),
+            "XLA_FLAGS": env.get("XLA_FLAGS", ""),
+        },
+        "require_accelerator": want_accel,
+    }
+
+    t0 = time.time()
+    unavailable = check_platform_available(env)
+    report["precheck"] = {
+        "took_s": round(time.time() - t0, 1),
+        "unavailable": unavailable,
+    }
+    if unavailable is None:
+        t0 = time.time()
+        ok = probe_device_health(
+            args.timeout,
+            env=env,
+            require_accelerator=want_accel,
+            precheck=False,  # already ran it (and reported it) above
+        )
+        detail = last_probe_detail() or {}
+        report["probe"] = {
+            "ok": ok,
+            "took_s": round(time.time() - t0, 1),
+            "timeout_s": args.timeout,
+            "reason": detail.get("reason", ""),
+            "retryable": detail.get("retryable", True),
+            "output_tail": detail.get("output_tail", ""),
+        }
+        rc = 0 if ok else (2 if detail.get("retryable", True) else 3)
+    else:
+        report["probe"] = {"ok": False, "skipped": "failed precheck"}
+        rc = 3
+
+    if args.json:
+        print(json.dumps(report))
+        return rc
+    print(f"JAX_PLATFORMS={report['env']['JAX_PLATFORMS'] or '(unset)'}"
+          f"  axon_pool={report['env']['axon_pool']}"
+          f"  require_accelerator={want_accel}")
+    pre = report["precheck"]
+    if pre["unavailable"]:
+        print(f"PRECHECK FAIL ({pre['took_s']}s): {pre['unavailable']}")
+        print("verdict: NON-RETRYABLE — fix the platform pin/plugin (rc=3)")
+        return rc
+    print(f"precheck ok ({pre['took_s']}s): every pinned platform has a"
+          " registered PJRT factory")
+    probe = report["probe"]
+    if probe["ok"]:
+        print(f"PROBE OK ({probe['took_s']}s): backend healthy")
+    else:
+        print(f"PROBE FAIL ({probe['took_s']}s, timeout {args.timeout}s):"
+              f" {probe['reason']}")
+        if probe.get("output_tail"):
+            print("--- probe child output tail ---")
+            print(probe["output_tail"])
+            print("-------------------------------")
+        print(
+            "verdict: "
+            + (
+                "RETRYABLE — backend wedged or crashed; it may recover (rc=2)"
+                if probe.get("retryable", True)
+                else "NON-RETRYABLE — deterministic config error (rc=3)"
+            )
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

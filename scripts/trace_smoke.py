@@ -87,10 +87,18 @@ def check_trace(events) -> list:
 
     problems = list(validate_chrome_trace(events))
     names = {ev.get("name") for ev in events if isinstance(ev, dict)}
+    # the delta-solve path (solver/deltastate.py) replaces the from-scratch
+    # scheduler.encode with solve.delta_encode — either satisfies the
+    # encode-phase requirement, whichever path the harness ran
+    encode_span = (
+        "solve.delta_encode"
+        if "solve.delta_encode" in names
+        else "scheduler.encode"
+    )
     for required in (
         "engine.reconcile",
         "scheduler.schedule",
-        "scheduler.encode",
+        encode_span,
         "scheduler.solve",
         "scheduler.commit",
     ):
@@ -103,7 +111,7 @@ def check_trace(events) -> list:
         for ev in events
         if isinstance(ev, dict) and ev.get("name") == "scheduler.schedule"
     ]
-    for child_name in ("scheduler.encode", "scheduler.solve", "scheduler.commit"):
+    for child_name in (encode_span, "scheduler.solve", "scheduler.commit"):
         for ev in events:
             if not isinstance(ev, dict) or ev.get("name") != child_name:
                 continue

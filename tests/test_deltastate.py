@@ -1,0 +1,337 @@
+"""Incremental delta-solve state: equivalence under randomized churn.
+
+The delta state (solver/deltastate.py) exists only if the incremental
+encode is BIT-IDENTICAL to a from-scratch ``build_problem`` over the same
+store view at every solve — across binds, evictions, node flap, cordons,
+drains, quota reclaim, rolling recreates, and failovers — and only if the
+admissions that come out are bit-identical to the full solve's. These
+tests replay randomized churn storms with the scheduler's
+``delta_selfcheck`` A/B armed (every tick re-derives the problem from
+scratch and asserts tensor + result equality), plus targeted unit tests of
+the dirty masks, warm-start cache, fingerprint solve reuse, drift audit,
+and the out-of-band invalidation (GL012 registration) API.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.meta import deep_copy
+from grove_tpu.models import load_sample
+from grove_tpu.sim.harness import SimHarness
+
+NS = "default"
+
+
+def _mixed_harness(num_nodes=6, ok_sets=3, big_sets=2, selfcheck=True):
+    """Harness with an admittable mix AND a standing pending backlog (the
+    multinode sample needs slice-packed TPUs a small cluster can't give),
+    so solves keep running with real pending work every tick."""
+    h = SimHarness(num_nodes=num_nodes)
+    assert h.scheduler.delta is not None, "harness must enable delta-solve"
+    h.scheduler.delta_selfcheck = selfcheck
+    for i in range(ok_sets):
+        pcs = deep_copy(load_sample("simple"))
+        pcs.metadata.name = f"ok-{i}"
+        h.apply(pcs)
+    for i in range(big_sets):
+        pcs = deep_copy(load_sample("multinode_disaggregated"))
+        pcs.metadata.name = f"big-{i}"
+        h.apply(pcs)
+    return h
+
+
+class TestChurnStormEquivalence:
+    """The headline pin: randomized churn with the A/B selfcheck armed.
+    Any divergence between the incremental encode and a from-scratch
+    build_problem — or between the delta solve's result and the full
+    solve's — raises inside schedule_pending."""
+
+    @pytest.mark.parametrize("seed", [1, 42, 2026])
+    def test_storm_keeps_delta_bit_identical(self, seed):
+        rng = random.Random(seed)
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        sched = h.scheduler
+        n = h.cluster.nodes
+        applied = 0
+        for step in range(30):
+            roll = rng.random()
+            if roll < 0.15:
+                # arrival: a new set (sometimes admittable, sometimes not)
+                sample = "simple" if rng.random() < 0.5 else (
+                    "multinode_disaggregated"
+                )
+                pcs = deep_copy(load_sample(sample))
+                pcs.metadata.name = f"storm-{seed}-{applied}"
+                applied += 1
+                h.apply(pcs)
+            elif roll < 0.3:
+                # pod crash (breach churn: restarts, MinAvailable checks)
+                pods = h.store.list("Pod", NS)
+                if pods:
+                    p = rng.choice(sorted(pods, key=lambda p: p.metadata.name))
+                    h.cluster.fail_pod(NS, p.metadata.name)
+            elif roll < 0.45:
+                # node flap: kubelet dies, monitor walks the lifecycle
+                h.cluster.crash_node(rng.choice(n).name)
+            elif roll < 0.6:
+                for node in n:
+                    if node.crashed and rng.random() < 0.7:
+                        h.cluster.restart_node(node.name)
+            elif roll < 0.75:
+                # cordon/uncordon (topology change → full-fallback path)
+                node = rng.choice(n)
+                node.cordoned = not node.cordoned
+            elif roll < 0.85:
+                # deletion churn (binding release, gang teardown)
+                sets = h.store.list("PodCliqueSet", NS)
+                if len(sets) > 2:
+                    victim = rng.choice(
+                        sorted(sets, key=lambda s: s.metadata.name)
+                    )
+                    h.delete(victim.metadata.name)
+            elif roll < 0.95:
+                # voluntary drain / uncordon (budget-checked gang-whole
+                # eviction + trial-solve pre-placement — the PR 5 layer)
+                node = rng.choice(n)
+                if node.cordoned:
+                    h.drainer.uncordon(node.name)
+                else:
+                    h.drainer.request_drain(node.name)
+            # converge a few ticks: every solve inside runs the A/B
+            h.converge(max_ticks=rng.randrange(2, 6))
+        # let the monitor drain any remaining lifecycle work, still A/B'd
+        for node in n:
+            if h.drainer.drain_state(node.name):
+                h.drainer.uncordon(node.name)
+            node.cordoned = False
+            if node.crashed:
+                h.cluster.restart_node(node.name)
+        h.converge(max_ticks=60)
+        d = sched.delta
+        # the storm must actually have exercised the machinery
+        assert d._ticks > 30
+        assert d.full_fallbacks > 0, "cordon churn should force fallbacks"
+
+    def test_reclaim_storm_keeps_delta_bit_identical(self):
+        """Cross-queue quota-reclaim churn under the per-tick A/B: the
+        staggered 3-tenant contention scenario (sim/multitenant.py) —
+        tenant A hogs the cluster, B and C arrive and reclaim it back down
+        to deserved — runs with delta_selfcheck armed, so every reclaim
+        eviction, claimant re-admission, and queue-ordered solve is pinned
+        bit-identical to the from-scratch encode + full solve."""
+        from grove_tpu.observability.metrics import METRICS
+        from grove_tpu.sim.multitenant import build_contended_harness
+
+        before = METRICS.counters.get("quota_reclaims_total", 0)
+        h, _tenants = build_contended_harness()
+        h.scheduler.delta_selfcheck = True
+        h.converge(max_ticks=200)
+        assert (
+            METRICS.counters.get("quota_reclaims_total", 0) > before
+        ), "scenario must actually reclaim"
+        d = h.scheduler.delta
+        assert d is not None and d._ticks > 0
+
+    def test_storm_admissions_match_delta_disabled_run(self):
+        """End-to-end A/B: the same seeded scenario, delta on vs off —
+        final bindings and gang phases identical (the scheduler-level
+        'admissions bit-identical to the full solve' acceptance pin)."""
+
+        def run(enable_delta):
+            h = SimHarness(num_nodes=6)
+            if not enable_delta:
+                h.scheduler.delta = None  # from-scratch path
+            for i in range(3):
+                pcs = deep_copy(load_sample("simple"))
+                pcs.metadata.name = f"ab-{i}"
+                h.apply(pcs)
+            for i in range(2):
+                pcs = deep_copy(load_sample("multinode_disaggregated"))
+                pcs.metadata.name = f"ab-big-{i}"
+                h.apply(pcs)
+            h.converge(max_ticks=30)
+            h.cluster.fail_node("node-1")
+            h.converge(max_ticks=40)
+            bindings = dict(h.cluster.bindings)
+            phases = {
+                g.metadata.name: g.status.phase
+                for g in h.store.list("PodGang", NS)
+            }
+            return bindings, phases
+
+        assert run(True) == run(False)
+
+
+class TestDirtyMasks:
+    def test_status_only_gang_write_keeps_warm_start(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()
+        h.scheduler.schedule_pending()
+        before = d.warm_start_hits
+        # an idle tick re-runs phase/health upserts (status-only writes):
+        # cached specs must keep serving
+        h.scheduler.schedule_pending()
+        assert d.warm_start_hits > before
+
+    def test_pod_bind_dirties_only_its_node_row(self):
+        h = _mixed_harness(num_nodes=8, ok_sets=2, big_sets=0)
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        assert not d._dirty_nodes
+        # out-of-band style: pick a bound pod and delete it — the release
+        # must dirty exactly the node it was charged to
+        (ns, name), node = next(iter(h.cluster.bindings.items()))
+        h.store.delete("Pod", ns, name)
+        assert node in d._dirty_nodes
+
+    def test_free_matrix_matches_node_free_all_exactly(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        nodes = [n for n in h.cluster.nodes if n.schedulable]
+        assert d.check_drift(nodes) is False, "incremental rows drifted"
+        # and the sidecar-facing dict view reproduces node_free_all
+        oracle = h.cluster.node_free_all(nodes)
+        dicts = d.free_dicts(nodes)
+        for node in nodes:
+            for r, v in oracle[node.name].items():
+                assert dicts[node.name].get(r, 0.0) == pytest.approx(
+                    np.float32(v), abs=0
+                )
+
+    def test_topology_change_falls_back_and_clears_specs(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        assert d._specs
+        before = d.full_fallbacks
+        h.cluster.nodes[0].cordoned = True
+        h.scheduler.schedule_pending()
+        assert d.full_fallbacks == before + 1
+        met = [n for n in h.cluster.nodes if n.schedulable]
+        assert d._enc is None or len(d._enc.node_names) == len(met)
+
+    def test_flap_back_reuses_device_staged_encoding(self):
+        """A cordon/uncordon flap returns to a previously seen node
+        signature: the retired NodeEncoding (topology sort, dense ids,
+        device-staged tensors) is reused rather than rebuilt — and the
+        solve stays bit-identical (selfcheck armed throughout)."""
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()  # standing backlog → encode runs
+        enc_before = d._enc
+        assert enc_before is not None
+        h.cluster.nodes[0].cordoned = True
+        h.scheduler.schedule_pending()  # fallback 1: fresh N-1 encoding
+        assert d._enc is not enc_before
+        h.cluster.nodes[0].cordoned = False
+        before = d.full_fallbacks
+        h.scheduler.schedule_pending()  # fallback 2: flap-back, cache hit
+        assert d.full_fallbacks == before + 1
+        assert d._enc is enc_before
+        # and flapping out again reuses the retired N-1 encoding too
+        enc_cordoned = d._enc_cache
+        assert len(enc_cordoned) >= 2
+
+    def test_rebuild_bindings_epoch_invalidates_mirror(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        assert d._mirror_built
+        h.cluster.rebuild_bindings()  # out-of-band rewrite (failover path)
+        before = d.full_fallbacks
+        h.scheduler.schedule_pending()
+        assert d.full_fallbacks == before + 1
+        h.scheduler.schedule_pending()
+        assert d._mirror_built
+
+    def test_manual_invalidate_registration_api(self):
+        """GL012's sanctioned escape hatch: code that must mutate cluster-
+        tensor inputs outside the watched channels registers the mutation."""
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        d.mark_node_dirty("node-0")
+        assert "node-0" in d._dirty_nodes
+        d.mark_gang_dirty(NS, "some-gang")
+        assert (NS, "some-gang") in d._dirty_gangs
+        before = d.full_fallbacks
+        d.invalidate()
+        assert d.full_fallbacks == before + 1
+        assert not d._specs and d._enc is None
+        # next tick re-derives everything and the A/B still holds
+        h.scheduler.schedule_pending()
+
+    def test_drift_recovery_costs_exactly_one_fallback(self):
+        """A drift hit invalidates mid-refresh — but the topology did NOT
+        change, so the signature must be restored: the very next tick must
+        not misread the unchanged node set as a second fallback, and the
+        rebuilt encoding must cache under its true signature."""
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()  # backlog keeps encodes running
+        # corrupt one maintained row out-of-band, then force the audit
+        # window so refresh() detects drift THIS tick
+        d._free[0, 0] += 1.0  # type: ignore[index]
+        d._ticks = d.drift_check_every - 1
+        before_fb, before_drift = d.full_fallbacks, d.drift_detected
+        h.scheduler.schedule_pending()
+        assert d.drift_detected == before_drift + 1
+        assert d.full_fallbacks == before_fb + 1
+        assert d._node_sig is not None
+        h.scheduler.schedule_pending()  # unchanged topology: NO 2nd fallback
+        assert d.full_fallbacks == before_fb + 1
+        assert (None, tuple(d._enc.resource_names)) not in d._enc_cache
+        # and the A/B still holds after recovery
+        assert d.check_drift([n for n in h.cluster.nodes if n.schedulable]) is False
+
+
+class TestWarmStartAndReuse:
+    def test_identical_ticks_reuse_the_whole_solve(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()  # settle status writes
+        h.scheduler.schedule_pending()
+        before = d.solve_reuses
+        h.scheduler.schedule_pending()
+        h.scheduler.schedule_pending()
+        assert d.solve_reuses >= before + 2, (
+            "identical pending backlog must skip the device dispatch"
+        )
+
+    def test_pod_delta_breaks_the_reuse_fingerprint(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()
+        h.scheduler.schedule_pending()
+        reuses = d.solve_reuses
+        # real churn: a pod eviction changes both a node row and its gang
+        (ns, name), _node = next(iter(h.cluster.bindings.items()))
+        h.store.delete("Pod", ns, name)
+        h.scheduler.schedule_pending()
+        assert d.solve_reuses == reuses, "changed input must re-solve"
+
+    def test_spec_cache_misses_on_pending_set_change(self):
+        h = _mixed_harness()
+        h.converge(max_ticks=40)
+        d = h.scheduler.delta
+        h.scheduler.schedule_pending()
+        # a CLEAN cached spec (dirty entries are pending invalidations for
+        # gangs currently held in requeue backoff — they miss by design)
+        key = next(k for k in d._specs if k not in d._dirty_gangs)
+        entry = d._specs[key]
+        pendlike = [
+            type("P", (), {"metadata": type("M", (), {"name": n})()})()
+            for n in entry["names"]
+        ]
+        assert d.cached_spec(key[0], key[1], pendlike) is not None
+        assert d.cached_spec(key[0], key[1], pendlike[:-1]) is None

@@ -303,8 +303,15 @@ class TestReclaim:
         assert report["within_one_gang"], report
 
     def test_reclaim_happened_and_is_counted(self, contended):
+        # report["reclaims"] is already a delta vs run_contended's own
+        # baseline (earlier tests in the process legitimately bump the
+        # global counter — e.g. the delta-solve reclaim storm)
         _, report, saved, _ = contended
-        assert report["reclaims"] > saved
+        assert report["reclaims"] > 0
+        assert (
+            METRICS.counters.get("quota_reclaims_total", 0)
+            >= saved + report["reclaims"]
+        )
 
     def test_quota_reclaim_event_names_victim_and_claimant(self, contended):
         """PR 1 event-namespace convention: the event is recorded on the
