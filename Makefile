@@ -9,7 +9,7 @@ IMAGE ?= grove-tpu:0.2.0
 .PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
-        probe-debug dryrun docker-build compose-up clean
+        scale-smoke probe-debug dryrun docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -19,13 +19,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint      ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance
+check: lint scale-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL010) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL013) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -57,8 +57,9 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail): catches schedule-dependent regressions the single-seed smoke misses
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7
+	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 $(PY) scripts/chaos_smoke.py --seeds 7 --cp-crash-seed 7
 
 recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tail (prints replayed records + recovery wall time), acked-prefix audit, inert WAL A/B
 	$(CPU_ENV) $(PY) scripts/recovery_smoke.py
@@ -68,6 +69,9 @@ drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node d
 
 delta-smoke:     ## incremental delta-solve smoke: churn loop with the per-tick A/B selfcheck armed (delta problem + admissions bit-identical to the from-scratch solve), warm-start/reuse/fallback counters printed against floors
 	$(CPU_ENV) $(PY) scripts/delta_smoke.py
+
+scale-smoke:     ## sharded control-plane smoke: small-S multi-tenant converge with cross-shard spread, S=1 inert A/B (identical content/reconciles/rv), per-shard WAL crash-recover + acked-prefix audit across shard dirs
+	$(CPU_ENV) $(PY) scripts/scale_smoke.py
 
 probe-debug:     ## accelerator-probe debugger: availability precheck + subprocess jit probe against the REAL env (no CPU scrub), full child traceback printed; rc 0 healthy / 2 retryable / 3 config error
 	$(PY) scripts/probe_debug.py

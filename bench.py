@@ -454,7 +454,29 @@ def _quota_artifact() -> dict:
     return report
 
 
-def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
+def _scale_artifact_block(n_sets: int, scale_shape) -> dict:
+    """Sharded control-plane block (docs/control-plane.md): the 10×-shape
+    multi-tenant converge with the keyspace-sharded store — µs/reconcile,
+    solver share, the level-2 fold-depth histogram, per-shard census —
+    plus the S=1 inert A/B. Full-size integrated runs default to the
+    ROADMAP's 100k nodes / 500k pods; smoke shapes scale the block down
+    proportionally so cp-bench-smoke stays seconds."""
+    from grove_tpu.sim.scale import scale_artifact
+
+    if scale_shape is not None:
+        sc_sets, sc_nodes, sc_shards = scale_shape
+    elif n_sets >= 10240:
+        sc_sets, sc_nodes, sc_shards = 62_500, 100_000, 8
+    else:
+        sc_sets, sc_nodes, sc_shards = max(n_sets // 2, 32), max(n_sets // 2, 32), 4
+    return scale_artifact(
+        n_sets=sc_sets, n_nodes=sc_nodes, num_shards=sc_shards
+    )
+
+
+def integrated_stress_bench(
+    n_sets: int, n_nodes: int, scale_shape=None
+) -> None:
     """ONE run exercising the full stack at reference scale (round-4 VERDICT
     missing #3): a BASELINE-shaped population — n_sets PodCliqueSets, 1
     PodGang each, mixed scaling-group/standalone — flows through admission,
@@ -539,6 +561,10 @@ def integrated_stress_bench(n_sets: int, n_nodes: int) -> None:
             # rule counts + suppression inventory over the exact tree
             # this artifact was produced from
             "lint": _lint_artifact_block(),
+            # sharded control-plane block (docs/control-plane.md): the
+            # keyspace-sharded store at the ROADMAP's 10× shape, with the
+            # fold-depth histogram and the S=1 inert A/B
+            "scale": _scale_artifact_block(n_sets, scale_shape),
             # delta-solve block LAST: it churns the main harness (the
             # other blocks run isolated harnesses, and the headline
             # convergence metrics above were already computed), measuring
@@ -592,6 +618,12 @@ def main() -> None:
         help="cluster size for --control-plane (default 512) / "
         "--integrated (default 5120, or 1024 with --small)",
     )
+    parser.add_argument(
+        "--scale-shape", type=str, default=None, metavar="SETS,NODES,SHARDS",
+        help="override the integrated artifact's \"scale\" block shape"
+        " (default: 62500,100000,8 — 500k pods — on full-size runs, a"
+        " proportional mini shape otherwise)",
+    )
     args = parser.parse_args()
 
     if args.integrated:
@@ -599,9 +631,26 @@ def main() -> None:
 
         force_cpu_platform()
         d_sets, d_nodes = (1280, 1024) if args.small else (10240, 5120)
+        scale_shape = None
+        if args.scale_shape:
+            # validate BEFORE the multi-hour converge: a malformed shape
+            # must fail here, not when the artifact assembles at the end
+            parts = args.scale_shape.split(",")
+            if len(parts) != 3:
+                parser.error(
+                    "--scale-shape needs exactly SETS,NODES,SHARDS, got"
+                    f" {args.scale_shape!r}"
+                )
+            try:
+                scale_shape = tuple(int(x) for x in parts)
+            except ValueError:
+                parser.error(
+                    f"--scale-shape fields must be integers: {args.scale_shape!r}"
+                )
         integrated_stress_bench(
             d_sets if args.sets is None else args.sets,
             d_nodes if args.nodes is None else args.nodes,
+            scale_shape=scale_shape,
         )
         return
 

@@ -11,6 +11,7 @@ from grove_tpu.analysis.rules.scheduling import (
     BrokerGrantRule,
     SchedulableMaskRule,
 )
+from grove_tpu.analysis.rules.shardrules import ShardInternalsRule
 from grove_tpu.analysis.rules.storepath import (
     StoreLoggedCommitRule,
     StoreWritePathRule,
@@ -29,4 +30,5 @@ ALL_RULES = (
     WireRoundTripRule,  # GL010
     StoreLoggedCommitRule,  # GL011
     DirtyMaskRegistrationRule,  # GL012
+    ShardInternalsRule,  # GL013
 )

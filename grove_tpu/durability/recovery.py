@@ -23,6 +23,7 @@ prefix from disk and demands the recovered store match it exactly.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from contextlib import nullcontext
@@ -36,7 +37,9 @@ from grove_tpu.durability.wal import (
     apply_record,
     decode_envelope,
     list_segments,
+    list_shard_dirs,
     replay,
+    shard_dir_name,
 )
 from grove_tpu.observability.events import (
     EVENTS,
@@ -48,6 +51,7 @@ from grove_tpu.observability.events import (
 )
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.observability.tracing import TRACER
+from grove_tpu.runtime.errors import ERR_CONFLICT, GroveError
 
 # the EVENTS ref durability events attach to: the store has no CR of its
 # own (it IS the apiserver), so the recorder gets a synthetic singleton
@@ -94,33 +98,95 @@ def recover_store(
     report = RecoveryReport()
     t0 = time.perf_counter()
     with TRACER.span("recovery.replay", directory=directory) as span:
-        snap = load_latest_snapshot(directory)
+        # sharded layout probe (docs/control-plane.md): per-shard WAL dirs
+        # mean a sharded store wrote this directory — recover each shard's
+        # self-contained stream and merge; the dir count fixes the shard
+        # count (the keyspace map is deterministic, so every object lands
+        # back on the shard whose stream carried it). A dir with segments
+        # or a snapshot directly inside is the legacy unsharded layout and
+        # pins S=1 whatever the ambient knob says (the disk wins). A dir
+        # with NEITHER is a first boot: nothing on disk constrains the
+        # shape, so the store follows the configured shard count
+        # (GROVE_TPU_STORE_SHARDS) — the real-cluster operator boots
+        # through recovery even on an empty data dir, and pinning S=1
+        # there would silently disable sharding forever.
+        from grove_tpu.durability.snapshot import list_snapshots
+
+        shard_dirs = list_shard_dirs(directory)
+        # existence probe only (filename scan) — loading the snapshot here
+        # would CRC-parse the whole store state twice per recovery
+        legacy_layout = bool(list_segments(directory)) or bool(
+            list_snapshots(directory)
+        )
+        if shard_dirs:
+            num_shards = shard_dirs[-1][0] + 1
+            if len(shard_dirs) != num_shards:
+                # a GAP in the shard-NNN sequence means a shard's whole
+                # stream is gone (partial copy, external deletion) —
+                # recovering it as "empty" would silently drop its acked
+                # commits and the audit could never see them
+                present = [i for i, _ in shard_dirs]
+                raise GroveError(
+                    ERR_CONFLICT,
+                    f"per-shard WAL layout has gaps: dirs {present} imply"
+                    f" {num_shards} shards but only {len(shard_dirs)}"
+                    " stream(s) are on disk — refusing to recover with a"
+                    " missing shard stream",
+                    "recover",
+                )
+            streams = shard_dirs
+        elif legacy_layout:
+            num_shards = 1
+            streams = [(0, directory)]
+        else:
+            # first boot: env-/default-configured shape (num_shards=None →
+            # the Store constructor's GROVE_TPU_STORE_SHARDS default), no
+            # streams to read
+            num_shards = None
+            streams = []
         state: dict = {}
-        max_rv = 0
-        min_segment = -1
-        if snap is not None:
-            report.snapshot_rv = snap["rv"]
-            max_rv = snap["rv"]
-            min_segment = snap.get("wal_seg", -1)
-            for env in snap["objects"]:
-                state[(env["kind"], env["ns"], env["name"])] = env
-        records, torn, _truncated = replay(directory, min_segment=min_segment)
-        report.torn_tail = torn
-        report.replayed_records = len(records)
-        for rec in records:
-            max_rv = max(max_rv, rec.rv)
-            apply_record(state, rec)
-        store = Store(clock, cache_lag=cache_lag)
+        shard_rvs: dict = {}
+        for shard_idx, stream_dir in streams:
+            snap = load_latest_snapshot(stream_dir)
+            max_rv = 0
+            min_segment = -1
+            if snap is not None:
+                # scalar report field follows the store's merge rule:
+                # per-shard watermarks SUM to the store-level rv
+                report.snapshot_rv += snap["rv"]
+                max_rv = snap["rv"]
+                min_segment = snap.get("wal_seg", -1)
+                for env in snap["objects"]:
+                    state[(env["kind"], env["ns"], env["name"])] = env
+            records, torn, _truncated = replay(
+                stream_dir, min_segment=min_segment
+            )
+            report.torn_tail = report.torn_tail or torn
+            report.replayed_records += len(records)
+            for rec in records:
+                max_rv = max(max_rv, rec.rv)
+                apply_record(state, rec)
+            shard_rvs[shard_idx] = max_rv
+        torn = report.torn_tail
+        store = Store(clock, cache_lag=cache_lag, num_shards=num_shards)
+        rv_vector = [
+            shard_rvs.get(i, 0) for i in range(store.num_shards)
+        ]
         objects = [
             decode_envelope(env)
             for _key, env in sorted(state.items())
             if env is not None
         ]
-        report.restored_objects = store.restore_objects(objects, rv=max_rv)
+        report.restored_objects = store.restore_objects(
+            objects,
+            rv=rv_vector[0],
+            rv_vector=tuple(rv_vector) if store.num_shards > 1 else None,
+        )
         report.resource_version = store.resource_version
         span.set("replayed", report.replayed_records)
         span.set("restored", report.restored_objects)
         span.set("torn_tail", torn)
+        span.set("shards", store.num_shards)
     report.wall_seconds = time.perf_counter() - t0
     METRICS.observe("recovery_seconds", report.wall_seconds)
     METRICS.set("recovery_replayed_records", report.replayed_records)
@@ -156,24 +222,53 @@ def verify_acked_prefix(directory: str, store) -> List[str]:
     runs ahead of the log's unflushed buffer."""
     problems: List[str] = []
     seen = set()
-    durable_rv = 0
-    for key, env in _iter_durable_state(directory):
-        kind, ns, name = key
-        if env is None:
-            continue  # durably deleted: absence is checked via `seen`
-        seen.add(key)
-        durable_rv = max(durable_rv, env["rv"])
-        obj = store.get(kind, ns, name, readonly=True)
-        if obj is None:
+    shard_dirs = list_shard_dirs(directory)
+    streams = shard_dirs if shard_dirs else [(None, directory)]
+    if shard_dirs:
+        present = [i for i, _ in shard_dirs]
+        if present != list(range(getattr(store, "num_shards", 1))):
+            # covers both count mismatch and a GAP in the sequence (a
+            # missing stream means lost acked commits the per-stream scan
+            # below could never see)
             problems.append(
-                f"acked commit lost: {kind} {ns}/{name} rv {env['rv']}"
-                " is durable on disk but missing from the recovered store"
+                f"per-shard WAL layout mismatch: dirs {present} on disk,"
+                f" store has {getattr(store, 'num_shards', 1)} shard(s)"
             )
-        elif obj.metadata.resource_version != env["rv"]:
+            return problems
+    for shard_idx, stream_dir in streams:
+        where = "" if shard_idx is None else f" (shard {shard_idx})"
+        durable_rv = 0
+        for key, env in _iter_durable_state(stream_dir):
+            kind, ns, name = key
+            if env is None:
+                continue  # durably deleted: absence is checked via `seen`
+            seen.add(key)
+            durable_rv = max(durable_rv, env["rv"])
+            obj = store.get(kind, ns, name, readonly=True)
+            if obj is None:
+                problems.append(
+                    f"acked commit lost: {kind} {ns}/{name} rv {env['rv']}"
+                    " is durable on disk but missing from the recovered"
+                    f" store{where}"
+                )
+            elif obj.metadata.resource_version != env["rv"]:
+                problems.append(
+                    f"acked commit diverged: {kind} {ns}/{name} recovered at"
+                    f" rv {obj.metadata.resource_version}, durable rv is"
+                    f" {env['rv']}{where}"
+                )
+        # monotonicity per rv sequence: one scalar for the unsharded
+        # store, each shard's own watermark when sharded (the scalar sum
+        # would mask a single shard's regression)
+        watermark = (
+            store.resource_version
+            if shard_idx is None
+            else store.shard_resource_version(shard_idx)
+        )
+        if watermark < durable_rv:
             problems.append(
-                f"acked commit diverged: {kind} {ns}/{name} recovered at"
-                f" rv {obj.metadata.resource_version}, durable rv is"
-                f" {env['rv']}"
+                f"resourceVersion regressed{where}: store at {watermark},"
+                f" durable watermark {durable_rv}"
             )
     for kind in store.kinds():
         if kind == "Event":
@@ -186,11 +281,6 @@ def verify_acked_prefix(directory: str, store) -> List[str]:
                     f" {key[1]}/{key[2]} is in the store but not in the"
                     " durable prefix"
                 )
-    if store.resource_version < durable_rv:
-        problems.append(
-            f"resourceVersion regressed: store at {store.resource_version},"
-            f" durable watermark {durable_rv}"
-        )
     return problems
 
 
@@ -214,9 +304,31 @@ class StoreDurability:
     ) -> None:
         self.store = store
         self.directory = directory
-        self.wal = WriteAheadLog(
-            directory, segment_max_bytes=segment_max_bytes
-        )
+        # sharded stores (docs/control-plane.md) get one self-contained
+        # WAL stream PER KEYSPACE SHARD, each subscribed to that shard's
+        # fan-out (never filtering — or waiting on — another shard's
+        # traffic) and writing its own shard-NNN/ subdirectory. The
+        # unsharded store keeps the single WAL in `directory` itself:
+        # S=1 is byte-identical on disk and over the wire.
+        self.num_shards = max(1, getattr(store, "num_shards", 1))
+        if self.num_shards > 1:
+            self.wals = [
+                WriteAheadLog(
+                    os.path.join(directory, shard_dir_name(i)),
+                    segment_max_bytes=segment_max_bytes,
+                )
+                for i in range(self.num_shards)
+            ]
+            for i, wal in enumerate(self.wals):
+                store.subscribe_system(wal.note_event, shard=i)
+        else:
+            self.wals = [
+                WriteAheadLog(directory, segment_max_bytes=segment_max_bytes)
+            ]
+            store.subscribe_system(self.wals[0].note_event)
+        # `wal` stays the single-stream handle (the whole pre-sharding
+        # API; shard 0 when sharded — chaos knob tweaks and stats read it)
+        self.wal = self.wals[0]
         self.snapshot_every_bytes = snapshot_every_bytes
         # external serialization for the snapshot's store scan (the
         # embedded apiserver's request lock in threaded real-cluster mode;
@@ -226,16 +338,19 @@ class StoreDurability:
         self.snapshots_taken = 0
         self._committer: Optional[threading.Thread] = None
         self._committer_stop: Optional[threading.Event] = None
-        store.subscribe_system(self.wal.note_event)
 
     # -- committer --------------------------------------------------------
 
     def pump(self) -> int:
-        """One group-commit round: flush (fsync) the buffered batch, then
-        snapshot + truncate when due. Returns records made durable."""
-        flushed = self.wal.flush()
+        """One group-commit round: flush (fsync) the buffered batch of
+        every shard stream, then snapshot + truncate when due. Returns
+        records made durable."""
+        flushed = 0
+        for wal in self.wals:
+            flushed += wal.flush()
         if (
-            self.wal.flushed_bytes - self._flushed_at_last_snapshot
+            sum(w.flushed_bytes for w in self.wals)
+            - self._flushed_at_last_snapshot
             >= self.snapshot_every_bytes
         ):
             self.snapshot()
@@ -243,11 +358,21 @@ class StoreDurability:
 
     def snapshot(self) -> str:
         """Snapshot now (scan serialized against concurrent writers when a
-        store lock was provided) and truncate the covered WAL segments."""
+        store lock was provided) and truncate the covered WAL segments.
+        Sharded: one snapshot per shard stream, each covering exactly its
+        shard's objects at the shard's own rv watermark."""
         with self._store_lock if self._store_lock is not None else nullcontext():
-            path = write_snapshot(self.directory, self.store, self.wal)
+            if self.num_shards > 1:
+                for i, wal in enumerate(self.wals):
+                    path = write_snapshot(
+                        wal.directory, self.store, wal, shard=i
+                    )
+            else:
+                path = write_snapshot(self.directory, self.store, self.wal)
             rv = self.store.resource_version
-        self._flushed_at_last_snapshot = self.wal.flushed_bytes
+        self._flushed_at_last_snapshot = sum(
+            w.flushed_bytes for w in self.wals
+        )
         self.snapshots_taken += 1
         EVENTS.record(
             _STORE_REF,
@@ -286,7 +411,8 @@ class StoreDurability:
 
     def close(self) -> None:
         self.stop_committer()
-        self.wal.close()
+        for wal in self.wals:
+            wal.close()
 
     # -- crash simulation -------------------------------------------------
 
@@ -297,8 +423,15 @@ class StoreDurability:
         # kill the WAL first: _dead turns any in-flight or final committer
         # pump into a no-op, so the thread cannot flush the buffer we are
         # about to lose (its shutdown path drains the buffer on purpose —
-        # that drain models a CLEAN stop, not a crash)
-        lost = self.wal.simulate_crash(torn_tail_bytes=torn_tail_bytes)
+        # that drain models a CLEAN stop, not a crash). Sharded: every
+        # stream dies with the one process; the torn frame lands on shard
+        # 0's stream (always carries traffic — cluster-scoped keys pin
+        # there), the others crash with clean tails.
+        lost = 0
+        for i, wal in enumerate(self.wals):
+            lost += wal.simulate_crash(
+                torn_tail_bytes=torn_tail_bytes if i == 0 else 0
+            )
         if self._committer is not None:
             self._committer_stop.set()
             self._committer.join(timeout=5.0)
@@ -309,11 +442,16 @@ class StoreDurability:
     # -- stats ------------------------------------------------------------
 
     def stats(self) -> dict:
+        # scalar durable_rv follows the store's rv merge rule (per-shard
+        # watermarks sum); at S=1 both forms collapse to the legacy scalar
         return {
-            "durable_rv": self.wal.durable_rv,
-            "flushed_records": self.wal.flushed_records,
-            "flushed_bytes": self.wal.flushed_bytes,
-            "pending_records": self.wal.pending(),
-            "segments_on_disk": len(list_segments(self.directory)),
+            "durable_rv": sum(w.durable_rv for w in self.wals),
+            "flushed_records": sum(w.flushed_records for w in self.wals),
+            "flushed_bytes": sum(w.flushed_bytes for w in self.wals),
+            "pending_records": sum(w.pending() for w in self.wals),
+            "segments_on_disk": sum(
+                len(list_segments(w.directory)) for w in self.wals
+            ),
             "snapshots_taken": self.snapshots_taken,
+            "shards": self.num_shards,
         }

@@ -57,7 +57,12 @@ def list_snapshots(directory: str) -> List[Tuple[int, str]]:
     return out
 
 
-def write_snapshot(directory: str, store, wal: Optional[WriteAheadLog] = None) -> str:
+def write_snapshot(
+    directory: str,
+    store,
+    wal: Optional[WriteAheadLog] = None,
+    shard: Optional[int] = None,
+) -> str:
     """Snapshot the store's committed state and truncate the WAL behind it.
 
     Ordering: flush + cut the WAL segment FIRST, so every record covered
@@ -65,18 +70,33 @@ def write_snapshot(directory: str, store, wal: Optional[WriteAheadLog] = None) -
     atomically; only then delete the covered segments and older
     snapshots. A crash between any two steps leaves a recoverable
     directory (at worst both the snapshot and the log cover the same
-    records — replay is idempotent last-write-wins)."""
+    records — replay is idempotent last-write-wins).
+
+    With ``shard=k`` (sharded stores, docs/control-plane.md) the snapshot
+    covers ONE keyspace shard — its objects via the store's per-shard
+    scan, its rv watermark from the shard's own sequence — and lands in
+    that shard's WAL directory: each shard's stream stays a
+    self-contained single-writer WAL+snapshot pair, recovered and merged
+    by ``recover_store``."""
     closed_through = wal.cut_segment() if wal is not None else -1
     objects = []
-    for kind in store.kinds():
+    kinds = store.kinds() if shard is None else store.shard_kinds(shard)
+    for kind in kinds:
         if kind == "Event":
             # fire-and-forget Events are outside the durability contract
             # (the WAL skips them; real etcd TTLs them away) — a snapshot
             # that carried them would resurrect stale Events on recovery
             continue
-        for obj in store.scan(kind):
+        scan = (
+            store.scan(kind) if shard is None else store.shard_scan(shard, kind)
+        )
+        for obj in scan:
             objects.append(object_envelope(obj))
-    rv = store.resource_version
+    rv = (
+        store.resource_version
+        if shard is None
+        else store.shard_resource_version(shard)
+    )
     # "wal_seg": the last WAL segment this snapshot covers — replay resumes
     # at the NEXT segment. Positional, not rv-based: delete records carry
     # the deleted object's (old) resourceVersion, so an rv cut would drop

@@ -80,6 +80,39 @@ _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 SEGMENT_PREFIX = "wal-"
 SEGMENT_SUFFIX = ".seg"
 
+# per-shard WAL layout (docs/control-plane.md): a sharded store's
+# durability directory holds one subdirectory per keyspace shard, each a
+# complete single-writer WAL+snapshot stream for that shard's slice.
+# The UNSHARDED layout (segments directly in the directory) is untouched
+# — S=1 stays byte-identical on disk.
+SHARD_DIR_PREFIX = "shard-"
+
+
+def shard_dir_name(index: int) -> str:
+    return f"{SHARD_DIR_PREFIX}{index:03d}"
+
+
+def list_shard_dirs(directory: str) -> List[Tuple[int, str]]:
+    """(shard index, absolute path) of every per-shard WAL dir, ordered.
+    Empty for an unsharded layout — the caller's sharded/unsharded probe."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(SHARD_DIR_PREFIX):
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            out.append((int(name[len(SHARD_DIR_PREFIX):]), path))
+        except ValueError:
+            continue
+    out.sort()
+    return out
+
 
 def _segment_name(index: int) -> str:
     return f"{SEGMENT_PREFIX}{index:08d}{SEGMENT_SUFFIX}"

@@ -64,7 +64,14 @@ class QuotaManager:
         sub = getattr(store, "subscribe_system", None)
         self._incremental = sub is not None
         if self._incremental:
-            sub(self.accountant.on_event)
+            # sharded stores (docs/control-plane.md): ride the per-shard
+            # fan-out — a pod's events never straddle shards (its
+            # namespace pins its shard), so the per-queue fold stays exact
+            per_shard = getattr(store, "subscribe_system_per_shard", None)
+            if per_shard is not None and getattr(store, "num_shards", 1) > 1:
+                per_shard(self.accountant.on_event)
+            else:
+                sub(self.accountant.on_event)
         # last ordering pass's per-queue rows (status writes / gauges)
         self.last_rows: List[dict] = []
         # sticky tensor padding (StickyGroupPad ethos): queue churn and

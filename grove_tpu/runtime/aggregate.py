@@ -103,10 +103,16 @@ class PodAggregate:
     are always exactly the full-rescan answer for that view.
     """
 
-    __slots__ = ("_rows",)
+    __slots__ = ("_rows", "grand_total", "grand_ready")
 
     def __init__(self) -> None:
         self._rows: Dict[Tuple[str, str], PodCounters] = {}
+        # this view's (total, ready) pod partial — the LEAF of the
+        # hierarchical shard fold (runtime/shards.py ShardSummaryTree):
+        # maintained here because _fold already computed the features, so
+        # the level-1 cost is two int adds per event
+        self.grand_total = 0
+        self.grand_ready = 0
 
     def counters(self, namespace: str, pclq_name: str) -> PodCounters:
         return self._rows.get((namespace, pclq_name), EMPTY_COUNTERS)
@@ -114,11 +120,16 @@ class PodAggregate:
     # -- maintenance (Store-internal) ------------------------------------
 
     def _fold(self, pod, sign: int) -> None:
-        pclq = pod.metadata.labels.get(namegen.LABEL_PODCLIQUE)
-        if pclq is None:
-            return
         feats = pod_features(pod)
         if feats is None:
+            return
+        # view-wide partial first: EVERY live pod counts toward the shard
+        # leaf (clique-labeled or not), so the hierarchical summary equals
+        # a full non-terminating-pod rescan
+        self.grand_total += sign * feats[0]
+        self.grand_ready += sign * feats[1]
+        pclq = pod.metadata.labels.get(namegen.LABEL_PODCLIQUE)
+        if pclq is None:
             return
         key = (pod.metadata.namespace, pclq)
         row = self._rows.get(key)
@@ -156,5 +167,7 @@ class PodAggregate:
     def rebuild(self, pods) -> None:
         """Recompute from scratch (full informer resync)."""
         self._rows.clear()
+        self.grand_total = 0
+        self.grand_ready = 0
         for pod in pods:
             self._fold(pod, +1)

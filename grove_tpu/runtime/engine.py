@@ -72,10 +72,19 @@ class Engine:
         self.store = store
         self.clock = clock or store.clock
         self.controllers: List[Controller] = []
+        # keyspace sharding (runtime/shards.py, docs/control-plane.md):
+        # one event backlog per store shard, routed on WatchEvent.shard,
+        # drained deterministic-round-robin so one busy tenant's shard
+        # cannot head-of-line-block the others' reconcile traffic. At S=1
+        # (non-sharded stores, HttpStore) there is ONE backlog and the
+        # subscription appends to it directly — the historical layout.
+        self.num_shards = max(1, getattr(store, "num_shards", 1))
         # deque + popleft-drain: watch THREADS append concurrently in
         # cluster mode, and a list snapshot-then-clear would silently drop
         # events appended in between (deque.append/popleft are atomic)
-        self._event_backlog = deque()
+        self._backlogs = [deque() for _ in range(self.num_shards)]
+        self._event_backlog = self._backlogs[0]  # S=1 alias (tests poke it)
+        self._backlog_rotation = 0
         self.held_kinds: set = set()
         self._pool = None  # lazy engine-lifetime reconcile thread pool
         # per-kind routing table (built lazily after registration): an event
@@ -84,9 +93,25 @@ class Engine:
         # (hundreds of thousands of events) the miss checks dominated
         # _route_events
         self._dispatch = None
-        store.subscribe(self._event_backlog.append)
+        if self.num_shards == 1:
+            store.subscribe(self._event_backlog.append)
+        else:
+            store.subscribe(self._enqueue_sharded)
+
+    def _enqueue_sharded(self, ev: WatchEvent) -> None:
+        # WatchEvent.shard is stamped by the store's _emit — no re-hash
+        self._backlogs[ev.shard].append(ev)
 
     def register(self, controller: Controller) -> None:
+        if self.num_shards > 1 and controller.queue.num_shards == 1:
+            # give the controller a shard-bucketed ready set (same backoff
+            # curve) so one shard's hot keys round-robin against the rest;
+            # registration happens before any traffic, so nothing to carry
+            controller.queue = WorkQueue(
+                base_backoff=controller.queue.base_backoff,
+                max_backoff=controller.queue.max_backoff,
+                num_shards=self.num_shards,
+            )
         self.controllers.append(controller)
         self._dispatch = None  # rebuilt on next routing
 
@@ -128,12 +153,14 @@ class Engine:
         drains, so its backlog would grow without bound; standbys drop and
         the fresh leader does a full `requeue_all` resync instead."""
         n = 0
-        while True:
-            try:
-                self._event_backlog.popleft()
-            except IndexError:
-                return n
-            n += 1
+        for backlog in self._backlogs:
+            while True:
+                try:
+                    backlog.popleft()
+                except IndexError:
+                    break
+                n += 1
+        return n
 
     def requeue_all(self) -> None:
         """Enqueue every live object of every controller's kind — the
@@ -145,15 +172,36 @@ class Engine:
                     (ctrl.kind, obj.metadata.namespace, obj.metadata.name)
                 )
 
+    def _next_event(self) -> Optional[WatchEvent]:
+        """Pop the next backlog event. S=1: plain popleft. Sharded:
+        deterministic round-robin over the per-shard backlogs — the
+        rotation pointer advances past each served shard, so every
+        non-empty shard gets a turn per cycle (seeded-reproducible under
+        the sim's virtual clock: the schedule depends only on event
+        arrival order, never on wall time or hashing)."""
+        if self.num_shards == 1:
+            try:
+                return self._event_backlog.popleft()
+            except IndexError:
+                return None
+        for off in range(self.num_shards):
+            idx = (self._backlog_rotation + off) % self.num_shards
+            try:
+                ev = self._backlogs[idx].popleft()
+            except IndexError:
+                continue
+            self._backlog_rotation = (idx + 1) % self.num_shards
+            return ev
+        return None
+
     def _route_events(self) -> None:
         # Drain via popleft until empty: reconciles (and concurrent watch
         # threads) emit new events while we iterate; popping one at a time
         # can never lose a concurrent append.
         remaining: List[WatchEvent] = []
         while True:
-            try:
-                ev = self._event_backlog.popleft()
-            except IndexError:
+            ev = self._next_event()
+            if ev is None:
                 break
             if ev.kind in self.held_kinds:
                 remaining.append(ev)
@@ -185,7 +233,9 @@ class Engine:
                     METRICS.inc(metric, len(hits))
                 for ns, name in hits:
                     ctrl.queue.add((ctrl.kind, ns, name))
-        self._event_backlog.extend(remaining)
+        for ev in remaining:
+            # held events return to their owning shard's backlog
+            self._backlogs[ev.shard if self.num_shards > 1 else 0].append(ev)
 
     # -- run loop --------------------------------------------------------
 
@@ -262,6 +312,11 @@ class Engine:
                         span.end()
             for ctrl in self.controllers:
                 METRICS.set(f"workqueue_depth/{ctrl.name}", len(ctrl.queue))
+            if self.num_shards > 1:
+                # per-shard backlog depth: a hot tenant's shard shows up
+                # here while the rotation keeps the others draining
+                for idx, backlog in enumerate(self._backlogs):
+                    METRICS.set(f"engine_shard_backlog/{idx}", len(backlog))
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()

@@ -14,18 +14,27 @@ controllers rely on:
   race the reference's expectations store exists to absorb
   (expect/expectations.go:33-50). Tests run the controllers in lagged mode so
   those races can't hide.
+- optional *keyspace sharding* (`num_shards`/`GROVE_TPU_STORE_SHARDS`,
+  docs/control-plane.md): namespaces hash onto S shards
+  (runtime/shards.py), each with its own object maps, indices, lock,
+  resourceVersion sequence, watch fan-out and (when durability is
+  attached) WAL segment stream. The router below preserves the exact
+  Store API; cross-shard `list()`/`scan()` merge per the documented
+  rv-vector rule. S=1 is the degenerate case and is provably
+  byte-identical to the historical unsharded store (tests/test_shards.py
+  pins the A/B).
 """
 
 from __future__ import annotations
 
 import copy as _copy
+import os
 import pickle
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from grove_tpu.api.meta import deep_copy, next_uid
-from grove_tpu.runtime.aggregate import PodAggregate
 from grove_tpu.runtime.clock import Clock
 from grove_tpu.runtime.errors import (
     ERR_CONFLICT,
@@ -33,6 +42,7 @@ from grove_tpu.runtime.errors import (
     ERR_NOT_FOUND,
     GroveError,
 )
+from grove_tpu.runtime.shards import ShardSummaryTree, StoreShard, shard_of
 
 ADDED = "Added"
 MODIFIED = "Modified"
@@ -79,6 +89,10 @@ class WatchEvent:
     # so watch predicates can gate on actual state TRANSITIONS
     # (reference register.go predicate.Funcs UpdateFunc(old, new))
     old: Optional[object] = field(default=None, repr=False, compare=False)
+    # owning keyspace shard (runtime/shards.py) — consumers that keep
+    # per-shard buffers (the engine's per-shard backlogs) route on this
+    # instead of re-hashing the namespace per event
+    shard: int = field(default=0, repr=False, compare=False)
 
     def materialize(self):
         """Private deep copy of the event payload (cheap: pre-pickled)."""
@@ -180,31 +194,34 @@ def matches_labels(obj, selector: Optional[Dict[str, str]]) -> bool:
 
 
 class Store:
-    def __init__(self, clock: Optional[Clock] = None, cache_lag: bool = False) -> None:
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        cache_lag: bool = False,
+        num_shards: Optional[int] = None,
+    ) -> None:
         self.clock = clock or Clock()
         self.cache_lag = cache_lag
-        self._committed: Dict[str, Dict[str, object]] = {}
-        self._cache: Dict[str, Dict[str, object]] = {}
-        # canonical pickled form per committed/cached object, computed once
-        # per write: reads materialize with ONE pickle.loads instead of a
-        # dumps+loads round trip (the control plane's hottest path).
-        # Committed objects are IMMUTABLE once stored — every write commits
-        # a fresh object — so blobs never go stale.
-        self._blob: Dict[str, Dict[str, bytes]] = {}
-        self._cache_blob: Dict[str, Dict[str, bytes]] = {}
-        # kind -> (label_key, label_value) -> set of object keys
-        self._index: Dict[str, Dict[tuple, set]] = {}
-        self._cache_index: Dict[str, Dict[tuple, set]] = {}
-        self._rv = 0
+        # keyspace sharding (runtime/shards.py, docs/control-plane.md):
+        # every per-keyspace structure — object maps, canonical blobs,
+        # label/namespace indices, the rv sequence, the write lock, the
+        # per-shard system watch fan-out, the level-1 pod aggregates —
+        # lives in a StoreShard. S=1 (the default) is the historical
+        # unsharded store, byte-identical (tests/test_shards.py A/B).
+        if num_shards is None:
+            num_shards = int(os.environ.get("GROVE_TPU_STORE_SHARDS", "1") or 1)
+        self.num_shards = max(1, int(num_shards))
+        self._shards: List[StoreShard] = [
+            StoreShard(i, cache_lag) for i in range(self.num_shards)
+        ]
+        self._single = self.num_shards == 1
+        self._shard_memo: Dict[str, StoreShard] = {}
+        # level-2 hierarchical fold over the shards' (total, ready) pod
+        # partials — refolded lazily on pod_summary() reads, zero cost on
+        # the commit path
+        self._summary_tree = ShardSummaryTree(self.num_shards)
         self._watchers: List[Callable[[WatchEvent], None]] = []
         self._system_watchers: List[Callable[[WatchEvent], None]] = []
-        # event-driven status aggregation (runtime/aggregate.py): one
-        # counter mirror per READ VIEW — committed (updated at commit time)
-        # and, under cache lag, the informer cache (updated exactly when
-        # events are applied to it), so pod_counters() always equals a full
-        # rescan of the view the caller would have scanned
-        self._agg_committed = PodAggregate()
-        self._agg_cached = PodAggregate() if cache_lag else self._agg_committed
         # copy-on-write commits skip the canonical pickle blob; under the
         # test-mode store guard (GROVE_TPU_STORE_GUARD, or sanitizer mode
         # GROVE_TPU_SANITIZE which generalizes it) they compute it eagerly
@@ -249,28 +266,117 @@ class Store:
         if not decision.allowed:
             raise GroveError(ERR_FORBIDDEN, decision.reason, operation)
 
+    # -- shard routing (runtime/shards.py, docs/control-plane.md) --------
+
+    def _shard_for(self, namespace: str) -> StoreShard:
+        """Owning shard of a namespace ("" — cluster-scoped — is shard 0).
+
+        Memoized: the router runs on every get/list/emit — crc32 per call
+        was ~1/5 of the sharded per-reconcile overhead at the 10k-set A/B
+        — and the namespace population is tiny next to the call volume
+        (the memo retains entries for deleted namespaces; bounded by
+        namespaces ever seen, and the map is immutable per store)."""
+        if self._single:
+            return self._shards[0]
+        shard = self._shard_memo.get(namespace)
+        if shard is None:
+            shard = self._shards[shard_of(namespace, self.num_shards)]
+            self._shard_memo[namespace] = shard
+        return shard
+
+    def _shard_of_obj(self, obj) -> StoreShard:
+        if self._single:
+            return self._shards[0]
+        return self._shard_for(obj.metadata.namespace)
+
+    def shard_index(self, namespace: str) -> int:
+        """Public keyspace map: which shard owns `namespace`."""
+        return 0 if self._single else shard_of(namespace, self.num_shards)
+
+    def shard_resource_version(self, index: int) -> int:
+        """One shard's rv sequence (per-shard durability watermark)."""
+        return self._shards[index].rv
+
+    def resource_version_vector(self) -> Tuple[int, ...]:
+        """Per-shard resourceVersion vector — the exact form of the merge
+        rule `resource_version` collapses to a scalar (docs/control-plane.md)."""
+        return tuple(s.rv for s in self._shards)
+
+    def shard_census(self) -> List[dict]:
+        """Per-shard object count + rv (the scale bench/smoke's census);
+        also publishes the `store_shard_objects` gauge per shard."""
+        from grove_tpu.observability.metrics import METRICS
+
+        out = []
+        for s in self._shards:
+            n = s.object_count()
+            METRICS.set(f"store_shard_objects/{s.index}", n)
+            METRICS.set(f"store_shard_rv/{s.index}", s.rv)
+            out.append({"shard": s.index, "objects": n, "rv": s.rv})
+        return out
+
     # -- watch ----------------------------------------------------------
 
     def subscribe(self, fn: Callable[[WatchEvent], None]) -> None:
         self._watchers.append(fn)
 
-    def subscribe_system(self, fn: Callable[[WatchEvent], None]) -> None:
+    def subscribe_system(
+        self, fn: Callable[[WatchEvent], None], shard: Optional[int] = None
+    ) -> None:
         """Subscribe a watcher OUTSIDE the operator process (sim kubelet /
         scheduler): operator-restart tests clear `_watchers` to model the
         crashed process's watches vanishing, but cluster-side components
-        are separate processes whose watches survive an operator crash."""
-        self._system_watchers.append(fn)
+        are separate processes whose watches survive an operator crash.
+
+        With `shard=k` the subscription is PER-SHARD: the watcher sees
+        only shard k's events (its slice of the keyspace), so a per-shard
+        consumer (a shard's WAL segment stream) never filters — or waits
+        on — another shard's traffic. Delivery order within a shard is
+        identical to the unsharded fan-out."""
+        if shard is None:
+            self._system_watchers.append(fn)
+        else:
+            self._shards[shard].system_watchers.append(fn)
+
+    def subscribe_system_per_shard(self, fn: Callable[[WatchEvent], None]) -> None:
+        """Register `fn` on EVERY shard's per-shard fan-out (S entries).
+        For incremental-fold consumers (quota accountant, delta-solve
+        state) whose per-object streams never straddle shards: they ride
+        the per-shard delivery path — in front of any store-wide
+        subscriber's traffic for other shards — without maintaining S
+        callbacks themselves. At S=1 this is one subscription on the
+        single shard, same delivery order as subscribe_system."""
+        for s in self._shards:
+            s.system_watchers.append(fn)
 
     def _emit(
-        self, type_: str, obj, blob: Optional[bytes], old: object = None
+        self,
+        type_: str,
+        obj,
+        blob: Optional[bytes],
+        old: object = None,
+        shard: Optional[StoreShard] = None,
     ) -> None:
         # zero-copy fanout: committed objects are immutable once stored, so
         # every subscriber may share the payload; WatchEvent.materialize()
         # (pre-pickled) is the escape hatch for watchers that must mutate
-        ev = WatchEvent(type=type_, kind=obj.kind, obj=obj, blob=blob, old=old)
-        # the committed view just mutated: fold the delta into its aggregate
-        # (kind-gated inside; `old` is the previous committed object)
-        self._agg_committed.apply(type_, obj, old)
+        if shard is None:
+            shard = self._shard_of_obj(obj)
+        ev = WatchEvent(
+            type=type_, kind=obj.kind, obj=obj, blob=blob, old=old,
+            shard=shard.index,
+        )
+        # the committed view just mutated: fold the delta into the OWNING
+        # SHARD's level-1 aggregate (kind-gated inside; `old` is the
+        # previous committed object). The level-2 summary tree refolds
+        # lazily on read — no per-commit cost.
+        shard.agg_committed.apply(type_, obj, old)
+        # fan-out order: the owning shard's subscribers first (per-shard
+        # streams), then the store-wide system watchers, then the operator
+        # watchers — at S=1 with no per-shard subscriber this is exactly
+        # the historical order
+        for w in shard.system_watchers:
+            w(ev)
         for w in self._system_watchers:
             w(ev)
         for w in self._watchers:
@@ -280,7 +386,10 @@ class Store:
 
     def sync_cache(self) -> None:
         """Advance the whole read cache to the committed state."""
-        for kind in self._committed:
+        kinds = set()
+        for shard in self._shards:
+            kinds.update(shard.committed)
+        for kind in kinds:
             self.sync_cache_kind(kind)
 
     def sync_cache_kind(self, kind: str) -> None:
@@ -288,37 +397,47 @@ class Store:
         its watch events (each informer syncs independently; cross-kind
         staleness is exactly the race expectations absorb). Committed
         objects are immutable, so the cache shares them (no copies)."""
-        self._cache[kind] = dict(self._committed.get(kind, {}))
-        self._cache_blob[kind] = dict(self._blob.get(kind, {}))
-        index: Dict[tuple, set] = {}
-        for obj in self._cache[kind].values():
-            _index_insert(index, obj)
-        self._cache_index[kind] = index
-        if kind == "Pod" and self.cache_lag:
-            # full resync: the cached aggregate re-derives from the new view
-            self._agg_cached.rebuild(self._cache[kind].values())
+        for shard in self._shards:
+            shard.cache[kind] = dict(shard.committed.get(kind, {}))
+            shard.cache_blob[kind] = dict(shard.blob.get(kind, {}))
+            index: Dict[tuple, set] = {}
+            ns_index: Dict[str, Dict[str, None]] = {}
+            for key, obj in shard.cache[kind].items():
+                _index_insert(index, obj)
+                ns_index.setdefault(obj.metadata.namespace, {})[key] = None
+            shard.cache_label_index[kind] = index
+            shard.cache_ns_index[kind] = ns_index
+            if kind == "Pod" and self.cache_lag:
+                # full resync: the shard's cached aggregate re-derives
+                # from its new view
+                shard.agg_cached.rebuild(shard.cache[kind].values())
 
     def apply_event_to_cache(self, ev: "WatchEvent") -> None:
         """Incrementally apply one delivered watch event to the read cache —
         O(1) informer semantics (sync_cache_kind re-syncs a whole kind and
         is kept for explicit full resyncs). Event payloads are immutable
         (read-only watcher contract), so the cache shares them."""
-        kind_cache = self._cache.setdefault(ev.kind, {})
-        kind_blob = self._cache_blob.setdefault(ev.kind, {})
-        kind_index = self._cache_index.setdefault(ev.kind, {})
+        shard = self._shard_for(ev.obj.metadata.namespace)
+        kind_cache = shard.cache.setdefault(ev.kind, {})
+        kind_blob = shard.cache_blob.setdefault(ev.kind, {})
+        kind_index = shard.cache_label_index.setdefault(ev.kind, {})
+        kind_ns = shard.cache_ns_index.setdefault(ev.kind, {})
         key = obj_key(ev.obj)
         old = kind_cache.get(key)
         if ev.kind == "Pod" and self.cache_lag:
             # the cached view advances exactly here — fold the same delta
-            # into its aggregate (old = the view's previous object). Gated
-            # on cache_lag: without lag _agg_cached aliases _agg_committed,
-            # which already folded this delta at commit time.
-            self._agg_cached.apply(ev.type, ev.obj, old)
+            # into the shard's aggregate (old = the view's previous
+            # object). Gated on cache_lag: without lag agg_cached aliases
+            # agg_committed, which already folded this delta at commit.
+            shard.agg_cached.apply(ev.type, ev.obj, old)
         if old is not None:
             _index_delete(kind_index, old)
         if ev.type == DELETED:
             kind_cache.pop(key, None)
             kind_blob.pop(key, None)
+            ns_map = kind_ns.get(ev.obj.metadata.namespace)
+            if ns_map is not None:
+                ns_map.pop(key, None)
             return
         kind_cache[key] = ev.obj
         if ev.blob is not None:
@@ -326,25 +445,36 @@ class Store:
         else:
             kind_blob.pop(key, None)
         _index_insert(kind_index, ev.obj)
+        # dict-as-ordered-set; replacing an existing key keeps its slot, so
+        # the ns-scoped scan order equals the flat filtered-scan order
+        kind_ns.setdefault(ev.obj.metadata.namespace, {})[key] = None
 
-    # -- label index ------------------------------------------------------
+    # -- label + namespace indices ---------------------------------------
 
-    def _index_add(self, obj) -> None:
-        _index_insert(self._index.setdefault(obj.kind, {}), obj)
+    def _index_add(self, shard: StoreShard, obj) -> None:
+        _index_insert(shard.label_index.setdefault(obj.kind, {}), obj)
 
-    def _index_remove(self, obj) -> None:
-        _index_delete(self._index.get(obj.kind, {}), obj)
+    def _index_remove(self, shard: StoreShard, obj) -> None:
+        _index_delete(shard.label_index.get(obj.kind, {}), obj)
 
-    def _candidates(
+    def _shard_candidates(
         self,
+        shard: StoreShard,
         kind: str,
+        namespace: Optional[str],
         selector: Optional[Dict[str, str]],
-        cached: bool,
+        use_cache: bool,
         view: Dict[str, object],
     ):
-        """Smallest indexed candidate set for the selector, else all keys."""
+        """Smallest indexed candidate set within one shard: an indexed
+        label selector first (the controllers' hot selectors), else the
+        per-kind NAMESPACE index (a kind+namespace list never scans the
+        kind's full map — tests/test_shards.py pins no-full-scan), else
+        all of the shard's keys."""
         if selector:
-            index = (self._cache_index if cached else self._index).get(kind)
+            index = (
+                shard.cache_label_index if use_cache else shard.label_index
+            ).get(kind)
             if index is not None:
                 best = None
                 for lk in INDEXED_LABELS:
@@ -354,51 +484,107 @@ class Store:
                             best = entries
                 if best is not None:
                     return [view[k] for k in list(best) if k in view]
-        # snapshot of the reference list (not the objects): callers may
-        # create/delete while iterating a scan
+        if namespace is not None:
+            ns_map = (
+                shard.cache_ns_index if use_cache else shard.ns_index
+            ).get(kind, {}).get(namespace)
+            if ns_map is None:
+                return []
+            # snapshot of the key list (not the objects): callers may
+            # create/delete while iterating a scan
+            return [view[k] for k in list(ns_map) if k in view]
         return list(view.values())
-
-    def _read_view(self, cached: bool) -> Dict[str, Dict[str, object]]:
-        if cached and self.cache_lag:
-            return self._cache
-        return self._committed
 
     # -- durability (grove_tpu/durability, docs/robustness.md) -----------
 
     @property
     def resource_version(self) -> int:
-        """Highest resourceVersion committed so far (the WAL/snapshot
-        watermark; reads only — writes bump it through commits)."""
-        return self._rv
+        """Store-level resourceVersion watermark (the WAL/snapshot
+        watermark; reads only — writes bump it through commits).
+
+        Merge rule (docs/control-plane.md): each shard runs its own rv
+        sequence; the scalar is their SUM — every commit bumps exactly
+        one shard by one, so the sum is the total commit count, strictly
+        monotone, and at S=1 it IS the legacy counter byte-for-byte.
+        Clients needing the exact per-shard form read
+        `resource_version_vector()`."""
+        if self._single:
+            return self._shards[0].rv
+        return sum(s.rv for s in self._shards)
 
     def kinds(self) -> List[str]:
         """Kinds with at least one committed object (snapshot scans pair
         this with `scan(kind)` to enumerate the whole population)."""
-        return sorted(k for k, v in self._committed.items() if v)
+        kinds = set()
+        for shard in self._shards:
+            kinds.update(k for k, v in shard.committed.items() if v)
+        return sorted(kinds)
 
-    def restore_objects(self, objects, rv: int) -> int:
+    def shard_kinds(self, index: int) -> List[str]:
+        """One shard's kinds (per-shard snapshot scans pair this with
+        `scan(kind)` filtered by the shard's own view)."""
+        shard = self._shards[index]
+        return sorted(k for k, v in shard.committed.items() if v)
+
+    def shard_scan(self, index: int, kind: str) -> Iterator[object]:
+        """Zero-copy readonly iteration over ONE shard's committed objects
+        of a kind (per-shard durability snapshots; same mutate-nothing
+        contract as scan())."""
+        yield from self._shards[index].committed.get(kind, {}).values()
+
+    def restore_objects(
+        self,
+        objects,
+        rv: int = 0,
+        rv_vector: Optional[Sequence[int]] = None,
+    ) -> int:
         """Recovery-path bulk load: commit `objects` VERBATIM — identity
         (uid/resourceVersion/generation/timestamps) preserved, no watch
         events (recovery precedes every subscriber; the boot resync
         machinery — engine.requeue_all, rebuild_bindings, monitor resync —
         covers delivery), aggregates/caches rebuilt, and the version
-        counter resumed at `rv` so resourceVersion monotonicity survives
-        the restart. Only valid on a store with no prior commits."""
-        if self._rv:
+        counter(s) resumed so resourceVersion monotonicity survives the
+        restart: scalar `rv` for the unsharded store, `rv_vector` (one
+        watermark per shard, from the per-shard WAL dirs) when sharded.
+        Only valid on a store with no prior commits."""
+        if any(s.rv for s in self._shards):
             raise GroveError(
                 ERR_CONFLICT,
                 "restore_objects requires a fresh store (writes already"
-                f" committed up to rv {self._rv})",
+                f" committed up to rv {self.resource_version})",
+                "restore",
+            )
+        if rv_vector is not None and len(rv_vector) != self.num_shards:
+            raise GroveError(
+                ERR_CONFLICT,
+                f"rv_vector has {len(rv_vector)} entries for a"
+                f" {self.num_shards}-shard store",
+                "restore",
+            )
+        if rv_vector is None and not self._single:
+            raise GroveError(
+                ERR_CONFLICT,
+                "sharded restore requires the per-shard rv_vector (the"
+                " scalar watermark cannot be split back into sequences)",
                 "restore",
             )
         n = 0
         for obj in objects:
-            self._commit(obj)
+            shard = self._shard_of_obj(obj)
+            self._commit(shard, obj)
+            # keep each shard's sequence at/after its restored objects even
+            # if the recorded watermark trails (defense in depth)
+            shard.rv = max(shard.rv, obj.metadata.resource_version)
             n += 1
-        self._rv = max(self._rv, int(rv))
-        self._agg_committed.rebuild(
-            self._committed.get("Pod", {}).values()
-        )
+        if rv_vector is not None:
+            for shard, shard_rv in zip(self._shards, rv_vector):
+                shard.rv = max(shard.rv, int(shard_rv))
+        else:
+            self._shards[0].rv = max(self._shards[0].rv, int(rv))
+        for shard in self._shards:
+            shard.agg_committed.rebuild(
+                shard.committed.get("Pod", {}).values()
+            )
         if self.cache_lag:
             # warm informer caches (the initial LIST a restarted process
             # serves its informers); per-kind sync also rebuilds the
@@ -409,20 +595,29 @@ class Store:
     # -- CRUD -----------------------------------------------------------
 
     def _commit(
-        self, stored, blob: Optional[bytes] = None, serialize: bool = True
+        self,
+        shard: StoreShard,
+        stored,
+        blob: Optional[bytes] = None,
+        serialize: bool = True,
     ) -> Optional[bytes]:
-        """Commit `stored` as the new immutable committed state + canonical
-        blob. `stored` must never be mutated after this call. With
-        serialize=False (copy-on-write commits) no blob is computed: later
-        mutable reads fall back to deep_copy."""
+        """Commit `stored` as the owning shard's new immutable committed
+        state + canonical blob. `stored` must never be mutated after this
+        call. With serialize=False (copy-on-write commits) no blob is
+        computed: later mutable reads fall back to deep_copy."""
         if blob is None and serialize:
             blob = _dumps(stored)
-        self._committed.setdefault(stored.kind, {})[obj_key(stored)] = stored
+        key = obj_key(stored)
+        shard.committed.setdefault(stored.kind, {})[key] = stored
         if blob is not None:
-            self._blob.setdefault(stored.kind, {})[obj_key(stored)] = blob
+            shard.blob.setdefault(stored.kind, {})[key] = blob
         else:
-            self._blob.get(stored.kind, {}).pop(obj_key(stored), None)
-        self._index_add(stored)
+            shard.blob.get(stored.kind, {}).pop(key, None)
+        self._index_add(shard, stored)
+        # dict-as-ordered-set: re-commits of an existing key keep its slot
+        shard.ns_index.setdefault(stored.kind, {}).setdefault(
+            stored.metadata.namespace, {}
+        )[key] = None
         return blob
 
     def verify_readonly_integrity(self) -> int:
@@ -441,8 +636,14 @@ class Store:
         than silent."""
         checked = 0
         self.unverified_readonly = 0
-        for kind, view in self._committed.items():
-            blobs = self._blob.get(kind, {})
+        for shard in self._shards:
+            checked += self._verify_shard_readonly(shard)
+        return checked
+
+    def _verify_shard_readonly(self, shard: StoreShard) -> int:
+        checked = 0
+        for kind, view in shard.committed.items():
+            blobs = shard.blob.get(kind, {})
             for key, obj in view.items():
                 blob = blobs.get(key)
                 if blob is None:
@@ -464,20 +665,35 @@ class Store:
                 checked += 1
         return checked
 
-    def _uncommit(self, obj) -> Optional[bytes]:
+    def _uncommit(self, shard: StoreShard, obj) -> Optional[bytes]:
         key = obj_key(obj)
-        self._committed.get(obj.kind, {}).pop(key, None)
-        blob = self._blob.get(obj.kind, {}).pop(key, None)
-        self._index_remove(obj)
+        shard.committed.get(obj.kind, {}).pop(key, None)
+        blob = shard.blob.get(obj.kind, {}).pop(key, None)
+        self._index_remove(shard, obj)
+        ns_map = shard.ns_index.get(obj.kind, {}).get(obj.metadata.namespace)
+        if ns_map is not None:
+            ns_map.pop(key, None)
+            if not ns_map:
+                # bound memory: a drained namespace drops its index row
+                shard.ns_index[obj.kind].pop(obj.metadata.namespace, None)
         return blob
 
-    def _blob_view(self, use_cache: bool, kind: str) -> Dict[str, bytes]:
-        return (self._cache_blob if use_cache else self._blob).get(kind, {})
+    def _shard_blobs(
+        self, shard: StoreShard, use_cache: bool, kind: str
+    ) -> Dict[str, bytes]:
+        return (shard.cache_blob if use_cache else shard.blob).get(kind, {})
 
     def create(self, obj, consume: bool = False, share: bool = False) -> object:
         self._authorize("create", obj)
         self._inject("create", obj)
-        kind_objs = self._committed.setdefault(obj.kind, {})
+        shard = self._shard_of_obj(obj)
+        with shard.lock:
+            return self._create_locked(shard, obj, consume, share)
+
+    def _create_locked(
+        self, shard: StoreShard, obj, consume: bool, share: bool
+    ) -> object:
+        kind_objs = shard.committed.setdefault(obj.kind, {})
         key = obj_key(obj)
         if key in kind_objs:
             raise GroveError(
@@ -489,14 +705,14 @@ class Store:
             # it again, so it becomes the committed state directly — no
             # private pickled copy at all
             meta = obj.metadata
-            self._rv += 1
+            shard.rv += 1
             meta.uid = meta.uid or next_uid()
-            meta.resource_version = self._rv
+            meta.resource_version = shard.rv
             meta.generation = 1
             meta.creation_timestamp = self.clock.now()
             blob = _dumps(obj) if self._guard_blobs else None
-            self._commit(obj, blob, serialize=False)
-            self._emit(ADDED, obj, blob)
+            self._commit(shard, obj, blob, serialize=False)
+            self._emit(ADDED, obj, blob, shard=shard)
             return obj
         if share:
             # structural-sharing create for memoized DESIRED objects
@@ -507,14 +723,14 @@ class Store:
             # private copy so identity never leaks back into the memo.
             stored = _copy.copy(obj)
             meta = stored.metadata = _copy.copy(obj.metadata)
-            self._rv += 1
+            shard.rv += 1
             meta.uid = next_uid()
-            meta.resource_version = self._rv
+            meta.resource_version = shard.rv
             meta.generation = 1
             meta.creation_timestamp = self.clock.now()
             blob = _dumps(stored) if self._guard_blobs else None
-            self._commit(stored, blob, serialize=False)
-            self._emit(ADDED, stored, blob)
+            self._commit(shard, stored, blob, serialize=False)
+            self._emit(ADDED, stored, blob, shard=shard)
             return stored
         # Serialize ONCE with the final identity already stamped: the same
         # bytes are the private committed copy (loads) and the canonical
@@ -529,10 +745,10 @@ class Store:
             meta.generation,
             meta.creation_timestamp,
         )
-        self._rv += 1
+        shard.rv += 1
         try:
             meta.uid = meta.uid or next_uid()
-            meta.resource_version = self._rv
+            meta.resource_version = shard.rv
             meta.generation = 1
             meta.creation_timestamp = self.clock.now()
             blob = _dumps(obj)
@@ -544,8 +760,8 @@ class Store:
                 meta.generation,
                 meta.creation_timestamp,
             ) = saved
-        self._commit(stored, blob)
-        self._emit(ADDED, stored, blob)
+        self._commit(shard, stored, blob)
+        self._emit(ADDED, stored, blob, shard=shard)
         # return the CALLER's object carrying the committed identity — its
         # content is what was committed (stored was copied from it), so a
         # fresh materialized copy would only duplicate it
@@ -567,13 +783,17 @@ class Store:
         object WITHOUT a copy — the caller MUST NOT mutate it (same contract
         as scan(); re-get mutably before building an update)."""
         use_cache = cached and self.cache_lag
+        shard = self._shard_for(namespace)
         key = f"{namespace}/{name}"
-        obj = self._read_view(cached).get(kind, {}).get(key)
+        view = (shard.cache if use_cache else shard.committed).get(kind, {})
+        obj = view.get(key)
         if obj is None:
             return None
         if readonly:
             return obj
-        return _materialize(obj, self._blob_view(use_cache, kind).get(key))
+        return _materialize(
+            obj, self._shard_blobs(shard, use_cache, kind).get(key)
+        )
 
     def list(
         self,
@@ -583,13 +803,48 @@ class Store:
         cached: bool = False,
     ) -> List[object]:
         use_cache = cached and self.cache_lag
-        blobs = self._blob_view(use_cache, kind)
-        out = [
-            _materialize(obj, blobs.get(obj_key(obj)))
-            for obj in self.scan(kind, namespace, label_selector, cached)
-        ]
+        out = []
+        # iterate shard-by-shard so the per-kind blob dict is fetched ONCE
+        # per shard, not re-resolved per object (list("Pod") at the 500k-pod
+        # shape would otherwise pay ~1M redundant routing lookups)
+        for shard in self._shards_for_read(namespace):
+            blobs = self._shard_blobs(shard, use_cache, kind)
+            for obj in self._scan_shard(
+                shard, kind, namespace, label_selector, use_cache
+            ):
+                out.append(_materialize(obj, blobs.get(obj_key(obj))))
+        # cross-shard merge rule: one global (namespace, name) sort — the
+        # same total order the unsharded store produced, whatever shard
+        # each namespace hashed to
         out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
         return out
+
+    def _shards_for_read(self, namespace: Optional[str]):
+        """Shards a read must consult: the owner for a namespace-scoped
+        read, every shard (index order) otherwise."""
+        if namespace is None:
+            return self._shards
+        return (self._shard_for(namespace),)
+
+    def _scan_shard(
+        self,
+        shard: StoreShard,
+        kind: str,
+        namespace: Optional[str],
+        label_selector: Optional[Dict[str, str]],
+        use_cache: bool,
+    ) -> Iterator[object]:
+        """One shard's slice of a scan (shared by scan()/list())."""
+        view = (shard.cache if use_cache else shard.committed).get(kind, {})
+        if not view:
+            return
+        for obj in self._shard_candidates(
+            shard, kind, namespace, label_selector, use_cache, view
+        ):
+            if namespace is not None and obj.metadata.namespace != namespace:
+                continue
+            if matches_labels(obj, label_selector):
+                yield obj
 
     def scan(
         self,
@@ -604,17 +859,19 @@ class Store:
         NOT mutate them (deep_copy first to build an update). This is the
         informer-cache contract from client-go, and it is what makes the
         hot status/compute scans O(matched) with no serialization cost.
+
+        Sharded: a namespace-scoped scan touches ONLY the owning shard
+        (and only that namespace's index row); namespace=None chains the
+        shards in index order (within a shard, the historical order).
         """
         use_cache = cached and self.cache_lag
-        view = self._read_view(cached).get(kind, {})
-        for obj in self._candidates(kind, label_selector, use_cache, view):
-            if namespace is not None and obj.metadata.namespace != namespace:
-                continue
-            if matches_labels(obj, label_selector):
-                yield obj
+        for shard in self._shards_for_read(namespace):
+            yield from self._scan_shard(
+                shard, kind, namespace, label_selector, use_cache
+            )
 
-    def _require(self, obj):
-        kind_objs = self._committed.get(obj.kind, {})
+    def _require(self, shard: StoreShard, obj):
+        kind_objs = shard.committed.get(obj.kind, {})
         key = obj_key(obj)
         if key not in kind_objs:
             raise GroveError(
@@ -629,7 +886,14 @@ class Store:
         stale read (resource_version behind committed) raises ERR_CONFLICT,
         so controllers that clobber concurrent writes fail in the sim too.
         """
-        kind_objs, key = self._require(obj)
+        shard = self._shard_of_obj(obj)
+        with shard.lock:
+            return self._update_locked(shard, obj, bump_generation)
+
+    def _update_locked(
+        self, shard: StoreShard, obj, bump_generation: bool
+    ) -> object:
+        kind_objs, key = self._require(shard, obj)
         current = kind_objs[key]
         self._authorize("update", current)
         self._inject("update", obj)  # injectors see the state being written
@@ -684,7 +948,7 @@ class Store:
             if obj == current:
                 return _return_caller_obj(current)
             # real write: stamp the final identity and serialize once
-            meta.resource_version = self._rv + 1
+            meta.resource_version = shard.rv + 1
             meta.generation = current.metadata.generation + (
                 1 if bump_generation else 0
             )
@@ -700,10 +964,10 @@ class Store:
                 meta.uid,
                 meta.creation_timestamp,
             ) = saved
-        self._rv += 1
-        self._index_remove(current)
-        self._commit(stored, blob)
-        self._emit(MODIFIED, stored, blob, old=current)
+        shard.rv += 1
+        self._index_remove(shard, current)
+        self._commit(shard, stored, blob)
+        self._emit(MODIFIED, stored, blob, old=current, shard=shard)
         return _return_caller_obj(stored)
 
     def update_status(self, obj) -> object:
@@ -715,9 +979,46 @@ class Store:
         event-driven replacement for scanning+categorizing its pods on
         every reconcile. Always equals a full rescan of the view the caller
         would have scanned (committed, or the lagged cache when
-        cached=True). Returned row is READ-ONLY."""
-        agg = self._agg_cached if (cached and self.cache_lag) else self._agg_committed
+        cached=True). Returned row is READ-ONLY.
+
+        Two-level when sharded: the namespace's OWNING SHARD holds the
+        level-1 row (a namespace never straddles shards), so the read is
+        shard → row — no structure consulted spans the cluster."""
+        shard = self._shard_for(namespace)
+        agg = (
+            shard.agg_cached
+            if (cached and self.cache_lag)
+            else shard.agg_committed
+        )
         return agg.counters(namespace, name)
+
+    def pod_summary(self, cached: bool = False) -> Tuple[int, int]:
+        """Cluster-wide (total, ready) over live (non-terminating) pods —
+        the hierarchical replacement for scanning the whole pod
+        population: per-shard level-1 partials (folded per watch delta by
+        the shard's PodAggregate) are folded up the level-2 summary tree
+        (fan-in 8), so no fold at any level sees every pod or even every
+        shard. Equivalence vs a flat rescan is pinned in
+        tests/test_shards.py; the fold-depth histogram lands in the bench
+        `"scale"` block."""
+        from grove_tpu.observability.metrics import METRICS
+
+        use_cache = cached and self.cache_lag
+        self._summary_tree.refold(
+            [
+                (
+                    (s.agg_cached if use_cache else s.agg_committed).grand_total,
+                    (s.agg_cached if use_cache else s.agg_committed).grand_ready,
+                )
+                for s in self._shards
+            ]
+        )
+        METRICS.set("aggregate_fold_depth", self._summary_tree.depth)
+        return self._summary_tree.root()
+
+    def fold_depth_histogram(self) -> List[int]:
+        """Nodes per level of the level-2 fold tree, leaves first."""
+        return self._summary_tree.fold_depth_histogram()
 
     def commit_cow(
         self,
@@ -745,7 +1046,17 @@ class Store:
         suppression (replaced fields equal to committed → no bump, no
         event), authorization + fault injection, MODIFIED event with `old`.
         """
-        kind_objs = self._committed.get(view.kind, {})
+        shard = self._shard_of_obj(view)
+        with shard.lock:
+            return self._commit_cow_locked(
+                shard, view, status, spec, metadata, bump_generation
+            )
+
+    def _commit_cow_locked(
+        self, shard: StoreShard, view, status, spec, metadata,
+        bump_generation: bool,
+    ) -> object:
+        kind_objs = shard.committed.get(view.kind, {})
         key = obj_key(view)
         current = kind_objs.get(key)
         if current is None:
@@ -782,18 +1093,25 @@ class Store:
         if not changed:
             return current
         meta = stored.metadata = _copy.copy(stored.metadata)
-        self._rv += 1
-        meta.resource_version = self._rv
+        shard.rv += 1
+        meta.resource_version = shard.rv
         if bump_generation:
             meta.generation = current.metadata.generation + 1
         blob = _dumps(stored) if self._guard_blobs else None
-        self._index_remove(current)
-        self._commit(stored, blob, serialize=False)
-        self._emit(MODIFIED, stored, blob, old=current)
+        self._index_remove(shard, current)
+        self._commit(shard, stored, blob, serialize=False)
+        self._emit(MODIFIED, stored, blob, old=current, shard=shard)
         return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        kind_objs = self._committed.get(kind, {})
+        shard = self._shard_for(namespace)
+        with shard.lock:
+            self._delete_locked(shard, kind, namespace, name)
+
+    def _delete_locked(
+        self, shard: StoreShard, kind: str, namespace: str, name: str
+    ) -> None:
+        kind_objs = shard.committed.get(kind, {})
         key = f"{namespace}/{name}"
         obj = kind_objs.get(key)
         if obj is None:
@@ -804,35 +1122,38 @@ class Store:
             if obj.metadata.deletion_timestamp is None:
                 # committed objects are immutable: commit a fresh copy with
                 # the deletion timestamp instead of mutating in place
-                stored = _materialize(obj, self._blob.get(kind, {}).get(key))
+                stored = _materialize(obj, shard.blob.get(kind, {}).get(key))
                 stored.metadata.deletion_timestamp = self.clock.now()
-                self._rv += 1
-                stored.metadata.resource_version = self._rv
-                self._index_remove(obj)
-                blob = self._commit(stored)
-                self._emit(MODIFIED, stored, blob, old=obj)
+                shard.rv += 1
+                stored.metadata.resource_version = shard.rv
+                self._index_remove(shard, obj)
+                blob = self._commit(shard, stored)
+                self._emit(MODIFIED, stored, blob, old=obj, shard=shard)
             return
-        blob = self._uncommit(obj)
-        self._emit(DELETED, obj, blob)
+        blob = self._uncommit(shard, obj)
+        self._emit(DELETED, obj, blob, shard=shard)
 
     def remove_finalizer(self, kind: str, namespace: str, name: str, finalizer: str) -> None:
-        kind_objs = self._committed.get(kind, {})
-        key = f"{namespace}/{name}"
-        obj = kind_objs.get(key)
-        if obj is None:
-            return
-        # finalizer drain is an update-class write: same guard + fault hooks
-        self._authorize("update", obj)
-        self._inject("update", obj)
-        if finalizer in obj.metadata.finalizers:
-            stored = _materialize(obj, self._blob.get(kind, {}).get(key))
-            stored.metadata.finalizers.remove(finalizer)
-            self._rv += 1
-            stored.metadata.resource_version = self._rv
-            self._index_remove(obj)
-            blob = self._commit(stored)
-            self._emit(MODIFIED, stored, blob, old=obj)
-        self.complete_deletion_if_drained(kind, namespace, name)
+        shard = self._shard_for(namespace)
+        with shard.lock:
+            kind_objs = shard.committed.get(kind, {})
+            key = f"{namespace}/{name}"
+            obj = kind_objs.get(key)
+            if obj is None:
+                return
+            # finalizer drain is an update-class write: same guard + fault
+            # hooks
+            self._authorize("update", obj)
+            self._inject("update", obj)
+            if finalizer in obj.metadata.finalizers:
+                stored = _materialize(obj, shard.blob.get(kind, {}).get(key))
+                stored.metadata.finalizers.remove(finalizer)
+                shard.rv += 1
+                stored.metadata.resource_version = shard.rv
+                self._index_remove(shard, obj)
+                blob = self._commit(shard, stored)
+                self._emit(MODIFIED, stored, blob, old=obj, shard=shard)
+            self.complete_deletion_if_drained(kind, namespace, name)
 
     def complete_deletion_if_drained(
         self, kind: str, namespace: str, name: str
@@ -841,18 +1162,20 @@ class Store:
         the apiserver-side rule the HTTP server applies after updates that
         rewrite metadata.finalizers (a real apiserver deletes the object when
         deletionTimestamp is set and the finalizer list becomes empty)."""
-        kind_objs = self._committed.get(kind, {})
-        key = f"{namespace}/{name}"
-        obj = kind_objs.get(key)
-        if (
-            obj is not None
-            and obj.metadata.deletion_timestamp is not None
-            and not obj.metadata.finalizers
-        ):
-            blob = self._uncommit(obj)
-            self._emit(DELETED, obj, blob)
-            return True
-        return False
+        shard = self._shard_for(namespace)
+        with shard.lock:  # reentrant from remove_finalizer (RLock)
+            kind_objs = shard.committed.get(kind, {})
+            key = f"{namespace}/{name}"
+            obj = kind_objs.get(key)
+            if (
+                obj is not None
+                and obj.metadata.deletion_timestamp is not None
+                and not obj.metadata.finalizers
+            ):
+                blob = self._uncommit(shard, obj)
+                self._emit(DELETED, obj, blob, shard=shard)
+                return True
+            return False
 
     def delete_collection(
         self,

@@ -4,6 +4,17 @@ Single-process, virtual-time equivalent of client-go's rate-limited workqueue
 as used by the reference's controllers (manager concurrency model,
 controller/manager.go). Items are (kind, namespace, name) keys; a key is
 deduped while pending, like the real workqueue.
+
+Sharded mode (``num_shards > 1``, docs/control-plane.md): ready keys are
+bucketed by the owning keyspace shard of their namespace
+(runtime/shards.py ``shard_of`` — the store's map) and popped round-robin
+across non-empty buckets via a rotation pointer, so one shard's hot key —
+re-added every round by a crash-looping tenant — cannot starve another
+shard's entries (including delayed re-adds, which promote into their
+shard's bucket and get their rotation turn). The delayed heap stays
+global: it is time-ordered, and promotion is by readiness, not shard.
+At ``num_shards=1`` there is one bucket and the pointer is pinned at 0 —
+pop order is the historical FIFO, byte-identical.
 """
 
 from __future__ import annotations
@@ -14,6 +25,8 @@ import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from grove_tpu.runtime.shards import shard_of
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
 
@@ -53,22 +66,45 @@ class WorkQueue:
         self,
         base_backoff: float = BASE_BACKOFF,
         max_backoff: float = MAX_BACKOFF,
+        num_shards: int = 1,
     ) -> None:
         # per-instance rate-limiter curve: reconcile queues keep the
         # client-go-style 5ms base, while coarser consumers (gang requeue
         # after node failure) pick a second-scale base with a tighter cap
         self.base_backoff = base_backoff
         self.max_backoff = max_backoff
-        self._ready: Deque[Key] = deque()
+        self.num_shards = max(1, num_shards)
+        # per-shard ready buckets + rotation pointer (module docstring);
+        # one bucket at num_shards=1 keeps the historical FIFO exactly
+        self._buckets: List[Deque[Key]] = [
+            deque() for _ in range(self.num_shards)
+        ]
+        self._rotation = 0
+        # namespace -> bucket memo (the keyspace map is immutable per
+        # queue; crc32 per add is measurable at stress volume)
+        self._bucket_memo: Dict[str, Deque[Key]] = {}
+        self._ready_count = 0
         self._pending: Set[Key] = set()
         self._delayed: List[_Delayed] = []
         self._seq = itertools.count()
         self._failures: Dict[Key, int] = {}
 
+    def _bucket_of(self, key: Key) -> Deque[Key]:
+        if self.num_shards == 1:
+            return self._buckets[0]
+        # key[1] is the namespace — the same keyspace map the store routes
+        # writes with, so a shard's reconcile traffic is exactly its slice
+        bucket = self._bucket_memo.get(key[1])
+        if bucket is None:
+            bucket = self._buckets[shard_of(key[1], self.num_shards)]
+            self._bucket_memo[key[1]] = bucket
+        return bucket
+
     def add(self, key: Key) -> None:
         if key not in self._pending:
             self._pending.add(key)
-            self._ready.append(key)
+            self._bucket_of(key).append(key)
+            self._ready_count += 1
 
     def add_after(self, key: Key, delay: float, now: float) -> None:
         delay = max(delay, MIN_DELAY)
@@ -116,12 +152,23 @@ class WorkQueue:
             self.add(item.key)
 
     def pop(self, now: float) -> Optional[Key]:
+        """Next ready key: FIFO within a shard bucket, deterministic
+        round-robin across buckets (the pointer advances past each served
+        shard, so consecutive pops rotate shards while any other bucket
+        has work — the per-shard fairness pin in tests/test_runtime.py)."""
         self._promote_delayed(now)
-        if not self._ready:
+        if not self._ready_count:
             return None
-        key = self._ready.popleft()
-        self._pending.discard(key)
-        return key
+        for off in range(self.num_shards):
+            idx = (self._rotation + off) % self.num_shards
+            bucket = self._buckets[idx]
+            if bucket:
+                key = bucket.popleft()
+                self._pending.discard(key)
+                self._ready_count -= 1
+                self._rotation = (idx + 1) % self.num_shards
+                return key
+        return None
 
     def next_delayed_at(self) -> Optional[float]:
         return self._delayed[0].ready_at if self._delayed else None
@@ -133,8 +180,8 @@ class WorkQueue:
         return any(d.key == key for d in self._delayed)
 
     def __len__(self) -> int:
-        return len(self._ready)
+        return self._ready_count
 
     def empty(self, now: float) -> bool:
         self._promote_delayed(now)
-        return not self._ready
+        return not self._ready_count

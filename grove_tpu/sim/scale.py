@@ -1,0 +1,215 @@
+"""Sharded-control-plane scale scenario (docs/control-plane.md).
+
+Two jobs:
+
+- ``scale_artifact`` — the bench ``"scale"`` block: converge a
+  multi-tenant population at the ROADMAP's 10× shape (100k nodes /
+  ≥500k pods, shards on) and report µs/reconcile, the solver share, the
+  level-2 fold-depth histogram, and the per-shard census. The shape
+  scales down proportionally for smoke runs (``make cp-bench-smoke``
+  must stay seconds, not hours).
+- ``inert_ab`` — the S=1 guard rail: the SAME population applied to an
+  unsharded and a sharded control plane must converge to byte-identical
+  store content (canonical-uid wire dump), identical reconcile counts
+  and identical admissions. Sharding is a routing change, never a
+  semantic one.
+
+Populations spread over ``n_tenants`` namespaces (set ``i`` lands in
+``tenant-(i % n_tenants)``) because the keyspace map is per-namespace:
+a single-namespace population degenerates every shard count to one hot
+shard, which exercises nothing.
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+from typing import List, Optional, Tuple
+
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.meta import deep_copy
+from grove_tpu.api.pod import is_ready
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.clock import VirtualClock
+from grove_tpu.runtime.store import Store
+from grove_tpu.sim.harness import SimHarness
+
+# one clique × 8 replicas: the leanest gang shape that still runs the
+# whole pipeline (PCS → PCLQ → PodGang → solve → bind → status). The
+# scale run is a CONTROL-PLANE stress; 8 pods/set keeps the solver's
+# chunk count (each chunk pays O(nodes) per wave — at 100k nodes the
+# dominant term, measured 84% of wall at 4 pods/set) low enough that the
+# 500k-pod converge stays tractable on CPU while the CP still folds
+# every pod event
+_SCALE_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: scale
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: serve
+        spec:
+          roleName: role-serve
+          replicas: 8
+          podSpec:
+            containers:
+              - name: serve
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 10m
+"""
+
+_BASE = load_podcliquesets(_SCALE_YAML)[0]
+
+
+def tenant_namespaces(n_tenants: int) -> List[str]:
+    return [f"tenant-{i:03d}" for i in range(n_tenants)]
+
+
+def _populate(h: SimHarness, n_sets: int, tenants: List[str]) -> float:
+    t0 = time.perf_counter()
+    for i in range(n_sets):
+        pcs = deep_copy(_BASE)
+        pcs.metadata.name = f"svc-{i:06d}"
+        pcs.metadata.namespace = tenants[i % len(tenants)]
+        h.apply(pcs)
+    return time.perf_counter() - t0
+
+
+def _reconcile_count() -> int:
+    return int(
+        sum(
+            v
+            for k, v in METRICS.counters.items()
+            if k.startswith("reconcile_total")
+        )
+    )
+
+
+def converge_population(
+    n_sets: int,
+    n_nodes: int,
+    num_shards: int,
+    n_tenants: int = 64,
+    max_ticks: Optional[int] = None,
+) -> Tuple[SimHarness, dict]:
+    """Apply + converge one multi-tenant population on a fresh harness;
+    returns (harness, report). Same GC discipline as the integrated
+    bench (the population is large, long-lived and acyclic)."""
+    tenants = tenant_namespaces(min(n_tenants, max(n_sets, 1)))
+    store = Store(VirtualClock(), cache_lag=True, num_shards=num_shards)
+    h = SimHarness(num_nodes=n_nodes, store=store)
+    solver_s0 = METRICS.hist_sum.get("gang_solve_seconds", 0.0)
+    reconciles0 = _reconcile_count()
+    t0 = time.perf_counter()
+    applied_s = _populate(h, n_sets, tenants)
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        h.converge(max_ticks=max_ticks or (60 + 8 * n_sets))
+    finally:
+        gc.enable()
+        gc.unfreeze()
+        gc.collect()
+    wall = time.perf_counter() - t0
+    pods = h.store.list("Pod")
+    ready = bool(pods) and all(is_ready(p) for p in pods)
+    reconciles = _reconcile_count() - reconciles0
+    solver_s = METRICS.hist_sum.get("gang_solve_seconds", 0.0) - solver_s0
+    cp_seconds = max(wall - solver_s - applied_s, 0.0)
+    total, ready_n = h.store.pod_summary()
+    report = {
+        "sets": n_sets,
+        "nodes": n_nodes,
+        "shards": num_shards,
+        "tenants": len(tenants),
+        "pods": len(pods),
+        "all_ready": ready,
+        "wall_seconds": round(wall, 2),
+        "apply_seconds": round(applied_s, 2),
+        "solver_seconds": round(solver_s, 2),
+        "solver_share": round(solver_s / wall, 4) if wall else 0.0,
+        "control_plane_seconds": round(cp_seconds, 2),
+        "reconciles": reconciles,
+        "us_per_reconcile": round(1e6 * cp_seconds / max(reconciles, 1), 1),
+        # the hierarchical-fold proof: pod summary off the level-2 tree
+        # (equal to the flat fold — tests/test_shards.py) + nodes/level
+        "pod_summary": {"total": total, "ready": ready_n},
+        "fold_depth_histogram": h.store.fold_depth_histogram(),
+        "shard_census": h.store.shard_census(),
+    }
+    return h, report
+
+
+def _rv_normalized(dump: dict) -> dict:
+    """Drop the per-object resourceVersion stamps: per-shard rv SEQUENCES
+    legitimately differ from the single global sequence (the documented
+    vector merge rule) — everything else must match byte-for-byte."""
+    for doc in dump.values():
+        doc.get("metadata", {}).pop("resourceVersion", None)
+    return dump
+
+
+def inert_ab(
+    n_sets: int = 192, n_nodes: int = 64, num_shards: int = 5
+) -> dict:
+    """S=1 vs S=num_shards on the identical population: byte-identical
+    committed content up to the documented rv renumbering (canonical-uid
+    wire dump, Events excluded — their emission counts depend on dedup
+    timing, not store routing; per-object resourceVersions normalized —
+    per-shard sequences differ from the global one by construction,
+    which is exactly the vector merge rule), equal reconcile counts,
+    equal scalar resourceVersion (total commit count), equal admissions.
+
+    A throwaway warmup converge runs first so neither side is billed the
+    solver's XLA compile — the wall comparison is control-plane work."""
+    from grove_tpu.sim.recovery import store_dump
+
+    converge_population(min(n_sets, 16), min(n_nodes, 16), num_shards=1)
+    h1, r1 = converge_population(n_sets, n_nodes, num_shards=1)
+    hs, rs = converge_population(n_sets, n_nodes, num_shards=num_shards)
+    dump1 = _rv_normalized(
+        store_dump(h1.store, canonical_uids=True, include_events=False)
+    )
+    dumps = _rv_normalized(
+        store_dump(hs.store, canonical_uids=True, include_events=False)
+    )
+    return {
+        "sets": n_sets,
+        "shards_b": num_shards,
+        "identical_content": dump1 == dumps,
+        "objects": len(dump1),
+        "reconciles_s1": r1["reconciles"],
+        "reconciles_sharded": rs["reconciles"],
+        "identical_reconciles": r1["reconciles"] == rs["reconciles"],
+        "all_ready_both": r1["all_ready"] and rs["all_ready"],
+        "rv_scalar_s1": h1.store.resource_version,
+        "rv_scalar_sharded": hs.store.resource_version,
+        "identical_rv_scalar": (
+            h1.store.resource_version == hs.store.resource_version
+        ),
+        "wall_s1": r1["wall_seconds"],
+        "wall_sharded": rs["wall_seconds"],
+    }
+
+
+def scale_artifact(
+    n_sets: int = 62_500,
+    n_nodes: int = 100_000,
+    num_shards: int = 8,
+    ab_sets: int = 192,
+) -> dict:
+    """The bench ``"scale"`` block: the big sharded converge + the small
+    inert A/B. Caller picks the shape (the integrated bench passes the
+    full 100k-node shape only on full-size runs)."""
+    harness, report = converge_population(n_sets, n_nodes, num_shards)
+    # release the big population before the A/B runs its twin harnesses
+    del harness
+    gc.collect()
+    report["inert_ab"] = inert_ab(n_sets=ab_sets, num_shards=num_shards)
+    return report

@@ -163,7 +163,14 @@ class DeltaSolveState:
         self.drift_detected = 0
         self.last_reencoded = 0  # specs rebuilt THIS tick
         self.last_reused = 0  # specs served from cache THIS tick
-        store.subscribe_system(self._on_event)
+        # sharded stores deliver per shard (docs/control-plane.md): the
+        # fold is per-pod/per-gang and an object's events never straddle
+        # shards, so per-shard delivery preserves every order the fold
+        # depends on (storm-equivalence pinned in tests/test_shards.py)
+        if getattr(store, "num_shards", 1) > 1:
+            store.subscribe_system_per_shard(self._on_event)
+        else:
+            store.subscribe_system(self._on_event)
 
     # -- watch-delta fold ------------------------------------------------
 

@@ -2,7 +2,7 @@
 
 Three layers (docs/static-analysis.md):
 
-1. **Fixture teeth** — for every rule GL001..GL010, a known-bad snippet
+1. **Fixture teeth** — for every rule GL001..GL013, a known-bad snippet
    must fire and its known-good twin must pass. This is what pins
    "deleting any single enforced invariant makes `make lint` fail".
 2. **Live-tree mutations** — the real invariants (the `schedulable`
@@ -197,6 +197,21 @@ FIXTURES = {
             "    self.store.update(obj)\n"
         ),
     },
+    "GL013": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def peek(self):\n"
+            "    shard = self.store._shards[0]\n"
+            "    shard.system_watchers.append(print)\n"
+            "    return shard.committed['Pod']\n"
+        ),
+        "good": (
+            "def peek(self):\n"
+            "    vec = self.store.resource_version_vector()\n"
+            "    self.store.subscribe_system(print, shard=0)\n"
+            "    return self.store.shard_census()\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -308,6 +323,31 @@ def test_injecting_direct_store_mutation_fails_lint():
         "grove_tpu/durability/recovery.py",
     )
     assert "GL011" not in rules_of(report2)
+
+
+def test_grafting_shard_internals_access_fails_lint():
+    """GL013 live-tree teeth: a rogue helper reaching into a shard's
+    private state (per-shard object maps, fan-out lists) from the
+    engine must fail lint; the durability module (per-shard WAL streams)
+    stays exempt."""
+    rel = "grove_tpu/runtime/engine.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_shard_tap(store):\n"
+        "    for shard in store._shards:\n"
+        "        shard.system_watchers.clear()\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL013" in rules_of(report)
+    # the untouched engine source itself is clean (routes on ev.shard and
+    # the public num_shards only)
+    assert "GL013" not in rules_of(lint_source(src, rel))
+    report2 = lint_source(
+        "def attach(store, wal):\n"
+        "    store._shards[0].system_watchers.append(wal.note_event)\n",
+        "grove_tpu/durability/recovery.py",
+    )
+    assert "GL013" not in rules_of(report2)
 
 
 def test_unregistering_reason_fails_lint():
