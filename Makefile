@@ -9,7 +9,8 @@ IMAGE ?= grove-tpu:0.2.0
 .PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
-        scale-smoke probe-debug dryrun docker-build compose-up clean
+        scale-smoke frontier-smoke probe-debug dryrun docker-build \
+        compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -19,13 +20,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint scale-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke
+check: lint scale-smoke frontier-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL013) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL014) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -70,8 +71,11 @@ drain-smoke:     ## voluntary-disruption smoke: budget-checked gang-whole node d
 delta-smoke:     ## incremental delta-solve smoke: churn loop with the per-tick A/B selfcheck armed (delta problem + admissions bit-identical to the from-scratch solve), warm-start/reuse/fallback counters printed against floors
 	$(CPU_ENV) $(PY) scripts/delta_smoke.py
 
-scale-smoke:     ## sharded control-plane smoke: small-S multi-tenant converge with cross-shard spread, S=1 inert A/B (identical content/reconciles/rv), per-shard WAL crash-recover + acked-prefix audit across shard dirs
+scale-smoke:     ## sharded control-plane smoke: small-S multi-tenant converge with cross-shard spread (shard-count aware: S=1 exercises the inert-A/B arm), S=1 inert A/B (identical content/reconciles/rv), per-shard WAL crash-recover + acked-prefix audit across shard dirs
 	$(CPU_ENV) $(PY) scripts/scale_smoke.py
+
+frontier-smoke:  ## partitioned-frontier smoke: multi-slice converge+churn with the per-tick batched-vs-sequential A/B armed, >=2 partitions + residual path exercised, single-partition degenerate case byte-identical to the global solve
+	$(CPU_ENV) $(PY) scripts/frontier_smoke.py
 
 probe-debug:     ## accelerator-probe debugger: availability precheck + subprocess jit probe against the REAL env (no CPU scrub), full child traceback printed; rc 0 healthy / 2 retryable / 3 config error
 	$(PY) scripts/probe_debug.py

@@ -457,20 +457,30 @@ def _quota_artifact() -> dict:
 def _scale_artifact_block(n_sets: int, scale_shape) -> dict:
     """Sharded control-plane block (docs/control-plane.md): the 10×-shape
     multi-tenant converge with the keyspace-sharded store — µs/reconcile,
-    solver share, the level-2 fold-depth histogram, per-shard census —
-    plus the S=1 inert A/B. Full-size integrated runs default to the
-    ROADMAP's 100k nodes / 500k pods; smoke shapes scale the block down
-    proportionally so cp-bench-smoke stays seconds."""
+    solver share, the level-2 fold-depth histogram, per-shard census,
+    peak RSS per phase — plus the S=1 inert A/B. The converge runs with
+    the partitioned solver frontier ON (docs/solver.md "Partitioned
+    frontier"): its ``"frontier"`` sub-block reports subproblem count,
+    residual fraction, batched-dispatch count, overlap occupancy and the
+    A/B overhead ledger, and ``"frontier_ab"`` is the paired frontier
+    on/off converge behind the ≥1.8× wall gate. Full-size integrated
+    runs default to the ROADMAP's 100k nodes / 500k pods; smoke shapes
+    scale the block down proportionally so cp-bench-smoke stays
+    seconds."""
     from grove_tpu.sim.scale import scale_artifact
 
     if scale_shape is not None:
         sc_sets, sc_nodes, sc_shards = scale_shape
+        fab = (max(sc_sets // 2, 32), max(sc_nodes // 2, 32))
     elif n_sets >= 10240:
         sc_sets, sc_nodes, sc_shards = 62_500, 100_000, 8
+        fab = (4096, 6400)
     else:
         sc_sets, sc_nodes, sc_shards = max(n_sets // 2, 32), max(n_sets // 2, 32), 4
+        fab = (max(n_sets // 4, 32), max(n_sets // 4, 32))
     return scale_artifact(
-        n_sets=sc_sets, n_nodes=sc_nodes, num_shards=sc_shards
+        n_sets=sc_sets, n_nodes=sc_nodes, num_shards=sc_shards,
+        frontier_ab_shape=fab,
     )
 
 

@@ -4,6 +4,7 @@ is the set `make lint` runs (docs/static-analysis.md is the catalog)."""
 from grove_tpu.analysis.rules.apiwire import WireRoundTripRule
 from grove_tpu.analysis.rules.clocks import BlockingTickRule, ClockDisciplineRule
 from grove_tpu.analysis.rules.dirtymask import DirtyMaskRegistrationRule
+from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
 from grove_tpu.analysis.rules.locks import LockOrderRule
 from grove_tpu.analysis.rules.observability import EventReasonRule, SpanLeakRule
@@ -31,4 +32,5 @@ ALL_RULES = (
     StoreLoggedCommitRule,  # GL011
     DirtyMaskRegistrationRule,  # GL012
     ShardInternalsRule,  # GL013
+    FrontierStateRule,  # GL014
 )

@@ -42,6 +42,9 @@ _ROUTER_PRIVATE = {
     "_shard_for",
     "_shard_of_obj",
     "_summary_tree",
+    "_summary_tree_cached",
+    "_summary_dirty",
+    "_summary_dirty_cached",
     "_single",
 }
 

@@ -1011,6 +1011,94 @@ def solve_wave_chunk(
     }
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "commit_iters", "grouped", "pinned", "spread", "uniform",
+    ),
+)
+def solve_wave_chunk_stack(
+    free,  # [B, N, R] — one capacity snapshot per stacked subproblem
+    topo,  # [B, N, L]
+    seg_starts,  # [B, L, D]
+    seg_ends,  # [B, L, D]
+    demand,  # [B, C, P, R] — one CHUNK of gangs per subproblem lane
+    count,  # [B, C, P]
+    min_count,  # [B, C, P]
+    req_level,  # [B, C]
+    pref_level,  # [B, C]
+    pending,  # [B, C] bool
+    narrow_cap,  # [B, C] int32
+    seeds,  # [B, C] int32
+    group_req,  # [B, C, P]
+    group_pin,  # [B, C, P]
+    gang_pin,  # [B, C]
+    spread_level,  # [B, C]
+    spread_min,  # [B, C]
+    spread_required,  # [B, C]
+    spread_seed,  # [B, C, D]
+    commit_iters: int = 2,
+    grouped: bool = False,
+    pinned: bool = False,
+    spread: bool = False,
+    uniform: bool = False,
+):
+    """One wave over one chunk of EVERY stacked subproblem lane at once —
+    the partitioned-frontier batch dispatch (solver/frontier.py).
+
+    Node-disjoint subproblems padded to one shape are stacked on a leading
+    batch axis and decided in a single ``jax.vmap`` of the exact same
+    :func:`wave_chunk_core` the host-loop binding path runs per problem, so
+    B small same-shape solves cost one kernel dispatch instead of B. Each
+    lane carries its OWN capacity snapshot, topology slabs and narrow-cap
+    state — lanes never read or write each other's rows, which is what
+    makes the per-lane results bit-identical to solving each subproblem
+    alone (pinned by the frontier selfcheck). Inert padding lanes (zero
+    capacity, zero counts, pending False) are provably no-ops: a zero
+    count zeroes the fill and the commit mask, leaving free untouched.
+
+    Static flags are the OR over the whole stack (uniform: the AND): a
+    lane without groups/pins/spread takes the same values through the
+    flagged code paths (the kernel's documented flag-equivalences), so
+    mixed stacks stay exact."""
+
+    def lane(
+        free_b, topo_b, ss_b, se_b, dem_b, cnt_b, mn_b, rq_b, pf_b,
+        pend_b, ncap_b, seed_b, grq_b, gpin_b, gangpin_b,
+        slvl_b, smin_b, sreq_b, sseed_b,
+    ):
+        free_after, accept, placed, score, chosen, retry, new_cap, _ff, alloc = (
+            wave_chunk_core(
+                free_b, topo_b, ss_b, se_b,
+                dem_b, cnt_b, mn_b, rq_b, pf_b, pend_b, ncap_b, seed_b,
+                grq_b, gpin_b, gangpin_b, slvl_b, smin_b, sreq_b, sseed_b,
+                commit_iters, grouped, pinned, spread, uniform=uniform,
+            )
+        )
+        n_levels = topo_b.shape[1]
+        # identical post-processing to solve_wave_chunk so the stacked
+        # lane and the per-problem host path can never diverge
+        return (
+            free_after,
+            accept,
+            retry,
+            new_cap,
+            jnp.where(accept[:, None], placed, 0),
+            jnp.where(accept, score, 0.0),
+            jnp.where(
+                accept, jnp.where(chosen >= n_levels, -1, chosen), -1
+            ),
+            jnp.where(accept[:, None, None], alloc, 0),
+        )
+
+    return jax.vmap(lane)(
+        free, topo, seg_starts, seg_ends, demand, count, min_count,
+        req_level, pref_level, pending, narrow_cap, seeds,
+        group_req, group_pin, gang_pin,
+        spread_level, spread_min, spread_required, spread_seed,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Wave-solver core (shared by the chunked binding path and the
 # device-resident stats loop)

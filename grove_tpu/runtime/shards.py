@@ -176,6 +176,25 @@ class ShardSummaryTree:
                 level.append((total, ready))
             self.levels[li] = level
 
+    def update_leaf(self, index: int, partial: Tuple[int, int]) -> None:
+        """Path refold: replace ONE leaf partial and refold only its
+        ancestor chain — O(depth × fan_in) instead of O(S). The read-side
+        skip for quiet stores: `pod_summary()` tracks which shards' level-1
+        partials moved since the last read and path-refolds when few did
+        (docs/control-plane.md §4 routing-overhead shave)."""
+        self.levels[0][index] = partial
+        child = index
+        for li in range(1, len(self.levels)):
+            parent = child // self.fan_in
+            base = parent * self.fan_in
+            below = self.levels[li - 1]
+            total = ready = 0
+            for t, r in below[base : base + self.fan_in]:
+                total += t
+                ready += r
+            self.levels[li][parent] = (total, ready)
+            child = parent
+
     def root(self) -> Tuple[int, int]:
         return self.levels[-1][0]
 

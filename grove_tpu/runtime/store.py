@@ -218,8 +218,21 @@ class Store:
         self._shard_memo: Dict[str, StoreShard] = {}
         # level-2 hierarchical fold over the shards' (total, ready) pod
         # partials — refolded lazily on pod_summary() reads, zero cost on
-        # the commit path
+        # the commit path beyond a set-add of the owning shard's index.
+        # One tree per read view (the cached view's partials advance on
+        # watch delivery, not at commit); dirty sets track which shards'
+        # level-1 partials moved since the last read, so a quiet store's
+        # summary read is a cached root and a one-shard-dirty read is a
+        # path refold, not an O(S) whole-tree fold (docs/control-plane.md
+        # §4 routing-overhead shave)
         self._summary_tree = ShardSummaryTree(self.num_shards)
+        self._summary_tree_cached = (
+            ShardSummaryTree(self.num_shards)
+            if cache_lag
+            else self._summary_tree
+        )
+        self._summary_dirty = set(range(self.num_shards))
+        self._summary_dirty_cached = set(range(self.num_shards))
         self._watchers: List[Callable[[WatchEvent], None]] = []
         self._system_watchers: List[Callable[[WatchEvent], None]] = []
         # copy-on-write commits skip the canonical pickle blob; under the
@@ -369,8 +382,12 @@ class Store:
         # the committed view just mutated: fold the delta into the OWNING
         # SHARD's level-1 aggregate (kind-gated inside; `old` is the
         # previous committed object). The level-2 summary tree refolds
-        # lazily on read — no per-commit cost.
+        # lazily on read — the commit path only notes WHICH shard's
+        # partial moved, so a summary read after a quiet spell skips the
+        # whole fold and a hot-shard burst path-refolds one leaf chain.
         shard.agg_committed.apply(type_, obj, old)
+        if obj.kind == "Pod":
+            self._summary_dirty.add(shard.index)
         # fan-out order: the owning shard's subscribers first (per-shard
         # streams), then the store-wide system watchers, then the operator
         # watchers — at S=1 with no per-shard subscriber this is exactly
@@ -411,13 +428,18 @@ class Store:
                 # full resync: the shard's cached aggregate re-derives
                 # from its new view
                 shard.agg_cached.rebuild(shard.cache[kind].values())
+                self._summary_dirty_cached.add(shard.index)
 
     def apply_event_to_cache(self, ev: "WatchEvent") -> None:
         """Incrementally apply one delivered watch event to the read cache —
         O(1) informer semantics (sync_cache_kind re-syncs a whole kind and
         is kept for explicit full resyncs). Event payloads are immutable
         (read-only watcher contract), so the cache shares them."""
-        shard = self._shard_for(ev.obj.metadata.namespace)
+        # the event already carries its owning shard (stamped at _emit):
+        # index straight into the shard table instead of re-routing the
+        # namespace through the crc32 memo — this runs once per delivered
+        # event on the informer hot path (docs/control-plane.md §4)
+        shard = self._shards[ev.shard]
         kind_cache = shard.cache.setdefault(ev.kind, {})
         kind_blob = shard.cache_blob.setdefault(ev.kind, {})
         kind_index = shard.cache_label_index.setdefault(ev.kind, {})
@@ -430,6 +452,7 @@ class Store:
             # object). Gated on cache_lag: without lag agg_cached aliases
             # agg_committed, which already folded this delta at commit.
             shard.agg_cached.apply(ev.type, ev.obj, old)
+            self._summary_dirty_cached.add(shard.index)
         if old is not None:
             _index_delete(kind_index, old)
         if ev.type == DELETED:
@@ -585,6 +608,7 @@ class Store:
             shard.agg_committed.rebuild(
                 shard.committed.get("Pod", {}).values()
             )
+        self._summary_dirty = set(range(self.num_shards))
         if self.cache_lag:
             # warm informer caches (the initial LIST a restarted process
             # serves its informers); per-kind sync also rebuilds the
@@ -1004,17 +1028,52 @@ class Store:
         from grove_tpu.observability.metrics import METRICS
 
         use_cache = cached and self.cache_lag
-        self._summary_tree.refold(
-            [
-                (
-                    (s.agg_cached if use_cache else s.agg_committed).grand_total,
-                    (s.agg_cached if use_cache else s.agg_committed).grand_ready,
-                )
-                for s in self._shards
-            ]
+        tree = self._summary_tree_cached if use_cache else self._summary_tree
+        # drain by atomic pop()s BEFORE reading the aggregates: committers
+        # (threaded apiserver writers) add to this set holding only their
+        # shard lock, so iterating it live could see a mid-add resize, and
+        # clearing after the reads would lose an add that raced the fold.
+        # Each GIL-atomic pop either lands in this read (whose aggregate
+        # read comes after) or survives for the next one — no lock on the
+        # commit path, no lost notification, no shared iteration.
+        dirty = (
+            self._summary_dirty_cached if use_cache else self._summary_dirty
         )
-        METRICS.set("aggregate_fold_depth", self._summary_tree.depth)
-        return self._summary_tree.root()
+        drained = []
+        while True:
+            try:
+                drained.append(dirty.pop())
+            except KeyError:
+                break
+        drained.sort()
+        if drained:
+            if 2 * len(drained) > self.num_shards:
+                tree.refold(
+                    [
+                        (
+                            (
+                                s.agg_cached if use_cache else s.agg_committed
+                            ).grand_total,
+                            (
+                                s.agg_cached if use_cache else s.agg_committed
+                            ).grand_ready,
+                        )
+                        for s in self._shards
+                    ]
+                )
+            else:
+                # few shards moved since the last read (the steady-state
+                # common case is ONE): path-refold each dirty leaf's
+                # ancestor chain instead of the whole tree
+                for i in drained:
+                    agg = (
+                        self._shards[i].agg_cached
+                        if use_cache
+                        else self._shards[i].agg_committed
+                    )
+                    tree.update_leaf(i, (agg.grand_total, agg.grand_ready))
+        METRICS.set("aggregate_fold_depth", tree.depth)
+        return tree.root()
 
     def fold_depth_histogram(self) -> List[int]:
         """Nodes per level of the level-2 fold tree, leaves first."""

@@ -133,6 +133,14 @@ class SimHarness:
             self.scheduler.enable_delta()
             if _sanitize_enabled():
                 self.scheduler.delta_selfcheck = True
+        # partitioned solver frontier (solver/frontier.py): OPT-IN — it
+        # changes placement semantics (partition-confined placements with
+        # a global residual pass), so only scale-focused runs enable it.
+        # Sanitized runs arm the per-tick batched-vs-sequential A/B.
+        if _os.environ.get("GROVE_TPU_FRONTIER", "") in ("1", "true"):
+            self.scheduler.enable_frontier()
+            if _sanitize_enabled():
+                self.scheduler.frontier_selfcheck = True
         # node-health monitor (controller/nodehealth.py): heartbeat
         # lifecycle, pod failure on Lost nodes, gang rescue vs. requeue.
         # Inert while no node crashes (one O(nodes) pass per tick).

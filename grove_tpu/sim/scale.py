@@ -90,33 +90,68 @@ def _reconcile_count() -> int:
     )
 
 
+def _peak_rss_kb() -> int:
+    """Process peak RSS in KB (ru_maxrss is KB on Linux). Monotone over
+    the process lifetime — sampled after each phase, the per-phase rows
+    show WHICH phase first pushed the high-water mark."""
+    import resource
+
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+
+
 def converge_population(
     n_sets: int,
     n_nodes: int,
     num_shards: int,
     n_tenants: int = 64,
     max_ticks: Optional[int] = None,
+    frontier: bool = False,
+    frontier_selfcheck: bool = False,
 ) -> Tuple[SimHarness, dict]:
     """Apply + converge one multi-tenant population on a fresh harness;
-    returns (harness, report). Same GC discipline as the integrated
-    bench (the population is large, long-lived and acyclic)."""
+    returns (harness, report).
+
+    GC discipline (the PR 8 delta-block measurement rule): the population
+    is large, long-lived and acyclic, so cyclic full collections inside
+    the measured window are multi-second pauses billed to arbitrary
+    ticks. Freeze+disable covers BOTH measured phases (apply and
+    converge), and the wall clock is read BEFORE the closing collect so
+    the teardown collection never lands inside the window. Peak RSS is
+    sampled after each phase.
+
+    ``frontier=True`` attaches the partitioned solver frontier
+    (solver/frontier.py) and reports its counters under ``"frontier"``;
+    ``frontier_selfcheck`` arms the per-tick batched-vs-sequential A/B
+    (the smoke's setting — measurement runs keep it off and report the
+    overhead ledger as 0)."""
     tenants = tenant_namespaces(min(n_tenants, max(n_sets, 1)))
     store = Store(VirtualClock(), cache_lag=True, num_shards=num_shards)
     h = SimHarness(num_nodes=n_nodes, store=store)
+    if frontier:
+        h.scheduler.enable_frontier()
+        h.scheduler.frontier_selfcheck = frontier_selfcheck
+    else:
+        # PIN the global path: the harness env hook (GROVE_TPU_FRONTIER=1)
+        # may have attached the frontier, and a paired A/B whose baseline
+        # arm silently runs partitioned measures speedup ~1.0
+        h.scheduler.frontier = None
+        h.scheduler.frontier_selfcheck = False
     solver_s0 = METRICS.hist_sum.get("gang_solve_seconds", 0.0)
     reconciles0 = _reconcile_count()
-    t0 = time.perf_counter()
-    applied_s = _populate(h, n_sets, tenants)
     gc.collect()
     gc.freeze()
     gc.disable()
     try:
+        t0 = time.perf_counter()
+        applied_s = _populate(h, n_sets, tenants)
+        rss_after_apply = _peak_rss_kb()
         h.converge(max_ticks=max_ticks or (60 + 8 * n_sets))
+        wall = time.perf_counter() - t0
     finally:
         gc.enable()
         gc.unfreeze()
         gc.collect()
-    wall = time.perf_counter() - t0
+    rss_after_converge = _peak_rss_kb()
     pods = h.store.list("Pod")
     ready = bool(pods) and all(is_ready(p) for p in pods)
     reconciles = _reconcile_count() - reconciles0
@@ -142,7 +177,13 @@ def converge_population(
         "pod_summary": {"total": total, "ready": ready_n},
         "fold_depth_histogram": h.store.fold_depth_histogram(),
         "shard_census": h.store.shard_census(),
+        "peak_rss_kb": {
+            "after_apply": rss_after_apply,
+            "after_converge": rss_after_converge,
+        },
     }
+    if frontier and h.scheduler.frontier is not None:
+        report["frontier"] = h.scheduler.frontier.stats()
     return h, report
 
 
@@ -198,18 +239,96 @@ def inert_ab(
     }
 
 
+def census_spread_problems(census: List[dict], num_shards: int) -> List[str]:
+    """Shard-count-aware census gate (scripts/scale_smoke.py): at S≥2 the
+    population must actually spread over ≥2 shards (the smoke exercised
+    routing, not one hot shard); at S=1 there is exactly one shard to
+    land on — the run exercises the inert-A/B arm instead, and a spread
+    demand would always trip. Returns the problem list (empty = ok)."""
+    busy = [c for c in census if c["objects"] > 0]
+    if num_shards <= 1:
+        if len(busy) != 1:
+            return [
+                f"S=1 run landed objects on {len(busy)} shards — the"
+                " unsharded store must have exactly one populated shard"
+            ]
+        return []
+    if len(busy) < 2:
+        return [
+            f"population landed on {len(busy)} shard(s) — the smoke must"
+            " exercise cross-shard routing"
+        ]
+    return []
+
+
+def frontier_ab(
+    n_sets: int = 512, n_nodes: int = 512, num_shards: int = 2
+) -> dict:
+    """Paired converge at one shape, global frontier vs partitioned
+    frontier — the wall/solver A/B behind the scale block's ≥1.8×
+    converge gate (docs/solver.md "Partitioned frontier"). Throwaway
+    warmup converges absorb the solver's XLA compiles first — one per
+    arm, AT THE MEASURED NODE COUNT: the global arm's chunk kernel
+    compiles per (chunk, nodes) shape (the gang count only changes the
+    chunk count), so a few-set warmup over the full node axis warms
+    exactly the shapes the measured converge dispatches. The stacked
+    arm's slab kernels are node-count-invariant; its batch-axis shape
+    still differs between warmup and measurement (few partitions carry
+    warmup gangs), so one pow2 batch-lane compile can land in the
+    partitioned arm's wall — conservative against the speedup, noted
+    rather than hidden."""
+    converge_population(min(n_sets, 16), n_nodes, num_shards=1)
+    converge_population(
+        min(n_sets, 16), n_nodes, num_shards=1, frontier=True
+    )
+    _off_h, off = converge_population(n_sets, n_nodes, num_shards)
+    del _off_h
+    gc.collect()
+    _on_h, on = converge_population(
+        n_sets, n_nodes, num_shards, frontier=True
+    )
+    del _on_h
+    gc.collect()
+    return {
+        "sets": n_sets,
+        "nodes": n_nodes,
+        "wall_off": off["wall_seconds"],
+        "wall_on": on["wall_seconds"],
+        "solver_off": off["solver_seconds"],
+        "solver_on": on["solver_seconds"],
+        "speedup_wall": round(
+            off["wall_seconds"] / max(on["wall_seconds"], 1e-9), 2
+        ),
+        "speedup_solver": round(
+            off["solver_seconds"] / max(on["solver_seconds"], 1e-9), 2
+        ),
+        "all_ready_both": off["all_ready"] and on["all_ready"],
+        "frontier": on.get("frontier", {}),
+    }
+
+
 def scale_artifact(
     n_sets: int = 62_500,
     n_nodes: int = 100_000,
     num_shards: int = 8,
     ab_sets: int = 192,
+    frontier_ab_shape: Tuple[int, int] = (512, 512),
 ) -> dict:
-    """The bench ``"scale"`` block: the big sharded converge + the small
-    inert A/B. Caller picks the shape (the integrated bench passes the
-    full 100k-node shape only on full-size runs)."""
-    harness, report = converge_population(n_sets, n_nodes, num_shards)
+    """The bench ``"scale"`` block: the big sharded converge (partitioned
+    frontier ON — the PR 10 configuration) + the small S=1 inert A/B +
+    the paired frontier on/off A/B. Caller picks the shape (the
+    integrated bench passes the full 100k-node shape only on full-size
+    runs)."""
+    harness, report = converge_population(
+        n_sets, n_nodes, num_shards, frontier=True
+    )
     # release the big population before the A/B runs its twin harnesses
     del harness
     gc.collect()
     report["inert_ab"] = inert_ab(n_sets=ab_sets, num_shards=num_shards)
+    report["frontier_ab"] = frontier_ab(
+        n_sets=frontier_ab_shape[0],
+        n_nodes=frontier_ab_shape[1],
+        num_shards=num_shards,
+    )
     return report

@@ -534,6 +534,14 @@ class DeltaSolveState:
         )
         return problem, fingerprint
 
+    def encoding_view(self) -> tuple:
+        """Read-only (NodeEncoding, free matrix) pair for sibling solver
+        tiers (the partitioned frontier rides the cached topology slabs
+        and the maintained free rows instead of re-deriving them). The
+        matrix is the live maintained state — callers must not mutate it
+        (copy before composing); both are None until the first encode."""
+        return self._enc, self._free
+
     def free_dicts(self, nodes) -> Dict[str, Dict[str, float]]:
         """Per-node free-capacity dicts from the maintained matrix — the
         gRPC sidecar path's request builder consumes dicts, so delta state

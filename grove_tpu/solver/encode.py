@@ -324,6 +324,46 @@ class NodeEncoding:
         self.seg_starts, self.seg_ends = domain_boundaries(topo)
         self.node_index = {name: i for i, name in enumerate(node_names)}
 
+def slice_encoding(
+    enc: NodeEncoding, start: int, end: int, pad_to: Optional[int] = None
+):
+    """Localized node-side tensors for one contiguous topology slab of a
+    :class:`NodeEncoding` — the partitioned frontier's subproblem encode
+    (solver/frontier.py).
+
+    Nodes are topology-sorted, so the slab ``[start, end)`` of a domain at
+    any level is contiguous and its per-level dense ids form contiguous
+    ranges; subtracting the first row re-bases them at 0 without changing
+    domain identity (two slab nodes share a local id iff they shared the
+    global one). ``pad_to`` appends zero-capacity ghost nodes that EXTEND
+    the last domain of every level (ids replicated from the final real
+    row), which the kernel provably never fills — zero capacity means a
+    zero capped-fit count everywhere — so padded and unpadded solves are
+    bit-identical while every subproblem in a batch bucket shares one
+    static shape.
+
+    Returns ``(topo_local, seg_starts, seg_ends, node_names, node_index)``
+    where ``node_names`` includes ghost names for the padding rows and
+    ``node_index`` maps REAL slab nodes only."""
+    n_real = end - start
+    topo_local = enc.topo[start:end] - enc.topo[start : start + 1]
+    if pad_to is not None and pad_to > n_real:
+        topo_local = np.concatenate(
+            [
+                topo_local,
+                np.repeat(topo_local[-1:], pad_to - n_real, axis=0),
+            ]
+        )
+    seg_starts, seg_ends = domain_boundaries(topo_local)
+    node_names = list(enc.node_names[start:end])
+    node_index = {name: i for i, name in enumerate(node_names)}
+    if pad_to is not None and pad_to > n_real:
+        node_names.extend(
+            f"__frontier-pad-{i}" for i in range(pad_to - n_real)
+        )
+    return topo_local, seg_starts, seg_ends, node_names, node_index
+
+
 def build_problem(
     nodes: Sequence,
     gang_specs: List[dict],

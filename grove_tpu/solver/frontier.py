@@ -1,0 +1,675 @@
+"""Partitioned solver frontier: per-super-domain subproblem decomposition
+with batched device dispatch (docs/solver.md "Partitioned frontier").
+
+PR 9 sharded every control-plane structure, but the pending-gang frontier
+stayed one global solve: every wave of every tick pays O(gangs × nodes)
+even though almost every gang's placement lands inside ONE narrow
+topology domain. This module decomposes the solve the way Tesserae
+decomposes placement policies (PAPERS.md) — the cluster is partitioned
+into **topology super-domains** (the broadest level of the encoded
+topology that has ≥ 2 domains; each domain is already a contiguous node
+slab of the :class:`~grove_tpu.solver.encode.NodeEncoding` sort), each
+pending gang is routed to one partition, and the partitions are solved
+as independent node-disjoint subproblems:
+
+- **Assignment** (deterministic, capacity-aware, host-side): a gang whose
+  recovery pins / survivor seeds resolve inside one partition is FORCED
+  there; a gang whose pins span partitions, carries a spread constraint,
+  prefers a level broader than the frontier level, demands a resource no
+  node supplies, or does not fit any single partition's remaining free
+  capacity goes to the **residual**; every other gang is placed in the
+  feasible partition with the most remaining headroom (greedy balance,
+  its aggregate demand debited so assignment spreads load).
+- **Independence**: a subproblem contains ONLY its slab's nodes and its
+  assigned gangs, so no subproblem can read or write another's capacity
+  rows — solving them in any order (or all at once) composes to the same
+  result as solving them one by one. That composition is the frontier's
+  semantic; the **residual solve** then runs the leftover gangs through
+  the ordinary global kernel against the post-partition free capacity,
+  in their original DRF-relative order, so any gang the local solve
+  rejected (or could not be confined) still gets the full cluster.
+- **Parallel execution**, two layers: (a) same-shape subproblems (gang
+  axis padded to sticky pow2 buckets, node/domain axes padded per
+  bucket) are STACKED and solved in single ``jax.vmap``-batched kernel
+  dispatches (``ops.packing.solve_wave_chunk_stack`` driven by
+  ``kernel.solve_waves_stacked``); (b) host-side encode of bucket k+1
+  overlaps device execution of bucket k through a one-worker
+  double-buffer thread (JAX releases the GIL during device compute).
+
+The A/B contract (``GangScheduler.frontier_selfcheck``, the analogue of
+PR 8's ``delta_selfcheck``): re-solve every subproblem ALONE through the
+trusted host-loop :func:`~grove_tpu.solver.kernel.solve_waves`, recompose
+sequentially, and assert the batched/overlapped composite is
+BIT-identical — admissions, placements, scores, allocations. Degenerate
+ticks (a single super-domain, or every gang residual) bypass to the
+global solve path entirely, byte-identical by code path (pinned by
+``make frontier-smoke``).
+
+Frontier partition state (the plan cache, per-partition sub-encodings,
+assignment scratch) is PRIVATE to this module — grovelint GL014 flags any
+outside write; out-of-band invalidation goes through :meth:`invalidate`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.tracing import TRACER
+from grove_tpu.solver.encode import (
+    _assemble_problem,
+    _next_pow2,
+    level_index_for_key,
+    slice_encoding,
+)
+from grove_tpu.solver.types import PackingResult
+
+# subproblems are small (a slab's worth of gangs): pad the gang axis to
+# pow2 buckets with this floor instead of the global MIN_GANG_BUCKET (32)
+# so a two-gang partition is not solved 16x padded
+MIN_SUB_GANG_BUCKET = 8
+RESIDUAL = -1
+
+
+class FrontierPlan:
+    """Partition table for one NodeEncoding: the frontier level, its
+    contiguous node slabs, and lazily-built per-slab sub-encodings."""
+
+    __slots__ = (
+        "level", "starts", "ends", "num_partitions", "_sub_encodings",
+    )
+
+    def __init__(self, level: int, starts: np.ndarray, ends: np.ndarray):
+        self.level = level
+        self.starts = starts  # [K] int, slab [start, end) per partition
+        self.ends = ends
+        self.num_partitions = len(starts)
+        # (partition, pad_to) -> slice_encoding(...) result
+        self._sub_encodings: Dict[Tuple[int, int], tuple] = {}
+
+    def partition_of_node(self, idx: int) -> int:
+        """Partition owning global (topology-sorted) node index `idx`."""
+        return int(np.searchsorted(self.starts, idx, side="right") - 1)
+
+    def sub_encoding(self, enc, k: int, pad_to: int) -> tuple:
+        key = (k, pad_to)
+        sub = self._sub_encodings.get(key)
+        if sub is None:
+            sub = slice_encoding(
+                enc, int(self.starts[k]), int(self.ends[k]), pad_to
+            )
+            self._sub_encodings[key] = sub
+        return sub
+
+
+class FrontierState:
+    """Partitioned-frontier solve state for one GangScheduler. Attach via
+    ``GangScheduler.enable_frontier()`` (requires the delta-solve state:
+    the plan rides its cached NodeEncoding and maintained free matrix)."""
+
+    def __init__(self, topology) -> None:
+        self.topology = topology
+        self._plan: Optional[FrontierPlan] = None
+        self._plan_enc = None  # NodeEncoding identity the plan was cut from
+        # lifetime counters (the bench "frontier" sub-block)
+        self.solves = 0  # partitioned solves executed
+        self.degenerate = 0  # ticks bypassed to the global path
+        self.subproblems_total = 0
+        self.assigned_total = 0
+        self.residual_total = 0
+        self.dispatches_total = 0
+        self.last_subproblems = 0
+        self.last_residual_fraction = 0.0
+        self.last_overlap_occupancy = 0.0
+        self.selfcheck_seconds = 0.0
+
+    # -- registration API (GL014) ----------------------------------------
+
+    def invalidate(self) -> None:
+        """Out-of-band invalidation hook: code that must touch frontier
+        inputs outside the watched channels calls this so the next solve
+        re-derives the plan (grovelint GL014 locks the private state to
+        this module)."""
+        self._plan = None
+        self._plan_enc = None
+
+    # -- plan ------------------------------------------------------------
+
+    def plan_for(self, enc) -> Optional[FrontierPlan]:
+        """The partition plan for this NodeEncoding: slabs of the broadest
+        topology level with ≥ 2 domains. None when every level is a single
+        domain (nothing to partition — the degenerate global case). The
+        outcome is cached per encoding IDENTITY either way: a degenerate
+        topology must not re-scan the topo matrix every tick just to
+        re-conclude there is nothing to partition."""
+        if self._plan_enc is enc:
+            return self._plan
+        self._plan = None
+        self._plan_enc = enc
+        topo = enc.topo
+        if topo.size == 0:
+            return None
+        for level in range(topo.shape[1]):
+            width = int(topo[:, level].max()) + 1
+            if width >= 2:
+                starts = enc.seg_starts[level, :width].astype(np.int64)
+                ends = enc.seg_ends[level, :width].astype(np.int64)
+                self._plan = FrontierPlan(level, starts, ends)
+                return self._plan
+        return None
+
+    # -- assignment ------------------------------------------------------
+
+    def _pin_nodes(self, spec: dict) -> List[str]:
+        pins = []
+        if spec.get("gang_pinned_node"):
+            pins.append(spec["gang_pinned_node"])
+        for grp in spec["groups"]:
+            if grp.get("pinned_node"):
+                pins.append(grp["pinned_node"])
+        pins.extend(spec.get("spread_survivor_nodes") or ())
+        return pins
+
+    def assign(
+        self, plan: FrontierPlan, enc, free: np.ndarray,
+        gang_specs: List[dict],
+    ) -> np.ndarray:
+        """Deterministic gang → partition map (RESIDUAL = -1), in the
+        caller's (global DRF) order. Pure host work over the maintained
+        free matrix: per-partition aggregates are slab prefix reductions,
+        and each assignment debits its gang's aggregate demand so the
+        greedy balance spreads load."""
+        g = len(gang_specs)
+        part_of = np.full((g,), RESIDUAL, dtype=np.int64)
+        if g == 0:
+            return part_of
+        rindex = {r: j for j, r in enumerate(enc.resource_names)}
+        # remaining free per partition, debited as gangs are assigned
+        remaining = np.add.reduceat(free, plan.starts, axis=0).astype(
+            np.float64
+        )
+        level_keys = enc.level_keys
+        for i, spec in enumerate(gang_specs):
+            if spec.get("spread_key"):
+                continue  # balanced fills want the broad view: residual
+            pref = level_index_for_key(
+                level_keys, spec.get("preferred_key")
+            )
+            if 0 <= pref < plan.level:
+                continue  # prefers a broader domain than a partition
+            pins = self._pin_nodes(spec)
+            forced = {
+                plan.partition_of_node(enc.node_index[n])
+                for n in pins
+                if n in enc.node_index
+            }
+            if len(forced) > 1:
+                continue  # multi-domain gang: survivors span partitions
+            dvec = np.zeros((free.shape[1],), dtype=np.float64)
+            unknown = False
+            for grp in spec["groups"]:
+                for r, q in grp["demand"].items():
+                    j = rindex.get(r)
+                    if j is None:
+                        if q > 0:
+                            unknown = True
+                        continue
+                    dvec[j] += q * grp["count"]
+            if unknown:
+                continue  # demands a resource no node supplies
+            if forced:
+                k = forced.pop()
+            else:
+                pos = dvec > 0
+                if pos.any():
+                    with np.errstate(divide="ignore"):
+                        head = np.min(
+                            remaining[:, pos] / dvec[pos], axis=1
+                        )
+                else:
+                    head = remaining.sum(axis=1)
+                k = int(np.argmax(head))
+                if pos.any() and head[k] < 1.0:
+                    continue  # fits no single partition: residual
+            part_of[i] = k
+            remaining[k] -= dvec
+        return part_of
+
+    # -- solve -----------------------------------------------------------
+
+    def solve(self, sched, gang_specs: List[dict], problem):
+        """Partitioned solve of the tick's pending frontier. Returns a
+        composite :class:`PackingResult` in the global problem's index
+        space, or None when the tick is degenerate (single super-domain,
+        or every gang residual) — the caller then runs the ordinary
+        global solve, byte-identical by code path."""
+        enc, free = sched.delta.encoding_view()
+        if enc is None or free is None:
+            return None
+        plan = self.plan_for(enc)
+        if plan is None:
+            self.degenerate += 1
+            METRICS.inc("frontier_degenerate_total")
+            return None
+        part_of = self.assign(plan, enc, free, gang_specs)
+        parts_used = sorted({int(k) for k in part_of if k >= 0})
+        if not parts_used:
+            self.degenerate += 1
+            METRICS.inc("frontier_degenerate_total")
+            return None
+        with TRACER.span(
+            "solve.partition",
+            subproblems=len(parts_used),
+            gangs=len(gang_specs),
+        ) as span:
+            result = self._solve_partitioned(
+                sched, gang_specs, problem, enc, free, plan, part_of,
+                parts_used,
+            )
+            span.set("residual", int((part_of < 0).sum()))
+        return result
+
+    def _build_lane(
+        self, enc, free, plan, k: int, idxs: List[int],
+        gang_specs: List[dict], pad_gangs: int, pad_groups: int,
+        n_pad: int, resource_names: List[str],
+    ):
+        """One partition's subproblem at the bucket's padded node shape."""
+        s, e = int(plan.starts[k]), int(plan.ends[k])
+        topo_local, seg_starts, seg_ends, node_names, node_index = (
+            plan.sub_encoding(enc, k, n_pad)
+        )
+        capacity = np.zeros((n_pad, free.shape[1]), dtype=np.float32)
+        capacity[: e - s] = free[s:e]
+        sub_specs = [gang_specs[i] for i in idxs]
+        return _assemble_problem(
+            capacity,
+            topo_local,
+            seg_starts,
+            seg_ends,
+            node_names,
+            resource_names,
+            list(enc.level_keys),
+            node_index,
+            sub_specs,
+            pad_gangs,
+            pad_groups,
+        )
+
+    @staticmethod
+    def _stack_bucket(problems: List) -> Dict[str, np.ndarray]:
+        """Stack same-(G,P,N)-shape subproblems on a leading batch axis,
+        padding the domain axis to the bucket max and the batch axis to
+        pow2 with inert all-zero lanes."""
+        d_max = max(p.seg_starts.shape[1] for p in problems)
+        b_pad = _next_pow2(len(problems))
+
+        def seg(a):
+            out = np.zeros((a.shape[0], d_max), dtype=a.dtype)
+            out[:, : a.shape[1]] = a
+            return out
+
+        fields = {
+            "capacity": [p.capacity for p in problems],
+            "topo": [p.topo for p in problems],
+            "seg_starts": [seg(p.seg_starts) for p in problems],
+            "seg_ends": [seg(p.seg_ends) for p in problems],
+            "demand": [p.demand for p in problems],
+            "count": [p.count for p in problems],
+            "min_count": [p.min_count for p in problems],
+            "req_level": [p.req_level for p in problems],
+            "pref_level": [p.pref_level for p in problems],
+            "group_req": [p.group_req for p in problems],
+            "group_pin": [p.group_pin for p in problems],
+            "gang_pin": [p.gang_pin for p in problems],
+            "spread_level": [p.spread_level for p in problems],
+            "spread_min": [p.spread_min for p in problems],
+            "spread_required": [p.spread_required for p in problems],
+            # assigned gangs never carry spread state: collapse every
+            # lane's seed to the zero-width placeholder
+            "spread_seed": [
+                np.zeros(
+                    (p.spread_level.shape[0], 0), dtype=np.int32
+                )
+                for p in problems
+            ],
+        }
+        stack = {}
+        for name, mats in fields.items():
+            arr = np.stack(mats)
+            if b_pad > arr.shape[0]:
+                pad = np.zeros(
+                    (b_pad - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype
+                )
+                if name in ("req_level", "pref_level", "group_req",
+                            "group_pin", "gang_pin", "spread_level"):
+                    pad -= 1  # sentinel -1 axes
+                arr = np.concatenate([arr, pad])
+            stack[name] = arr
+        return stack
+
+    def _solve_partitioned(
+        self, sched, gang_specs, problem, enc, free, plan, part_of,
+        parts_used,
+    ):
+        t0 = time.perf_counter()
+        pad_groups = problem.max_groups
+        resource_names = list(problem.resource_names)
+        # lanes grouped into sticky-pow2 buckets keyed by the padded
+        # (gang, node) shape so each bucket is ONE stacked dispatch set.
+        # ONE pass over the assignment builds every partition's index
+        # list (a rescan per partition would be O(partitions × gangs) —
+        # ~400M iterations at the 100k-node shape)
+        idxs_by_part: Dict[int, List[int]] = {}
+        for i, k in enumerate(part_of):
+            if k >= 0:
+                idxs_by_part.setdefault(int(k), []).append(i)
+        lanes: List[dict] = []
+        for k in parts_used:
+            idxs = idxs_by_part[k]
+            n_real = int(plan.ends[k] - plan.starts[k])
+            lanes.append(
+                {
+                    "k": k,
+                    "idxs": idxs,
+                    "n_real": n_real,
+                    "g_pad": _next_pow2(
+                        max(len(idxs), MIN_SUB_GANG_BUCKET)
+                    ),
+                }
+            )
+        buckets: Dict[Tuple[int, int], List[dict]] = {}
+        for lane in lanes:
+            n_pad = _next_pow2(max(lane["n_real"], 8))
+            lane["n_pad"] = n_pad
+            buckets.setdefault((lane["g_pad"], n_pad), []).append(lane)
+        bucket_keys = sorted(buckets)
+
+        def encode_bucket(key):
+            g_pad, n_bucket = key
+            for lane in buckets[key]:
+                lane["problem"] = self._build_lane(
+                    enc, free, plan, lane["k"], lane["idxs"], gang_specs,
+                    g_pad, pad_groups, n_bucket, resource_names,
+                )
+            return self._stack_bucket(
+                [lane["problem"] for lane in buckets[key]]
+            )
+
+        # double-buffered pipeline: the device executes bucket k while the
+        # host encodes bucket k+1 (JAX releases the GIL in device compute)
+        from concurrent.futures import ThreadPoolExecutor
+
+        from grove_tpu.solver.kernel import solve_waves_stacked
+
+        dispatches = 0
+        execute_wall = 0.0
+        overlapped = 0.0
+        bucket_results: Dict[tuple, dict] = {}
+
+        def run(stack):
+            t = time.perf_counter()
+            out = solve_waves_stacked(
+                stack, chunk_size=sched.chunk_size,
+                max_waves=sched.max_waves,
+            )
+            out["wall"] = time.perf_counter() - t
+            return out
+
+        if len(bucket_keys) == 1:
+            # one bucket ⇒ nothing to overlap: run inline rather than
+            # paying thread spawn/join on the common small-tick path
+            key = bucket_keys[0]
+            out = run(encode_bucket(key))
+            bucket_results[key] = out
+            dispatches += out["dispatches"]
+            execute_wall += out["wall"]
+        elif bucket_keys:
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                pending = list(bucket_keys)
+                next_stack = encode_bucket(pending[0])
+                while pending:
+                    key = pending.pop(0)
+                    stack = next_stack
+                    t_submit = time.perf_counter()
+                    future = pool.submit(run, stack)
+                    next_stack = None
+                    if pending:
+                        next_stack = encode_bucket(pending[0])
+                    encode_elapsed = time.perf_counter() - t_submit
+                    out = future.result()
+                    bucket_results[key] = out
+                    dispatches += out["dispatches"]
+                    execute_wall += out["wall"]
+                    overlapped += min(encode_elapsed, out["wall"])
+
+        # residual: the leftover gangs against the post-partition free
+        # capacity (original units), through the ordinary global kernel.
+        # LOCAL REJECTS join it: a gang the greedy assignment confined to
+        # a partition that turned out too fragmented for it must still
+        # see the whole cluster THIS tick (the admission-completeness
+        # half of the independence argument — docs/solver.md), not
+        # starve behind a deterministic re-confinement next tick.
+        rejected: set = set()
+        for key, out in bucket_results.items():
+            for li, lane in enumerate(buckets[key]):
+                for gi, g_global in enumerate(lane["idxs"]):
+                    if not out["admitted"][li, gi]:
+                        rejected.add(g_global)
+        residual_idxs = [
+            i
+            for i in range(len(part_of))
+            if part_of[i] == RESIDUAL or i in rejected
+        ]
+        free_after = np.array(free, dtype=np.float32)
+        rindex = {r: j for j, r in enumerate(enc.resource_names)}
+        for key, out in bucket_results.items():
+            for li, lane in enumerate(buckets[key]):
+                s = int(plan.starts[lane["k"]])
+                n_real = lane["n_real"]
+                for gi, g_global in enumerate(lane["idxs"]):
+                    if not out["admitted"][li, gi]:
+                        continue
+                    spec = gang_specs[g_global]
+                    for p, grp in enumerate(spec["groups"]):
+                        counts = out["alloc"][li, gi, p, :n_real]
+                        if not counts.any():
+                            continue
+                        for r, q in grp["demand"].items():
+                            j = rindex.get(r)
+                            if j is not None:
+                                free_after[s : s + n_real, j] -= (
+                                    counts * np.float32(q)
+                                )
+        residual_result = None
+        residual_problem = None
+        if residual_idxs:
+            from grove_tpu.solver.encode import build_problem_cached
+            from grove_tpu.solver.kernel import solve_waves
+
+            residual_problem = build_problem_cached(
+                enc,
+                free_after,
+                [gang_specs[i] for i in residual_idxs],
+                None,
+                pad_groups,
+            )
+            residual_result = solve_waves(
+                residual_problem,
+                chunk_size=sched.chunk_size,
+                max_waves=sched.max_waves,
+                with_alloc=True,
+            )
+
+        composite = self._compose(
+            problem, gang_specs, plan, buckets, bucket_results,
+            residual_idxs, residual_result,
+        )
+        composite.solve_seconds = execute_wall + (
+            residual_result.solve_seconds if residual_result else 0.0
+        )
+
+        # bookkeeping
+        self.solves += 1
+        self.last_subproblems = len(parts_used)
+        self.subproblems_total += len(parts_used)
+        self.assigned_total += int((part_of >= 0).sum())
+        self.residual_total += len(residual_idxs)
+        self.dispatches_total += dispatches + (
+            0 if residual_result is None else 1
+        )
+        self.last_residual_fraction = (
+            len(residual_idxs) / max(len(gang_specs), 1)
+        )
+        self.last_overlap_occupancy = overlapped / max(execute_wall, 1e-9)
+        METRICS.inc("frontier_solves_total")
+        METRICS.set("frontier_subproblems", self.last_subproblems)
+        METRICS.set(
+            "frontier_residual_fraction",
+            round(self.last_residual_fraction, 4),
+        )
+        METRICS.set("frontier_batched_dispatches", dispatches)
+        METRICS.set(
+            "frontier_overlap_occupancy",
+            round(self.last_overlap_occupancy, 4),
+        )
+        METRICS.observe(
+            "frontier_solve_seconds", time.perf_counter() - t0
+        )
+
+        if sched.frontier_selfcheck:
+            self._selfcheck(
+                sched, gang_specs, problem, plan, buckets, bucket_results,
+                residual_idxs, residual_result, composite,
+            )
+        return composite
+
+    def _compose(
+        self, problem, gang_specs, plan, buckets, bucket_results,
+        residual_idxs, residual_result,
+    ) -> PackingResult:
+        """Fold per-subproblem and residual results back into the global
+        problem's [G, P, N] index space (subproblem node columns map
+        through their slab offsets; residual columns are already global)."""
+        g_pad = problem.num_gangs
+        p_max = problem.max_groups
+        n = problem.num_nodes
+        admitted = np.zeros((g_pad,), dtype=bool)
+        placed = np.zeros((g_pad, p_max), dtype=np.int32)
+        score = np.zeros((g_pad,), dtype=np.float32)
+        chosen_level = np.full((g_pad,), -1, dtype=np.int32)
+        alloc = np.zeros((g_pad, p_max, n), dtype=np.int32)
+        for key, out in bucket_results.items():
+            for li, lane in enumerate(buckets[key]):
+                s = int(plan.starts[lane["k"]])
+                n_real = lane["n_real"]
+                for gi, g_global in enumerate(lane["idxs"]):
+                    admitted[g_global] = out["admitted"][li, gi]
+                    placed[g_global] = out["placed"][li, gi]
+                    score[g_global] = out["score"][li, gi]
+                    chosen_level[g_global] = out["chosen_level"][li, gi]
+                    alloc[g_global, :, s : s + n_real] = out["alloc"][
+                        li, gi, :, :n_real
+                    ]
+        if residual_result is not None:
+            for ri, g_global in enumerate(residual_idxs):
+                admitted[g_global] = residual_result.admitted[ri]
+                placed[g_global] = residual_result.placed[ri]
+                score[g_global] = residual_result.score[ri]
+                chosen_level[g_global] = residual_result.chosen_level[ri]
+                alloc[g_global] = residual_result.alloc[ri]
+        return PackingResult(
+            admitted=admitted,
+            placed=placed,
+            score=score,
+            chosen_level=chosen_level,
+            alloc=alloc,
+            free_after=None,  # composite; per-subproblem units differ
+            solve_seconds=0.0,
+        )
+
+    def _selfcheck(
+        self, sched, gang_specs, problem, plan, buckets, bucket_results,
+        residual_idxs, residual_result, composite,
+    ) -> None:
+        """The frontier A/B (delta_selfcheck's analogue): re-solve every
+        subproblem ALONE through the trusted host-loop solve_waves on the
+        SAME tensors, recompose sequentially, and assert the batched +
+        overlapped composite is bit-identical. The residual already ran
+        through solve_waves, so the check pins exactly the new machinery:
+        the vmap-batched dispatch, the stacking/padding, the double-buffer
+        thread, and the composition."""
+        from grove_tpu.solver.kernel import solve_waves
+
+        t0 = time.perf_counter()
+        ref_results: Dict[tuple, dict] = {}
+        for key, out in bucket_results.items():
+            lanes = buckets[key]
+            ref = {
+                f: np.zeros_like(out[f])
+                for f in ("admitted", "placed", "score", "chosen_level",
+                          "alloc")
+            }
+            for li, lane in enumerate(lanes):
+                solo = solve_waves(
+                    lane["problem"],
+                    chunk_size=sched.chunk_size,
+                    max_waves=sched.max_waves,
+                    with_alloc=True,
+                )
+                for field, got in (
+                    ("admitted", solo.admitted),
+                    ("placed", solo.placed),
+                    ("score", solo.score),
+                    ("chosen_level", solo.chosen_level),
+                    ("alloc", solo.alloc),
+                ):
+                    ref[field][li] = got
+                    if not np.array_equal(out[field][li], got):
+                        raise AssertionError(
+                            "partitioned frontier diverged from the solo"
+                            f" solve on {field!r} (partition"
+                            f" {lane['k']}, bucket {key})"
+                        )
+            ref_results[key] = ref
+        ref_composite = self._compose(
+            problem, gang_specs, plan, buckets, ref_results,
+            residual_idxs, residual_result,
+        )
+        for field in ("admitted", "placed", "score", "chosen_level",
+                      "alloc"):
+            if not np.array_equal(
+                getattr(composite, field), getattr(ref_composite, field)
+            ):
+                raise AssertionError(
+                    "partitioned frontier composite diverged from the"
+                    f" sequential recomposition on {field!r}"
+                )
+        elapsed = time.perf_counter() - t0
+        self.selfcheck_seconds += elapsed
+        sched.last_selfcheck_seconds += elapsed
+
+    # -- reporting -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Lifetime counters for the bench "frontier" sub-block."""
+        return {
+            "solves": self.solves,
+            "degenerate_ticks": self.degenerate,
+            "subproblems_total": self.subproblems_total,
+            "assigned_gangs_total": self.assigned_total,
+            "residual_gangs_total": self.residual_total,
+            "residual_fraction": round(
+                self.residual_total
+                / max(self.assigned_total + self.residual_total, 1),
+                4,
+            ),
+            "batched_dispatches_total": self.dispatches_total,
+            "last_overlap_occupancy": round(
+                self.last_overlap_occupancy, 4
+            ),
+            "ab_overhead_ms": round(self.selfcheck_seconds * 1e3, 1),
+        }

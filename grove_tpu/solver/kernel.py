@@ -409,6 +409,162 @@ def solve_waves(
     )
 
 
+def solve_waves_stacked(
+    stack: Dict[str, np.ndarray],
+    chunk_size: int = 32,
+    max_waves: int = 16,
+) -> Dict[str, np.ndarray]:
+    """Wave-parallel solve of a STACK of same-shape subproblems — the
+    partitioned frontier's batch execution (solver/frontier.py).
+
+    ``stack`` holds the per-lane problem tensors with a leading batch axis
+    (``capacity [B,N,R]``, ``topo [B,N,L]``, ``seg_starts``/``seg_ends
+    [B,L,D]``, gang tensors ``[B,G,...]``). The host loop reproduces
+    :func:`solve_waves` EXACTLY per lane — same chunk clamp and padding,
+    same per-wave seeds (lane-local ``arange + wave*7919``), same commit
+    semantics — but every (wave, chunk) step is ONE
+    ``solve_wave_chunk_stack`` dispatch covering all B lanes, so B small
+    solves cost ~one solve's dispatch count. Returns per-lane result
+    arrays (``admitted [B,G]``, ``placed``, ``score``, ``chosen_level``,
+    ``alloc [B,G,P,N]``) plus ``dispatches`` and ``solve_seconds``.
+
+    Bit-identity per lane vs a solo ``solve_waves`` run on the same
+    subproblem tensors is the frontier selfcheck's contract
+    (tests/test_frontier.py, ``make frontier-smoke``)."""
+    from grove_tpu.ops.packing import solve_wave_chunk_stack
+
+    demand = stack["demand"]
+    b, g, p_max, _r = demand.shape
+    n = stack["capacity"].shape[1]
+    chunk_size = min(chunk_size, max(g, 1))
+    n_chunks = max(1, (g + chunk_size - 1) // chunk_size)
+    g_pad = n_chunks * chunk_size
+
+    def pad(a, value=0):
+        if a.shape[1] == g_pad:
+            return a
+        width = [(0, 0), (0, g_pad - a.shape[1])] + [(0, 0)] * (a.ndim - 2)
+        return np.pad(a, width, constant_values=value)
+
+    demand = pad(demand)
+    count = pad(stack["count"])
+    min_count = pad(stack["min_count"])
+    req_level = pad(stack["req_level"], -1)
+    pref_level = pad(stack["pref_level"], -1)
+    group_req = pad(stack["group_req"], -1)
+    group_pin = pad(stack["group_pin"], -1)
+    gang_pin = pad(stack["gang_pin"], -1)
+    spread_level = pad(stack["spread_level"], -1)
+    spread_min = pad(stack["spread_min"])
+    spread_required = pad(stack["spread_required"])
+    spread_seed = pad(stack["spread_seed"])
+
+    _maybe_enable_disk_cache()
+    free = jnp.asarray(stack["capacity"])
+    topo = jnp.asarray(stack["topo"])
+    seg_starts = jnp.asarray(stack["seg_starts"])
+    seg_ends = jnp.asarray(stack["seg_ends"])
+    n_levels = stack["topo"].shape[2]
+    pending = np.zeros((b, g_pad), dtype=bool)
+    pending[:, :g] = True
+    narrow_cap = np.full((b, g_pad), n_levels - 1, dtype=np.int32)
+
+    admitted = np.zeros((b, g_pad), dtype=bool)
+    placed = np.zeros_like(count)
+    score = np.zeros((b, g_pad), dtype=np.float32)
+    chosen_level = np.full((b, g_pad), -1, dtype=np.int32)
+    alloc = np.zeros((b, g_pad, p_max, n), dtype=np.int32)
+
+    grouped = bool((group_req >= 0).any())
+    pinned = bool((gang_pin >= 0).any())
+    spread = bool((spread_level >= 0).any())
+    # the AND over lanes, not the OR: `uniform` asserts min == count for
+    # every gang (padded gangs are 0 == 0, preserving it)
+    uniform = bool((min_count == count).all())
+
+    chunk_const = [
+        tuple(
+            jnp.asarray(a[:, c * chunk_size : (c + 1) * chunk_size])
+            for a in (
+                demand, count, min_count, req_level, pref_level,
+                group_req, group_pin, gang_pin,
+                spread_level, spread_min, spread_required, spread_seed,
+            )
+        )
+        for c in range(n_chunks)
+    ]
+
+    t0 = time.perf_counter()
+    dispatches = 0
+    for wave in range(max_waves):
+        if not pending.any():
+            break
+        # lane-LOCAL seeds, exactly solve_waves' per-problem sequence: a
+        # lane's gang keeps the seed it would have had solving alone
+        seeds = np.broadcast_to(
+            np.arange(g_pad, dtype=np.int32) + np.int32(wave * 7919),
+            (b, g_pad),
+        )
+        for c in range(n_chunks):
+            sl = slice(c * chunk_size, (c + 1) * chunk_size)
+            mask = pending[:, sl]
+            if not mask.any():
+                continue
+            (
+                dem_c, cnt_c, mn_c, rq_c, pf_c, grq_c, gpin_c, gangpin_c,
+                slvl_c, smin_c, sreq_c, sseed_c,
+            ) = chunk_const[c]
+            with TRACER.span(
+                "solver.execute", kernel="solve_wave_chunk_stack", gangs=g
+            ):
+                out = solve_wave_chunk_stack(
+                    free, topo, seg_starts, seg_ends,
+                    dem_c, cnt_c, mn_c, rq_c, pf_c,
+                    jnp.asarray(mask),
+                    jnp.asarray(narrow_cap[:, sl]),
+                    jnp.asarray(np.ascontiguousarray(seeds[:, sl])),
+                    grq_c, gpin_c, gangpin_c,
+                    slvl_c, smin_c, sreq_c, sseed_c,
+                    grouped=grouped, pinned=pinned, spread=spread,
+                    uniform=uniform,
+                )
+            dispatches += 1
+            (
+                free, accept_d, retry_d, new_cap_d,
+                placed_d, score_d, chosen_d, alloc_d,
+            ) = out
+            committed = np.asarray(accept_d)
+            retry = np.asarray(retry_d)
+            admitted[:, sl] |= committed
+            placed[:, sl] = np.where(
+                committed[:, :, None], np.asarray(placed_d), placed[:, sl]
+            )
+            score[:, sl] = np.where(
+                committed, np.asarray(score_d), score[:, sl]
+            )
+            chosen_level[:, sl] = np.where(
+                committed, np.asarray(chosen_d), chosen_level[:, sl]
+            )
+            narrow_cap[:, sl] = np.asarray(new_cap_d)
+            alloc[:, sl] = np.where(
+                committed[:, :, None, None],
+                np.asarray(alloc_d),
+                alloc[:, sl],
+            )
+            pending[:, sl] = mask & retry
+    elapsed = time.perf_counter() - t0
+    return {
+        "admitted": admitted[:, :g],
+        "placed": placed[:, :g],
+        "score": score[:, :g],
+        "chosen_level": chosen_level[:, :g],
+        "alloc": alloc[:, :g],
+        "free_after": np.asarray(free),
+        "dispatches": dispatches,
+        "solve_seconds": elapsed,
+    }
+
+
 def level_widths_of(problem: PackingProblem) -> tuple:
     """Per-level REAL domain counts (dense ids ⇒ max id + 1), the static
     `level_widths` for the wave solvers' ragged candidate scan. Derived

@@ -129,6 +129,24 @@ class GangScheduler:
         # True while the most recent batch "solve" was a fingerprint reuse
         # (no dispatch ran): gates the gang_solve_seconds observation
         self._solve_reused = False
+        # partitioned solver frontier (solver/frontier.py, docs/solver.md
+        # "Partitioned frontier"): per-super-domain subproblem
+        # decomposition with vmap-batched dispatch. None → the global
+        # frontier; enable_frontier() attaches it (requires delta state).
+        self.frontier = None
+        # debug/A-B mode: after every partitioned solve, re-solve each
+        # subproblem alone through the host-loop kernel and assert the
+        # batched composite is bit-identical (tests, `make
+        # frontier-smoke`, sampled in the bench "frontier" block)
+        self.frontier_selfcheck = False
+        # True while the most recent solve went through the partitioned
+        # frontier (the delta A/B then pins the problem encode only — the
+        # frontier selfcheck owns the solve comparison)
+        self._frontier_solved = False
+        # shards whose pending_namespaces gauge was set last round (they
+        # are zeroed when they drain — a gauge never touched again would
+        # report phantom pending work forever)
+        self._pending_ns_shards: set = set()
 
     def enable_delta(self) -> bool:
         """Attach the incremental delta-solve state. In-memory stores only:
@@ -144,6 +162,21 @@ class GangScheduler:
         from grove_tpu.solver.deltastate import DeltaSolveState
 
         self.delta = DeltaSolveState(self.store, self.cluster, self.topology)
+        return True
+
+    def enable_frontier(self) -> bool:
+        """Attach the partitioned solver frontier (solver/frontier.py).
+        Requires the delta-solve state (the partition plan rides its
+        cached NodeEncoding and maintained free matrix) and an in-process
+        solver (the sidecar path keeps the global frontier). Safe to call
+        twice."""
+        if self.frontier is not None:
+            return True
+        if self.solver_sidecar is not None or not self.enable_delta():
+            return False
+        from grove_tpu.solver.frontier import FrontierState
+
+        self.frontier = FrontierState(self.topology)
         return True
 
     def _solve_batch_delta(self, nodes: List, gang_specs: List[dict]):
@@ -172,6 +205,18 @@ class GangScheduler:
             # must not re-observe it (flag checked at the observe site)
             self._solve_reused = True
             return self._delta_last[1], problem
+        self._frontier_solved = False
+        if self.frontier is not None and self.solver_sidecar is None:
+            # partitioned frontier: node-disjoint subproblems solved as
+            # batched dispatches + a global residual pass. None ⇒ the
+            # tick is degenerate (single super-domain or all-residual)
+            # and falls through to the ordinary global solve below.
+            result = self.frontier.solve(self, gang_specs, problem)
+            if result is not None:
+                self._solve_reused = False
+                self._frontier_solved = True
+                self._delta_last = (key, result)
+                return result, problem
         # the sidecar request is built from free-capacity DICTS — serve
         # them from the maintained matrix so delta state survives
         # _solve_remote without an O(bindings) repass (in-process solves
@@ -215,6 +260,14 @@ class GangScheduler:
                 f"delta-solve problem diverged from the from-scratch "
                 f"encode: {mismatch}"
             )
+        if self._frontier_solved:
+            # the partitioned frontier's result is semantically its own
+            # (partition-confined placements): the delta A/B pins the
+            # ENCODE equivalence above, and the frontier selfcheck owns
+            # the solve comparison (batched composite vs the sequential
+            # per-subproblem reference)
+            self.last_selfcheck_seconds += _time.perf_counter() - t0
+            return
         full_result = solve_waves(
             full,
             chunk_size=self.chunk_size,
@@ -429,6 +482,24 @@ class GangScheduler:
             ) or ["default"]
         else:
             namespaces = [namespace]
+        if namespace is None and getattr(self.store, "num_shards", 1) > 1:
+            # per-shard pending feed (docs/control-plane.md §4): surface
+            # how a FULL round's pending namespaces spread over keyspace
+            # shards — the partitioned frontier's demand-side analogue of
+            # the shard census (one O(namespaces) pass per round).
+            # Shards that drained since the last full round are zeroed,
+            # or the exposition would report phantom pending work
+            # forever; targeted single-namespace calls leave the gauges
+            # alone (they see one namespace, not the round's demand).
+            by_shard: Dict[int, int] = {}
+            for ns in namespaces:
+                idx = self.store.shard_index(ns)
+                by_shard[idx] = by_shard.get(idx, 0) + 1
+            for idx in self._pending_ns_shards - set(by_shard):
+                METRICS.set(f"pending_namespaces/{idx}", 0)
+            for idx, count in by_shard.items():
+                METRICS.set(f"pending_namespaces/{idx}", count)
+            self._pending_ns_shards = set(by_shard)
         self.cluster._gc_bindings()
         if self.delta is not None:
             # BEFORE the pending scan: a topology change (cordon, flap,

@@ -1,0 +1,389 @@
+"""Partitioned solver frontier: assignment pins + batched-vs-sequential
+equivalence under randomized churn (solver/frontier.py, docs/solver.md
+"Partitioned frontier").
+
+Two layers:
+
+1. **Assignment unit pins** — the deterministic gang→partition map:
+   multi-domain gangs (pins spanning super-domains), spread gangs,
+   too-big gangs and unknown-resource gangs go to the residual; forced
+   pins follow their survivors; a cordon that removes a partition's
+   nodes shifts the assignment; empty partitions build no subproblem.
+2. **Churn-storm equivalence** — randomized storms (arrivals, pod
+   failures, node flaps, cordons, drains, quota reclaim) run with the
+   scheduler's ``frontier_selfcheck`` armed EVERY tick: each partitioned
+   solve re-solves every subproblem ALONE through the host-loop kernel
+   and asserts the vmap-batched + double-buffered composite is
+   bit-identical (admissions, placements, scores, allocations), raising
+   inside ``schedule_pending`` on any divergence. ``delta_selfcheck``
+   rides along, so the problem ENCODE stays pinned against a
+   from-scratch ``build_problem`` at the same time. Degenerate topology
+   (single super-domain) must bypass byte-identically to the global
+   path.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.meta import deep_copy
+from grove_tpu.api.topology import ClusterTopology, TopologyLevel
+from grove_tpu.models import load_sample
+from grove_tpu.sim.cluster import make_nodes
+from grove_tpu.sim.harness import SimHarness
+from grove_tpu.solver.encode import NodeEncoding
+from grove_tpu.solver.frontier import RESIDUAL, FrontierState
+
+NS = "default"
+
+
+def _spec(name, cpu=0.1, count=2, **kw):
+    spec = {
+        "name": f"{NS}/{name}",
+        "gang_name": name,
+        "namespace": NS,
+        "groups": [
+            {
+                "name": f"{name}-g0",
+                "demand": {"cpu": cpu},
+                "count": count,
+                "min_count": count,
+                "partial": False,
+                "required_key": None,
+                "pinned_node": None,
+            }
+        ],
+        "required_key": None,
+        "preferred_key": None,
+        "spread_key": None,
+        "spread_min_domains": 2,
+        "spread_required": False,
+        "spread_survivor_nodes": [],
+        "gang_pinned_node": None,
+        "priority": 0,
+        "queue": "default",
+    }
+    spec.update(kw)
+    return spec
+
+
+class TestPartitionAssignment:
+    def setup_method(self):
+        self.topology = ClusterTopology()
+        self.nodes = make_nodes(32)  # 2 slices of 16 hosts
+        rset = sorted({r for n in self.nodes for r in n.capacity})
+        self.enc = NodeEncoding(self.nodes, self.topology, rset)
+        self.free = self.enc.base_capacity.copy()
+        self.state = FrontierState(self.topology)
+        self.plan = self.state.plan_for(self.enc)
+        assert self.plan is not None and self.plan.num_partitions == 2
+
+    def _slab_names(self, k):
+        s, e = int(self.plan.starts[k]), int(self.plan.ends[k])
+        return set(self.enc.node_names[s:e])
+
+    def assign(self, specs):
+        return self.state.assign(self.plan, self.enc, self.free, specs)
+
+    def test_multi_domain_pins_go_residual(self):
+        spec = _spec("multi")
+        spec["groups"][0]["pinned_node"] = "node-0"  # slice-0
+        spec["spread_survivor_nodes"] = ["node-31"]  # slice-1
+        assert self.assign([spec])[0] == RESIDUAL
+
+    def test_forced_partition_follows_pin(self):
+        spec = _spec("pinned", gang_pinned_node="node-20")
+        (part,) = self.assign([spec])
+        assert part >= 0 and "node-20" in self._slab_names(part)
+
+    def test_spread_gang_goes_residual(self):
+        assert (
+            self.assign(
+                [_spec("spread", spread_key="kubernetes.io/hostname")]
+            )[0]
+            == RESIDUAL
+        )
+
+    def test_broad_preference_goes_residual(self):
+        # prefers the zone level — broader than the slice-level partition
+        assert (
+            self.assign(
+                [_spec("broad", preferred_key="topology.kubernetes.io/zone")]
+            )[0]
+            == RESIDUAL
+        )
+
+    def test_oversized_gang_goes_residual(self):
+        # one slice holds 16 nodes x 8 cpu = 128: demand 20 x 7 = 140
+        assert self.assign([_spec("big", cpu=7.0, count=20)])[0] == RESIDUAL
+
+    def test_unknown_resource_goes_residual(self):
+        spec = _spec("weird")
+        spec["groups"][0]["demand"] = {"quantum-flux": 1.0}
+        assert self.assign([spec])[0] == RESIDUAL
+
+    def test_assignment_balances_and_debits(self):
+        # each gang demands most of a slice: the greedy debit must push
+        # the second gang to the OTHER partition
+        specs = [_spec(f"fat-{i}", cpu=7.0, count=14) for i in range(2)]
+        parts = self.assign(specs)
+        assert set(parts.tolist()) == {0, 1}
+
+    def test_cordon_mask_shifts_partition(self):
+        spec = _spec("mover", cpu=1.0, count=4)
+        (before,) = self.assign([spec])
+        cordoned = self._slab_names(before)
+        survivors = [n for n in self.nodes if n.name not in cordoned]
+        enc2 = NodeEncoding(
+            survivors, self.topology, list(self.enc.resource_names)
+        )
+        state2 = FrontierState(self.topology)
+        plan2 = state2.plan_for(enc2)
+        (after,) = state2.assign(
+            plan2, enc2, enc2.base_capacity.copy(), [spec]
+        )
+        assert after >= 0
+        s, e = int(plan2.starts[after]), int(plan2.ends[after])
+        assert not cordoned & set(enc2.node_names[s:e])
+
+
+def _frontier_harness(num_nodes=32, selfcheck=True):
+    h = SimHarness(num_nodes=num_nodes)
+    assert h.scheduler.enable_frontier()
+    h.scheduler.frontier_selfcheck = selfcheck
+    h.scheduler.delta_selfcheck = selfcheck  # encode equivalence rides along
+    return h
+
+
+class TestFrontierSolveEquivalence:
+    """Any batched-composite vs sequential-reference divergence raises
+    inside schedule_pending — converging a storm IS the assertion."""
+
+    @pytest.mark.parametrize("seed", [3, 42, 2026])
+    def test_churn_storm_bit_identical(self, seed):
+        rng = random.Random(seed)
+        h = _frontier_harness()
+        for i in range(5):
+            pcs = deep_copy(load_sample("simple"))
+            pcs.metadata.name = f"seed-{i}"
+            h.apply(pcs)
+        h.converge(max_ticks=30)
+        n = h.cluster.nodes
+        applied = 0
+        for _step in range(14):
+            roll = rng.random()
+            if roll < 0.3:
+                pcs = deep_copy(load_sample("simple"))
+                pcs.metadata.name = f"storm-{seed}-{applied}"
+                applied += 1
+                h.apply(pcs)
+            elif roll < 0.45:
+                pods = h.store.list("Pod", NS)
+                if pods:
+                    p = rng.choice(
+                        sorted(pods, key=lambda p: p.metadata.name)
+                    )
+                    h.cluster.fail_pod(NS, p.metadata.name)
+            elif roll < 0.6:
+                h.cluster.crash_node(rng.choice(n).name)  # flap out
+            elif roll < 0.7:
+                for node in n:
+                    if node.crashed and rng.random() < 0.7:
+                        h.cluster.restart_node(node.name)  # flap back
+            elif roll < 0.8:
+                node = rng.choice(n)
+                node.cordoned = not node.cordoned
+            elif roll < 0.9:
+                sets = h.store.list("PodCliqueSet", NS)
+                if len(sets) > 2:
+                    victim = rng.choice(
+                        sorted(sets, key=lambda s: s.metadata.name)
+                    )
+                    h.delete(victim.metadata.name)
+            else:
+                node = rng.choice(n)
+                if node.cordoned:
+                    h.drainer.uncordon(node.name)
+                else:
+                    h.drainer.request_drain(node.name)
+            h.converge(max_ticks=rng.randrange(2, 5))
+        for node in n:
+            if h.drainer.drain_state(node.name):
+                h.drainer.uncordon(node.name)
+            node.cordoned = False
+            if node.crashed:
+                h.cluster.restart_node(node.name)
+        h.converge(max_ticks=60)
+        st = h.scheduler.frontier.stats()
+        assert st["solves"] > 0, "storm never took the partitioned path"
+        assert st["subproblems_total"] >= st["solves"]
+
+    def test_reclaim_storm_bit_identical(self):
+        """Quota reclaim in the mix: the staggered 3-tenant contention
+        scenario runs with the frontier + both selfchecks armed — every
+        reclaim eviction and queue-ordered partitioned solve stays
+        pinned."""
+        from grove_tpu.observability.metrics import METRICS
+        from grove_tpu.sim.multitenant import build_contended_harness
+
+        before = METRICS.counters.get("quota_reclaims_total", 0)
+        h, _tenants = build_contended_harness()
+        assert h.scheduler.enable_frontier()
+        h.scheduler.frontier_selfcheck = True
+        h.scheduler.delta_selfcheck = True
+        h.converge(max_ticks=200)
+        assert (
+            METRICS.counters.get("quota_reclaims_total", 0) > before
+        ), "scenario must actually reclaim"
+        assert h.scheduler.frontier.solves > 0
+
+    def test_recovery_pins_force_partitions(self):
+        """A node crash inside one super-domain leaves survivors whose
+        recovery pins FORCE the replacement solve into that partition —
+        and the solve stays bit-identical (selfcheck armed)."""
+        h = _frontier_harness()
+        pcs = deep_copy(load_sample("multinode_disaggregated"))
+        pcs.metadata.name = "pinned"
+        h.apply(pcs)
+        h.converge(max_ticks=40)
+        bound = [node for (_, _), node in h.cluster.bindings.items()]
+        if bound:
+            h.cluster.crash_node(bound[0])
+            h.converge(max_ticks=80)
+        assert h.scheduler.frontier.stats()["solves"] > 0
+
+    def test_empty_partition_skip(self):
+        """One small gang on a 3-slice cluster: only the assigned
+        partition builds a subproblem."""
+        h = _frontier_harness(num_nodes=48)
+        pcs = deep_copy(load_sample("simple"))
+        pcs.metadata.name = "lone"
+        h.apply(pcs)
+        h.converge(max_ticks=30)
+        st = h.scheduler.frontier.stats()
+        assert st["solves"] >= 1
+        # every solve built at most one subproblem (the other slices are
+        # empty and skipped), and the lone gang was admitted
+        assert st["subproblems_total"] <= st["solves"]
+        from grove_tpu.api.pod import is_ready
+
+        pods = h.store.list("Pod", NS)
+        assert pods and all(is_ready(p) for p in pods)
+
+    def test_residual_pass_admits_oversized_gang(self):
+        """A gang no single partition can hold routes through the global
+        residual solve and still lands (partitioned admissions keep the
+        full cluster reachable)."""
+        h = _frontier_harness(num_nodes=48)
+        from grove_tpu.api.load import load_podcliquesets
+
+        big = load_podcliquesets(
+            """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: big
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: wide
+        spec:
+          roleName: role-wide
+          replicas: 20
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: "7"
+"""
+        )[0]
+        h.apply(big)
+        for i in range(3):
+            pcs = deep_copy(load_sample("simple"))
+            pcs.metadata.name = f"small-{i}"
+            h.apply(pcs)
+        h.converge(max_ticks=40)
+        st = h.scheduler.frontier.stats()
+        assert st["residual_gangs_total"] >= 1, "residual path not hit"
+        from grove_tpu.api.pod import is_ready
+
+        pods = h.store.list("Pod", NS)
+        assert pods and all(is_ready(p) for p in pods)
+
+    def test_degenerate_topology_matches_global_run(self):
+        """Single super-domain (one zone level): the frontier must bypass
+        to the global solve byte-identically — twin runs with the
+        frontier on and off converge to identical bindings and phases."""
+
+        def run(frontier):
+            topo = ClusterTopology()
+            topo.spec.levels = [
+                TopologyLevel("zone", "topology.kubernetes.io/zone")
+            ]
+            h = SimHarness(num_nodes=8, topology=topo)
+            if frontier:
+                assert h.scheduler.enable_frontier()
+                h.scheduler.frontier_selfcheck = True
+            for i in range(4):
+                pcs = deep_copy(load_sample("simple"))
+                pcs.metadata.name = f"d-{i}"
+                h.apply(pcs)
+            h.converge(max_ticks=30)
+            h.cluster.fail_pod(NS, sorted(
+                name for (_ns, name) in h.cluster.bindings
+            )[0])
+            h.converge(max_ticks=30)
+            bindings = dict(h.cluster.bindings)
+            phases = {
+                g.metadata.name: g.status.phase
+                for g in h.store.list("PodGang", NS)
+            }
+            stats = (
+                h.scheduler.frontier.stats()
+                if h.scheduler.frontier is not None
+                else None
+            )
+            return bindings, phases, stats
+
+        b_on, p_on, st_on = run(True)
+        b_off, p_off, _ = run(False)
+        assert (b_on, p_on) == (b_off, p_off)
+        assert st_on["solves"] == 0 and st_on["degenerate_ticks"] > 0
+
+    def test_composite_shape_matches_global_problem(self):
+        """The composite result indexes the global problem's padded gang
+        axis and node order (assignments() consumes it directly)."""
+        h = _frontier_harness()
+        for i in range(4):
+            pcs = deep_copy(load_sample("simple"))
+            pcs.metadata.name = f"shape-{i}"
+            h.apply(pcs)
+        # one manual schedule round so we can inspect the raw solve
+        h.engine.drain()
+        specs_seen = {}
+        orig = h.scheduler._solve_batch_delta
+
+        def spy(nodes, gang_specs):
+            result, problem = orig(nodes, gang_specs)
+            specs_seen["result"] = result
+            specs_seen["problem"] = problem
+            return result, problem
+
+        h.scheduler._solve_batch_delta = spy
+        try:
+            h.converge(max_ticks=30)
+        finally:
+            h.scheduler._solve_batch_delta = orig
+        result, problem = specs_seen["result"], specs_seen["problem"]
+        assert result.admitted.shape[0] == problem.num_gangs
+        assert result.alloc.shape == (
+            problem.num_gangs, problem.max_groups, problem.num_nodes,
+        )
+        # every allocated pod count maps onto a real node column
+        assert result.alloc.sum() > 0
+        placed_cols = np.nonzero(result.alloc.sum(axis=(0, 1)))[0]
+        assert placed_cols.max() < len(problem.node_names)

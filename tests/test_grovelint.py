@@ -212,6 +212,21 @@ FIXTURES = {
             "    return self.store.shard_census()\n"
         ),
     },
+    "GL014": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def tweak(self):\n"
+            "    self.scheduler.frontier._plan = None\n"
+            "    self.scheduler.frontier._sub_encodings.clear()\n"
+            "    self.scheduler.frontier.solves += 1\n"
+        ),
+        "good": (
+            "def tweak(self):\n"
+            "    self.scheduler.frontier.invalidate()\n"
+            "    stats = self.scheduler.frontier.stats()\n"
+            "    return stats\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -348,6 +363,51 @@ def test_grafting_shard_internals_access_fails_lint():
         "grove_tpu/durability/recovery.py",
     )
     assert "GL013" not in rules_of(report2)
+
+
+def test_grafting_frontier_state_write_fails_lint():
+    """GL014 live-tree teeth: a rogue helper rewriting the frontier's
+    partition plan from the scheduler source must fail lint — a plan
+    incoherent with the delta state's NodeEncoding composes allocations
+    onto the wrong global node columns. The owning module itself stays
+    exempt, and the sanctioned invalidate() hook passes anywhere."""
+    rel = "grove_tpu/solver/scheduler.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_replan(sched, plan, starts):\n"
+        "    sched.frontier._plan = plan\n"
+        "    sched.frontier.subproblems_total = 0\n"
+        # chain writes THROUGH the plan must be caught too (the slab
+        # table is exactly what maps allocations to node columns)
+        "    sched.frontier._plan.starts = starts\n"
+        "    sched.frontier._plan._sub_encodings.clear()\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL014" in rules_of(report)
+    # the untouched scheduler source is clean (it only attaches the state
+    # and reads stats)
+    assert "GL014" not in rules_of(lint_source(src, rel))
+    # the owning module may mutate its own state
+    own = (ROOT / "grove_tpu/solver/frontier.py").read_text()
+    assert "GL014" not in rules_of(
+        lint_source(own, "grove_tpu/solver/frontier.py")
+    )
+    # the sanctioned out-of-band hook is not a violation anywhere
+    ok = lint_source(
+        "def reset(sched):\n    sched.frontier.invalidate()\n",
+        "grove_tpu/controller/nodehealth.py",
+    )
+    assert "GL014" not in rules_of(ok)
+    # precision: FOREIGN plan state (no frontier binding in the chain)
+    # stays out of scope — generic field names must not false-positive
+    for src in (
+        "def f(self, x):\n    self._plan.starts = x\n",
+        "def f(self, x):\n    self.rollout_plan.level = x\n",
+        "def f(plan, d):\n    plan.update(d)\n",
+    ):
+        assert "GL014" not in rules_of(
+            lint_source(src, "grove_tpu/autoscale/fixture.py")
+        ), src
 
 
 def test_unregistering_reason_fails_lint():

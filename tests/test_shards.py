@@ -234,6 +234,44 @@ class TestHierarchicalAggregation:
         tree = ShardSummaryTree(64)
         assert tree.fold_depth_histogram() == [64, 8, 1]
 
+    def test_update_leaf_path_refold_equals_whole_refold(self):
+        """The read-side shave (docs/control-plane.md §4): replacing one
+        leaf and path-refolding its ancestor chain must equal a whole-tree
+        refold for every (tree width, leaf index)."""
+        rng = random.Random(23)
+        for width in (1, 2, 8, 9, 17, 64):
+            partials = [
+                (rng.randrange(100), rng.randrange(50)) for _ in range(width)
+            ]
+            a = ShardSummaryTree(width)
+            b = ShardSummaryTree(width)
+            a.refold(list(partials))
+            b.refold(list(partials))
+            for _ in range(20):
+                i = rng.randrange(width)
+                partials[i] = (rng.randrange(100), rng.randrange(50))
+                a.refold(list(partials))
+                b.update_leaf(i, partials[i])
+                assert a.root() == b.root(), (width, i)
+                assert a.levels == b.levels, (width, i)
+
+    def test_summary_read_skips_fold_when_quiet(self):
+        """A quiet store's summary read returns the cached root without
+        touching the aggregates; a single hot shard path-refolds and
+        still equals the flat fold."""
+        store = Store(Clock(), num_shards=8)
+        for op in _storm_ops(5, 120):
+            _apply_storm_op(store, op)
+        first = store.pod_summary()
+        assert not store._summary_dirty  # drained by the read
+        assert store.pod_summary() == first == _flat_summary(store)
+        # one more commit dirties exactly its owning shard
+        ns = NAMESPACES[0]
+        pod = _mk_pod(random.Random(9), ns, "hot-shard-pod")
+        store.create(pod, consume=True)
+        assert store._summary_dirty == {store.shard_index(ns)}
+        assert store.pod_summary() == _flat_summary(store)
+
     def test_cached_view_summary_under_lag(self):
         store = Store(Clock(), cache_lag=True, num_shards=3)
         backlog = []
@@ -675,3 +713,46 @@ class TestEngineSharding:
         # strict alternation for two equal streams (11 boundaries), far
         # from the 1 flip a shard-at-a-time drain would produce
         assert flips >= len(order) - 2
+
+
+class TestCensusSpreadGate:
+    """scripts/scale_smoke.py's census check is shard-count aware: S>=2
+    demands real cross-shard spread, S=1 (the inert-A/B arm) demands
+    exactly one populated shard — both arms pinned."""
+
+    def test_sharded_arm_requires_spread(self):
+        from grove_tpu.sim.scale import census_spread_problems
+
+        spread = [
+            {"shard": 0, "objects": 10, "rv": 10},
+            {"shard": 1, "objects": 4, "rv": 4},
+            {"shard": 2, "objects": 0, "rv": 0},
+        ]
+        assert census_spread_problems(spread, 3) == []
+        hot = [
+            {"shard": 0, "objects": 14, "rv": 14},
+            {"shard": 1, "objects": 0, "rv": 0},
+            {"shard": 2, "objects": 0, "rv": 0},
+        ]
+        assert census_spread_problems(hot, 3), "one hot shard must fail"
+
+    def test_single_shard_arm_is_inert_not_a_failure(self):
+        from grove_tpu.sim.scale import census_spread_problems
+
+        single = [{"shard": 0, "objects": 14, "rv": 14}]
+        assert census_spread_problems(single, 1) == []
+        # an S=1 store that somehow landed nothing anywhere IS a failure
+        assert census_spread_problems(
+            [{"shard": 0, "objects": 0, "rv": 0}], 1
+        )
+
+    def test_live_store_census_matches_gate(self):
+        from grove_tpu.sim.scale import census_spread_problems
+
+        for shards in (1, 3):
+            store = Store(Clock(), num_shards=shards)
+            for i, ns in enumerate(NAMESPACES * 2):
+                store.create(
+                    Pod(metadata=ObjectMeta(name=f"c-{i}", namespace=ns))
+                )
+            assert census_spread_problems(store.shard_census(), shards) == []
