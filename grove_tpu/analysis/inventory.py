@@ -22,16 +22,22 @@ from grove_tpu.analysis.engine import (
 _METRIC_METHODS = {"inc", "set", "observe"}
 
 
+def _metric_base(text: str) -> str:
+    """Base metric name: everything before the `/label` and `@shard`
+    suffixes (observability/metrics.py grammar)."""
+    return text.split("/", 1)[0].split("@", 1)[0]
+
+
 def _literal_prefix(node: ast.AST) -> str:
     """Literal text of a metric-name argument: a plain string, or the
-    leading constant of an f-string (names label with `/{...}` suffixes —
-    the base name is everything before the first '/')."""
+    leading constant of an f-string (names label with `/{...}` and/or
+    `@{...}` suffixes — the base name is everything before either)."""
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value.split("/", 1)[0]
+        return _metric_base(node.value)
     if isinstance(node, ast.JoinedStr) and node.values:
         head = node.values[0]
         if isinstance(head, ast.Constant) and isinstance(head.value, str):
-            return head.value.split("/", 1)[0].rstrip("/")
+            return _metric_base(head.value).rstrip("/@")
     return ""
 
 
@@ -116,6 +122,45 @@ def emitted_metric_names(root: Path) -> Dict[str, Set[Tuple[str, int]]]:
             name = _literal_prefix(node.args[0])
             if name:
                 out.setdefault(name, set()).add((rel, node.lineno))
+    return out
+
+
+def emitted_profile_phases(root: Path) -> Dict[str, Set[Tuple[str, int]]]:
+    """phase name -> {(path, line)} for every ``PROFILER.phase("...")``
+    call with a literal name, plus the implicit ``reconcile`` phase for
+    ``PROFILER.reconcile(...)`` call sites. Feeds the docs-drift gate: an
+    instrumented phase cannot ship outside the profile.py registry or the
+    docs/observability.md "Wall-attribution profiler" table."""
+    out: Dict[str, Set[Tuple[str, int]]] = {}
+    for rel in repo_python_files(root):
+        tree = ast.parse((root / rel).read_text())
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            base = dotted(node.func.value).split(".")[-1].lower()
+            if "profiler" not in base:
+                continue
+            if node.func.attr == "reconcile":
+                out.setdefault("reconcile", set()).add((rel, node.lineno))
+            elif node.func.attr == "phase" and node.args:
+                arg = node.args[0]
+                # literal, or a conditional between literals (the store's
+                # status-write vs store-commit split)
+                candidates = (
+                    (arg.body, arg.orelse)
+                    if isinstance(arg, ast.IfExp)
+                    else (arg,)
+                )
+                for cand in candidates:
+                    if isinstance(cand, ast.Constant) and isinstance(
+                        cand.value, str
+                    ):
+                        out.setdefault(cand.value, set()).add(
+                            (rel, node.lineno)
+                        )
     return out
 
 

@@ -5,6 +5,7 @@ from grove_tpu.analysis.rules.apiwire import WireRoundTripRule
 from grove_tpu.analysis.rules.clocks import BlockingTickRule, ClockDisciplineRule
 from grove_tpu.analysis.rules.dirtymask import DirtyMaskRegistrationRule
 from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
+from grove_tpu.analysis.rules.glassbox import GlassBoxStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
 from grove_tpu.analysis.rules.locks import LockOrderRule
 from grove_tpu.analysis.rules.observability import EventReasonRule, SpanLeakRule
@@ -33,4 +34,5 @@ ALL_RULES = (
     DirtyMaskRegistrationRule,  # GL012
     ShardInternalsRule,  # GL013
     FrontierStateRule,  # GL014
+    GlassBoxStateRule,  # GL015
 )

@@ -581,6 +581,179 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _print_profile_report(doc: dict, top: int) -> None:
+    """Render the wall-attribution report (docs/observability.md): top
+    phase sinks by total self-time, per-controller roll-up, coverage."""
+    if not doc.get("enabled", False):
+        print(
+            "note: the wall-attribution profiler is disabled on the server"
+            " (set GROVE_TPU_PROFILE=1)",
+            file=sys.stderr,
+        )
+    print(
+        f"attributed {doc.get('attributed_seconds', 0.0):.3f}s over"
+        f" {doc.get('covered_wall_seconds', 0.0):.3f}s of covered wall"
+        + (
+            f" (coverage {doc['coverage']:.1%})"
+            if "coverage" in doc
+            else ""
+        )
+    )
+    rows = [
+        (
+            ph["controller"],
+            str(ph["shard"]) if ph["shard"] >= 0 else "-",
+            ph["phase"],
+            str(ph["count"]),
+            f"{ph['total_s']:.4f}",
+            f"{ph['p50_s'] * 1e6:.0f}",
+            f"{ph['p99_s'] * 1e6:.0f}",
+        )
+        for ph in doc.get("phases", [])[:top]
+    ]
+    if rows:
+        _print_table(
+            ("CONTROLLER", "SHARD", "PHASE", "COUNT", "TOTAL-S", "P50-µS",
+             "P99-µS"),
+            rows,
+        )
+    by_ctrl = doc.get("by_controller") or {}
+    if by_ctrl:
+        print()
+        print(
+            "per controller: "
+            + "  ".join(
+                f"{c}={s:.3f}s"
+                for c, s in sorted(by_ctrl.items(), key=lambda kv: -kv[1])
+            )
+        )
+
+
+def _cmd_profile(args) -> int:
+    """Wall-attribution view (docs/observability.md): where control-plane
+    seconds went, per (controller, shard, phase) — from a live apiserver's
+    GET /debug/profile, or by converging manifests under a profiled sim."""
+    if args.apiserver:
+        doc = _fetch_server_json(args.apiserver, "/debug/profile", "profile")
+        if doc is None:
+            return 1
+        _print_profile_report(doc, args.top)
+        return 0
+
+    if not args.manifests:
+        print(
+            "profile: provide manifests to simulate, or --apiserver URL to"
+            " read a live operator's attribution report",
+            file=sys.stderr,
+        )
+        return 2
+    from grove_tpu.observability.profile import PROFILER
+
+    PROFILER.enable()
+    PROFILER.reset()
+    # no coverage claim here: the sim bootstrap (harness build, manifest
+    # apply) is outside the attribution window by design — the gated
+    # coverage measurement lives in `make profile-smoke` / the bench
+    harness = _sim_from_manifests(args)
+    _print_profile_report(PROFILER.report(), args.top)
+    del harness
+    return 0
+
+
+def _print_journey(doc: dict) -> None:
+    name = f"{doc.get('namespace')}/{doc.get('name')}"
+    state = "complete" if doc.get("complete") else "in flight"
+    extra = ""
+    if "partition" in doc:
+        part = doc["partition"]
+        extra = f", frontier partition {part}" if part >= 0 else ", residual"
+    print(f"PodGang {name}: {state}, {doc.get('rounds', 0)} solve round(s){extra}")
+    rows = [
+        (
+            ph["phase"],
+            f"+{ph['t_s']:.6f}s",
+            f"vt={ph['vt']:g}" if "vt" in ph else "-",
+        )
+        for ph in doc.get("phases", [])
+    ]
+    if rows:
+        _print_table(("PHASE", "T", "VIRTUAL"), rows)
+    if doc.get("segments"):
+        print()
+        print(
+            "admission decomposition: "
+            + "  ".join(
+                f"{k}={v:.6f}s" for k, v in doc["segments"].items()
+            )
+            + f"  (total {doc.get('total_s', 0.0):.6f}s)"
+        )
+
+
+def _cmd_journey(args) -> int:
+    """One PodGang's causal admission timeline (docs/observability.md
+    "Gang journeys"): created → first-scan → encode → solve → commit →
+    scheduled, with the queue-wait/service/solver split — from a live
+    apiserver's GET /gangs/{ns}/{name}/journey, or by converging manifests
+    under a journey-traced sim."""
+    if args.apiserver:
+        if not args.gang:
+            print(
+                "journey: --apiserver mode needs --gang NAME"
+                " (and --namespace)",
+                file=sys.stderr,
+            )
+            return 2
+        doc = _fetch_server_json(
+            args.apiserver,
+            f"/gangs/{args.namespace}/{args.gang}/journey",
+            "journey",
+        )
+        if doc is None:
+            return 1
+        _print_journey(doc)
+        return 0
+
+    if not args.manifests:
+        print(
+            "journey: provide manifests to simulate, or --apiserver URL to"
+            " read a live operator's journeys",
+            file=sys.stderr,
+        )
+        return 2
+    from grove_tpu.observability.journey import JOURNEYS
+
+    JOURNEYS.enable()
+    JOURNEYS.reset()
+    harness = _sim_from_manifests(args)
+    if args.gang:
+        doc = JOURNEYS.journey(args.namespace, args.gang)
+        if doc is None:
+            print(
+                f"journey: no journey recorded for PodGang"
+                f" {args.namespace}/{args.gang}",
+                file=sys.stderr,
+            )
+            return 1
+        _print_journey(doc)
+    else:
+        # no gang named: every PodGang the converge admitted, worst last
+        gangs = sorted(
+            (j.as_dict() for j in JOURNEYS.completed()),
+            key=lambda d: d.get("total_s", 0.0),
+        )
+        for doc in gangs:
+            _print_journey(doc)
+            print()
+        summary = JOURNEYS.decomposition()
+        print(
+            f"{summary['journeys']} journeys: admission p50"
+            f" {summary['admission_p50_s']:.6f}s / p99"
+            f" {summary['admission_p99_s']:.6f}s"
+        )
+    del harness
+    return 0
+
+
 def _fmt_resource_map(m: dict) -> str:
     return ",".join(f"{k}={g:g}" for k, g in sorted(m.items())) or "-"
 
@@ -1095,6 +1268,43 @@ def main(argv: List[str] | None = None) -> int:
         help="also write the Chrome trace_event JSON (chrome://tracing)",
     )
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser(
+        "profile",
+        help=(
+            "wall-attribution report: where control-plane seconds went per"
+            " (controller, shard, phase) — from a live apiserver"
+            " (--apiserver URL) or a profiled sim converge"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument(
+        "--apiserver", help="read /debug/profile from a live server"
+    )
+    p.add_argument("--top", type=int, default=15, help="phase rows to show")
+    p.set_defaults(fn=_cmd_profile)
+
+    p = sub.add_parser(
+        "journey",
+        help=(
+            "one PodGang's admission timeline (created → scanned → encoded"
+            " → solved → committed → scheduled) with the queue-wait/"
+            "service/solver split — from a live apiserver or a sim"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument(
+        "--apiserver",
+        help="read /gangs/{ns}/{name}/journey from a live server",
+    )
+    p.add_argument("--namespace", default="default")
+    p.add_argument(
+        "--gang",
+        help="PodGang name (sim mode defaults to every admitted gang)",
+    )
+    p.set_defaults(fn=_cmd_journey)
 
     p = sub.add_parser("config-check", help="validate an operator config file")
     p.add_argument("config")

@@ -424,8 +424,25 @@ class APIServer:
                         },
                     )
                 if path == "/debug/profile":
-                    # pprof-server equivalent: sample every thread's stack
-                    # for ?seconds=N and return aggregated frame counts
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query
+                    )
+                    if "seconds" not in query:
+                        # wall-attribution report (observability/profile.py,
+                        # docs/observability.md): the per-(controller,
+                        # shard, phase) self-time ledger — process-global,
+                        # populated when the operator runs in this process
+                        # with GROVE_TPU_PROFILE=1
+                        from grove_tpu.observability.profile import PROFILER
+
+                        return self._send_json(
+                            200,
+                            dict(
+                                {"kind": "ProfileReport"}, **PROFILER.report()
+                            ),
+                        )
+                    # ?seconds=N — pprof-server equivalent: sample every
+                    # thread's stack and return aggregated frame counts
                     # (whole-process view, py-spy style — cProfile would
                     # only see this handler thread)
                     if not server.enable_profiling:
@@ -433,9 +450,6 @@ class APIServer:
                             404,
                             "profiling disabled (server.profilingEnabled)",
                         )
-                    query = urllib.parse.parse_qs(
-                        urllib.parse.urlsplit(self.path).query
-                    )
                     try:
                         seconds = min(
                             float((query.get("seconds") or ["2"])[0]), 30.0
@@ -456,6 +470,44 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path.startswith("/gangs/") and path.endswith("/journey"):
+                    # GET /gangs/{ns}/{name}/journey — one PodGang's causal
+                    # admission record (observability/journey.py): ordered
+                    # phase marks, frontier partition, and the queue-wait/
+                    # encode/solve/commit/status decomposition
+                    parts = path.split("/")
+                    if len(parts) != 5 or not parts[2] or not parts[3]:
+                        return self._error(
+                            404, "expected /gangs/{namespace}/{name}/journey"
+                        )
+                    from grove_tpu.observability.journey import JOURNEYS
+
+                    doc = JOURNEYS.journey(parts[2], parts[3])
+                    if doc is None:
+                        return self._error(
+                            404,
+                            f"no journey recorded for PodGang"
+                            f" {parts[2]}/{parts[3]} (journey tracing"
+                            " enabled? GROVE_TPU_JOURNEY=1)",
+                            "NotFound",
+                        )
+                    return self._send_json(
+                        200, dict({"kind": "GangJourney"}, **doc)
+                    )
+                if path == "/debug/journeys":
+                    # fleet view: admission-latency decomposition + the
+                    # critical-path fold over completed journeys
+                    from grove_tpu.observability.journey import JOURNEYS
+
+                    return self._send_json(
+                        200,
+                        {
+                            "kind": "JourneySummary",
+                            "enabled": JOURNEYS.enabled,
+                            "decomposition": JOURNEYS.decomposition(),
+                            "critical_path": JOURNEYS.critical_path(),
+                        },
+                    )
                 route = self._route()
                 if route is None:
                     return self._error(404, f"unknown path {self.path}")

@@ -205,6 +205,13 @@ class DisruptionBroker:
             f" disruptions denied until {self.close_after:g}s of quiet",
         )
         METRICS.inc("disruption_breaker_opens_total")
+        from grove_tpu.observability.flightrec import FLIGHTREC
+
+        if FLIGHTREC.enabled:
+            # a breaker open IS an incident: ship the telemetry that led
+            # to it (the eviction storm's commits/events/spans), not just
+            # the event saying it happened
+            FLIGHTREC.trigger("breaker-open", why)
 
     def _maybe_close(self, now: float) -> None:
         # fixed cooldown from OPENING, deliberately not from the last

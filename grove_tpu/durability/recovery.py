@@ -320,6 +320,7 @@ class StoreDurability:
                 for i in range(self.num_shards)
             ]
             for i, wal in enumerate(self.wals):
+                wal.shard = i  # wall-attribution row per shard stream
                 store.subscribe_system(wal.note_event, shard=i)
         else:
             self.wals = [

@@ -74,6 +74,7 @@ from grove_tpu.api.serialize import (
     to_dict,
 )
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.profile import PROFILER
 
 _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -299,6 +300,9 @@ class WriteAheadLog:
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
         self.segment_max_bytes = segment_max_bytes
+        # owning keyspace shard of this stream (StoreDurability stamps it
+        # on sharded stores) — wall-attribution rows then split per shard
+        self.shard = 0
         # _lock guards the buffer/seq; _io_lock serializes flush and
         # truncation (lock order: _io_lock -> _lock, never inverted)
         self._lock = threading.Lock()
@@ -417,8 +421,20 @@ class WriteAheadLog:
     def flush(self) -> int:
         """Group commit: serialize the buffered batch, append, fsync ONCE,
         then advance the durable watermark. Returns records flushed."""
-        with self._io_lock:
-            return self._flush_locked()
+        # wall attribution (observability/profile.py): the flush IS the
+        # durability layer's share of control-plane wall — one row per
+        # shard stream. Disabled profiling costs this one boolean check.
+        prof = (
+            PROFILER.phase("wal-flush", controller="wal", shard=self.shard)
+            if PROFILER.enabled
+            else None
+        )
+        try:
+            with self._io_lock:
+                return self._flush_locked()
+        finally:
+            if prof is not None:
+                prof.end()
 
     @staticmethod
     def _coalesce(batch: List[tuple]) -> List[tuple]:

@@ -1,18 +1,32 @@
-"""Observability: metrics registry, span tracer, event recorder, logging.
+"""Observability: metrics registry, span tracer, event recorder, logging,
+and the glass-box layer — wall-attribution profiler, gang-journey tracer,
+chaos flight recorder.
 
 Singletons (process-global, mirroring the reference manager's one metrics
-server / one event broadcaster): ``METRICS``, ``TRACER``, ``EVENTS``.
+server / one event broadcaster): ``METRICS``, ``TRACER``, ``EVENTS``,
+``PROFILER``, ``JOURNEYS``, ``FLIGHTREC``. The glass-box trio follows the
+PR-1 cost discipline: off by default, one boolean check per instrumented
+site while disabled.
 """
 
 from grove_tpu.observability.events import EVENTS, EventRecorder
+from grove_tpu.observability.flightrec import FLIGHTREC, FlightRecorder
+from grove_tpu.observability.journey import JOURNEYS, JourneyTracker
 from grove_tpu.observability.metrics import METRICS, Metrics
+from grove_tpu.observability.profile import PROFILER, WallProfiler
 from grove_tpu.observability.tracing import TRACER, Tracer
 
 __all__ = [
     "EVENTS",
     "EventRecorder",
+    "FLIGHTREC",
+    "FlightRecorder",
+    "JOURNEYS",
+    "JourneyTracker",
     "METRICS",
     "Metrics",
+    "PROFILER",
+    "WallProfiler",
     "TRACER",
     "Tracer",
 ]

@@ -62,6 +62,10 @@ REASON_BREAKER_CLOSED = "BreakerClosed"
 REASON_SNAPSHOT_TAKEN = "SnapshotTaken"
 REASON_RECOVERY_COMPLETED = "RecoveryCompleted"
 REASON_WAL_TORN_TAIL = "WalTornTail"
+# glass-box layer (docs/observability.md "Flight recorder"): the chaos
+# flight recorder froze its telemetry rings into a postmortem bundle
+# (invariant violation, reconcile GroveError, breaker open, or explicit)
+REASON_FLIGHT_RECORDED = "FlightRecorderDumped"
 # operator-component lifecycle reasons (controller/podcliqueset components,
 # rolling update, gang termination) — emitted as literals at the call
 # sites; registered here so grovelint GL006 and the docs-drift test keep
@@ -102,6 +106,11 @@ class EventRecord:
     count: int
     first_timestamp: float
     last_timestamp: float
+    # owning keyspace shard of the involved object's namespace (0 on
+    # unsharded stores; cluster-scoped objects pin to shard 0) — stamped
+    # so per-shard telemetry consumers (flight recorder rings, PR 13's
+    # worker lanes) can slice the event stream without re-hashing
+    shard: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -116,6 +125,7 @@ class EventRecord:
             "count": self.count,
             "firstTimestamp": self.first_timestamp,
             "lastTimestamp": self.last_timestamp,
+            "shard": self.shard,
         }
 
 
@@ -137,6 +147,14 @@ class EventRecorder:
         # virtual clock (optional): sim timestamps then line up with the
         # harness's requeue math instead of wall time
         self.clock = clock
+        # shard attribution (optional): namespace -> shard index, wired by
+        # a sharded Store at construction (Store.shard_index). None keeps
+        # the unsharded shard-0 stamp.
+        self.shard_fn = None
+        # flight-recorder sink (observability/flightrec.py): receives each
+        # updated EventRecord; installed by FLIGHTREC.enable(), one
+        # attribute check per record otherwise
+        self.sink = None
         self._lock = threading.Lock()
         # dedup key -> EventRecord, recency-ordered (LRU) for bounded
         # eviction: least-recently-updated groups drop first
@@ -161,22 +179,27 @@ class EventRecorder:
                 # LRU: an actively-updated group must outlive idle ones, or
                 # bounded eviction would silently reset its count to 1
                 self._events.move_to_end(key)
-                return rec
-            rec = EventRecord(
-                kind=kind,
-                namespace=namespace,
-                name=name,
-                type=type,
-                reason=reason,
-                message=message,
-                count=1,
-                first_timestamp=now,
-                last_timestamp=now,
-            )
-            self._events[key] = rec
-            while len(self._events) > self.max_events:
-                self._events.popitem(last=False)
-            return rec
+            else:
+                rec = EventRecord(
+                    kind=kind,
+                    namespace=namespace,
+                    name=name,
+                    type=type,
+                    reason=reason,
+                    message=message,
+                    count=1,
+                    first_timestamp=now,
+                    last_timestamp=now,
+                    shard=self.shard_fn(namespace)
+                    if self.shard_fn is not None
+                    else 0,
+                )
+                self._events[key] = rec
+                while len(self._events) > self.max_events:
+                    self._events.popitem(last=False)
+        if self.sink is not None:
+            self.sink.note_event(rec)
+        return rec
 
     def list(
         self,

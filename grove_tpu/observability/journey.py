@@ -1,0 +1,342 @@
+"""Gang-journey tracing: the causal record of one PodGang's admission.
+
+The schedulers in PAPERS.md that reason about starvation all lean on the
+same primitive — per-queue latency decomposition: you cannot even DEFINE
+"starved" without splitting *how long a gang waited in the queue* from
+*how long the control plane spent serving it* from *how long the solver
+held it*. The span tracer can't provide that: spans are per-call-site,
+a gang's admission crosses dozens of them over many rounds.
+
+``JOURNEYS`` records, per PodGang, the causal chain
+
+    created → first-scan → encode → solve → commit → scheduled
+
+with both wall (``time.perf_counter``) and virtual-clock timestamps, and
+derives the admission-latency decomposition on completion:
+
+- ``queue_wait``: creation → the encode of the round that ADMITTED it
+  (covers detection latency + every deferred round);
+- ``encode`` / ``solve``: that round's problem-assembly and solve walls
+  (the gang experiences the whole batch phase — batch attribution is the
+  honest per-gang number in a batched scheduler);
+- ``commit``: solve end → this gang's pods bound;
+- ``status``: bind → the Scheduled=True condition committed.
+
+The partitioned frontier stamps which partition (or the residual pass)
+solved the gang, so a journey names its frontier lane. A critical-path
+fold over completed journeys (:meth:`JourneyTracker.critical_path`)
+explains converge wall top-down: per-segment totals/shares plus the tail
+journey's own decomposition.
+
+Off by default, one-boolean-check discipline (``GROVE_TPU_JOURNEY=1`` /
+``JOURNEYS.enable()``). Surfaced at ``GET /gangs/{ns}/{name}/journey``,
+``cli journey``, and the bench's admission-latency block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from grove_tpu.observability.metrics import METRICS, _quantile
+
+# Canonical journey phases, in causal order — the closed registry
+# tests/test_docs_drift.py pins against the docs/observability.md
+# "Journey phases" table. RESIDUAL/partition ids annotate `solve`.
+JOURNEY_PHASES = (
+    "created",
+    "first-scan",
+    "encode",
+    "solve",
+    "commit",
+    "scheduled",
+)
+# admission-latency decomposition segment names (derived, docs-gated too)
+JOURNEY_SEGMENTS = ("queue_wait", "encode", "solve", "commit", "status")
+
+PARTITION_RESIDUAL = -1  # solved by the global residual pass (or global solve)
+
+
+class _Journey:
+    __slots__ = (
+        "namespace",
+        "name",
+        "marks",  # phase -> (wall_t, vt)
+        "rounds",  # solve rounds this gang was encoded into (deferrals + 1)
+        "partition",
+        "segments",  # filled on completion
+        "complete",
+    )
+
+    def __init__(self, namespace: str, name: str) -> None:
+        self.namespace = namespace
+        self.name = name
+        self.marks: Dict[str, Tuple[float, Optional[float]]] = {}
+        self.rounds = 0
+        self.partition: Optional[int] = None
+        self.segments: Optional[Dict[str, float]] = None
+        self.complete = False
+
+    def as_dict(self) -> dict:
+        origin = self.marks.get("created") or self.marks.get("first-scan")
+        t0 = origin[0] if origin else 0.0
+        phases = [
+            {
+                "phase": ph,
+                "t_s": round(self.marks[ph][0] - t0, 9),
+                **(
+                    {"vt": self.marks[ph][1]}
+                    if self.marks[ph][1] is not None
+                    else {}
+                ),
+            }
+            for ph in JOURNEY_PHASES
+            if ph in self.marks
+        ]
+        doc = {
+            "namespace": self.namespace,
+            "name": self.name,
+            "complete": self.complete,
+            "rounds": self.rounds,
+            "phases": phases,
+        }
+        if self.partition is not None:
+            doc["partition"] = self.partition
+        if self.segments is not None:
+            doc["segments"] = {
+                k: round(v, 9) for k, v in self.segments.items()
+            }
+            doc["total_s"] = round(sum(self.segments.values()), 9)
+        return doc
+
+
+class JourneyTracker:
+    """Process-global (``JOURNEYS``), thread-safe, bounded: active
+    journeys are LRU-evicted past ``max_active`` (deleted gangs are
+    dropped eagerly), completed ones keep the most recent
+    ``max_completed`` for percentile math."""
+
+    def __init__(
+        self, max_active: int = 65_536, max_completed: int = 65_536
+    ) -> None:
+        self.enabled = os.environ.get("GROVE_TPU_JOURNEY", "") not in (
+            "",
+            "0",
+            "false",
+        )
+        self.clock = None  # optional virtual clock (newest harness wins)
+        self.max_active = max_active
+        self.max_completed = max_completed
+        self.completed_total = 0
+        self._lock = threading.Lock()
+        self._active: "OrderedDict[tuple, _Journey]" = OrderedDict()
+        self._done: "OrderedDict[tuple, _Journey]" = OrderedDict()
+        # current solve round's batch stamps (encode start/end, solve end):
+        # written by the scheduler once per round, consumed per admitted gang
+        self._round: Optional[Tuple[float, float, float]] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._done.clear()
+            self._round = None
+            self.completed_total = 0
+
+    # -- marks (scheduler / store call sites) ----------------------------
+
+    def t(self) -> float:
+        return time.perf_counter()
+
+    def _vt(self) -> Optional[float]:
+        return round(self.clock.now(), 3) if self.clock is not None else None
+
+    def _get(self, namespace: str, name: str, create: bool) -> Optional[_Journey]:
+        key = (namespace, name)
+        j = self._active.get(key)
+        if j is None and create:
+            j = self._active[key] = _Journey(namespace, name)
+            while len(self._active) > self.max_active:
+                self._active.popitem(last=False)
+        return j
+
+    def _mark(self, j: _Journey, phase: str, t: Optional[float] = None) -> None:
+        j.marks[phase] = (t if t is not None else self.t(), self._vt())
+
+    def note_created(self, namespace: str, name: str) -> None:
+        """PodGang ADDED committed (store watch hook)."""
+        with self._lock:
+            j = self._get(namespace, name, create=True)
+            if "created" not in j.marks:
+                self._mark(j, "created")
+
+    def note_deleted(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._active.pop((namespace, name), None)
+
+    def note_seen(self, namespace: str, name: str) -> None:
+        """The gang's pods entered a pending scan (first-win)."""
+        with self._lock:
+            j = self._get(namespace, name, create=True)
+            if "first-scan" not in j.marks:
+                self._mark(j, "first-scan")
+
+    def note_round(self, t_encode0: float, t_encode1: float, t_solve1: float) -> None:
+        """One solve round's batch stamps: encode start, encode end, solve
+        end. Consumed by every gang admitted (or deferred) in the round."""
+        with self._lock:
+            self._round = (t_encode0, t_encode1, t_solve1)
+
+    def note_encoded(self, namespace: str, name: str) -> None:
+        """The gang's spec was in the round's solver input (deferred rounds
+        bump the counter; the ADMITTING round's stamps win)."""
+        with self._lock:
+            j = self._get(namespace, name, create=True)
+            j.rounds += 1
+            if self._round is not None:
+                t_enc0, t_enc1, _t_solve1 = self._round
+                # the ADMITTING round's stamps win: deferred rounds just
+                # overwrite until the gang finally places
+                self._mark(j, "encode", t_enc0)
+                self._mark(j, "solve", t_enc1)
+
+    def note_partition(self, namespace: str, name: str, partition: int) -> None:
+        """Frontier lane stamp: partition id, or PARTITION_RESIDUAL."""
+        with self._lock:
+            j = self._active.get((namespace, name))
+            if j is not None:
+                j.partition = partition
+
+    def note_commit(self, namespace: str, name: str) -> None:
+        """This gang's pods were bound (commit loop)."""
+        with self._lock:
+            j = self._active.get((namespace, name))
+            if j is not None:
+                self._mark(j, "commit")
+
+    def note_scheduled(self, namespace: str, name: str) -> None:
+        """Scheduled=True committed — the journey completes and its
+        admission-latency decomposition is derived."""
+        now = self.t()
+        with self._lock:
+            key = (namespace, name)
+            j = self._active.pop(key, None)
+            if j is None:
+                return
+            self._mark(j, "scheduled", now)
+            rnd = self._round
+            marks = j.marks
+            created = marks.get("created") or marks.get("first-scan")
+            enc0 = marks.get("encode")
+            solve0 = marks.get("solve")
+            commit = marks.get("commit")
+            if created and enc0 and solve0 and commit and rnd is not None:
+                t_solve1 = min(rnd[2], commit[0])
+                j.segments = {
+                    "queue_wait": max(enc0[0] - created[0], 0.0),
+                    "encode": max(solve0[0] - enc0[0], 0.0),
+                    "solve": max(t_solve1 - solve0[0], 0.0),
+                    "commit": max(commit[0] - t_solve1, 0.0),
+                    "status": max(now - commit[0], 0.0),
+                }
+            j.complete = all(ph in marks for ph in JOURNEY_PHASES)
+            self._done[key] = j
+            self.completed_total += 1
+            while len(self._done) > self.max_completed:
+                self._done.popitem(last=False)
+        METRICS.inc("journeys_completed_total")
+
+    # -- read side -------------------------------------------------------
+
+    def journey(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            # active first: a deleted-and-recreated gang's LIVE in-flight
+            # journey must not be shadowed by its previous incarnation's
+            # completed record (that is exactly the gang someone queries)
+            j = self._active.get((namespace, name)) or self._done.get(
+                (namespace, name)
+            )
+            return j.as_dict() if j is not None else None
+
+    def completed(self) -> List[_Journey]:
+        with self._lock:
+            return list(self._done.values())
+
+    def decomposition(self) -> dict:
+        """Admission-latency p50/p99 per segment over completed journeys —
+        the bench's first-class field."""
+        samples: Dict[str, List[float]] = {seg: [] for seg in JOURNEY_SEGMENTS}
+        totals: List[float] = []
+        for j in self.completed():
+            if j.segments is None:
+                continue
+            for seg in JOURNEY_SEGMENTS:
+                samples[seg].append(j.segments[seg])
+            totals.append(sum(j.segments.values()))
+        totals.sort()
+        doc = {
+            "journeys": len(totals),
+            "completed_total": self.completed_total,
+            "admission_p50_s": round(_quantile(totals, 0.5), 6)
+            if totals
+            else 0.0,
+            "admission_p99_s": round(_quantile(totals, 0.99), 6)
+            if totals
+            else 0.0,
+            "segments": {},
+        }
+        for seg in JOURNEY_SEGMENTS:
+            vals = sorted(samples[seg])
+            doc["segments"][seg] = {
+                "p50_s": round(_quantile(vals, 0.5), 6) if vals else 0.0,
+                "p99_s": round(_quantile(vals, 0.99), 6) if vals else 0.0,
+                "total_s": round(sum(vals), 6),
+            }
+        return doc
+
+    def critical_path(self) -> dict:
+        """Top-down converge explanation: per-segment share of total
+        admission latency across every completed journey, plus the TAIL
+        journey (latest completion) decomposed — the gang whose journey
+        bounds the converge wall."""
+        per_seg: Dict[str, float] = {seg: 0.0 for seg in JOURNEY_SEGMENTS}
+        tail: Optional[_Journey] = None
+        tail_t = -1.0
+        n = 0
+        for j in self.completed():
+            if j.segments is None:
+                continue
+            n += 1
+            for seg in JOURNEY_SEGMENTS:
+                per_seg[seg] += j.segments[seg]
+            done_t = j.marks.get("scheduled", (0.0, None))[0]
+            if done_t > tail_t:
+                tail_t, tail = done_t, j
+        total = sum(per_seg.values())
+        doc = {
+            "journeys": n,
+            "total_s": round(total, 6),
+            "segments": {
+                seg: {
+                    "total_s": round(v, 6),
+                    "share": round(v / total, 4) if total > 0 else 0.0,
+                }
+                for seg, v in per_seg.items()
+            },
+        }
+        if tail is not None:
+            doc["tail"] = tail.as_dict()
+        return doc
+
+
+JOURNEYS = JourneyTracker()

@@ -111,10 +111,24 @@ def _quantile(sorted_values: List[float], q: float) -> float:
 
 
 def _prom_parts(name: str):
+    """Registry-name grammar → (prometheus name, label string).
+
+    ``base`` → no labels; ``base/label`` → ``name="label"``;
+    ``base@K`` → ``shard="K"``; ``base/label@K`` → both. The ``@shard``
+    suffix is how per-keyspace-shard series (engine backlogs, shard
+    census, pending feeds, WAL streams) expose the shard as a first-class
+    Prometheus label instead of overloading ``name=`` — so PR 13's
+    concurrent per-shard workers can be graphed with a `by (shard)`."""
+    shard = None
+    if "@" in name:
+        name, _, shard = name.rpartition("@")
+    labels = []
     if "/" in name:
-        base, label = name.split("/", 1)
-        return f"grove_tpu_{base}", f'name="{label}"'
-    return f"grove_tpu_{name}", ""
+        name, _, label = name.partition("/")
+        labels.append(f'name="{label}"')
+    if shard is not None:
+        labels.append(f'shard="{shard}"')
+    return f"grove_tpu_{name}", ",".join(labels)
 
 
 def _promname(name: str) -> str:

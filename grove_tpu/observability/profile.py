@@ -1,0 +1,351 @@
+"""Wall-attribution profiler: where a control-plane second actually goes.
+
+The tracer (tracing.py) answers "how long did span X take"; the sampling
+profiler (apiserver ``/debug/profile?seconds=N``) answers "which frames
+are hot right now". Neither can answer the ROADMAP's question — *of the
+964 s the control plane burned converging the 100k-node shape, how many
+went to dequeue vs reconcile compute vs store commits vs status writes
+vs WAL fsync, per controller, per keyspace shard?* — because spans are
+bounded samples and stack sampling has no phase semantics.
+
+This module is the ledger for that question:
+
+- ``PROFILER.phase(name)`` opens a *phase* — a timed interval attributed
+  to a ``(controller, shard, phase)`` key. Phases nest via a per-thread
+  stack and account **exclusive (self) time**: when a child phase opens,
+  the parent stops accumulating, so the sum of all recorded self-times
+  equals the wall of the outermost phases (no double counting). That is
+  what makes the roll-up's coverage claim honest: *attributed seconds /
+  independently measured wall ≥ 0.95* is arithmetic, not hope.
+- Self-times fold into **log-bucketed online histograms** (power-of-two
+  µs buckets, 64 of them): O(1) memory per key no matter how many
+  reconciles run, with p50/p99 read back by bucket interpolation.
+- Context flows down the stack: a phase opened with an explicit
+  ``controller``/``shard`` (the engine's per-reconcile phase, the
+  scheduler's round phase) re-keys every descendant phase, so a store
+  commit inside a PodClique reconcile on shard 3 lands under
+  ``(podclique, 3, store-commit)`` without the store knowing either.
+
+Cost model, same discipline as the tracer (PR 1): **off by default**,
+every instrumentation site reduces to one ``PROFILER.enabled`` boolean
+check (``phase()`` is only called when enabled, or returns the shared
+no-op). Enable with ``GROVE_TPU_PROFILE=1`` or ``PROFILER.enable()``.
+Surfaced at ``GET /debug/profile``, ``cli profile``, the bench
+``"attribution"`` block, and ``make profile-smoke``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Canonical phase names — the closed registry tests/test_docs_drift.py
+# pins against the docs/observability.md "Profiler phases" table (the
+# event-reason treatment, applied to phases). grovelint GL015 keeps the
+# recording state itself private to this module.
+PHASE_DRAIN = "drain"  # engine drain loop (self = pop/route glue)
+PHASE_DEQUEUE = "dequeue"  # watch-event routing into workqueues
+PHASE_RECONCILE = "reconcile"  # one reconcile (self = controller compute/diff)
+PHASE_SNAPSHOT = "snapshot"  # store reads (get/list) under the open phase
+PHASE_STORE_COMMIT = "store-commit"  # store writes (create/update/delete/cow)
+PHASE_STATUS_WRITE = "status-write"  # status-subtree copy-on-write commits
+PHASE_SCHEDULE = "schedule"  # one scheduler round (self = ordering/quota glue)
+PHASE_PENDING_SCAN = "pending-scan"  # phase/health upkeep + pending encode
+PHASE_ENCODE = "encode"  # problem assembly (from-scratch or delta)
+PHASE_SOLVE = "solve"  # wave solve incl. device dispatch (or sidecar call)
+PHASE_COMMIT = "commit"  # binding admitted gangs' pods
+PHASE_TICK = "tick"  # one component tick (autoscaler/monitor/drainer/kubelet)
+PHASE_WAL_FLUSH = "wal-flush"  # one WAL group commit (encode+write+fsync)
+
+PHASES = frozenset(
+    v
+    for k, v in list(globals().items())
+    if k.startswith("PHASE_") and isinstance(v, str)
+)
+
+# shard index meaning "not shard-scoped work" (cluster-wide / unsharded)
+NO_SHARD = -1
+
+_NBUCKETS = 64
+
+
+class _Hist:
+    """One (controller, shard, phase) key's online histogram: power-of-two
+    µs buckets + exact count/total/max. Bounded and mergeable — the report
+    is O(keys), never O(samples)."""
+
+    __slots__ = ("counts", "count", "total_us", "max_us")
+
+    def __init__(self) -> None:
+        self.counts = [0] * _NBUCKETS
+        self.count = 0
+        self.total_us = 0
+        self.max_us = 0
+
+    def add(self, us: int) -> None:
+        idx = us.bit_length()
+        if idx >= _NBUCKETS:
+            idx = _NBUCKETS - 1
+        self.counts[idx] += 1
+        self.count += 1
+        self.total_us += us
+        if us > self.max_us:
+            self.max_us = us
+
+    def quantile_us(self, q: float) -> float:
+        """Bucket-interpolated quantile: the value is estimated at the
+        geometric midpoint of the bucket holding the q-th sample (bucket b
+        spans [2^(b-1), 2^b) µs), so the error is bounded by the bucket
+        width — the price of O(1) memory."""
+        if self.count == 0:
+            return 0.0
+        target = max(1, int(q * self.count + 0.5))
+        seen = 0
+        for b, n in enumerate(self.counts):
+            seen += n
+            if seen >= target:
+                if b == 0:
+                    return 0.5
+                return 1.5 * (1 << (b - 1))
+        return float(self.max_us)
+
+
+class _NullPhase:
+    """Shared no-op phase (the disabled path's `with` target)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullPhase":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def end(self) -> None:
+        pass
+
+
+_NULL_PHASE = _NullPhase()
+
+
+class _Phase:
+    __slots__ = ("_prof", "key", "_t0", "_child", "_prev_ctx", "_done")
+
+    def __init__(
+        self,
+        prof: "WallProfiler",
+        key: Tuple[str, int, str],
+        prev_ctx: Optional[Tuple[str, int]],
+    ) -> None:
+        self._prof = prof
+        self.key = key
+        self._prev_ctx = prev_ctx  # restored on end() when ctx was re-keyed
+        self._child = 0.0
+        self._done = False
+        self._t0 = time.perf_counter()
+
+    def end(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        dur = time.perf_counter() - self._t0
+        prof = self._prof
+        tls = prof._tls
+        stack = tls.stack
+        # tolerate out-of-order ends (a parent ended from a finally after a
+        # leaked child) — drop self from wherever it sits
+        if self in stack:
+            stack.remove(self)
+        if stack:
+            stack[-1]._child += dur
+        else:
+            prof._note_toplevel(dur)
+        if self._prev_ctx is not None:
+            tls.ctx = self._prev_ctx
+        self_s = dur - self._child
+        if self_s < 0.0:
+            self_s = 0.0
+        prof._record(self.key, self_s)
+
+    def __enter__(self) -> "_Phase":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+class WallProfiler:
+    """Process-global (``PROFILER``), thread-safe: histogram updates are
+    locked (drain_concurrent runs reconciles on worker threads), the phase
+    stack and attribution context are thread-local."""
+
+    def __init__(self) -> None:
+        self.enabled = os.environ.get("GROVE_TPU_PROFILE", "") not in (
+            "",
+            "0",
+            "false",
+        )
+        self._lock = threading.Lock()
+        self._hist: Dict[Tuple[str, int, str], _Hist] = {}
+        self._toplevel_s = 0.0  # wall covered by outermost phases
+        self._tls = threading.local()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hist = {}
+            self._toplevel_s = 0.0
+
+    # -- recording -------------------------------------------------------
+
+    def _state(self):
+        tls = self._tls
+        if getattr(tls, "stack", None) is None:
+            tls.stack = []
+            tls.ctx = ("-", NO_SHARD)
+        return tls
+
+    def phase(
+        self,
+        name: str,
+        controller: Optional[str] = None,
+        shard: Optional[int] = None,
+    ):
+        """Open a phase (context manager, or call ``.end()`` explicitly).
+        ``controller``/``shard`` default to the enclosing phase's context;
+        passing either re-keys the context for every descendant phase until
+        this one ends. The disabled path is ONE attribute check at the call
+        site (``if PROFILER.enabled``) — or this early return."""
+        if not self.enabled:
+            return _NULL_PHASE
+        tls = self._state()
+        ctx = tls.ctx
+        prev = None
+        if controller is not None or shard is not None:
+            new_ctx = (
+                controller if controller is not None else ctx[0],
+                shard if shard is not None else ctx[1],
+            )
+            prev, tls.ctx, ctx = ctx, new_ctx, new_ctx
+        ph = _Phase(self, (ctx[0], ctx[1], name), prev)
+        tls.stack.append(ph)
+        return ph
+
+    def reconcile(self, controller: str, shard: int = NO_SHARD):
+        """The engine's per-reconcile phase: re-keys the context so every
+        store read/write inside the reconcile attributes to this
+        (controller, shard)."""
+        return self.phase(PHASE_RECONCILE, controller=controller, shard=shard)
+
+    def _record(self, key: Tuple[str, int, str], self_s: float) -> None:
+        us = int(self_s * 1e6)
+        with self._lock:
+            hist = self._hist.get(key)
+            if hist is None:
+                hist = self._hist[key] = _Hist()
+            hist.add(us)
+
+    def _note_toplevel(self, dur: float) -> None:
+        with self._lock:
+            self._toplevel_s += dur
+
+    # -- report ----------------------------------------------------------
+
+    def attributed_seconds(self) -> float:
+        """Sum of every recorded self-time — the numerator of coverage."""
+        with self._lock:
+            return sum(h.total_us for h in self._hist.values()) / 1e6
+
+    def covered_wall_seconds(self) -> float:
+        """Wall covered by outermost phases (the profiler's own notion of
+        the window; the smoke compares against an independent timer)."""
+        with self._lock:
+            return self._toplevel_s
+
+    def report(
+        self, wall_seconds: Optional[float] = None, top: Optional[int] = None
+    ) -> dict:
+        """The roll-up: per-(controller, shard, phase) rows sorted by total
+        self-time, per-controller totals, and — when the caller provides an
+        independently measured wall — the coverage ratio the acceptance
+        gate reads (``attributed_seconds / wall_seconds``)."""
+        with self._lock:
+            items = [
+                (key, h.count, h.total_us, h.quantile_us(0.5),
+                 h.quantile_us(0.99), h.max_us)
+                for key, h in self._hist.items()
+            ]
+            toplevel = self._toplevel_s
+        items.sort(key=lambda row: -row[2])
+        phases: List[dict] = []
+        by_controller: Dict[str, float] = {}
+        attributed_us = 0
+        for (controller, shard, name), count, total_us, p50, p99, mx in items:
+            attributed_us += total_us
+            by_controller[controller] = (
+                by_controller.get(controller, 0.0) + total_us / 1e6
+            )
+            phases.append(
+                {
+                    "controller": controller,
+                    "shard": shard,
+                    "phase": name,
+                    "count": count,
+                    "total_s": round(total_us / 1e6, 6),
+                    "p50_s": round(p50 / 1e6, 9),
+                    "p99_s": round(p99 / 1e6, 9),
+                    "max_s": round(mx / 1e6, 6),
+                }
+            )
+        if top is not None:
+            phases = phases[:top]
+        doc = {
+            "enabled": self.enabled,
+            "attributed_seconds": round(attributed_us / 1e6, 6),
+            "covered_wall_seconds": round(toplevel, 6),
+            "by_controller": {
+                c: round(s, 6) for c, s in sorted(by_controller.items())
+            },
+            "phases": phases,
+        }
+        if wall_seconds is not None:
+            doc["wall_seconds"] = round(wall_seconds, 6)
+            doc["coverage"] = round(
+                attributed_us / 1e6 / wall_seconds, 4
+            ) if wall_seconds > 0 else 0.0
+        return doc
+
+
+def disabled_check_cost_ns(iters: int = 200_000) -> float:
+    """Measured cost of ONE all-off instrumentation check — the exact
+    boolean pattern every hot site pays while tracing/profiling/journeys/
+    flight-recording are disabled. Feeds the bench's all-off-overhead
+    estimate (checks × this ÷ measured wall), so the <1% claim is
+    arithmetic over measured quantities."""
+    from grove_tpu.observability.flightrec import FLIGHTREC
+    from grove_tpu.observability.journey import JOURNEYS
+    from grove_tpu.observability.tracing import TRACER
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if (
+            TRACER.enabled
+            or PROFILER.enabled
+            or JOURNEYS.enabled
+            or FLIGHTREC.enabled
+        ):  # pragma: no cover - all-off microbench
+            pass
+    return (time.perf_counter() - t0) / iters * 1e9
+
+
+PROFILER = WallProfiler()

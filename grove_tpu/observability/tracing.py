@@ -60,6 +60,11 @@ _NULL_SPAN = _NullSpan()
 # span lifecycle when tracing is on; no cost while tracing is off.
 SPAN_HOOK = None
 
+# Flight-recorder sink (grove_tpu.observability.flightrec): an object with
+# note_span(span), installed by FLIGHTREC.enable() so finished spans land
+# in the per-shard postmortem rings. Same cost contract as SPAN_HOOK.
+FLIGHT_SINK = None
+
 
 class Span:
     __slots__ = (
@@ -84,6 +89,13 @@ class Span:
         stack.append(self)
         if tracer.clock is not None:
             attrs["vt"] = round(tracer.clock.now(), 3)
+        # shard attribution (docs/control-plane.md keyspace sharding): the
+        # engine stamps the owning shard around each reconcile, so every
+        # span opened inside it carries its lane; explicit attrs win
+        if "shard" not in attrs:
+            shard = getattr(tracer._tls, "shard", None)
+            if shard is not None:
+                attrs["shard"] = shard
         self._done = False
         if SPAN_HOOK is not None:
             SPAN_HOOK.span_opened(self)
@@ -101,6 +113,8 @@ class Span:
         if SPAN_HOOK is not None:
             SPAN_HOOK.span_closed(self)
         self.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if FLIGHT_SINK is not None:
+            FLIGHT_SINK.note_span(self)
         tracer = self._tracer
         stack = tracer._stack()
         # tolerate out-of-order ends (a span ended from a finally after its
@@ -170,6 +184,14 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def set_shard(self, shard: Optional[int]) -> None:
+        """Per-thread shard context: spans opened after this carry the
+        shard as an attribute (and the Chrome export's `shard` column)
+        until cleared with None. Set by the engine around each reconcile
+        when sharded; costs nothing while tracing is off (only called
+        behind the enabled check)."""
+        self._tls.shard = shard
+
     # -- export ----------------------------------------------------------
 
     def spans(self) -> List[Span]:
@@ -208,10 +230,14 @@ class Tracer:
     def chrome_trace(self) -> List[dict]:
         """Chrome trace_event complete events ("ph":"X"), ts/dur in µs.
         A JSON array — chrome://tracing and Perfetto load it directly;
-        nesting is by time containment within (pid, tid)."""
+        nesting is by time containment within (pid, tid). Every event
+        carries a `shard` column (the span's keyspace-shard attribution,
+        -1 for unsharded/cluster-wide work) so per-shard workers render
+        as separate lanes when grouped by it."""
         pid = os.getpid()
         events = []
         for sp in self.spans():
+            shard = sp.attrs.get("shard")
             events.append(
                 {
                     "name": sp.name,
@@ -220,6 +246,7 @@ class Tracer:
                     "dur": sp.dur_us,
                     "pid": pid,
                     "tid": sp.tid,
+                    "shard": shard if isinstance(shard, int) else -1,
                     "args": dict(sp.attrs, parent=sp.parent),
                 }
             )

@@ -24,9 +24,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
 
+from grove_tpu.observability.flightrec import FLIGHTREC
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.profile import NO_SHARD, PROFILER
 from grove_tpu.observability.tracing import TRACER
 from grove_tpu.runtime.clock import Clock
+from grove_tpu.runtime.errors import GroveError
 from grove_tpu.runtime.flow import ReconcileStepResult
 from grove_tpu.runtime.store import Store, WatchEvent
 from grove_tpu.runtime.workqueue import Key, WorkQueue
@@ -93,6 +96,10 @@ class Engine:
         # (hundreds of thousands of events) the miss checks dominated
         # _route_events
         self._dispatch = None
+        # shard attribution for the glass-box layer: key namespace -> owning
+        # shard (the in-memory Store's crc32 memo; HttpStore has none —
+        # reconciles there attribute to NO_SHARD)
+        self._shard_index = getattr(store, "shard_index", None)
         if self.num_shards == 1:
             store.subscribe(self._event_backlog.append)
         else:
@@ -195,6 +202,19 @@ class Engine:
         return None
 
     def _route_events(self) -> None:
+        # disabled profiling costs exactly this one boolean check per round
+        prof = (
+            PROFILER.phase("dequeue", controller="engine")
+            if PROFILER.enabled
+            else None
+        )
+        try:
+            self._route_events_inner()
+        finally:
+            if prof is not None:
+                prof.end()
+
+    def _route_events_inner(self) -> None:
         # Drain via popleft until empty: reconciles (and concurrent watch
         # threads) emit new events while we iterate; popping one at a time
         # can never lose a concurrent append.
@@ -245,6 +265,17 @@ class Engine:
         and threaded drains can never drift."""
         if error is not None:
             METRICS.inc(f"reconcile_panics_total/{ctrl.name}")
+            if FLIGHTREC.enabled:
+                # postmortem evidence AT the failure: ring snapshot plus a
+                # bundle when a GroveError escaped a reconcile (store
+                # outage, forbidden write, torn recovery) — dump count is
+                # capped inside trigger(), so error storms can't disk-spam
+                FLIGHTREC.note_error(ctrl.name, key, error)
+                if isinstance(error, GroveError):
+                    FLIGHTREC.trigger(
+                        "reconcile-grove-error",
+                        f"{ctrl.name} {key[1]}/{key[2]}: {error}",
+                    )
             # RecoverPanic equivalent (manager.go:99-101): requeue
             ctrl.queue.add_rate_limited(key, now)
             return
@@ -260,6 +291,15 @@ class Engine:
     def drain(self, max_rounds: int = 10_000) -> int:
         """Process until no controller has a ready item at the current time.
         Returns the number of reconciles executed."""
+        if not PROFILER.enabled:
+            return self._drain_rounds(max_rounds)
+        # attribution window: the drain loop's own glue (pops, metrics,
+        # quiescence checks) lands on (engine, -, drain); dequeue and each
+        # reconcile open their own child phases
+        with PROFILER.phase("drain", controller="engine"):
+            return self._drain_rounds(max_rounds)
+
+    def _drain_rounds(self, max_rounds: int) -> int:
         executed = 0
         now = self.clock.now()
         for _ in range(max_rounds):
@@ -316,7 +356,7 @@ class Engine:
                 # per-shard backlog depth: a hot tenant's shard shows up
                 # here while the rotation keeps the others draining
                 for idx, backlog in enumerate(self._backlogs):
-                    METRICS.set(f"engine_shard_backlog/{idx}", len(backlog))
+                    METRICS.set(f"engine_shard_backlog@{idx}", len(backlog))
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()
@@ -330,13 +370,22 @@ class Engine:
     def _timed(self, ctrl: Controller, key):
         t0 = time.perf_counter()
         # disabled tracing costs exactly this one boolean check per reconcile
-        span = (
-            TRACER.span(
+        span = None
+        if TRACER.enabled:
+            # thread-local shard context: every span opened INSIDE the
+            # reconcile inherits the lane (cleared in the finally)
+            TRACER.set_shard(self._shard_of_key(key))
+            span = TRACER.span(
                 "engine.reconcile",
                 controller=ctrl.name,
                 key=f"{key[1]}/{key[2]}",
             )
-            if TRACER.enabled
+        # ... and disabled profiling this one: the reconcile phase re-keys
+        # the attribution context, so store reads/writes inside land under
+        # (controller, shard, snapshot/store-commit/status-write)
+        prof = (
+            PROFILER.reconcile(ctrl.name, self._shard_of_key(key))
+            if PROFILER.enabled
             else None
         )
         outcome = "error"
@@ -345,12 +394,22 @@ class Engine:
             outcome = result.result if result is not None else "done"
             return result
         finally:
+            if prof is not None:
+                prof.end()
             if span is not None:
                 span.set("outcome", outcome)
                 span.end()
+                TRACER.set_shard(None)
             METRICS.observe(
                 f"reconcile_seconds/{ctrl.name}", time.perf_counter() - t0
             )
+
+    def _shard_of_key(self, key) -> int:
+        """Owning keyspace shard of a reconcile key's namespace (NO_SHARD
+        when the store has no shard map — HttpStore in cluster mode)."""
+        if self._shard_index is None:
+            return NO_SHARD
+        return self._shard_index(key[1])
 
     def _ensure_pool(self):
         if self._pool is None:

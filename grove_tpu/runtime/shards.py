@@ -38,6 +38,16 @@ exact vector (per-shard durability, the sharded recovery merge) read
 Shard internals are PRIVATE to runtime/shards.py, runtime/store.py and
 grove_tpu/durability/ — grovelint GL013 flags any other access, the way
 GL011 guards the unsharded store internals.
+
+The shard index stamped here is also the telemetry lane (PR 12
+glass-box layer, docs/observability.md): ``WatchEvent.shard`` routes the
+engine's backlogs AND the flight recorder's commit-digest rings; the
+engine stamps spans/profiler phases with ``Store.shard_index(namespace)``
+around each reconcile; the event recorder stamps ``EventRecord.shard``
+through the same map; and each per-shard WAL stream attributes its
+flushes to its own shard. One map, every signal — so when the ROADMAP's
+parallel-CP PR runs shards as real workers, every layer already renders
+them as separate lanes.
 """
 
 from __future__ import annotations

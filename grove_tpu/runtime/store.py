@@ -35,6 +35,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from grove_tpu.api.meta import deep_copy, next_uid
+from grove_tpu.observability.flightrec import FLIGHTREC
+from grove_tpu.observability.journey import JOURNEYS
+from grove_tpu.observability.profile import PROFILER
 from grove_tpu.runtime.clock import Clock
 from grove_tpu.runtime.errors import (
     ERR_CONFLICT,
@@ -251,6 +254,12 @@ class Store:
         # "create"|"update"|"delete" -> callable(obj) -> Optional[Exception];
         # a returned exception is raised before the write commits
         self.error_injectors: Dict[str, Callable] = {}
+        # shard attribution for the event recorder ("newest store wins",
+        # like the tracer/event clocks): events then carry the involved
+        # object's owning keyspace shard without re-hashing anywhere else
+        from grove_tpu.observability.events import EVENTS
+
+        EVENTS.shard_fn = self.shard_index if self.num_shards > 1 else None
 
     def _inject(self, operation: str, obj) -> None:
         injector = self.error_injectors.get(operation)
@@ -323,8 +332,8 @@ class Store:
         out = []
         for s in self._shards:
             n = s.object_count()
-            METRICS.set(f"store_shard_objects/{s.index}", n)
-            METRICS.set(f"store_shard_rv/{s.index}", s.rv)
+            METRICS.set(f"store_shard_objects@{s.index}", n)
+            METRICS.set(f"store_shard_rv@{s.index}", s.rv)
             out.append({"shard": s.index, "objects": n, "rv": s.rv})
         return out
 
@@ -388,6 +397,16 @@ class Store:
         shard.agg_committed.apply(type_, obj, old)
         if obj.kind == "Pod":
             self._summary_dirty.add(shard.index)
+        # glass-box hooks (docs/observability.md), one boolean check each
+        # while disabled: the flight recorder's per-shard commit-digest
+        # ring, and the journey tracker's PodGang creation/deletion marks
+        if FLIGHTREC.enabled:
+            FLIGHTREC.note_commit(ev)
+        if JOURNEYS.enabled and obj.kind == "PodGang":
+            if type_ == ADDED:
+                JOURNEYS.note_created(obj.metadata.namespace, obj.metadata.name)
+            elif type_ == DELETED:
+                JOURNEYS.note_deleted(obj.metadata.namespace, obj.metadata.name)
         # fan-out order: the owning shard's subscribers first (per-shard
         # streams), then the store-wide system watchers, then the operator
         # watchers — at S=1 with no per-shard subscriber this is exactly
@@ -708,11 +727,20 @@ class Store:
         return (shard.cache_blob if use_cache else shard.blob).get(kind, {})
 
     def create(self, obj, consume: bool = False, share: bool = False) -> object:
-        self._authorize("create", obj)
-        self._inject("create", obj)
-        shard = self._shard_of_obj(obj)
-        with shard.lock:
-            return self._create_locked(shard, obj, consume, share)
+        # wall attribution (observability/profile.py): writes land on the
+        # enclosing reconcile's (controller, shard, store-commit) row —
+        # lock wait included, that IS part of the commit's wall. Disabled
+        # profiling costs this one boolean check.
+        prof = PROFILER.phase("store-commit") if PROFILER.enabled else None
+        try:
+            self._authorize("create", obj)
+            self._inject("create", obj)
+            shard = self._shard_of_obj(obj)
+            with shard.lock:
+                return self._create_locked(shard, obj, consume, share)
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _create_locked(
         self, shard: StoreShard, obj, consume: bool, share: bool
@@ -806,18 +834,25 @@ class Store:
         """Fetch one object. `readonly=True` returns the store's committed
         object WITHOUT a copy — the caller MUST NOT mutate it (same contract
         as scan(); re-get mutably before building an update)."""
-        use_cache = cached and self.cache_lag
-        shard = self._shard_for(namespace)
-        key = f"{namespace}/{name}"
-        view = (shard.cache if use_cache else shard.committed).get(kind, {})
-        obj = view.get(key)
-        if obj is None:
-            return None
-        if readonly:
-            return obj
-        return _materialize(
-            obj, self._shard_blobs(shard, use_cache, kind).get(key)
-        )
+        # snapshot-phase attribution; the readonly fast path stays a dict
+        # hit + one boolean check while profiling is off
+        prof = PROFILER.phase("snapshot") if PROFILER.enabled else None
+        try:
+            use_cache = cached and self.cache_lag
+            shard = self._shard_for(namespace)
+            key = f"{namespace}/{name}"
+            view = (shard.cache if use_cache else shard.committed).get(kind, {})
+            obj = view.get(key)
+            if obj is None:
+                return None
+            if readonly:
+                return obj
+            return _materialize(
+                obj, self._shard_blobs(shard, use_cache, kind).get(key)
+            )
+        finally:
+            if prof is not None:
+                prof.end()
 
     def list(
         self,
@@ -826,22 +861,27 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
         cached: bool = False,
     ) -> List[object]:
-        use_cache = cached and self.cache_lag
-        out = []
-        # iterate shard-by-shard so the per-kind blob dict is fetched ONCE
-        # per shard, not re-resolved per object (list("Pod") at the 500k-pod
-        # shape would otherwise pay ~1M redundant routing lookups)
-        for shard in self._shards_for_read(namespace):
-            blobs = self._shard_blobs(shard, use_cache, kind)
-            for obj in self._scan_shard(
-                shard, kind, namespace, label_selector, use_cache
-            ):
-                out.append(_materialize(obj, blobs.get(obj_key(obj))))
-        # cross-shard merge rule: one global (namespace, name) sort — the
-        # same total order the unsharded store produced, whatever shard
-        # each namespace hashed to
-        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
-        return out
+        prof = PROFILER.phase("snapshot") if PROFILER.enabled else None
+        try:
+            use_cache = cached and self.cache_lag
+            out = []
+            # iterate shard-by-shard so the per-kind blob dict is fetched
+            # ONCE per shard, not re-resolved per object (list("Pod") at the
+            # 500k-pod shape would otherwise pay ~1M redundant lookups)
+            for shard in self._shards_for_read(namespace):
+                blobs = self._shard_blobs(shard, use_cache, kind)
+                for obj in self._scan_shard(
+                    shard, kind, namespace, label_selector, use_cache
+                ):
+                    out.append(_materialize(obj, blobs.get(obj_key(obj))))
+            # cross-shard merge rule: one global (namespace, name) sort —
+            # the same total order the unsharded store produced, whatever
+            # shard each namespace hashed to
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _shards_for_read(self, namespace: Optional[str]):
         """Shards a read must consult: the owner for a namespace-scoped
@@ -910,9 +950,14 @@ class Store:
         stale read (resource_version behind committed) raises ERR_CONFLICT,
         so controllers that clobber concurrent writes fail in the sim too.
         """
-        shard = self._shard_of_obj(obj)
-        with shard.lock:
-            return self._update_locked(shard, obj, bump_generation)
+        prof = PROFILER.phase("store-commit") if PROFILER.enabled else None
+        try:
+            shard = self._shard_of_obj(obj)
+            with shard.lock:
+                return self._update_locked(shard, obj, bump_generation)
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _update_locked(
         self, shard: StoreShard, obj, bump_generation: bool
@@ -1105,11 +1150,29 @@ class Store:
         suppression (replaced fields equal to committed → no bump, no
         event), authorization + fault injection, MODIFIED event with `old`.
         """
-        shard = self._shard_of_obj(view)
-        with shard.lock:
-            return self._commit_cow_locked(
-                shard, view, status, spec, metadata, bump_generation
+        # status-only COW commits are the reconcile loops' dominant write
+        # (phase/condition upkeep) — attribute them to their own
+        # `status-write` row so the ISSUE's dequeue→snapshot→diff→commit→
+        # status-write decomposition falls out of the report directly
+        prof = None
+        if PROFILER.enabled:
+            only_status = (
+                status is not _UNSET
+                and spec is _UNSET
+                and metadata is _UNSET
             )
+            prof = PROFILER.phase(
+                "status-write" if only_status else "store-commit"
+            )
+        try:
+            shard = self._shard_of_obj(view)
+            with shard.lock:
+                return self._commit_cow_locked(
+                    shard, view, status, spec, metadata, bump_generation
+                )
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _commit_cow_locked(
         self, shard: StoreShard, view, status, spec, metadata,
@@ -1163,9 +1226,14 @@ class Store:
         return stored
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        shard = self._shard_for(namespace)
-        with shard.lock:
-            self._delete_locked(shard, kind, namespace, name)
+        prof = PROFILER.phase("store-commit") if PROFILER.enabled else None
+        try:
+            shard = self._shard_for(namespace)
+            with shard.lock:
+                self._delete_locked(shard, kind, namespace, name)
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _delete_locked(
         self, shard: StoreShard, kind: str, namespace: str, name: str

@@ -93,10 +93,14 @@ class SimHarness:
         # Process-global singletons — the newest harness wins (one sim per
         # process in practice).
         from grove_tpu.observability.events import EVENTS
+        from grove_tpu.observability.flightrec import FLIGHTREC
+        from grove_tpu.observability.journey import JOURNEYS
         from grove_tpu.observability.tracing import TRACER
 
         TRACER.clock = self.clock
         EVENTS.clock = self.clock
+        JOURNEYS.clock = self.clock
+        FLIGHTREC.clock = self.clock
         self.ctx = OperatorContext(
             store=self.store, clock=self.clock, topology=self.topology
         )
@@ -276,19 +280,33 @@ class SimHarness:
     def converge(self, max_ticks: int = 60, tick_seconds: float = 1.0) -> int:
         """Reconcile ⇄ schedule ⇄ kubelet until quiescent. Each tick advances
         virtual time so requeue_after-based waits can fire."""
+        from grove_tpu.observability.profile import PROFILER
+
         ticks = 0
         for _ in range(max_ticks):
+            # wall attribution (docs/observability.md "Wall-attribution
+            # profiler"): every component of the tick gets a top-level
+            # phase (engine/scheduler/WAL open their own finer phases
+            # inside), so the roll-up's coverage vs an independent wall
+            # measurement is arithmetic. phase() is the shared no-op while
+            # profiling is off, and this runs per TICK, not per event —
+            # the hot paths keep the `if PROFILER.enabled` guard.
             work = self.engine.drain()
-            work += self.autoscaler.tick()
-            work += self.node_monitor.tick()
-            work += self.drainer.tick()
+            with PROFILER.phase("tick", controller="autoscaler"):
+                work += self.autoscaler.tick()
+            with PROFILER.phase("tick", controller="node-monitor"):
+                work += self.node_monitor.tick()
+            with PROFILER.phase("tick", controller="drain"):
+                work += self.drainer.tick()
             bound = self.schedule()
-            started = self.cluster.kubelet_tick()
+            with PROFILER.phase("tick", controller="kubelet"):
+                started = self.cluster.kubelet_tick()
             work += self.engine.drain()
             if self.durability is not None:
                 # group commit at the tick boundary — the sim's committer
-                # cadence (real-cluster mode uses the background thread)
-                self.durability.pump()
+                # cadence (real mode: the background thread)
+                with PROFILER.phase("tick", controller="wal"):
+                    self.durability.pump()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a

@@ -107,6 +107,7 @@ def converge_population(
     max_ticks: Optional[int] = None,
     frontier: bool = False,
     frontier_selfcheck: bool = False,
+    glassbox: bool = False,
 ) -> Tuple[SimHarness, dict]:
     """Apply + converge one multi-tenant population on a fresh harness;
     returns (harness, report).
@@ -123,7 +124,17 @@ def converge_population(
     (solver/frontier.py) and reports its counters under ``"frontier"``;
     ``frontier_selfcheck`` arms the per-tick batched-vs-sequential A/B
     (the smoke's setting — measurement runs keep it off and report the
-    overhead ledger as 0)."""
+    overhead ledger as 0).
+
+    ``glassbox=True`` arms the wall-attribution profiler and the
+    gang-journey tracer for the CONVERGE window (never the apply loop)
+    and adds ``"attribution"`` / ``"admission_latency"`` /
+    ``"critical_path"`` blocks: the per-(controller, shard, phase)
+    ledger gated on ≥95% coverage of the independently timed converge
+    wall, and the per-gang queue-wait/encode/solve/commit decomposition
+    (docs/observability.md). Profiling overhead lands INSIDE the
+    measured wall, so glass-box runs are not comparable to dark ones —
+    the frontier/inert A/Bs always run dark."""
     tenants = tenant_namespaces(min(n_tenants, max(n_sets, 1)))
     store = Store(VirtualClock(), cache_lag=True, num_shards=num_shards)
     h = SimHarness(num_nodes=n_nodes, store=store)
@@ -145,8 +156,28 @@ def converge_population(
         t0 = time.perf_counter()
         applied_s = _populate(h, n_sets, tenants)
         rss_after_apply = _peak_rss_kb()
+        if glassbox:
+            from grove_tpu.observability.journey import JOURNEYS
+            from grove_tpu.observability.profile import PROFILER
+
+            PROFILER.enable()
+            PROFILER.reset()
+            JOURNEYS.enable()
+            JOURNEYS.reset()
+            JOURNEYS.clock = h.clock
+        t_conv0 = time.perf_counter()
         h.converge(max_ticks=max_ticks or (60 + 8 * n_sets))
+        converge_wall = time.perf_counter() - t_conv0
         wall = time.perf_counter() - t0
+        glass = None
+        if glassbox:
+            # freeze the ledger NOW: the report-building store reads below
+            # must not leak into the attribution window (coverage is
+            # attributed ÷ converge wall and both sides stop here)
+            solver_glass = (
+                METRICS.hist_sum.get("gang_solve_seconds", 0.0) - solver_s0
+            )
+            glass = glassbox_blocks(converge_wall, solver_glass)
     finally:
         gc.enable()
         gc.unfreeze()
@@ -184,7 +215,48 @@ def converge_population(
     }
     if frontier and h.scheduler.frontier is not None:
         report["frontier"] = h.scheduler.frontier.stats()
+    if glassbox and glass is not None:
+        report.update(glass)
     return h, report
+
+
+def glassbox_blocks(converge_wall: float, solver_s: float) -> dict:
+    """Freeze the glass-box layer into bench blocks and disarm it.
+
+    ``attribution``: the profiler roll-up with TWO coverage ratios —
+    ``coverage`` (attributed ÷ the independently timed converge wall,
+    solver included on both sides) and ``cp_coverage`` (the same with
+    the solve-phase rows subtracted from both sides: the CP-only claim
+    the acceptance gate reads). ``admission_latency``/``critical_path``:
+    the journey decomposition and its top-down fold."""
+    from grove_tpu.observability.journey import JOURNEYS
+    from grove_tpu.observability.profile import PROFILER
+
+    attribution = PROFILER.report(wall_seconds=converge_wall)
+    solve_attr = sum(
+        ph["total_s"]
+        for ph in attribution["phases"]
+        if ph["phase"] == "solve"
+    )
+    cp_wall = converge_wall - solve_attr
+    cp_attr = attribution["attributed_seconds"] - solve_attr
+    attribution["cp_wall_seconds"] = round(cp_wall, 6)
+    attribution["cp_attributed_seconds"] = round(cp_attr, 6)
+    attribution["cp_coverage"] = (
+        round(cp_attr / cp_wall, 4) if cp_wall > 0 else 0.0
+    )
+    attribution["solver_histogram_seconds"] = round(solver_s, 6)
+    # the artifact keeps the top sinks; the full table stays queryable at
+    # GET /debug/profile while the process lives
+    attribution["phases"] = attribution["phases"][:24]
+    blocks = {
+        "attribution": attribution,
+        "admission_latency": JOURNEYS.decomposition(),
+        "critical_path": JOURNEYS.critical_path(),
+    }
+    PROFILER.disable()
+    JOURNEYS.disable()
+    return blocks
 
 
 def _rv_normalized(dump: dict) -> dict:
@@ -319,8 +391,13 @@ def scale_artifact(
     the paired frontier on/off A/B. Caller picks the shape (the
     integrated bench passes the full 100k-node shape only on full-size
     runs)."""
+    # glassbox=True: the headline converge ships its own wall-attribution
+    # ledger ("attribution": per-(controller, shard, phase) with the
+    # ≥95%-coverage claim) and per-gang admission decomposition — the
+    # before/after evidence the parallel-CP PR is gated on. The A/Bs
+    # below stay dark so their walls are comparable across PRs.
     harness, report = converge_population(
-        n_sets, n_nodes, num_shards, frontier=True
+        n_sets, n_nodes, num_shards, frontier=True, glassbox=True
     )
     # release the big population before the A/B runs its twin harnesses
     del harness

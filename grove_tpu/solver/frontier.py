@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from grove_tpu.observability.journey import JOURNEYS
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.observability.tracing import TRACER
 from grove_tpu.solver.encode import (
@@ -255,6 +256,14 @@ class FrontierState:
             METRICS.inc("frontier_degenerate_total")
             return None
         part_of = self.assign(plan, enc, free, gang_specs)
+        if JOURNEYS.enabled:
+            # journey lane stamp: which frontier partition will solve each
+            # gang this round (-1 = the global residual pass) — the per-gang
+            # answer to "which solver lane held my admission"
+            for i, spec in enumerate(gang_specs):
+                JOURNEYS.note_partition(
+                    spec["namespace"], spec["gang_name"], int(part_of[i])
+                )
         parts_used = sorted({int(k) for k in part_of if k >= 0})
         if not parts_used:
             self.degenerate += 1

@@ -41,7 +41,9 @@ from grove_tpu.observability.events import (
     TYPE_NORMAL,
     TYPE_WARNING,
 )
+from grove_tpu.observability.journey import JOURNEYS
 from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.profile import PROFILER
 from grove_tpu.observability.tracing import TRACER
 from grove_tpu.quota.manager import QuotaManager, spec_demand
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
@@ -147,6 +149,12 @@ class GangScheduler:
         # are zeroed when they drain — a gauge never touched again would
         # report phantom pending work forever)
         self._pending_ns_shards: set = set()
+        # journey tracing (observability/journey.py): wall stamp of the
+        # current round's encode completion, set only while JOURNEYS is
+        # enabled — splits encode from solve in the admission decomposition
+        self._journey_encode_end = None
+        # pods bound by the most recent _commit_admitted pass
+        self._last_commit_bound = 0
 
     def enable_delta(self) -> bool:
         """Attach the incremental delta-solve state. In-memory stores only:
@@ -187,15 +195,22 @@ class GangScheduler:
         equal tensors ⇒ the deterministic wave solve returns the same
         result — the steady-state "pending backlog, nothing changed"
         spin). Returns (PackingResult, PackingProblem)."""
-        with TRACER.span(
-            "solve.delta_encode", gangs=len(gang_specs), nodes=len(nodes)
-        ) as span:
-            problem, fingerprint = self.delta.encode(
-                nodes,
-                gang_specs,
-                pad_groups=self._pad_groups.grow(gang_specs),
-            )
-            span.set("reencoded", self.delta.last_reencoded)
+        prof = PROFILER.phase("encode") if PROFILER.enabled else None
+        try:
+            with TRACER.span(
+                "solve.delta_encode", gangs=len(gang_specs), nodes=len(nodes)
+            ) as span:
+                problem, fingerprint = self.delta.encode(
+                    nodes,
+                    gang_specs,
+                    pad_groups=self._pad_groups.grow(gang_specs),
+                )
+                span.set("reencoded", self.delta.last_reencoded)
+        finally:
+            if prof is not None:
+                prof.end()
+        if JOURNEYS.enabled:
+            self._journey_encode_end = JOURNEYS.t()
         key = (fingerprint, self.chunk_size, self.max_waves)
         if self._delta_last is not None and self._delta_last[0] == key:
             self.delta.solve_reuses += 1
@@ -211,7 +226,12 @@ class GangScheduler:
             # batched dispatches + a global residual pass. None ⇒ the
             # tick is degenerate (single super-domain or all-residual)
             # and falls through to the ordinary global solve below.
-            result = self.frontier.solve(self, gang_specs, problem)
+            prof = PROFILER.phase("solve") if PROFILER.enabled else None
+            try:
+                result = self.frontier.solve(self, gang_specs, problem)
+            finally:
+                if prof is not None:
+                    prof.end()
             if result is not None:
                 self._solve_reused = False
                 self._frontier_solved = True
@@ -312,36 +332,48 @@ class GangScheduler:
         # widest template seen and keep padding there: compiles stay
         # monotone-few, executables keep getting reused.
         if problem is None:
-            with TRACER.span(
-                "scheduler.encode", gangs=len(gang_specs), nodes=len(nodes)
-            ):
-                problem = build_problem(
-                    nodes, gang_specs, self.topology,
-                    free_capacity=free_capacity,
-                    pad_groups=self._pad_groups.grow(gang_specs),
-                )
+            prof = PROFILER.phase("encode") if PROFILER.enabled else None
+            try:
+                with TRACER.span(
+                    "scheduler.encode", gangs=len(gang_specs), nodes=len(nodes)
+                ):
+                    problem = build_problem(
+                        nodes, gang_specs, self.topology,
+                        free_capacity=free_capacity,
+                        pad_groups=self._pad_groups.grow(gang_specs),
+                    )
+            finally:
+                if prof is not None:
+                    prof.end()
+            if JOURNEYS.enabled:
+                self._journey_encode_end = JOURNEYS.t()
         import time as _time
 
-        if (
-            self.solver_sidecar is None
-            or _time.monotonic() < self._sidecar_skip_until
-        ):
-            with TRACER.span(
-                "scheduler.solve", gangs=len(gang_specs), where="in-process"
+        prof = PROFILER.phase("solve") if PROFILER.enabled else None
+        try:
+            if (
+                self.solver_sidecar is None
+                or _time.monotonic() < self._sidecar_skip_until
             ):
-                result = solve_waves(
-                    problem,
-                    chunk_size=self.chunk_size,
-                    max_waves=self.max_waves,
-                    with_alloc=with_alloc,
+                with TRACER.span(
+                    "scheduler.solve", gangs=len(gang_specs), where="in-process"
+                ):
+                    result = solve_waves(
+                        problem,
+                        chunk_size=self.chunk_size,
+                        max_waves=self.max_waves,
+                        with_alloc=with_alloc,
+                    )
+                return result, problem
+            with TRACER.span(
+                "scheduler.solve", gangs=len(gang_specs), where="sidecar"
+            ):
+                return self._solve_remote(
+                    problem, nodes, gang_specs, free_capacity, with_alloc
                 )
-            return result, problem
-        with TRACER.span(
-            "scheduler.solve", gangs=len(gang_specs), where="sidecar"
-        ):
-            return self._solve_remote(
-                problem, nodes, gang_specs, free_capacity, with_alloc
-            )
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _solve_remote(
         self, problem, nodes, gang_specs, free_capacity, with_alloc: bool
@@ -466,10 +498,22 @@ class GangScheduler:
         nodes are shared cluster-wide, so per-namespace rounds would let a
         low-priority gang in an alphabetically-earlier namespace take
         capacity a high-priority gang elsewhere needs (priority inversion)."""
-        with TRACER.span("scheduler.schedule") as span:
-            bound = self._schedule_pending(namespace)
-            span.set("bound", bound)
-            return bound
+        # wall attribution: everything below lands under controller
+        # "scheduler" — pending-scan/encode/solve/commit phases open their
+        # own rows, this phase's self-time is ordering/quota/round glue
+        prof = (
+            PROFILER.phase("schedule", controller="scheduler")
+            if PROFILER.enabled
+            else None
+        )
+        try:
+            with TRACER.span("scheduler.schedule") as span:
+                bound = self._schedule_pending(namespace)
+                span.set("bound", bound)
+                return bound
+        finally:
+            if prof is not None:
+                prof.end()
 
     def _schedule_pending(self, namespace: Optional[str] = None) -> int:
         if namespace is None:
@@ -496,9 +540,9 @@ class GangScheduler:
                 idx = self.store.shard_index(ns)
                 by_shard[idx] = by_shard.get(idx, 0) + 1
             for idx in self._pending_ns_shards - set(by_shard):
-                METRICS.set(f"pending_namespaces/{idx}", 0)
+                METRICS.set(f"pending_namespaces@{idx}", 0)
             for idx, count in by_shard.items():
-                METRICS.set(f"pending_namespaces/{idx}", count)
+                METRICS.set(f"pending_namespaces@{idx}", count)
             self._pending_ns_shards = set(by_shard)
         self.cluster._gc_bindings()
         if self.delta is not None:
@@ -514,19 +558,37 @@ class GangScheduler:
         loose_pods: List = []  # (namespace, pod)
         with TRACER.span("scheduler.pending-scan", namespaces=len(namespaces)):
             for ns in namespaces:
-                self.update_gang_phases(ns)
-                self.update_gang_health(ns)
-                pending = self._pending_pods(ns)
-                if not pending:
-                    continue
-                sticky, pending = self._bind_with_reused_reservations(
-                    ns, pending
+                # per-shard attribution: the scan is the scheduler's only
+                # namespace-partitioned work, so its rows are the demand-side
+                # per-shard ledger the parallel-CP PR will A/B against
+                prof = (
+                    PROFILER.phase(
+                        "pending-scan", shard=self.store.shard_index(ns)
+                    )
+                    if PROFILER.enabled
+                    and getattr(self.store, "shard_index", None) is not None
+                    else None
                 )
-                sticky_bound += sticky
-                specs, pods, loose = self._encode_pending(ns, pending)
-                gang_specs.extend(specs)
-                gang_pods.update(pods)
-                loose_pods.extend((ns, p) for p in loose)
+                try:
+                    self.update_gang_phases(ns)
+                    self.update_gang_health(ns)
+                    pending = self._pending_pods(ns)
+                    if not pending:
+                        continue
+                    sticky, pending = self._bind_with_reused_reservations(
+                        ns, pending
+                    )
+                    sticky_bound += sticky
+                    specs, pods, loose = self._encode_pending(ns, pending)
+                    gang_specs.extend(specs)
+                    gang_pods.update(pods)
+                    loose_pods.extend((ns, p) for p in loose)
+                    if JOURNEYS.enabled:
+                        for spec in specs:
+                            JOURNEYS.note_seen(ns, spec["gang_name"])
+                finally:
+                    if prof is not None:
+                        prof.end()
 
         # global solve order across all namespaces (kernel admits in input
         # order): the quota manager's fair-share pass when Queue CRs exist,
@@ -548,6 +610,10 @@ class GangScheduler:
             # recovery pin, or preemption trial can target one
             nodes = [n for n in self.cluster.nodes if n.schedulable]
             if nodes:
+                jz = JOURNEYS.enabled
+                if jz:
+                    t_enc0 = JOURNEYS.t()
+                    self._journey_encode_end = None
                 # wave solver with allocations: cheap-to-compile vmapped
                 # decisions (the exact scan kernel stays on the parity/bench
                 # paths; unadmitted gangs retry on the next control round)
@@ -562,6 +628,18 @@ class GangScheduler:
                     result, problem = self._solve_batch(
                         nodes, gang_specs, free
                     )
+                if jz:
+                    # this round's batch stamps: every gang in the batch
+                    # experienced the same encode/solve walls — the
+                    # admitting round's stamps become the gang's journey
+                    t_solve1 = JOURNEYS.t()
+                    JOURNEYS.note_round(
+                        t_enc0, self._journey_encode_end or t_enc0, t_solve1
+                    )
+                    for spec in gang_specs:
+                        JOURNEYS.note_encoded(
+                            spec["namespace"], spec["gang_name"]
+                        )
                 if self.delta is not None and self.delta_selfcheck:
                     self._delta_ab_check(nodes, gang_specs, problem, result)
                 if not self._solve_reused:
@@ -580,58 +658,18 @@ class GangScheduler:
                     preempted |= reclaimed
                 assignments = result.assignments(problem)
                 to_mark = []
-                with TRACER.span(
-                    "scheduler.commit", gangs=len(gang_specs)
-                ) as commit_span:
-                    for gi, spec in enumerate(gang_specs):
-                        ns = spec["namespace"]
-                        if not result.admitted[gi]:
-                            if (ns, spec["gang_name"]) not in preempted:
-                                EVENTS.record(
-                                    ("PodGang", ns, spec["gang_name"]),
-                                    TYPE_WARNING,
-                                    REASON_GANG_DEFERRED,
-                                    "not admitted this round (insufficient "
-                                    "capacity or unsatisfiable topology)",
-                                )
-                            continue
-                        if (ns, spec["gang_name"]) in preempted:
-                            # a victim's stale admission from this solve must
-                            # not overwrite its Preempted status (its pods
-                            # are gone)
-                            continue
-                        for pclq_fqn, node_names in assignments[
-                            spec["name"]
-                        ].items():
-                            pods = gang_pods[spec["name"]].get(pclq_fqn, [])
-                            for pod, node_name in zip(pods, node_names):
-                                self.cluster.bind(pod, node_name)
-                                EVENTS.record(
-                                    ("Pod", ns, pod.metadata.name),
-                                    TYPE_NORMAL,
-                                    REASON_POD_BOUND,
-                                    f"bound to {node_name}",
-                                )
-                                bound += 1
-                        # A recovery delta-solve (floors reduced by pods
-                        # already placed) only covers the missing pods; its
-                        # score says nothing about the whole gang — keep the
-                        # original.
-                        partial = any(g["partial"] for g in spec["groups"])
-                        EVENTS.record(
-                            ("PodGang", ns, spec["gang_name"]),
-                            TYPE_NORMAL,
-                            REASON_GANG_ADMITTED,
-                            f"placement score {float(result.score[gi]):.4f}",
-                        )
-                        to_mark.append(
-                            (
-                                ns,
-                                spec["gang_name"],
-                                None if partial else float(result.score[gi]),
-                            )
-                        )
-                    commit_span.set("bound", bound)
+                prof = (
+                    PROFILER.phase("commit") if PROFILER.enabled else None
+                )
+                try:
+                    self._commit_admitted(
+                        gang_specs, result, assignments, gang_pods,
+                        preempted, to_mark,
+                    )
+                    bound += self._last_commit_bound
+                finally:
+                    if prof is not None:
+                        prof.end()
                 with TRACER.span("scheduler.status-write", gangs=len(to_mark)):
                     for ns, gang_name, score in to_mark:
                         self._mark_scheduled(ns, gang_name, score)
@@ -644,6 +682,70 @@ class GangScheduler:
                     bound += 1
                     break
         return bound + sticky_bound
+
+    def _commit_admitted(
+        self, gang_specs, result, assignments, gang_pods, preempted, to_mark
+    ) -> None:
+        """Bind every admitted gang's pods and queue its status write —
+        the commit phase of one scheduling round, split out so the
+        attribution phase covers exactly it. The bound-pod count lands in
+        ``self._last_commit_bound`` (the caller's round total)."""
+        bound = 0
+        with TRACER.span(
+            "scheduler.commit", gangs=len(gang_specs)
+        ) as commit_span:
+            for gi, spec in enumerate(gang_specs):
+                ns = spec["namespace"]
+                if not result.admitted[gi]:
+                    if (ns, spec["gang_name"]) not in preempted:
+                        EVENTS.record(
+                            ("PodGang", ns, spec["gang_name"]),
+                            TYPE_WARNING,
+                            REASON_GANG_DEFERRED,
+                            "not admitted this round (insufficient "
+                            "capacity or unsatisfiable topology)",
+                        )
+                    continue
+                if (ns, spec["gang_name"]) in preempted:
+                    # a victim's stale admission from this solve must
+                    # not overwrite its Preempted status (its pods
+                    # are gone)
+                    continue
+                for pclq_fqn, node_names in assignments[
+                    spec["name"]
+                ].items():
+                    pods = gang_pods[spec["name"]].get(pclq_fqn, [])
+                    for pod, node_name in zip(pods, node_names):
+                        self.cluster.bind(pod, node_name)
+                        EVENTS.record(
+                            ("Pod", ns, pod.metadata.name),
+                            TYPE_NORMAL,
+                            REASON_POD_BOUND,
+                            f"bound to {node_name}",
+                        )
+                        bound += 1
+                # A recovery delta-solve (floors reduced by pods
+                # already placed) only covers the missing pods; its
+                # score says nothing about the whole gang — keep the
+                # original.
+                partial = any(g["partial"] for g in spec["groups"])
+                EVENTS.record(
+                    ("PodGang", ns, spec["gang_name"]),
+                    TYPE_NORMAL,
+                    REASON_GANG_ADMITTED,
+                    f"placement score {float(result.score[gi]):.4f}",
+                )
+                if JOURNEYS.enabled:
+                    JOURNEYS.note_commit(ns, spec["gang_name"])
+                to_mark.append(
+                    (
+                        ns,
+                        spec["gang_name"],
+                        None if partial else float(result.score[gi]),
+                    )
+                )
+            commit_span.set("bound", bound)
+        self._last_commit_bound = bound
 
     def _bind_with_reused_reservations(self, namespace: str, pending: List):
         """Honor PodGang.reuseReservationRef: a recreated pod of an
@@ -1104,6 +1206,11 @@ class GangScheduler:
                     self.store.clock.now(),
                 )
             if self._commit_status_tolerant(gang, st):
+                if JOURNEYS.enabled:
+                    # Scheduled=True is durable — the journey completes and
+                    # its admission decomposition is derived (a re-mark of
+                    # an already-completed gang is a no-op pop)
+                    JOURNEYS.note_scheduled(namespace, gang_name)
                 return
 
     # -- preemption (SURVEY §7 'hard parts': explicit solver feature) -----

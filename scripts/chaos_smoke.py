@@ -204,6 +204,13 @@ def run_one(
         )
         for p in problems:
             print(f"  - {p}", file=sys.stderr)
+        if report.flight_bundles:
+            # the run's own evidence (docs/observability.md "Flight
+            # recorder"): commit digests, events and errors leading up to
+            # each violation, per keyspace shard, plus a Chrome trace
+            print("flight-recorder bundles:", file=sys.stderr)
+            for bundle in report.flight_bundles:
+                print(f"  {bundle}", file=sys.stderr)
         return 1
     if not as_json:
         print("chaos smoke OK")

@@ -9,7 +9,12 @@ Checks:
 - engine-reconcile spans are present;
 - scheduler.schedule spans carry nested encode/solve/commit children
   (parent-linked AND time-contained, which is what chrome://tracing and
-  Perfetto use to nest).
+  Perfetto use to nest);
+- every event carries the `shard` lane column (PR 12 glass-box layer)
+  and engine.reconcile spans are stamped with a real shard index;
+- the flight recorder (observability/flightrec.py), armed for the run,
+  dumps a bundle whose own Chrome trace validates and whose rings carry
+  the run's spans and store-commit digests.
 
 Usage: python scripts/trace_smoke.py [--gangs N] [--out PATH]
 """
@@ -62,10 +67,11 @@ spec:
 
 
 def run_traced_sim(n_gangs: int, num_nodes: int = 0):
-    """Apply n_gangs single-gang PodCliqueSets to a traced sim and converge.
-    Returns (harness, chrome_events)."""
+    """Apply n_gangs single-gang PodCliqueSets to a traced sim (flight
+    recorder armed) and converge. Returns (harness, chrome_events)."""
     from grove_tpu.api.load import load_podcliquesets
     from grove_tpu.api.meta import deep_copy
+    from grove_tpu.observability.flightrec import FLIGHTREC
     from grove_tpu.observability.tracing import TRACER
     from grove_tpu.sim.harness import SimHarness
 
@@ -73,6 +79,10 @@ def run_traced_sim(n_gangs: int, num_nodes: int = 0):
     TRACER.reset()
     base = load_podcliquesets(_SET_YAML)[0]
     harness = SimHarness(num_nodes=num_nodes or max(16, n_gangs // 2))
+    FLIGHTREC.enable(
+        num_shards=getattr(harness.store, "num_shards", 1),
+        clock=harness.clock,
+    )
     for i in range(n_gangs):
         pcs = deep_copy(base)
         pcs.metadata.name = f"trace-{i:04d}"
@@ -127,6 +137,53 @@ def check_trace(events) -> list:
                     "scheduler.schedule span"
                 )
             break  # one per name suffices for the smoke
+    # shard lane column (glass-box layer): every export row carries it,
+    # and engine.reconcile spans resolve a REAL shard (>= 0) so per-shard
+    # workers render as separate lanes
+    missing_shard = [
+        ev.get("name")
+        for ev in events
+        if isinstance(ev, dict) and "shard" not in ev
+    ]
+    if missing_shard:
+        problems.append(
+            f"{len(missing_shard)} events lack the `shard` column"
+            f" (e.g. {missing_shard[:3]})"
+        )
+    reconcile_shards = {
+        ev["shard"]
+        for ev in events
+        if isinstance(ev, dict) and ev.get("name") == "engine.reconcile"
+    }
+    if reconcile_shards and reconcile_shards == {-1}:
+        problems.append(
+            "engine.reconcile spans carry no resolved shard (all -1)"
+        )
+    return problems
+
+
+def check_flight_bundle() -> list:
+    """Dump the armed flight recorder and validate the bundle's own
+    exports (the smoke's coverage of the new postmortem path)."""
+    from grove_tpu.observability.flightrec import FLIGHTREC, load_bundle
+    from grove_tpu.observability.tracing import validate_chrome_trace
+
+    problems = []
+    bundle = FLIGHTREC.trigger("trace-smoke", "end-of-run export check")
+    if bundle is None:
+        return ["flight recorder refused the explicit dump"]
+    doc = load_bundle(bundle)
+    records = [r for s in doc["shards"] for r in s["records"]]
+    if not any(r["rec"] == "span" for r in records):
+        problems.append("flight bundle rings carry no spans")
+    if not any(r["rec"] == "commit" for r in records):
+        problems.append("flight bundle rings carry no commit digests")
+    chrome_problems = validate_chrome_trace(doc["chrome"])
+    if chrome_problems:
+        problems.append(
+            f"flight bundle chrome trace invalid: {chrome_problems[:2]}"
+        )
+    FLIGHTREC.disable()
     return problems
 
 
@@ -144,6 +201,7 @@ def main() -> int:
     with open(args.out) as f:
         loaded = json.load(f)
     problems = check_trace(loaded)
+    problems.extend(check_flight_bundle())
     if problems:
         for p in problems:
             print(f"FAIL: {p}", file=sys.stderr)

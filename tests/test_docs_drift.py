@@ -8,8 +8,14 @@
    every documented metric exists as a string literal in the code (the
    variable-assigned emitters like `metric="gang_preemptions_total"`
    resolve through the literal inventory).
+3. Profiler phases: every literal phase an instrumented site opens
+   (AST inventory over PROFILER.phase()/.reconcile() calls) ⊆ the PHASES
+   registry in observability/profile.py ⊆ the docs table — the
+   event-reason treatment applied to the glass-box layer (PR 12).
+4. Journey phases: the JOURNEY_PHASES registry ⇄ the docs table (marks
+   are internal to journey.py, so the registry itself is the inventory).
 
-These pin the three layers against each other so a new event/metric
+These pin the layers against each other so a new event/metric/phase
 cannot ship undocumented, and a doc row cannot outlive its emitter.
 """
 
@@ -22,17 +28,22 @@ from grove_tpu.analysis.inventory import (
     all_string_literals,
     emitted_event_reasons,
     emitted_metric_names,
+    emitted_profile_phases,
 )
 from grove_tpu.analysis.engine import repo_python_files
 from grove_tpu.observability.events import REGISTERED_REASONS
+from grove_tpu.observability.journey import JOURNEY_PHASES, JOURNEY_SEGMENTS
+from grove_tpu.observability.profile import PHASES
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 OBS_DOC = ROOT / "docs" / "observability.md"
 
 
-def _table_first_cells(section: str):
+def _table_first_cells(section: str, pattern: str = r"`([A-Za-z0-9_]+)`"):
     """All code spans from the FIRST column of a markdown table section
-    (cells may hold several names: `A` / `B` / `C`)."""
+    (cells may hold several names: `A` / `B` / `C`). Phase tables pass a
+    hyphen-aware pattern — phase names like `pending-scan` are one name,
+    not two."""
     names = set()
     for line in section.splitlines():
         line = line.strip()
@@ -41,7 +52,7 @@ def _table_first_cells(section: str):
         first = line.split("|")[1]
         if set(first.strip()) <= {"-", ":", " "}:
             continue  # separator row
-        names.update(re.findall(r"`([A-Za-z0-9_]+)`", first))
+        names.update(re.findall(pattern, first))
     return names
 
 
@@ -107,10 +118,90 @@ class TestMetricNameDrift:
 
     def test_documented_metrics_exist_in_code(self, documented):
         literals = all_string_literals(ROOT, repo_python_files(ROOT))
-        # f-string heads keep their '/label' tail — normalize to base names
-        bases = {lit.split("/", 1)[0] for lit in literals}
+        # f-string heads keep their '/label' / '@shard' tails — normalize
+        # to base names (observability/metrics.py grammar)
+        bases = {
+            lit.split("/", 1)[0].split("@", 1)[0] for lit in literals
+        }
         missing = {m for m in documented if m not in bases}
         assert not missing, (
             "docs/observability.md documents metrics with no emitting"
             f" literal in grove_tpu/: {sorted(missing)}"
+        )
+
+
+_DASHED = r"`([A-Za-z0-9_-]+)`"
+
+
+class TestProfilerPhaseDrift:
+    """The glass-box analogue of the event-reason gates: instrumented
+    phases ⊆ the profile.py PHASES registry ⊆ the docs table, and no doc
+    row outlives its call sites."""
+
+    def test_emitted_subset_of_registry(self):
+        emitted = emitted_profile_phases(ROOT)
+        unregistered = set(emitted) - set(PHASES)
+        assert not unregistered, (
+            "profiler phases opened but not registered in"
+            f" observability/profile.py PHASES: {sorted(unregistered)}"
+            f" (sites: {[sorted(emitted[p]) for p in sorted(unregistered)]})"
+        )
+
+    def test_registry_subset_of_docs(self):
+        documented = _table_first_cells(
+            _doc_section("Wall-attribution profiler"), _DASHED
+        )
+        undocumented = set(PHASES) - documented
+        assert not undocumented, (
+            "registered profiler phases missing from the"
+            " docs/observability.md table:"
+            f" {sorted(undocumented)}"
+        )
+
+    def test_docs_not_stale(self):
+        documented = _table_first_cells(
+            _doc_section("Wall-attribution profiler"), _DASHED
+        )
+        stale = documented - set(PHASES)
+        assert not stale, (
+            "docs/observability.md documents profiler phases no longer"
+            f" in the registry: {sorted(stale)}"
+        )
+
+    def test_registry_is_emitted(self):
+        """A registered-but-never-opened phase is dead registry weight."""
+        emitted = set(emitted_profile_phases(ROOT))
+        dead = set(PHASES) - emitted
+        assert not dead, (
+            "registered profiler phases with no opening call site:"
+            f" {sorted(dead)}"
+        )
+
+
+class TestJourneyPhaseDrift:
+    def test_registry_matches_docs(self):
+        """Journey phases (and derived segments) ⇄ the docs table — the
+        marks are internal to journey.py, so the importable registry is
+        the code-side inventory."""
+        documented = _table_first_cells(
+            _doc_section("Gang journeys"), _DASHED
+        )
+        assert set(JOURNEY_PHASES) <= documented, (
+            "journey phases missing from the docs/observability.md"
+            f" table: {sorted(set(JOURNEY_PHASES) - documented)}"
+        )
+        stale = documented - set(JOURNEY_PHASES)
+        assert not stale, (
+            "docs/observability.md documents journey phases no longer in"
+            f" JOURNEY_PHASES: {sorted(stale)}"
+        )
+        # every derived segment the decomposition reports is described in
+        # the section body (prose, not the table)
+        section = _doc_section("Gang journeys")
+        missing = [
+            seg for seg in JOURNEY_SEGMENTS if f"`{seg}`" not in section
+        ]
+        assert not missing, (
+            "journey segments undescribed in docs/observability.md:"
+            f" {missing}"
         )

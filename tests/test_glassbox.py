@@ -1,0 +1,593 @@
+"""Glass-box control-plane layer (PR 12, docs/observability.md):
+
+- **Wall-attribution profiler** — exclusive-time accounting over nested
+  phases (sums equal outer wall: the coverage claim is arithmetic),
+  context re-keying (a store write inside a reconcile attributes to the
+  reconcile's controller+shard), log-bucketed histograms, report shape.
+- **Gang journeys** — causal chain completeness under a churn storm:
+  every admitted gang ends with a gap-free, time-ordered
+  created → first-scan → encode → solve → commit → scheduled record and
+  a non-negative admission decomposition.
+- **Flight recorder** — bounded rings, dump-on-invariant-violation via an
+  injected chaos failure, bundle round-trip, breaker-open trigger.
+- **Disabled-path pins (PR-1 discipline)** — the hot paths grown since
+  PR 1 (frontier assignment loop, per-shard event routing, WAL
+  note_event) must allocate ZERO span/phase/journey records while the
+  layers are off: constructors are patched to raise for the duration.
+- **Wire shapes** — GET /debug/profile (attribution JSON vs the
+  PR-1 sampling mode), GET /gangs/{ns}/{name}/journey, GET
+  /debug/journeys, per-shard `@` labels in the Prometheus exposition,
+  the `shard` column in the Chrome export, and the event recorder's
+  shard stamp.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from grove_tpu.api.meta import deep_copy
+from grove_tpu.models import load_sample
+from grove_tpu.observability import flightrec as flightrec_mod
+from grove_tpu.observability import journey as journey_mod
+from grove_tpu.observability import profile as profile_mod
+from grove_tpu.observability import tracing as tracing_mod
+from grove_tpu.observability.events import EVENTS, EventRecorder
+from grove_tpu.observability.flightrec import FLIGHTREC, load_bundle
+from grove_tpu.observability.journey import JOURNEY_PHASES, JOURNEYS
+from grove_tpu.observability.metrics import Metrics
+from grove_tpu.observability.profile import PROFILER
+from grove_tpu.observability.tracing import TRACER
+from grove_tpu.sim.harness import SimHarness
+
+
+@pytest.fixture(autouse=True)
+def _reset_glassbox():
+    """Every test starts and ends with the layer disarmed (the singletons
+    are process-global; leakage between tests would be exactly the bug
+    class GL015 exists to prevent in production code)."""
+    PROFILER.disable()
+    PROFILER.reset()
+    JOURNEYS.disable()
+    JOURNEYS.reset()
+    FLIGHTREC.disable()
+    FLIGHTREC.reset()
+    yield
+    PROFILER.disable()
+    PROFILER.reset()
+    JOURNEYS.disable()
+    JOURNEYS.reset()
+    FLIGHTREC.disable()
+    FLIGHTREC.reset()
+
+
+def _apply_sets(harness, n, base_name="glass"):
+    base = load_sample("simple")
+    for i in range(n):
+        pcs = deep_copy(base)
+        pcs.metadata.name = f"{base_name}-{i:03d}"
+        harness.apply(pcs)
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+
+class TestWallProfiler:
+    def test_disabled_phase_is_shared_noop(self):
+        ph = PROFILER.phase("solve")
+        assert ph is profile_mod._NULL_PHASE
+        with ph:
+            pass
+        assert PROFILER.report()["phases"] == []
+
+    def test_exclusive_times_sum_to_outer_wall(self):
+        """Self-times across a nested phase tree sum to the outermost
+        phase's duration — the arithmetic behind the coverage gate."""
+        import time
+
+        PROFILER.enable()
+        with PROFILER.phase("drain", controller="engine"):
+            time.sleep(0.005)
+            with PROFILER.phase("dequeue"):
+                time.sleep(0.005)
+            with PROFILER.phase("reconcile", controller="podclique", shard=2):
+                time.sleep(0.005)
+                with PROFILER.phase("store-commit"):
+                    time.sleep(0.005)
+        report = PROFILER.report()
+        attributed = report["attributed_seconds"]
+        covered = report["covered_wall_seconds"]
+        assert covered == pytest.approx(attributed, rel=0.05)
+        keys = {
+            (p["controller"], p["shard"], p["phase"])
+            for p in report["phases"]
+        }
+        # context re-keying: the store commit attributed to the reconcile's
+        # controller and shard, the dequeue to the engine
+        assert ("podclique", 2, "store-commit") in keys
+        assert ("podclique", 2, "reconcile") in keys
+        assert ("engine", -1, "dequeue") in keys
+        assert ("engine", -1, "drain") in keys
+
+    def test_context_restored_after_rekeyed_phase(self):
+        PROFILER.enable()
+        with PROFILER.phase("drain", controller="engine"):
+            with PROFILER.phase("reconcile", controller="podgang", shard=1):
+                pass
+            with PROFILER.phase("dequeue"):
+                pass
+        keys = {
+            (p["controller"], p["shard"], p["phase"])
+            for p in PROFILER.report()["phases"]
+        }
+        # after the re-keyed child ended, the engine context came back
+        assert ("engine", -1, "dequeue") in keys
+
+    def test_log_bucket_quantiles_are_ordered(self):
+        hist = profile_mod._Hist()
+        for us in (3, 5, 9, 100, 4000, 4100, 65000):
+            hist.add(us)
+        assert hist.count == 7
+        p50 = hist.quantile_us(0.5)
+        p99 = hist.quantile_us(0.99)
+        assert 0 < p50 <= p99 <= hist.max_us * 1.5
+        assert hist.total_us == 3 + 5 + 9 + 100 + 4000 + 4100 + 65000
+
+    def test_report_coverage_field(self):
+        import time
+
+        PROFILER.enable()
+        with PROFILER.phase("tick", controller="kubelet"):
+            time.sleep(0.002)
+        doc = PROFILER.report(wall_seconds=PROFILER.covered_wall_seconds())
+        assert doc["coverage"] == pytest.approx(1.0, abs=0.1)
+
+    def test_converge_coverage_against_independent_wall(self):
+        """End to end on a real (small) converge: the ledger accounts for
+        ≥90% of an independently measured wall (the smoke gates ≥95% on
+        the mid shape; the floor here is looser — tiny converges have
+        proportionally more loop glue)."""
+        import time
+
+        h = SimHarness(num_nodes=8)
+        _apply_sets(h, 2)
+        PROFILER.enable()
+        PROFILER.reset()
+        t0 = time.perf_counter()
+        h.converge()
+        wall = time.perf_counter() - t0
+        report = PROFILER.report(wall_seconds=wall)
+        PROFILER.disable()
+        assert report["coverage"] >= 0.90, report["coverage"]
+        controllers = {p["controller"] for p in report["phases"]}
+        assert {"engine", "scheduler"} <= controllers
+
+
+# ---------------------------------------------------------------------------
+# journeys
+# ---------------------------------------------------------------------------
+
+
+def _storm(h):
+    """Churn: converge, delete a set, recreate it, cordon+uncordon a node,
+    converge again — admissions through recreate and topology-change
+    paths, not just the cold start."""
+    h.converge()
+    h.delete("storm-000")
+    h.converge()
+    base = load_sample("simple")
+    pcs = deep_copy(base)
+    pcs.metadata.name = "storm-000"
+    h.apply(pcs)
+    h.cluster.nodes[1].cordoned = True
+    h.converge()
+    h.cluster.nodes[1].cordoned = False
+    h.converge()
+
+
+class TestGangJourneys:
+    def test_completeness_under_churn(self):
+        """Every admitted gang in the storm ends with a COMPLETE journey:
+        all six phases present, time-ordered, segments non-negative."""
+        from grove_tpu.api.meta import get_condition
+        from grove_tpu.api.types import COND_PODGANG_SCHEDULED
+
+        JOURNEYS.enable()
+        JOURNEYS.reset()
+        h = SimHarness(num_nodes=8)
+        _apply_sets(h, 3, base_name="storm")
+        _storm(h)
+        gangs = h.store.list("PodGang")
+        assert gangs
+        for g in gangs:
+            cond = get_condition(
+                g.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is None or not cond.is_true():
+                continue
+            doc = JOURNEYS.journey(g.metadata.namespace, g.metadata.name)
+            assert doc is not None, g.metadata.name
+            assert doc["complete"], (g.metadata.name, doc)
+            phases = [p["phase"] for p in doc["phases"]]
+            assert phases == list(JOURNEY_PHASES), phases
+            ts = [p["t_s"] for p in doc["phases"]]
+            assert ts == sorted(ts), (g.metadata.name, ts)
+            assert doc["segments"] is not None
+            assert all(v >= 0.0 for v in doc["segments"].values())
+            assert doc["rounds"] >= 1
+
+    def test_decomposition_and_critical_path(self):
+        JOURNEYS.enable()
+        JOURNEYS.reset()
+        h = SimHarness(num_nodes=8)
+        _apply_sets(h, 2)
+        h.converge()
+        d = JOURNEYS.decomposition()
+        assert d["journeys"] >= 2
+        assert d["admission_p99_s"] >= d["admission_p50_s"] >= 0.0
+        assert set(d["segments"]) == {
+            "queue_wait", "encode", "solve", "commit", "status",
+        }
+        cp = JOURNEYS.critical_path()
+        assert cp["journeys"] == d["journeys"]
+        shares = [row["share"] for row in cp["segments"].values()]
+        assert sum(shares) == pytest.approx(1.0, abs=0.02)
+        assert cp["tail"]["complete"]
+
+    def test_deleted_gang_journey_dropped(self):
+        JOURNEYS.enable()
+        JOURNEYS.reset()
+        JOURNEYS.note_created("ns", "gone")
+        assert JOURNEYS.journey("ns", "gone") is not None
+        JOURNEYS.note_deleted("ns", "gone")
+        assert JOURNEYS.journey("ns", "gone") is None
+
+    def test_recreated_gang_shows_live_journey_not_stale_completed(self):
+        """A deleted-and-recreated gang's IN-FLIGHT journey must win over
+        its previous incarnation's completed record — that is exactly the
+        gang an operator queries while it is stuck."""
+        JOURNEYS.enable()
+        JOURNEYS.reset()
+        JOURNEYS.note_created("ns", "g")
+        JOURNEYS.note_seen("ns", "g")
+        JOURNEYS.note_round(JOURNEYS.t(), JOURNEYS.t(), JOURNEYS.t())
+        JOURNEYS.note_encoded("ns", "g")
+        JOURNEYS.note_commit("ns", "g")
+        JOURNEYS.note_scheduled("ns", "g")
+        assert JOURNEYS.journey("ns", "g")["complete"]
+        # recreate: the new incarnation is pending again
+        JOURNEYS.note_created("ns", "g")
+        doc = JOURNEYS.journey("ns", "g")
+        assert doc["complete"] is False
+        assert [p["phase"] for p in doc["phases"]] == ["created"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_chaos_injected_invariant_failure_dumps_bundle(self, tmp_path):
+        """The dump-on-invariant-violation wiring, exercised end to end
+        via an injected (clearly-labeled) chaos failure: the report names
+        the bundle, the bundle re-reads, its rings carry commit digests
+        and its Chrome trace validates."""
+        from grove_tpu.observability.tracing import validate_chrome_trace
+        from grove_tpu.sim.chaos import ChaosRunner
+
+        runner = ChaosRunner(seed=1234)
+        runner.inject_invariant_failure_at = 5.0
+        import os
+
+        os.environ["GROVE_TPU_FLIGHTREC_DIR"] = str(tmp_path)
+        try:
+            report = runner.run()
+        finally:
+            del os.environ["GROVE_TPU_FLIGHTREC_DIR"]
+        assert any(
+            "INJECTED" in v for v in report.invariant_violations
+        )
+        assert report.flight_bundles, report.invariant_violations
+        doc = load_bundle(report.flight_bundles[0])
+        assert doc["reason"] == "chaos-invariant"
+        assert "INJECTED" in doc["detail"]
+        records = [r for s in doc["shards"] for r in s["records"]]
+        assert any(r["rec"] == "commit" for r in records)
+        assert doc["events"]
+        # tracing was off: an empty chrome array is valid "no spans", the
+        # validator only complains about emptiness — tolerate exactly that
+        problems = validate_chrome_trace(doc["chrome"])
+        assert all("empty" in p for p in problems), problems
+        # the as_dict wire shape carries the evidence pointer
+        assert report.as_dict()["flight_bundles"] == report.flight_bundles
+
+    def test_rings_are_bounded_per_shard(self):
+        FLIGHTREC.enable(num_shards=2, capacity=16)
+        from grove_tpu.runtime.clock import VirtualClock
+        from grove_tpu.runtime.store import Store
+
+        store = Store(VirtualClock(), num_shards=2)
+        h = None  # no harness: drive the store directly
+        from grove_tpu.api.types import PodCliqueSet
+
+        for i in range(200):
+            pcs = PodCliqueSet()
+            pcs.metadata.name = f"ring-{i:03d}"
+            pcs.metadata.namespace = f"tenant-{i % 8}"
+            store.create(pcs)
+        assert all(len(ring) <= 16 for ring in FLIGHTREC._rings)
+        # both shards saw traffic (8 namespaces over 2 shards) and each
+        # ring is full — 200 commits, only the most recent 16 retained
+        assert [len(ring) for ring in FLIGHTREC._rings] == [16, 16]
+
+    def test_dump_budget_caps_bundles(self, tmp_path):
+        FLIGHTREC.enable(out_dir=str(tmp_path), max_dumps=2)
+        assert FLIGHTREC.trigger("one") is not None
+        assert FLIGHTREC.trigger("two") is not None
+        assert FLIGHTREC.trigger("three") is None
+        assert len(FLIGHTREC.dumps) == 2
+
+    def test_breaker_open_triggers_dump(self, tmp_path):
+        """The disruption breaker's open transition ships its bundle."""
+        from grove_tpu.disruption.broker import DisruptionBroker
+        from grove_tpu.runtime.clock import VirtualClock
+        from grove_tpu.runtime.store import Store
+
+        store = Store(VirtualClock())
+        broker = DisruptionBroker(store, bucket_capacity=2.0)
+        FLIGHTREC.enable(out_dir=str(tmp_path))
+        broker._open(store.clock.now(), "eviction storm (test)")
+        assert len(FLIGHTREC.dumps) == 1
+        doc = load_bundle(FLIGHTREC.dumps[0])
+        assert doc["reason"] == "breaker-open"
+        # re-opening while already open is idempotent: no second bundle
+        broker._open(store.clock.now(), "again")
+        assert len(FLIGHTREC.dumps) == 1
+
+
+# ---------------------------------------------------------------------------
+# disabled-path allocation pins (the PR-1 one-boolean-check discipline)
+# ---------------------------------------------------------------------------
+
+
+class _Boom:
+    def __init__(self, *a, **kw):  # pragma: no cover - must never run
+        raise AssertionError(
+            "telemetry record allocated while its layer is disabled"
+        )
+
+
+@pytest.fixture
+def _no_allocations(monkeypatch):
+    """While active, constructing ANY span/phase/journey/ring record
+    raises — the teeth behind 'disabled hot paths stay one boolean
+    check'."""
+    assert not TRACER.enabled
+    assert not PROFILER.enabled
+    assert not JOURNEYS.enabled
+    assert not FLIGHTREC.enabled
+    monkeypatch.setattr(tracing_mod, "Span", _Boom)
+    monkeypatch.setattr(profile_mod, "_Phase", _Boom)
+    monkeypatch.setattr(journey_mod, "_Journey", _Boom)
+    monkeypatch.setattr(
+        flightrec_mod.FlightRecorder, "note_commit", _Boom.__init__
+    )
+    yield
+
+
+class TestDisabledPathsAllocateNothing:
+    def test_frontier_assignment_loop(self, _no_allocations):
+        from grove_tpu.api.topology import ClusterTopology
+        from grove_tpu.sim.cluster import make_nodes
+        from grove_tpu.solver.encode import NodeEncoding
+        from grove_tpu.solver.frontier import FrontierState
+
+        topology = ClusterTopology()
+        nodes = make_nodes(32)
+        rset = sorted({r for n in nodes for r in n.capacity})
+        enc = NodeEncoding(nodes, topology, rset)
+        state = FrontierState(topology)
+        plan = state.plan_for(enc)
+        assert plan is not None
+        specs = [
+            {
+                "name": f"default/g{i}",
+                "gang_name": f"g{i}",
+                "namespace": "default",
+                "groups": [
+                    {
+                        "name": f"g{i}-g0",
+                        "demand": {"cpu": 0.1},
+                        "count": 2,
+                        "min_count": 2,
+                        "partial": False,
+                        "required_key": None,
+                        "pinned_node": None,
+                    }
+                ],
+                "required_key": None,
+                "preferred_key": None,
+                "spread_key": None,
+                "spread_min_domains": 2,
+                "spread_required": False,
+                "spread_survivor_nodes": [],
+                "gang_pinned_node": None,
+                "priority": 0,
+                "queue": "default",
+            }
+            for i in range(32)
+        ]
+        part_of = state.assign(plan, enc, enc.base_capacity.copy(), specs)
+        assert len(part_of) == 32
+
+    def test_sharded_event_routing_and_wal_note_event(
+        self, _no_allocations, tmp_path
+    ):
+        from grove_tpu.api.types import PodCliqueSet
+        from grove_tpu.durability.wal import WriteAheadLog
+        from grove_tpu.runtime.clock import VirtualClock
+        from grove_tpu.runtime.engine import Engine
+        from grove_tpu.runtime.store import Store
+
+        store = Store(VirtualClock(), num_shards=3)
+        engine = Engine(store)
+        wal = WriteAheadLog(str(tmp_path))
+        store.subscribe_system(wal.note_event)
+        for i in range(24):
+            pcs = PodCliqueSet()
+            pcs.metadata.name = f"alloc-{i:02d}"
+            pcs.metadata.namespace = f"tenant-{i % 5}"
+            store.create(pcs)
+        engine.drain()
+        assert wal.pending() == 24
+        assert TRACER.recorded == 0
+
+    def test_small_converge_allocates_nothing(self, _no_allocations):
+        h = SimHarness(num_nodes=4)
+        _apply_sets(h, 1)
+        h.converge()
+        assert TRACER.recorded == 0
+
+
+# ---------------------------------------------------------------------------
+# wire shapes
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestGlassBoxWire:
+    def test_debug_profile_attribution_shape(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        PROFILER.enable()
+        with PROFILER.phase("reconcile", controller="podclique", shard=1):
+            pass
+        server = APIServer().start()
+        try:
+            doc = _get_json(server.address + "/debug/profile")
+            assert doc["kind"] == "ProfileReport"
+            assert doc["enabled"] is True
+            assert isinstance(doc["attributed_seconds"], float)
+            assert isinstance(doc["by_controller"], dict)
+            row = doc["phases"][0]
+            assert set(row) == {
+                "controller", "shard", "phase", "count", "total_s",
+                "p50_s", "p99_s", "max_s",
+            }
+            assert row["controller"] == "podclique"
+            assert row["shard"] == 1
+            # the PR-1 sampling mode still answers (and stays gated)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    server.address + "/debug/profile?seconds=0.1",
+                    timeout=10,
+                )
+            assert err.value.code == 404  # profiling disabled by default
+        finally:
+            server.stop()
+
+    def test_gang_journey_endpoint(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        JOURNEYS.enable()
+        JOURNEYS.reset()
+        h = SimHarness(num_nodes=8)
+        _apply_sets(h, 1, base_name="wire")
+        h.converge()
+        gang = h.store.list("PodGang")[0]
+        server = APIServer(store=h.store).start()
+        try:
+            doc = _get_json(
+                server.address
+                + f"/gangs/{gang.metadata.namespace}/"
+                f"{gang.metadata.name}/journey"
+            )
+            assert doc["kind"] == "GangJourney"
+            assert doc["namespace"] == gang.metadata.namespace
+            assert doc["name"] == gang.metadata.name
+            assert doc["complete"] is True
+            assert [p["phase"] for p in doc["phases"]] == list(
+                JOURNEY_PHASES
+            )
+            for p in doc["phases"]:
+                assert isinstance(p["t_s"], float)
+                assert "vt" in p  # sim clock attached
+            assert set(doc["segments"]) == {
+                "queue_wait", "encode", "solve", "commit", "status",
+            }
+            assert isinstance(doc["total_s"], float)
+            assert doc["rounds"] >= 1
+            # unknown gang -> 404 with the NotFound reason
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    server.address + "/gangs/nope/nothing/journey",
+                    timeout=10,
+                )
+            assert err.value.code == 404
+            # fleet view
+            summary = _get_json(server.address + "/debug/journeys")
+            assert summary["kind"] == "JourneySummary"
+            assert summary["decomposition"]["journeys"] >= 1
+            assert "critical_path" in summary
+        finally:
+            server.stop()
+
+    def test_prometheus_shard_label_grammar(self):
+        m = Metrics()
+        m.set("engine_shard_backlog@3", 7.0)
+        m.set("queue_pending_gangs/teama", 2.0)
+        m.observe("reconcile_seconds/podclique@1", 0.25)
+        text = m.prometheus_text()
+        assert 'grove_tpu_engine_shard_backlog{shard="3"} 7.0' in text
+        assert 'grove_tpu_queue_pending_gangs{name="teama"} 2.0' in text
+        assert (
+            'grove_tpu_reconcile_seconds_count{name="podclique",shard="1"}'
+            in text
+        )
+
+    def test_event_records_carry_shard(self):
+        from grove_tpu.runtime.clock import VirtualClock
+        from grove_tpu.runtime.store import Store
+
+        store = Store(VirtualClock(), num_shards=4)
+        rec = EVENTS.record(
+            ("PodGang", "tenant-x", "g1"), "Normal", "GangAdmitted", "m"
+        )
+        assert rec.shard == store.shard_index("tenant-x")
+        assert rec.as_dict()["shard"] == rec.shard
+        # unsharded store resets the stamp to 0
+        Store(VirtualClock(), num_shards=1)
+        rec2 = EVENTS.record(
+            ("PodGang", "tenant-y", "g2"), "Normal", "GangAdmitted", "m"
+        )
+        assert rec2.shard == 0
+
+    def test_chrome_trace_shard_column(self):
+        from grove_tpu.runtime.clock import VirtualClock
+        from grove_tpu.runtime.store import Store
+
+        TRACER.enable()
+        TRACER.reset()
+        try:
+            store = Store(VirtualClock(), num_shards=3)
+            h = SimHarness(num_nodes=4, store=store)
+            _apply_sets(h, 1, base_name="lane")
+            h.converge()
+            events = TRACER.chrome_trace()
+        finally:
+            TRACER.disable()
+        assert events
+        assert all("shard" in ev for ev in events)
+        reconciles = [
+            ev for ev in events if ev["name"] == "engine.reconcile"
+        ]
+        assert reconciles
+        assert all(ev["shard"] >= 0 for ev in reconciles)

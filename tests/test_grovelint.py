@@ -227,6 +227,25 @@ FIXTURES = {
             "    return stats\n"
         ),
     },
+    "GL015": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def fudge(self):\n"
+            "    PROFILER._hist.clear()\n"
+            "    PROFILER.enabled = True\n"
+            "    self.journeys._active[('ns', 'g')] = None\n"
+            "    FLIGHTREC._rings[0].append({'rec': 'fake'})\n"
+        ),
+        "good": (
+            "def observe(self):\n"
+            "    PROFILER.enable()\n"
+            "    with PROFILER.phase('tick', controller='demo'):\n"
+            "        pass\n"
+            "    self.journeys.note_seen('ns', 'g')\n"
+            "    FLIGHTREC.trigger('manual', 'operator request')\n"
+            "    return PROFILER.report()\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -408,6 +427,55 @@ def test_grafting_frontier_state_write_fails_lint():
         assert "GL014" not in rules_of(
             lint_source(src, "grove_tpu/autoscale/fixture.py")
         ), src
+
+
+def test_grafting_glassbox_state_write_fails_lint():
+    """GL015 live-tree teeth: a rogue helper poking the profiler's
+    histogram table or the journey tracker's active map from real engine/
+    scheduler sources must fail lint — the coverage and gap-free-chain
+    claims assume only grove_tpu/observability/ writes that state. The
+    owning modules stay exempt, and the sanctioned phase()/note_*() API
+    passes anywhere."""
+    rel = "grove_tpu/runtime/engine.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_cook_coverage(key, seconds):\n"
+        "    PROFILER._hist.clear()\n"
+        "    PROFILER._toplevel_s = seconds\n"
+        "    PROFILER.enabled = True\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL015" in rules_of(report)
+    # the untouched engine source is clean (one-boolean-check call sites)
+    assert "GL015" not in rules_of(lint_source(src, rel))
+    rel2 = "grove_tpu/solver/scheduler.py"
+    src2 = (ROOT / rel2).read_text()
+    rogue2 = (
+        "\n\ndef _rogue_fake_journey(ns, name):\n"
+        "    JOURNEYS._active[(ns, name)] = None\n"
+        "    JOURNEYS._round = (0.0, 0.0, 0.0)\n"
+    )
+    report2 = lint_source(src2 + rogue2, rel2)
+    assert "GL015" in rules_of(report2)
+    assert "GL015" not in rules_of(lint_source(src2, rel2))
+    # the owning modules may mutate their own state
+    for own_rel in (
+        "grove_tpu/observability/profile.py",
+        "grove_tpu/observability/journey.py",
+        "grove_tpu/observability/flightrec.py",
+    ):
+        own = (ROOT / own_rel).read_text()
+        assert "GL015" not in rules_of(lint_source(own, own_rel)), own_rel
+    # precision: foreign `_active`/`enabled` writes without a glass-box
+    # binding in the chain stay out of scope
+    for ok_src in (
+        "def f(self):\n    self._active = {}\n",
+        "def f(self):\n    self.watcher.enabled = True\n",
+        "def f(self):\n    self.tracer.enabled = False\n",
+    ):
+        assert "GL015" not in rules_of(
+            lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
+        ), ok_src
 
 
 def test_unregistering_reason_fails_lint():
