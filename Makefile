@@ -9,8 +9,8 @@ IMAGE ?= grove-tpu:0.2.0
 .PHONY: test test-fast check lint crds api-docs bench bench-small \
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
-        scale-smoke frontier-smoke profile-smoke probe-debug dryrun \
-        docker-build compose-up clean
+        scale-smoke frontier-smoke profile-smoke explain-smoke probe-debug \
+        dryrun docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -20,13 +20,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint scale-smoke frontier-smoke profile-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke
+check: lint scale-smoke frontier-smoke profile-smoke explain-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL015) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL016) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -79,6 +79,9 @@ frontier-smoke:  ## partitioned-frontier smoke: multi-slice converge+churn with 
 
 profile-smoke:   ## glass-box smoke: wall-attribution coverage >=95% of an independently timed sharded converge (top-5 phase sinks printed), gap-free gang journeys with the admission p50/p99 split, flight-recorder bundle dump + re-read, all-off overhead <1%
 	$(CPU_ENV) $(PY) scripts/profile_smoke.py
+
+explain-smoke:   ## admission-explain smoke: contended multi-tenant scenario with >=1 quota-blocked, >=1 fragmentation-blocked, >=1 fits-now verdict; one what-if that flips a verdict, confirmed by an actual drain; explain/what-if burst provably read-only (rv vector + delta fingerprint unchanged)
+	$(CPU_ENV) $(PY) scripts/explain_smoke.py
 
 probe-debug:     ## accelerator-probe debugger: availability precheck + subprocess jit probe against the REAL env (no CPU scrub), full child traceback printed; rc 0 healthy / 2 retryable / 3 config error
 	$(PY) scripts/probe_debug.py

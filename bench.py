@@ -482,6 +482,105 @@ def _delta_artifact_block(harness) -> dict:
     return delta_artifact(harness)
 
 
+def _explain_artifact_block() -> dict:
+    """Decision-explainability block (docs/observability.md "Admission
+    explain"): the contended scenario's three verdict classes, verdict
+    latency p50/p99 over a repeated explain burst, a truthfulness counter
+    (every fits_now=True verdict followed by admission in the confirming
+    converge; every blocked verdict still unscheduled), the per-level
+    fragmentation statistic, and the read-only pin (rv vector + delta
+    fingerprint unchanged across the burst)."""
+    import time as _time
+
+    from grove_tpu.api.meta import get_condition
+    from grove_tpu.api.types import COND_PODGANG_SCHEDULED
+    from grove_tpu.sim.multitenant import build_explain_scenario
+    from grove_tpu.solver.introspect import fragmentation_stats
+
+    harness, refs = build_explain_scenario()
+    engine = harness.explain
+    rv0 = harness.store.resource_version_vector()
+    fp0 = (
+        harness.scheduler.delta.state_fingerprint()
+        if harness.scheduler.delta is not None
+        else None
+    )
+    subjects = [refs["frag"], refs["fits"], refs["capped"]]
+    # un-measured warmup round: the first explain pays the trial-solve
+    # kernel's XLA compile; the latency percentiles describe steady state
+    # (compile-warmup discipline of the delta/frontier blocks)
+    for name in subjects:
+        engine.explain("default", name)
+    latencies = []
+    verdicts = {}
+    for _ in range(24):
+        for name in subjects:
+            t0 = _time.perf_counter()
+            verdicts[name] = engine.explain("default", name)
+            latencies.append(_time.perf_counter() - t0)
+    whatif = engine.whatif(
+        {
+            "gang": {"namespace": "default", "name": refs["frag"]},
+            "actions": [
+                {"action": "drain-node", "node": refs["bridge_node"]}
+            ],
+        }
+    )
+    frag = fragmentation_stats(engine.capacity())
+    read_only = (
+        rv0 == harness.store.resource_version_vector()
+        and fp0
+        == (
+            harness.scheduler.delta.state_fingerprint()
+            if harness.scheduler.delta is not None
+            else None
+        )
+    )
+    # confirming converge: the drain the what-if modeled, for real
+    harness.drainer.request_drain(refs["bridge_node"])
+    harness.converge(max_ticks=120)
+
+    def scheduled(name: str) -> bool:
+        gang = harness.store.get("PodGang", "default", name)
+        cond = (
+            get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+            if gang is not None
+            else None
+        )
+        return cond is not None and cond.is_true()
+
+    truthful = 0
+    for name in subjects:
+        fits = bool(verdicts[name].get("fits_now"))
+        # blocked-but-later-admitted is allowed (the drain intervened);
+        # only fits_now=True ⇒ admitted is the hard direction
+        if not fits or scheduled(name):
+            truthful += 1
+    import numpy as _np
+
+    return {
+        "verdicts": {
+            "fragmentation_blocked": verdicts[refs["frag"]].get("detail"),
+            "quota_blocked": verdicts[refs["capped"]].get("detail"),
+            "fits_now": bool(verdicts[refs["fits"]].get("fits_now")),
+        },
+        # interpolated percentiles, like every other block (the nearest-
+        # rank shortcut degenerates p99 toward the max at n=72 — the
+        # tail-honesty problem the solver block's p99_interp fixed)
+        "verdict_latency_ms": {
+            "p50": round(float(_np.percentile(latencies, 50)) * 1e3, 3),
+            "p99": round(float(_np.percentile(latencies, 99)) * 1e3, 3),
+            "n": len(latencies),
+        },
+        "truthful": truthful,
+        "subjects": len(subjects),
+        "whatif_flipped": bool(whatif["flipped"]),
+        "whatif_confirmed_by_drain": scheduled(refs["frag"]),
+        "read_only": read_only,
+        "fragmentation": frag,
+    }
+
+
 def _quota_artifact() -> dict:
     """3-tenant contended fair-share run + single-queue A/B, run after the
     main integrated population in the same process (metrics are deltas, so
@@ -610,6 +709,11 @@ def integrated_stress_bench(
             # rule counts + suppression inventory over the exact tree
             # this artifact was produced from
             "lint": _lint_artifact_block(),
+            # decision-explainability block (docs/observability.md
+            # "Admission explain"): verdict latency p50/p99, the
+            # truthfulness counter, per-level fragmentation statistics,
+            # the what-if flip + its confirming drain, the read-only pin
+            "explain": _explain_artifact_block(),
             # sharded control-plane block (docs/control-plane.md): the
             # keyspace-sharded store at the ROADMAP's 10× shape, with the
             # fold-depth histogram and the S=1 inert A/B

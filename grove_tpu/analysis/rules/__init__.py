@@ -4,6 +4,7 @@ is the set `make lint` runs (docs/static-analysis.md is the catalog)."""
 from grove_tpu.analysis.rules.apiwire import WireRoundTripRule
 from grove_tpu.analysis.rules.clocks import BlockingTickRule, ClockDisciplineRule
 from grove_tpu.analysis.rules.dirtymask import DirtyMaskRegistrationRule
+from grove_tpu.analysis.rules.explainrule import ExplainReadonlyRule
 from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
 from grove_tpu.analysis.rules.glassbox import GlassBoxStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
@@ -35,4 +36,5 @@ ALL_RULES = (
     ShardInternalsRule,  # GL013
     FrontierStateRule,  # GL014
     GlassBoxStateRule,  # GL015
+    ExplainReadonlyRule,  # GL016
 )

@@ -754,6 +754,273 @@ def _cmd_journey(args) -> int:
     return 0
 
 
+def _print_explain(doc: dict) -> None:
+    """Render one GangExplain verdict (docs/observability.md "Admission
+    explain"): headline, then the constraint-elimination funnel."""
+    head = f"{doc.get('namespace')}/{doc.get('name')}: "
+    state = doc.get("state")
+    if state == "scheduled":
+        print(head + "SCHEDULED (nothing to explain)")
+        return
+    if doc.get("fits_now"):
+        print(head + "FITS NOW — " + doc.get("message", ""))
+    else:
+        slug = doc.get("detail") or "?"
+        print(
+            head
+            + f"BLOCKED on {doc.get('binding_constraint')} ({slug}): "
+            + (doc.get("detail_text") or doc.get("message") or "")
+        )
+    funnel = doc.get("funnel") or []
+    if funnel:
+        rows = [
+            (
+                ("✗ " if not f.get("ok") else "  ") + f["stage"],
+                str(f.get("surviving_nodes", "")),
+                f.get("detail", ""),
+            )
+            for f in funnel
+        ]
+        _print_table(("STAGE", "NODES", "DETAIL"), rows)
+    q = doc.get("queue") or {}
+    if q.get("ahead"):
+        print(
+            f"ahead in order ({q.get('ahead_count')}):"
+            f" {', '.join(q['ahead'])}"
+        )
+    if "partition" in doc:
+        print(f"frontier partition: {doc['partition']}")
+
+
+def _print_capacity(doc: dict) -> None:
+    print(
+        f"{doc.get('nodes')} schedulable of {doc.get('totalNodes')} nodes;"
+        f" total free: {_fmt_resource_map(doc.get('totalFree', {}))}"
+    )
+    if doc.get("superDomainLevel"):
+        print(f"super-domain level: {doc['superDomainLevel']}")
+    rows = []
+    for lvl in doc.get("levels", []):
+        rows.append(
+            (
+                lvl.get("domain", lvl["key"]),
+                str(lvl.get("domainCount", 0)),
+                _fmt_resource_map(lvl.get("fragmentation", {})),
+                _fmt_resource_map(lvl.get("largestDomainFree", {})),
+            )
+        )
+    if rows:
+        _print_table(
+            ("LEVEL", "DOMAINS", "FRAGMENTATION", "LARGEST-FREE"), rows
+        )
+
+
+def _cmd_explain(args) -> int:
+    """Admission explain verdict for one PodGang — from a live
+    apiserver's GET /gangs/{ns}/{name}/explain, or after simulating
+    manifests (the still-pending gangs are the interesting ones)."""
+    if args.apiserver:
+        if not args.gang:
+            print(
+                "explain: --apiserver mode needs --gang NAME"
+                " (and --namespace)",
+                file=sys.stderr,
+            )
+            return 2
+        doc = _fetch_server_json(
+            args.apiserver,
+            f"/gangs/{args.namespace}/{args.gang}/explain",
+            "explain",
+        )
+        if doc is None:
+            return 1
+        _print_explain(doc)
+        return 0
+    if not args.manifests:
+        print(
+            "explain: provide manifests to simulate, or --apiserver URL"
+            " to query a live operator",
+            file=sys.stderr,
+        )
+        return 2
+    harness = _sim_from_manifests(args)
+    gangs = (
+        [args.gang]
+        if args.gang
+        else [
+            g.metadata.name
+            for g in harness.store.list("PodGang", args.namespace)
+        ]
+    )
+    for i, gang in enumerate(gangs):
+        doc = harness.explain.explain(args.namespace, gang)
+        if doc is None:
+            print(
+                f"explain: PodGang {args.namespace}/{gang} not found",
+                file=sys.stderr,
+            )
+            return 1
+        if i:
+            print()
+        _print_explain(doc)
+    return 0
+
+
+def _cmd_capacity(args) -> int:
+    """Capacity & fragmentation introspection — GET /debug/capacity on a
+    live apiserver, or after simulating manifests."""
+    if args.apiserver:
+        doc = _fetch_server_json(
+            args.apiserver, "/debug/capacity", "capacity"
+        )
+        if doc is None:
+            return 1
+        _print_capacity(doc)
+        return 0
+    _ensure_backend()
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=args.nodes)
+    for path in args.manifests:
+        with open(path) as f:
+            harness.apply_yaml(f.read())
+    if args.manifests:
+        harness.converge()
+    _print_capacity(harness.explain.capacity())
+    return 0
+
+
+def _whatif_body(args) -> dict:
+    actions = []
+    for node in args.drain or []:
+        actions.append({"action": "drain-node", "node": node})
+    for node in args.remove or []:
+        actions.append({"action": "remove-node", "node": node})
+    if args.add_nodes:
+        actions.append(
+            {
+                "action": "add-nodes",
+                "count": args.add_nodes,
+                "like": args.like,
+            }
+        )
+    if args.set_queue:
+        act = {"action": "set-queue", "queue": args.set_queue}
+        for field_name, raw in (
+            ("deserved", args.deserved),
+            ("ceiling", args.ceiling),
+        ):
+            if raw:
+                try:
+                    act[field_name] = {
+                        k: float(v)
+                        for k, _, v in (
+                            part.partition("=") for part in raw.split(",")
+                        )
+                    }
+                except ValueError:
+                    raise _BadResourceMap(field_name, raw)
+        actions.append(act)
+    return {
+        "gang": {"namespace": args.namespace, "name": args.gang},
+        "actions": actions,
+    }
+
+
+class _BadResourceMap(Exception):
+    def __init__(self, field_name: str, raw: str) -> None:
+        self.field_name = field_name
+        self.raw = raw
+
+
+def _cmd_whatif(args) -> int:
+    """Hypothetical trial solve: would the gang fit if N nodes were
+    drained/removed/added or a queue's entitlement changed? POST
+    /debug/whatif on a live apiserver, or against a simulated cluster.
+    Commits nothing either way."""
+    try:
+        body = _whatif_body(args)
+    except _BadResourceMap as e:
+        print(
+            f"whatif: --{e.field_name} expects RES=VALUE[,RES=VALUE],"
+            f" got {e.raw!r}",
+            file=sys.stderr,
+        )
+        return 2
+    if not body["actions"]:
+        print(
+            "whatif: give at least one action (--drain/--remove/"
+            "--add-nodes --like/--set-queue)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.apiserver:
+        doc = _post_server_json_body(
+            args.apiserver, "/debug/whatif", body, "whatif"
+        )
+        if doc is None:
+            return 1
+    else:
+        if not args.manifests:
+            print(
+                "whatif: provide manifests to simulate, or --apiserver"
+                " URL for a live operator",
+                file=sys.stderr,
+            )
+            return 2
+        harness = _sim_from_manifests(args)
+        try:
+            doc = harness.explain.whatif(body)
+        except ValueError as e:
+            print(f"whatif: {e}", file=sys.stderr)
+            return 1
+    before, after = doc.get("before", {}), doc.get("after", {})
+    print(
+        f"before: fits_now={before.get('fits_now')}"
+        f" (binding: {before.get('binding_constraint')})"
+    )
+    print(
+        f"after:  fits_now={after.get('fits_now')}"
+        f" (binding: {after.get('binding_constraint')})"
+    )
+    print(
+        "verdict FLIPS under this hypothetical"
+        if doc.get("flipped")
+        else "verdict unchanged"
+    )
+    return 0
+
+
+def _post_server_json_body(apiserver: str, path: str, body: dict, label: str):
+    """POST a JSON document to a live apiserver; returns the JSON response
+    or None after printing the error."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    url = apiserver if "://" in apiserver else f"http://{apiserver}"
+    req = urllib.request.Request(
+        f"{url}{path}",
+        data=_json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            doc = _json.loads(e.read())
+            msg = doc.get("message", str(e))
+        except ValueError:
+            msg = str(e)
+        print(f"{label}: {url}: {msg}", file=sys.stderr)
+        return None
+    except (OSError, ValueError) as e:
+        print(f"{label}: {url}: {e}", file=sys.stderr)
+        return None
+
+
 def _fmt_resource_map(m: dict) -> str:
     return ",".join(f"{k}={g:g}" for k, g in sorted(m.items())) or "-"
 
@@ -1305,6 +1572,87 @@ def main(argv: List[str] | None = None) -> int:
         help="PodGang name (sim mode defaults to every admitted gang)",
     )
     p.set_defaults(fn=_cmd_journey)
+
+    p = sub.add_parser(
+        "explain",
+        help=(
+            "why is this PodGang Pending, and what binds it — the"
+            " constraint-elimination funnel (node health → capacity →"
+            " topology → quota → disruption → solve) from a live"
+            " apiserver or a sim"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument(
+        "--apiserver",
+        help="read /gangs/{ns}/{name}/explain from a live server",
+    )
+    p.add_argument("--namespace", default="default")
+    p.add_argument(
+        "--gang",
+        help="PodGang name (sim mode defaults to every gang)",
+    )
+    p.set_defaults(fn=_cmd_explain)
+
+    p = sub.add_parser(
+        "capacity",
+        help=(
+            "per-topology-level free capacity + the fragmentation"
+            " statistic (largest contiguous domain vs total free)"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument(
+        "--apiserver", help="read /debug/capacity from a live server"
+    )
+    p.set_defaults(fn=_cmd_capacity)
+
+    p = sub.add_parser(
+        "whatif",
+        help=(
+            "hypothetical trial solve: would the gang fit if nodes were"
+            " drained/removed/added or a queue's entitlement changed?"
+            " Commits nothing"
+        ),
+    )
+    p.add_argument("manifests", nargs="*")
+    p.add_argument("--nodes", type=int, default=32)
+    p.add_argument(
+        "--apiserver", help="POST /debug/whatif to a live server"
+    )
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--gang", required=True, help="target PodGang name")
+    p.add_argument(
+        "--drain", action="append", metavar="NODE",
+        help="hypothetically drain NODE (gang-whole relocation)",
+    )
+    p.add_argument(
+        "--remove", action="append", metavar="NODE",
+        help="hypothetically remove NODE (capacity only)",
+    )
+    p.add_argument(
+        "--add-nodes", type=int, metavar="N",
+        help="hypothetically add N nodes cloned from --like",
+    )
+    p.add_argument(
+        "--like", metavar="NODE",
+        help="template node for --add-nodes (capacity + topology)",
+    )
+    p.add_argument(
+        "--set-queue", metavar="QUEUE",
+        help="hypothetically rewrite QUEUE's entitlement",
+    )
+    p.add_argument(
+        "--deserved", metavar="RES=V[,RES=V]",
+        help="deserved shares for --set-queue",
+    )
+    p.add_argument(
+        "--ceiling", metavar="RES=V[,RES=V]",
+        help="ceiling for --set-queue",
+    )
+    p.set_defaults(fn=_cmd_whatif)
 
     p = sub.add_parser("config-check", help="validate an operator config file")
     p.add_argument("config")

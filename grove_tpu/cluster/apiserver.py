@@ -150,6 +150,14 @@ class APIServer:
         # None for an unknown node. Unset → 404 (no drain controller).
         self.drain_handler: Optional[Callable[[str], Optional[dict]]] = None
         self.uncordon_handler: Optional[Callable[[str], Optional[dict]]] = None
+        # admission explain engine (observability/explain.py,
+        # docs/observability.md "Admission explain"): GET
+        # /gangs/{ns}/{name}/explain, GET /debug/capacity, POST
+        # /debug/whatif, and the /debug/journeys pending annotation all
+        # serve from it. Unset → 404 (no scheduler attached). Read-only
+        # by contract (grovelint GL016), so handlers run WITHOUT
+        # server.lock — an explain burst must never stall writes.
+        self.explain_engine = None
         # config-gated like the reference pprof listener (manager.go:108-113)
         # and serialized: concurrent samplers would degrade the whole
         # control plane (every 100Hz stack walk contends on the GIL)
@@ -470,6 +478,43 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path == "/debug/capacity":
+                    # capacity & fragmentation introspection
+                    # (docs/observability.md "Admission explain"):
+                    # per-topology-level domain free vectors + the
+                    # max-contiguous-slab fragmentation statistic
+                    if server.explain_engine is None:
+                        return self._error(
+                            404,
+                            "no explain engine attached to this server"
+                            " (scheduler not running in this process)",
+                        )
+                    return self._send_json(
+                        200, server.explain_engine.capacity()
+                    )
+                if path.startswith("/gangs/") and path.endswith("/explain"):
+                    # GET /gangs/{ns}/{name}/explain — the admission
+                    # explain verdict: constraint-elimination funnel,
+                    # fits_now, blocking stages, binding constraint
+                    parts = path.split("/")
+                    if len(parts) != 5 or not parts[2] or not parts[3]:
+                        return self._error(
+                            404, "expected /gangs/{namespace}/{name}/explain"
+                        )
+                    if server.explain_engine is None:
+                        return self._error(
+                            404,
+                            "no explain engine attached to this server"
+                            " (scheduler not running in this process)",
+                        )
+                    doc = server.explain_engine.explain(parts[2], parts[3])
+                    if doc is None:
+                        return self._error(
+                            404,
+                            f"PodGang {parts[2]}/{parts[3]} not found",
+                            "NotFound",
+                        )
+                    return self._send_json(200, doc)
                 if path.startswith("/gangs/") and path.endswith("/journey"):
                     # GET /gangs/{ns}/{name}/journey — one PodGang's causal
                     # admission record (observability/journey.py): ordered
@@ -496,9 +541,17 @@ class APIServer:
                     )
                 if path == "/debug/journeys":
                     # fleet view: admission-latency decomposition + the
-                    # critical-path fold over completed journeys
+                    # critical-path fold over completed journeys, PLUS
+                    # the pending gangs (age, current stage, last explain
+                    # verdict when one ran) — stuck gangs are visible
+                    # here instead of silently absent (journey gap fix)
                     from grove_tpu.observability.journey import JOURNEYS
 
+                    pending = (
+                        server.explain_engine.pending_journeys()
+                        if server.explain_engine is not None
+                        else JOURNEYS.pending()
+                    )
                     return self._send_json(
                         200,
                         {
@@ -506,6 +559,7 @@ class APIServer:
                             "enabled": JOURNEYS.enabled,
                             "decomposition": JOURNEYS.decomposition(),
                             "critical_path": JOURNEYS.critical_path(),
+                            "pending": pending,
                         },
                     )
                 route = self._route()
@@ -587,6 +641,26 @@ class APIServer:
                             server._subs.remove(sub)
 
             def do_POST(self):
+                if urllib.parse.urlsplit(self.path).path == "/debug/whatif":
+                    # hypothetical trial solves (docs/observability.md
+                    # "Admission explain"): before/after verdicts for a
+                    # gang under drain/remove/add-node or queue rewrites
+                    # — commits NOTHING (read-only by GL016 contract)
+                    if server.explain_engine is None:
+                        return self._error(
+                            404,
+                            "no explain engine attached to this server"
+                            " (scheduler not running in this process)",
+                        )
+                    try:
+                        body = self._body()
+                    except ValueError:
+                        return self._error(400, "invalid JSON body")
+                    try:
+                        doc = server.explain_engine.whatif(body)
+                    except ValueError as e:
+                        return self._error(400, str(e))
+                    return self._send_json(200, doc)
                 # node lifecycle actions (docs/robustness.md drain flow):
                 # POST /nodes/{name}/drain | /nodes/{name}/uncordon
                 parts = [

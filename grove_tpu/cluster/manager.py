@@ -391,6 +391,12 @@ def start_operator(
             apiserver.node_provider = node_monitor.node_snapshot
             apiserver.drain_handler = drainer.request_drain
             apiserver.uncordon_handler = drainer.uncordon
+            # decision explainability (docs/observability.md "Admission
+            # explain"): GET /gangs/{ns}/{name}/explain, /debug/capacity,
+            # POST /debug/whatif — read-only, so no lock coupling
+            from grove_tpu.observability.explain import ExplainEngine
+
+            apiserver.explain_engine = ExplainEngine(scheduler)
     from grove_tpu.autoscale.hpa import (
         HorizontalAutoscaler,
         StaticMetricsProvider,

@@ -42,7 +42,6 @@ from grove_tpu.api import names as namegen
 from grove_tpu.api.meta import ObjectMeta, get_condition
 from grove_tpu.api.types import (
     COND_PODGANG_SCHEDULED,
-    SPREAD_SCHEDULE_ANYWAY,
     GenericObject,
 )
 from grove_tpu.observability.events import (
@@ -275,64 +274,12 @@ class NodeDrainController:
     def _gang_spec(self, gang) -> dict:
         """Whole-gang solver spec from the CR (the drain analogue of the
         scheduler's _encode_pending, without recovery pins — the entire
-        gang relocates, nothing anchors it)."""
-        groups = []
-        for group in gang.spec.pod_groups:
-            demand: Dict[str, float] = {}
-            for ref in group.pod_references:
-                pod = self.store.get(
-                    "Pod", ref.namespace, ref.name, readonly=True
-                )
-                if pod is not None:
-                    demand = pod.spec.total_requests()
-                    break
-            groups.append(
-                {
-                    "name": group.name,
-                    "demand": demand,
-                    "count": len(group.pod_references),
-                    "min_count": group.min_replicas,
-                    "partial": False,
-                    "required_key": (
-                        group.topology_constraint.pack_constraint.required
-                        if group.topology_constraint is not None
-                        and group.topology_constraint.pack_constraint
-                        is not None
-                        else None
-                    ),
-                    "pinned_node": None,
-                }
-            )
-        tc = gang.spec.topology_constraint
-        required = preferred = spread_key = None
-        spread_min, spread_required = 2, False
-        if tc is not None and tc.pack_constraint is not None:
-            required = tc.pack_constraint.required
-            preferred = tc.pack_constraint.preferred
-        if tc is not None and tc.spread_constraint is not None:
-            sc = tc.spread_constraint
-            spread_key = sc.topology_key
-            spread_min = sc.min_domains
-            spread_required = sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
-        ns = gang.metadata.namespace
-        return {
-            "name": f"{ns}/{gang.metadata.name}",
-            "gang_name": gang.metadata.name,
-            "namespace": ns,
-            "groups": groups,
-            "required_key": required,
-            "preferred_key": preferred,
-            "spread_key": spread_key,
-            "spread_min_domains": spread_min,
-            "spread_required": spread_required,
-            "spread_survivor_nodes": [],
-            "gang_pinned_node": None,
-            "priority": self.scheduler.priority_map.get(
-                gang.spec.priority_class_name, 0
-            ),
-            "queue": gang.metadata.labels.get(namegen.LABEL_QUEUE)
-            or self.scheduler.quota.default_queue,
-        }
+        gang relocates, nothing anchors it). One shared implementation
+        with the what-if engine (solver/introspect.py), so a hypothetical
+        drain and a real drain judge relocation identically."""
+        from grove_tpu.solver.introspect import gang_spec_from_cr
+
+        return gang_spec_from_cr(self.store, self.scheduler, gang)
 
     def _trial_preplacement(self, gang) -> Tuple[bool, List[str]]:
         """Trial-solve the whole gang on the remaining schedulable nodes

@@ -94,6 +94,30 @@ REGISTERED_REASONS = frozenset(
     if k.startswith("REASON_") and isinstance(v, str)
 )
 
+# Deferral-detail slugs (docs/observability.md "Admission explain"): the
+# closed vocabulary of machine-readable blocking reasons an unscheduled
+# gang can carry. The scheduler prefixes GangDeferred/QueuePending
+# messages with one (`<slug>: <text>`), and the explain engine's verdicts
+# cite the same slug for the same gang — one classifier
+# (solver/introspect.py classify_rejections) feeds both, so `GET /events`
+# alone answers the common "why is it Pending" case and never disagrees
+# with `GET /gangs/{ns}/{name}/explain`. tests/test_docs_drift.py pins
+# this registry against the docs table.
+DETAIL_NO_NODES = "no-schedulable-nodes"
+DETAIL_INSUFFICIENT_CAPACITY = "insufficient-capacity"
+DETAIL_TOPOLOGY_FRAGMENTATION = "topology-fragmentation"
+DETAIL_NODE_FRAGMENTATION = "node-fragmentation"
+DETAIL_UNSATISFIABLE = "unsatisfiable-constraint"
+DETAIL_QUOTA_CEILING = "quota-ceiling"
+DETAIL_QUEUE_POSITION = "queue-position"
+DETAIL_DISRUPTION_HOLD = "disruption-hold"
+
+REGISTERED_DETAILS = frozenset(
+    v
+    for k, v in list(globals().items())
+    if k.startswith("DETAIL_") and isinstance(v, str)
+)
+
 
 @dataclass
 class EventRecord:

@@ -272,6 +272,42 @@ class JourneyTracker:
         with self._lock:
             return list(self._done.values())
 
+    def pending(self) -> List[dict]:
+        """Every ACTIVE (not-yet-Scheduled) journey, oldest first, with
+        its age and current stage — the journey-gap fix: /debug/journeys
+        used to summarize only completions, so a stuck gang was silently
+        absent from the one endpoint that should surface it. Age prefers
+        the virtual clock (sims; lines up with requeue math) and falls
+        back to wall time."""
+        wall_now = self.t()
+        vt_now = self._vt()
+        with self._lock:
+            journeys = list(self._active.values())
+        rows = []
+        for j in journeys:
+            origin = j.marks.get("created") or j.marks.get("first-scan")
+            doc = j.as_dict()
+            if origin is not None:
+                if vt_now is not None and origin[1] is not None:
+                    doc["age_s"] = round(max(vt_now - origin[1], 0.0), 3)
+                else:
+                    doc["age_s"] = round(
+                        max(wall_now - origin[0], 0.0), 9
+                    )
+            else:
+                doc["age_s"] = 0.0
+            doc["stage"] = next(
+                (
+                    ph
+                    for ph in reversed(JOURNEY_PHASES)
+                    if ph in j.marks
+                ),
+                "created",
+            )
+            rows.append(doc)
+        rows.sort(key=lambda d: -d["age_s"])
+        return rows
+
     def decomposition(self) -> dict:
         """Admission-latency p50/p99 per segment over completed journeys —
         the bench's first-class field."""

@@ -132,21 +132,33 @@ class QuotaManager:
         )
 
     def order_specs(
-        self, gang_specs: List[dict]
+        self,
+        gang_specs: List[dict],
+        crs: Optional[Dict[str, object]] = None,
+        usage: Optional[Dict[str, Dict[str, float]]] = None,
+        record_rows: bool = True,
     ) -> Tuple[List[dict], List[Tuple[dict, str]]]:
         """Produce the gang solve order. Returns (ordered_specs, held) where
         held is [(spec, reason)] — gangs excluded from this round's solve
         because their queue is at its ceiling (QueuePending).
 
+        ``crs``/``usage`` override the live queue tree and usage snapshot
+        (the admission explain engine's what-if trials order against a
+        hypothetical tree through this ONE implementation, so the
+        hypothetical and real orders can never diverge); None reads live.
+
         With no Queue CRs this is EXACTLY the flat global priority sort
         (guard rail: byte-identical order, zero quota overhead beyond one
         empty scan)."""
-        crs = self.queue_crs()
+        if crs is None:
+            crs = self.queue_crs()
         if not crs:
-            self.last_rows = []
+            if record_rows:
+                self.last_rows = []
             return sorted(gang_specs, key=_flat_key), []
 
-        usage = self._usage_snapshot()
+        if usage is None:
+            usage = self._usage_snapshot()
         # bucket pending gangs per queue, queue-local flat order inside
         buckets: Dict[str, List[dict]] = {}
         for spec in gang_specs:
@@ -246,7 +258,7 @@ class QuotaManager:
         shares = dominant_share(
             usage_t[: len(names)], deserved[: len(names)]
         )
-        self.last_rows = [
+        rows = [
             {
                 "name": name,
                 "cr": crs.get(name),
@@ -256,6 +268,11 @@ class QuotaManager:
             }
             for qi, name in enumerate(names)
         ]
+        if record_rows:
+            # read-only replay callers (the explain engine, which may run
+            # concurrently with a real round in threaded cluster mode)
+            # must not clobber the rows the round's status writer reads
+            self.last_rows = rows
         return ordered, held
 
 

@@ -145,6 +145,12 @@ class SimHarness:
             self.scheduler.enable_frontier()
             if _sanitize_enabled():
                 self.scheduler.frontier_selfcheck = True
+        # admission explain engine (observability/explain.py,
+        # docs/observability.md "Admission explain"): on-demand,
+        # strictly read-only — nothing runs unless somebody asks
+        from grove_tpu.observability.explain import ExplainEngine
+
+        self.explain = ExplainEngine(self.scheduler)
         # node-health monitor (controller/nodehealth.py): heartbeat
         # lifecycle, pod failure on Lost nodes, gang rescue vs. requeue.
         # Inert while no node crashes (one O(nodes) pass per tick).

@@ -114,6 +114,160 @@ def build_contended_harness(
     return harness, list(tenants)
 
 
+_EXPLAIN_GANG_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: placeholder
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: w
+        spec:
+          roleName: role-w
+          replicas: 1
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 1
+"""
+
+
+def _explain_pcs(
+    name: str,
+    queue: str,
+    cpu: float,
+    replicas: int = 1,
+    pack_domain: Optional[str] = None,
+    spread_domain: Optional[str] = None,
+    spread_min: int = 2,
+):
+    """One parameterized gang for the explain scenario: `replicas` pods of
+    `cpu` each, optional gang-level pack/spread constraint."""
+    from grove_tpu.api.types import (
+        SPREAD_DO_NOT_SCHEDULE,
+        TopologyConstraint,
+    )
+
+    pcs = load_podcliquesets(_EXPLAIN_GANG_YAML)[0]
+    pcs.metadata.name = name
+    pcs.metadata.labels[namegen.LABEL_QUEUE] = queue
+    clique = pcs.spec.template.cliques[0]
+    clique.spec.replicas = replicas
+    for c in clique.spec.pod_spec.containers:
+        c.requests = {"cpu": float(cpu)}
+    if pack_domain or spread_domain:
+        pcs.spec.template.topology_constraint = TopologyConstraint(
+            pack_domain=pack_domain,
+            spread_domain=spread_domain,
+            spread_min_domains=spread_min if spread_domain else None,
+            spread_when_unsatisfiable=(
+                SPREAD_DO_NOT_SCHEDULE if spread_domain else None
+            ),
+        )
+    return pcs
+
+
+def build_explain_scenario():
+    """The contended scenario behind ``make explain-smoke``, the bench
+    "explain" block, and the explain truthfulness tests
+    (docs/observability.md "Admission explain"): a fragmented 2-block
+    cluster where, simultaneously,
+
+    - ``frag``   (queue team-a) is FRAGMENTATION-blocked: 4x1 cpu packed
+      inside one ici-block, while each block holds only 3 free cpu
+      (aggregate free 6 covers the floor — no contiguous domain does);
+    - ``capped-1`` (queue team-b) FITS NOW (2x1 cpu, unconstrained);
+    - ``capped-2`` (queue team-b) is QUOTA-blocked at team-b's ceiling;
+    - draining the ``bridge`` gang's block-0 node (gang-whole eviction
+      frees its block-1 pod too) flips ``frag`` to fits-now — the what-if
+      a real drain then confirms.
+
+    Returns (harness, refs) with refs naming every actor:
+    {frag, fits, capped, bridge, bridge_node, filler_queue}.
+    """
+    from grove_tpu.sim.cluster import make_nodes
+    from grove_tpu.sim.harness import SimHarness
+
+    harness = SimHarness(num_nodes=1)
+    # 8 nodes x 4 cpu: block-0 = node-0..3 (slice-0), block-1 = node-4..7
+    # (slice-1) — cpu-only capacity keeps every number legible
+    harness.cluster.nodes = make_nodes(
+        8,
+        capacity={"cpu": 4.0},
+        hosts_per_ici_block=4,
+        blocks_per_slice=1,
+    )
+    # tenant-z: infrastructure filler queue, deserved far below its usage
+    # so its re-pended gangs always order LAST (never steal the capacity
+    # a what-if frees for team-a/team-b)
+    harness.apply_queue(tenant_queue("tenant-z", 10.0))
+    harness.apply_queue(tenant_queue("team-a", 4.0))
+    harness.apply_queue(tenant_queue("team-b", 2.0, ceiling_cpu=2.0))
+    harness.scheduler.quota.warm(4, 8)
+    # fill: one 3-cpu pod per node (exactly one fits a 4-cpu node) — every
+    # node keeps 1 cpu free
+    for i in range(8):
+        harness.apply(_explain_pcs(f"fill-{i}", "tenant-z", 3.0))
+    # bridge: 2x1 cpu spread HARD across slices — one pod lands in each
+    # block, so a gang-whole drain of its block-0 node frees block-1 too
+    harness.apply(
+        _explain_pcs(
+            "bridge", "tenant-z", 1.0, replicas=2,
+            spread_domain="slice", spread_min=2,
+        )
+    )
+    harness.converge(max_ticks=120)
+    # the three explain subjects arrive AFTER the fillers converged; the
+    # caller materializes their pods without solving (engine drains) so
+    # all three verdicts are observable at once
+    harness.apply(
+        _explain_pcs("frag", "team-a", 1.0, replicas=4,
+                     pack_domain="ici-block")
+    )
+    harness.apply(_explain_pcs("capped-1", "team-b", 1.0, replicas=2))
+    harness.apply(_explain_pcs("capped-2", "team-b", 2.0))
+    for _ in range(6):
+        harness.engine.drain()
+        harness.clock.advance(1.0)
+    # the bridge gang's block-0 node (drain target for the flip)
+    bridge_node = None
+    for (ns, pod_name), node_name in harness.cluster.bindings.items():
+        pod = harness.store.get("Pod", ns, pod_name, readonly=True)
+        if pod is None:
+            continue
+        if (pod.metadata.labels.get(namegen.LABEL_PODGANG) or "").startswith(
+            "bridge"
+        ):
+            node = harness.cluster.node(node_name)
+            if (
+                node is not None
+                and node.labels.get("cloud.google.com/gke-tpu-ici-block")
+                == "block-0"
+            ):
+                bridge_node = node_name
+    refs = {
+        "frag": _gang_name_of(harness, "frag"),
+        "fits": _gang_name_of(harness, "capped-1"),
+        "capped": _gang_name_of(harness, "capped-2"),
+        "bridge": _gang_name_of(harness, "bridge"),
+        "bridge_node": bridge_node,
+        "filler_queue": "tenant-z",
+    }
+    return harness, refs
+
+
+def _gang_name_of(harness, pcs_name: str) -> Optional[str]:
+    for gang in harness.store.list("PodGang"):
+        if gang.metadata.name.startswith(f"{pcs_name}-"):
+            return gang.metadata.name
+    return None
+
+
 def metrics_baseline() -> Dict[str, float]:
     """Snapshot of the process-global counters the contended report deltas
     against (the bench runs other workloads in the same process first)."""

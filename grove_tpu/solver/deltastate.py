@@ -534,6 +534,32 @@ class DeltaSolveState:
         )
         return problem, fingerprint
 
+    def state_fingerprint(self) -> tuple:
+        """Deterministic digest of EVERY piece of mutable delta state —
+        the read-only pin the admission explain engine is tested against
+        (docs/observability.md "Admission explain"): an explain/what-if
+        burst must leave this byte-identical, or the "strictly read-only"
+        contract is a lie. Pure read; no fold, no audit."""
+        import zlib
+
+        free_crc = (
+            None
+            if self._free is None
+            else zlib.crc32(self._free.tobytes())
+        )
+        return (
+            self._enc_epoch,
+            self._free_version,
+            free_crc,
+            self._spec_rev,
+            tuple(sorted(self._specs)),
+            tuple(sorted(self._dirty_nodes)),
+            tuple(sorted(self._dirty_gangs)),
+            self._mirror_built,
+            len(self._pod_node),
+            self._bindings_epoch,
+        )
+
     def encoding_view(self) -> tuple:
         """Read-only (NodeEncoding, free matrix) pair for sibling solver
         tiers (the partitioned frontier rides the cached topology slabs

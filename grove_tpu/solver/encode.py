@@ -285,6 +285,15 @@ class StickyGroupPad:
             self._width = max(self._width, batch_max, 1)
             return self._width
 
+    def peek(self, gang_specs: List[dict]) -> int:
+        """The width :meth:`grow` WOULD return for this batch, without
+        committing it — read-only replay paths (the admission explain
+        engine) must pad exactly like the next real solve will, while
+        leaving the scheduler's sticky state untouched."""
+        batch_max = max((len(s["groups"]) for s in gang_specs), default=1)
+        with self._lock:
+            return max(self._width, batch_max, 1)
+
 
 class NodeEncoding:
     """Cached node-side tensors for repeat solves over an unchanged

@@ -31,6 +31,7 @@ from grove_tpu.api.types import (
     SPREAD_SCHEDULE_ANYWAY,
 )
 from grove_tpu.observability.events import (
+    DETAIL_QUOTA_CEILING,
     EVENTS,
     REASON_GANG_ADMITTED,
     REASON_GANG_DEFERRED,
@@ -596,11 +597,15 @@ class GangScheduler:
         # pre-quota path (guard rail pinned in tests/test_quota.py)
         gang_specs, held = self._order_with_quota(gang_specs)
         for spec, reason in held:
+            # registered reason-detail prefix (events.py REGISTERED_DETAILS,
+            # docs/observability.md "Admission explain"): GET /events alone
+            # answers the common "why Pending" case with the same slug the
+            # explain verdict would cite
             EVENTS.record(
                 ("PodGang", spec["namespace"], spec["gang_name"]),
                 TYPE_WARNING,
                 REASON_QUEUE_PENDING,
-                reason,
+                f"{DETAIL_QUOTA_CEILING}: {reason}",
             )
 
         bound = 0
@@ -657,6 +662,20 @@ class GangScheduler:
                         rspan.set("victims", len(reclaimed))
                     preempted |= reclaimed
                 assignments = result.assignments(problem)
+                # explain-grade deferral details for this round's rejects
+                # (one numpy pass over tensors the solve already holds):
+                # every GangDeferred event cites the registered detail
+                # slug the explain engine would — docs/observability.md
+                # "Admission explain"
+                defer_details = {}
+                if not result.admitted[: len(gang_specs)].all():
+                    from grove_tpu.solver.introspect import (
+                        classify_rejections,
+                    )
+
+                    defer_details = classify_rejections(
+                        problem, result, gang_specs
+                    )
                 to_mark = []
                 prof = (
                     PROFILER.phase("commit") if PROFILER.enabled else None
@@ -664,7 +683,7 @@ class GangScheduler:
                 try:
                     self._commit_admitted(
                         gang_specs, result, assignments, gang_pods,
-                        preempted, to_mark,
+                        preempted, to_mark, defer_details,
                     )
                     bound += self._last_commit_bound
                 finally:
@@ -684,7 +703,8 @@ class GangScheduler:
         return bound + sticky_bound
 
     def _commit_admitted(
-        self, gang_specs, result, assignments, gang_pods, preempted, to_mark
+        self, gang_specs, result, assignments, gang_pods, preempted, to_mark,
+        defer_details=None,
     ) -> None:
         """Bind every admitted gang's pods and queue its status write —
         the commit phase of one scheduling round, split out so the
@@ -698,12 +718,20 @@ class GangScheduler:
                 ns = spec["namespace"]
                 if not result.admitted[gi]:
                     if (ns, spec["gang_name"]) not in preempted:
+                        slug, text = (defer_details or {}).get(
+                            gi,
+                            (
+                                None,
+                                "insufficient capacity or unsatisfiable"
+                                " topology",
+                            ),
+                        )
                         EVENTS.record(
                             ("PodGang", ns, spec["gang_name"]),
                             TYPE_WARNING,
                             REASON_GANG_DEFERRED,
-                            "not admitted this round (insufficient "
-                            "capacity or unsatisfiable topology)",
+                            f"not admitted this round"
+                            f" ({slug + ': ' if slug else ''}{text})",
                         )
                     continue
                 if (ns, spec["gang_name"]) in preempted:
@@ -759,30 +787,10 @@ class GangScheduler:
         nodes_by_name = {n.name: n for n in self.cluster.nodes}
         gang_cache: Dict[str, object] = {}
         for pod in pending:
-            gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
-            if gang_name and gang_name not in gang_cache:
-                gang_cache[gang_name] = self.store.get(
-                    "PodGang", namespace, gang_name, readonly=True
-                )
-            gang = gang_cache.get(gang_name) if gang_name else None
-            prev = self.cluster.last_node.get((namespace, pod.metadata.name))
-            cond = (
-                get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
-                if gang is not None
-                else None
+            prev = self._reuse_bind_target(
+                namespace, pod, nodes_by_name, gang_cache, self.cluster.fits
             )
-            if (
-                gang is not None
-                and gang.spec.reuse_reservation_ref is not None
-                and cond is not None
-                and cond.is_true()
-                and prev in nodes_by_name
-                and nodes_by_name[prev].schedulable
-                and self.cluster.fits(nodes_by_name[prev], pod)
-                and self._reuse_respects_pack_constraint(
-                    namespace, gang, nodes_by_name, nodes_by_name[prev]
-                )
-            ):
+            if prev is not None:
                 self.cluster.bind(pod, prev)
                 EVENTS.record(
                     ("Pod", namespace, pod.metadata.name),
@@ -794,6 +802,46 @@ class GangScheduler:
             else:
                 remaining.append(pod)
         return bound, remaining
+
+    def _reuse_bind_target(
+        self, namespace: str, pod, nodes_by_name, gang_cache, fits
+    ) -> Optional[str]:
+        """The node a pending pod would be sticky-rebound to under the
+        reuse-reservation rule, or None. The WHOLE predicate (gang carries
+        the hint, gang still Scheduled=True, previous node live/schedulable/
+        fitting, pack constraint respected) lives here so the binding loop
+        above and the read-only admission-explain replica
+        (``solver/introspect.py``) judge reuse identically. ``fits`` is the
+        capacity check — ``cluster.fits`` on the live path, a snapshot
+        check on the replica (which must also debit the would-be bind)."""
+        from grove_tpu.api.meta import get_condition
+
+        gang_name = pod.metadata.labels.get(namegen.LABEL_PODGANG)
+        if gang_name and gang_name not in gang_cache:
+            gang_cache[gang_name] = self.store.get(
+                "PodGang", namespace, gang_name, readonly=True
+            )
+        gang = gang_cache.get(gang_name) if gang_name else None
+        prev = self.cluster.last_node.get((namespace, pod.metadata.name))
+        cond = (
+            get_condition(gang.status.conditions, COND_PODGANG_SCHEDULED)
+            if gang is not None
+            else None
+        )
+        if (
+            gang is not None
+            and gang.spec.reuse_reservation_ref is not None
+            and cond is not None
+            and cond.is_true()
+            and prev in nodes_by_name
+            and nodes_by_name[prev].schedulable
+            and fits(nodes_by_name[prev], pod)
+            and self._reuse_respects_pack_constraint(
+                namespace, gang, nodes_by_name, nodes_by_name[prev]
+            )
+        ):
+            return prev
+        return None
 
     def _reuse_respects_pack_constraint(
         self, namespace: str, gang, nodes_by_name, candidate_node
@@ -972,142 +1020,11 @@ class GangScheduler:
                     gang_specs.append(spec)
                     gang_pods[spec["name"]] = dict(pods_by_pclq)
                     continue
-            gang_cr = self.store.get(
-                "PodGang", namespace, gang_name, readonly=True
-            )
-            if gang_cr is None:
+            built = self._build_gang_spec(namespace, gang_name, pods)
+            if built is None:
                 loose.extend(pods)
                 continue
-            groups_cr = {g.name: g for g in gang_cr.spec.pod_groups}
-            by_pclq: Dict[str, List] = defaultdict(list)
-            for pod in pods:
-                by_pclq[pod.metadata.labels.get(namegen.LABEL_PODCLIQUE, "")].append(
-                    pod
-                )
-            # PCSG-tier pack groups (scheduler podgang.go:117-126): a config
-            # covering EVERY pending group is an exact collective constraint
-            # and folds into the gang-level required key; a config covering a
-            # subset is approximated by confining each member group to one
-            # domain at that level (each member stays packed; the subset as a
-            # whole may span domains — conservative per-member, relaxed
-            # collectively)
-            pending_group_names = set(by_pclq)
-            collective_req = None
-            group_cfg_req = {}
-            for cfg in gang_cr.spec.topology_constraint_group_configs:
-                tc = cfg.topology_constraint
-                if tc is None or tc.pack_constraint is None:
-                    continue
-                cfg_key = tc.pack_constraint.required
-                if set(cfg.pod_group_names) >= pending_group_names:
-                    collective_req = self._narrower_key(collective_req, cfg_key)
-                else:
-                    for member in cfg.pod_group_names:
-                        group_cfg_req[member] = self._narrower_key(
-                            group_cfg_req.get(member), cfg_key
-                        )
-
-            groups = []
-            for pclq_fqn, members in sorted(by_pclq.items()):
-                members.sort(key=lambda p: p.metadata.name)
-                group_cr = groups_cr.get(pclq_fqn)
-                min_replicas = group_cr.min_replicas if group_cr else len(members)
-                already = self._scheduled_count(namespace, pclq_fqn)
-                own_req = None
-                if group_cr is not None and group_cr.topology_constraint is not None:
-                    pc = group_cr.topology_constraint.pack_constraint
-                    own_req = pc.required if pc is not None else None
-                group_required = self._narrower_key(
-                    own_req, group_cfg_req.get(pclq_fqn)
-                )
-                # recovery pin: surviving pods of a constrained group anchor
-                # the replacement pods to their domain
-                pinned_node = None
-                if group_required is not None and already > 0:
-                    pinned_node = self._any_bound_node(namespace, pclq_fqn)
-                groups.append(
-                    {
-                        "name": pclq_fqn,
-                        "demand": members[0].spec.total_requests(),
-                        "count": len(members),
-                        # floor reduced by already-scheduled pods (recovery)
-                        "min_count": max(0, min_replicas - already),
-                        "partial": already > 0,
-                        "required_key": group_required,
-                        "pinned_node": pinned_node,
-                    }
-                )
-            required_key = preferred_key = None
-            spread_key = None
-            spread_min = 2
-            spread_required = False
-            tc = gang_cr.spec.topology_constraint
-            if tc is not None and tc.pack_constraint is not None:
-                required_key = tc.pack_constraint.required
-                preferred_key = tc.pack_constraint.preferred
-            spread_survivor_nodes: List[str] = []
-            if tc is not None and tc.spread_constraint is not None:
-                sc = tc.spread_constraint
-                spread_key = sc.topology_key
-                spread_min = sc.min_domains
-                spread_required = (
-                    sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
-                )
-                # spread recovery: a delta-solve must judge the LIVE gang's
-                # spread — survivors' nodes seed the balanced fill so
-                # replacements land in un-covered domains (spread analogue
-                # of the pack path's gang_pinned_node below)
-                if any(g["partial"] for g in groups):
-                    for grp in groups:
-                        spread_survivor_nodes.extend(
-                            self._bound_nodes(namespace, grp["name"])
-                        )
-            required_key = self._narrower_key(required_key, collective_req)
-            # gang-level recovery pin: a gang-level required pack (template
-            # constraint or collective PCSG fold) with surviving pods must
-            # anchor its replacements to the survivors' domain, or the live
-            # gang could end up spanning two required-level domains
-            gang_pinned_node = None
-            if required_key is not None and any(g["partial"] for g in groups):
-                # scan ALL groups for a survivor on a live node before
-                # settling for an unschedulable fallback (the encoder drops
-                # pins resolved to nodes outside the solve's node set)
-                cordoned = self.cluster.unschedulable_names()
-                for grp in groups:
-                    node = self._any_bound_node(namespace, grp["name"])
-                    if node is None:
-                        continue
-                    if node not in cordoned:
-                        gang_pinned_node = node
-                        break
-                    gang_pinned_node = gang_pinned_node or node
-            spec = (
-                {
-                    # globally-unique solver key (gangs from different
-                    # namespaces meet in one solve); the bare CR name stays
-                    # in gang_name
-                    "name": f"{namespace}/{gang_name}",
-                    "gang_name": gang_name,
-                    "namespace": namespace,
-                    "groups": groups,
-                    "required_key": required_key,
-                    "preferred_key": preferred_key,
-                    "spread_key": spread_key,
-                    "spread_min_domains": spread_min,
-                    "spread_required": spread_required,
-                    "spread_survivor_nodes": spread_survivor_nodes,
-                    "gang_pinned_node": gang_pinned_node,
-                    "priority": self.priority_map.get(
-                        gang_cr.spec.priority_class_name, 0
-                    ),
-                    # tenant queue (quota subsystem): operator-propagated
-                    # label; unlabeled gangs land in the default queue
-                    "queue": gang_cr.metadata.labels.get(
-                        namegen.LABEL_QUEUE
-                    )
-                    or self.quota.default_queue,
-                }
-            )
+            spec, by_pclq = built
             gang_specs.append(spec)
             gang_pods[f"{namespace}/{gang_name}"] = dict(by_pclq)
             if self.delta is not None:
@@ -1115,6 +1032,150 @@ class GangScheduler:
                     namespace, gang_name, pods, spec, dict(by_pclq)
                 )
         return gang_specs, gang_pods, loose
+
+    def _build_gang_spec(self, namespace: str, gang_name: str, pods: List):
+        """Encode one pending gang's solver spec from its CR and pending
+        pod list — the PURE (read-only) half of ``_encode_pending``,
+        shared with the admission explain engine
+        (``solver/introspect.py``) so the explain replica and the real
+        encode can never diverge. Returns ``(spec, pods_by_pclq)`` or
+        None when the PodGang CR is missing (the pods are loose)."""
+        gang_cr = self.store.get(
+            "PodGang", namespace, gang_name, readonly=True
+        )
+        if gang_cr is None:
+            return None
+        groups_cr = {g.name: g for g in gang_cr.spec.pod_groups}
+        by_pclq: Dict[str, List] = defaultdict(list)
+        for pod in pods:
+            by_pclq[pod.metadata.labels.get(namegen.LABEL_PODCLIQUE, "")].append(
+                pod
+            )
+        # PCSG-tier pack groups (scheduler podgang.go:117-126): a config
+        # covering EVERY pending group is an exact collective constraint
+        # and folds into the gang-level required key; a config covering a
+        # subset is approximated by confining each member group to one
+        # domain at that level (each member stays packed; the subset as a
+        # whole may span domains — conservative per-member, relaxed
+        # collectively)
+        pending_group_names = set(by_pclq)
+        collective_req = None
+        group_cfg_req = {}
+        for cfg in gang_cr.spec.topology_constraint_group_configs:
+            tc = cfg.topology_constraint
+            if tc is None or tc.pack_constraint is None:
+                continue
+            cfg_key = tc.pack_constraint.required
+            if set(cfg.pod_group_names) >= pending_group_names:
+                collective_req = self._narrower_key(collective_req, cfg_key)
+            else:
+                for member in cfg.pod_group_names:
+                    group_cfg_req[member] = self._narrower_key(
+                        group_cfg_req.get(member), cfg_key
+                    )
+
+        groups = []
+        for pclq_fqn, members in sorted(by_pclq.items()):
+            members.sort(key=lambda p: p.metadata.name)
+            group_cr = groups_cr.get(pclq_fqn)
+            min_replicas = group_cr.min_replicas if group_cr else len(members)
+            already = self._scheduled_count(namespace, pclq_fqn)
+            own_req = None
+            if group_cr is not None and group_cr.topology_constraint is not None:
+                pc = group_cr.topology_constraint.pack_constraint
+                own_req = pc.required if pc is not None else None
+            group_required = self._narrower_key(
+                own_req, group_cfg_req.get(pclq_fqn)
+            )
+            # recovery pin: surviving pods of a constrained group anchor
+            # the replacement pods to their domain
+            pinned_node = None
+            if group_required is not None and already > 0:
+                pinned_node = self._any_bound_node(namespace, pclq_fqn)
+            groups.append(
+                {
+                    "name": pclq_fqn,
+                    "demand": members[0].spec.total_requests(),
+                    "count": len(members),
+                    # floor reduced by already-scheduled pods (recovery)
+                    "min_count": max(0, min_replicas - already),
+                    "partial": already > 0,
+                    "required_key": group_required,
+                    "pinned_node": pinned_node,
+                }
+            )
+        required_key = preferred_key = None
+        spread_key = None
+        spread_min = 2
+        spread_required = False
+        tc = gang_cr.spec.topology_constraint
+        if tc is not None and tc.pack_constraint is not None:
+            required_key = tc.pack_constraint.required
+            preferred_key = tc.pack_constraint.preferred
+        spread_survivor_nodes: List[str] = []
+        if tc is not None and tc.spread_constraint is not None:
+            sc = tc.spread_constraint
+            spread_key = sc.topology_key
+            spread_min = sc.min_domains
+            spread_required = (
+                sc.when_unsatisfiable != SPREAD_SCHEDULE_ANYWAY
+            )
+            # spread recovery: a delta-solve must judge the LIVE gang's
+            # spread — survivors' nodes seed the balanced fill so
+            # replacements land in un-covered domains (spread analogue
+            # of the pack path's gang_pinned_node below)
+            if any(g["partial"] for g in groups):
+                for grp in groups:
+                    spread_survivor_nodes.extend(
+                        self._bound_nodes(namespace, grp["name"])
+                    )
+        required_key = self._narrower_key(required_key, collective_req)
+        # gang-level recovery pin: a gang-level required pack (template
+        # constraint or collective PCSG fold) with surviving pods must
+        # anchor its replacements to the survivors' domain, or the live
+        # gang could end up spanning two required-level domains
+        gang_pinned_node = None
+        if required_key is not None and any(g["partial"] for g in groups):
+            # scan ALL groups for a survivor on a live node before
+            # settling for an unschedulable fallback (the encoder drops
+            # pins resolved to nodes outside the solve's node set)
+            cordoned = self.cluster.unschedulable_names()
+            for grp in groups:
+                node = self._any_bound_node(namespace, grp["name"])
+                if node is None:
+                    continue
+                if node not in cordoned:
+                    gang_pinned_node = node
+                    break
+                gang_pinned_node = gang_pinned_node or node
+        spec = (
+            {
+                # globally-unique solver key (gangs from different
+                # namespaces meet in one solve); the bare CR name stays
+                # in gang_name
+                "name": f"{namespace}/{gang_name}",
+                "gang_name": gang_name,
+                "namespace": namespace,
+                "groups": groups,
+                "required_key": required_key,
+                "preferred_key": preferred_key,
+                "spread_key": spread_key,
+                "spread_min_domains": spread_min,
+                "spread_required": spread_required,
+                "spread_survivor_nodes": spread_survivor_nodes,
+                "gang_pinned_node": gang_pinned_node,
+                "priority": self.priority_map.get(
+                    gang_cr.spec.priority_class_name, 0
+                ),
+                # tenant queue (quota subsystem): operator-propagated
+                # label; unlabeled gangs land in the default queue
+                "queue": gang_cr.metadata.labels.get(
+                    namegen.LABEL_QUEUE
+                )
+                or self.quota.default_queue,
+            }
+        )
+        return spec, dict(by_pclq)
 
     def _narrower_key(self, a: Optional[str], b: Optional[str]) -> Optional[str]:
         """Narrower (higher level index) of two topology keys."""

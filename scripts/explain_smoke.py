@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Explain smoke (`make explain-smoke`, wired into `make check`): drive
+the decision-explainability surface end to end on the contended scenario
+(docs/observability.md "Admission explain") and fail loudly unless:
+
+1. the three verdict classes all appear at once — >=1 fragmentation-
+   blocked (topology / topology-fragmentation), >=1 quota-blocked
+   (quota / quota-ceiling), >=1 fits-now;
+2. a fits-now verdict is TRUTHFUL: the very next converge admits it;
+3. one what-if (drain the bridge gang's block-0 node) FLIPS the
+   fragmentation-blocked verdict to fits-now, and an ACTUAL drain of
+   that node then confirms it — the gang schedules;
+4. the whole explain/what-if burst is READ-ONLY: the store rv vector and
+   the delta-state fingerprint are byte-identical across it;
+5. GangDeferred events carry the registered detail slug, so GET /events
+   alone answers the common case.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from grove_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    from grove_tpu.api.meta import get_condition
+    from grove_tpu.api.types import COND_PODGANG_SCHEDULED
+    from grove_tpu.observability.events import (
+        DETAIL_QUOTA_CEILING,
+        DETAIL_TOPOLOGY_FRAGMENTATION,
+        EVENTS,
+        REASON_GANG_DEFERRED,
+    )
+    from grove_tpu.sim.multitenant import build_explain_scenario
+
+    t0 = time.perf_counter()
+    harness, refs = build_explain_scenario()
+    if refs["bridge_node"] is None:
+        fail("scenario did not produce a block-0 bridge node")
+    engine = harness.explain
+
+    # -- read-only pin opens here --------------------------------------
+    rv0 = harness.store.resource_version_vector()
+    fp0 = (
+        harness.scheduler.delta.state_fingerprint()
+        if harness.scheduler.delta is not None
+        else None
+    )
+
+    verdicts = {}
+    for label in ("frag", "fits", "capped"):
+        v = engine.explain("default", refs[label])
+        if v is None:
+            fail(f"no verdict for {label} ({refs[label]})")
+        verdicts[label] = v
+        print(
+            f"{label:7s} {refs[label]:12s} fits_now={v['fits_now']!s:5s}"
+            f" binding={v.get('binding_constraint')}"
+            f" detail={v.get('detail')}"
+        )
+    if not (
+        verdicts["frag"]["binding_constraint"] == "topology"
+        and verdicts["frag"]["detail"] == DETAIL_TOPOLOGY_FRAGMENTATION
+    ):
+        fail("frag gang did not explain as fragmentation-blocked")
+    if not (
+        verdicts["capped"]["binding_constraint"] == "quota"
+        and verdicts["capped"]["detail"] == DETAIL_QUOTA_CEILING
+    ):
+        fail("capped gang did not explain as quota-blocked")
+    if not verdicts["fits"]["fits_now"]:
+        fail("fits gang did not explain as fits-now")
+
+    cap = engine.capacity()
+    frag_stats = {
+        lvl["key"]: lvl["fragmentation"] for lvl in cap["levels"]
+    }
+    block_frag = frag_stats.get(
+        "cloud.google.com/gke-tpu-ici-block", {}
+    ).get("cpu", 0.0)
+    print(
+        f"capacity: {cap['nodes']} nodes, total free"
+        f" {cap['totalFree']}, ici-block cpu fragmentation"
+        f" {block_frag}"
+    )
+    if block_frag <= 0.0:
+        fail("ici-block fragmentation statistic should be positive")
+
+    whatif = engine.whatif(
+        {
+            "gang": {"namespace": "default", "name": refs["frag"]},
+            "actions": [
+                {"action": "drain-node", "node": refs["bridge_node"]}
+            ],
+        }
+    )
+    print(
+        f"what-if drain {refs['bridge_node']}: flipped="
+        f"{whatif['flipped']} after.fits_now="
+        f"{whatif['after']['fits_now']}"
+    )
+    if not (whatif["flipped"] and whatif["after"]["fits_now"]):
+        fail("what-if drain did not flip the fragmentation verdict")
+
+    # -- read-only pin closes ------------------------------------------
+    rv1 = harness.store.resource_version_vector()
+    fp1 = (
+        harness.scheduler.delta.state_fingerprint()
+        if harness.scheduler.delta is not None
+        else None
+    )
+    if rv0 != rv1:
+        fail(f"explain burst moved the store rv vector: {rv0} -> {rv1}")
+    if fp0 != fp1:
+        fail("explain burst perturbed the delta-solve state fingerprint")
+    print("read-only pin: rv vector and delta fingerprint unchanged")
+
+    # -- the actual drain confirms the what-if, and the fits-now verdict
+    # confirms against the SAME converge (no admission may run between
+    # the verdicts and the confirming solve, or it would legitimately
+    # consume the capacity the verdicts were computed against)
+    harness.drainer.request_drain(refs["bridge_node"])
+    harness.converge(max_ticks=120)
+    frag_gang = harness.store.get("PodGang", "default", refs["frag"])
+    cond = get_condition(
+        frag_gang.status.conditions, COND_PODGANG_SCHEDULED
+    )
+    if cond is None or not cond.is_true():
+        fail("actual drain did not admit the fragmentation-blocked gang")
+    print("what-if confirmed: actual drain admitted the frag gang")
+    fits_gang = harness.store.get("PodGang", "default", refs["fits"])
+    cond = get_condition(
+        fits_gang.status.conditions, COND_PODGANG_SCHEDULED
+    )
+    if cond is None or not cond.is_true():
+        fail("fits-now verdict was not followed by admission")
+    print("truthfulness: fits-now gang admitted by the next converge")
+
+    # event enrichment: QueuePending carries the quota-ceiling slug, and
+    # every GangDeferred emitted during the converge leads with a
+    # registered detail slug — GET /events alone answers the common case
+    from grove_tpu.observability.events import (
+        REASON_QUEUE_PENDING,
+        REGISTERED_DETAILS,
+    )
+
+    pending_events = [
+        e
+        for e in EVENTS.list(reason=REASON_QUEUE_PENDING)
+        if e.name == refs["capped"]
+    ]
+    if not pending_events or not pending_events[0].message.startswith(
+        f"{DETAIL_QUOTA_CEILING}:"
+    ):
+        fail(
+            "QueuePending event for the capped gang does not lead with"
+            f" the {DETAIL_QUOTA_CEILING!r} slug"
+            f" (got: {[e.message for e in pending_events]!r})"
+        )
+    deferred = EVENTS.list(reason=REASON_GANG_DEFERRED)
+    bad = [
+        e.message
+        for e in deferred
+        if not any(
+            f"({slug}: " in e.message for slug in REGISTERED_DETAILS
+        )
+    ]
+    if not deferred or bad:
+        fail(
+            "GangDeferred events without a registered detail slug:"
+            f" {bad!r}"
+        )
+    print(
+        f"events: {len(deferred)} GangDeferred +"
+        f" {len(pending_events)} QueuePending all carry registered"
+        " detail slugs"
+    )
+
+    print(
+        f"explain-smoke OK in {time.perf_counter() - t0:.1f}s"
+        f" ({engine.explains_total} explains,"
+        f" {engine.whatifs_total} what-ifs)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,11 @@ from grove_tpu.analysis.inventory import (
     emitted_profile_phases,
 )
 from grove_tpu.analysis.engine import repo_python_files
-from grove_tpu.observability.events import REGISTERED_REASONS
+from grove_tpu.observability.events import (
+    REGISTERED_DETAILS,
+    REGISTERED_REASONS,
+)
+from grove_tpu.observability.explain import FUNNEL_STAGES
 from grove_tpu.observability.journey import JOURNEY_PHASES, JOURNEY_SEGMENTS
 from grove_tpu.observability.profile import PHASES
 
@@ -175,6 +179,88 @@ class TestProfilerPhaseDrift:
         assert not dead, (
             "registered profiler phases with no opening call site:"
             f" {sorted(dead)}"
+        )
+
+
+class TestExplainDrift:
+    """The explain layer's docs gates (PR 13): the funnel-stage registry
+    and the deferral-detail registry ⇄ the docs/observability.md
+    "Admission explain" tables, and the fragmentation-statistic
+    definition shared VERBATIM with docs/solver.md."""
+
+    FRAG_FORMULA = (
+        "frag(level, resource) = 1 − largest single-domain free ∕"
+        " total free"
+    )
+
+    @pytest.fixture(scope="class")
+    def documented(self):
+        # the section holds two tables (stages + details); both registries
+        # gate against the union, staleness against the union too
+        return _table_first_cells(_doc_section("Admission explain"), _DASHED)
+
+    def test_funnel_stages_documented(self, documented):
+        missing = set(FUNNEL_STAGES) - documented
+        assert not missing, (
+            "funnel stages missing from the docs/observability.md"
+            f" 'Admission explain' table: {sorted(missing)}"
+        )
+
+    def test_details_documented(self, documented):
+        missing = set(REGISTERED_DETAILS) - documented
+        assert not missing, (
+            "registered deferral details missing from the"
+            " docs/observability.md 'Admission explain' table:"
+            f" {sorted(missing)}"
+        )
+
+    def test_docs_not_stale(self, documented):
+        stale = documented - set(FUNNEL_STAGES) - set(REGISTERED_DETAILS)
+        assert not stale, (
+            "docs/observability.md 'Admission explain' documents names"
+            " that are neither funnel stages nor registered details:"
+            f" {sorted(stale)}"
+        )
+
+    def test_fragmentation_definition_shared(self):
+        """One definition, two documents: the formula line must appear
+        verbatim in both docs/observability.md and docs/solver.md — the
+        explain verdicts and the solver's scoring roadmap must never
+        describe two different statistics."""
+        for doc in (OBS_DOC, ROOT / "docs" / "solver.md"):
+            assert self.FRAG_FORMULA in doc.read_text(), (
+                f"{doc.name} lost the shared fragmentation-statistic"
+                f" definition line: {self.FRAG_FORMULA!r}"
+            )
+
+    def test_details_emitted(self):
+        """Every registered detail slug has a producing site in the
+        explain/introspect/scheduler layer (dead-registry gate, the
+        event-reason treatment): slugs are produced via the DETAIL_*
+        constants, so the gate is a constant referenced outside
+        events.py."""
+        import ast
+
+        referenced = set()
+        for rel in repo_python_files(ROOT):
+            if rel.endswith("observability/events.py"):
+                continue
+            tree = ast.parse((ROOT / rel).read_text())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Name) and node.id.startswith(
+                    "DETAIL_"
+                ):
+                    referenced.add(node.id)
+        from grove_tpu.observability import events as _ev
+
+        dead = {
+            k
+            for k in dir(_ev)
+            if k.startswith("DETAIL_") and k not in referenced
+        }
+        assert not dead, (
+            "registered detail constants with no producing reference"
+            f" outside events.py: {sorted(dead)}"
         )
 
 

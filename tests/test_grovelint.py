@@ -246,6 +246,28 @@ FIXTURES = {
             "    return PROFILER.report()\n"
         ),
     },
+    "GL016": {
+        "rel": "grove_tpu/solver/introspect.py",
+        "bad": (
+            "def explain_and_fix(self, ns, name):\n"
+            "    gang = self.store.get('PodGang', ns, name)\n"
+            "    self.store.update_status(gang)\n"
+            "    self.cluster.bind(pod, 'node-0')\n"
+            "    self.scheduler.delta.invalidate()\n"
+            "    self.scheduler.broker.grant([gang], 'explain')\n"
+            "    pad = self.scheduler._pad_groups.grow(specs)\n"
+        ),
+        "good": (
+            "def explain(self, ns, name):\n"
+            "    gang = self.store.get('PodGang', ns, name,"
+            " readonly=True)\n"
+            "    free = self.cluster.node_free_all(nodes)\n"
+            "    pad = self.scheduler._pad_groups.peek(specs)\n"
+            "    d = {}\n"
+            "    d.update({'a': 1})\n"  # plain dict: out of scope
+            "    items.append(gang)\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -476,6 +498,58 @@ def test_grafting_glassbox_state_write_fails_lint():
         assert "GL015" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
+
+
+def test_grafting_explain_mutation_fails_lint():
+    """GL016 live-tree teeth: grafting any store commit / bind / evict /
+    delta-invalidate call into the REAL explain or introspect sources
+    must fail lint — the read-only contract is what makes the verdicts
+    evidence rather than interference. The untouched modules lint clean,
+    and the engine's verdict cache is private outside explain.py."""
+    for rel, rogue in (
+        (
+            "grove_tpu/observability/explain.py",
+            "\n\ndef _rogue_commit(self, gang):\n"
+            "    self.scheduler.store.update_status(gang)\n",
+        ),
+        (
+            "grove_tpu/solver/introspect.py",
+            "\n\ndef _rogue_bind(scheduler, pod):\n"
+            "    scheduler.cluster.bind(pod, 'node-0')\n",
+        ),
+        (
+            "grove_tpu/solver/introspect.py",
+            "\n\ndef _rogue_invalidate(scheduler):\n"
+            "    scheduler.delta.invalidate()\n",
+        ),
+        (
+            "grove_tpu/observability/explain.py",
+            "\n\ndef _rogue_grow(self, specs):\n"
+            "    return self.scheduler._pad_groups.grow(specs)\n",
+        ),
+    ):
+        src = (ROOT / rel).read_text()
+        assert "GL016" not in rules_of(lint_source(src, rel)), rel
+        assert "GL016" in rules_of(lint_source(src + rogue, rel)), (
+            rel,
+            rogue,
+        )
+    # verdict-cache privacy outside the owning module
+    rogue_cache = (
+        "def fake_verdict(harness):\n"
+        "    harness.explain._verdicts[('ns', 'g')] = {'fits_now': True}\n"
+    )
+    assert "GL016" in rules_of(
+        lint_source(rogue_cache, "grove_tpu/sim/harness.py")
+    )
+    # precision: a non-explain-named chain writing `_verdicts` is out of
+    # scope, as is the engine mutating its own cache
+    assert "GL016" not in rules_of(
+        lint_source(
+            "def f(self):\n    self._verdicts = {}\n",
+            "grove_tpu/runtime/engine.py",
+        )
+    )
 
 
 def test_unregistering_reason_fails_lint():
