@@ -482,6 +482,26 @@ def _delta_artifact_block(harness) -> dict:
     return delta_artifact(harness)
 
 
+def _serving_artifact_block() -> dict:
+    """SLO-observatory serving block (docs/observability.md "SLO
+    observatory"): a seeded diurnal + flash-crowd traffic run autoscaling
+    prefill/decode scaling groups with a node-loss fault composed into
+    the first crowd — per-objective attainment/budget/breach counts,
+    scale-up latency p50/p99, time-under-min, per-tenant queue wait, and
+    the ROADMAP serving gate (steady-state admission p99 <1s THROUGH the
+    flash crowd). Isolated harness; the observatory is disarmed after."""
+    import time as _time
+
+    from grove_tpu.sim.traffic import serving_artifact
+
+    t0 = _time.perf_counter()
+    doc = serving_artifact(
+        seed=2026, tenants=2, num_nodes=16, duration=900.0
+    )
+    doc["wall_s"] = round(_time.perf_counter() - t0, 2)
+    return doc
+
+
 def _explain_artifact_block() -> dict:
     """Decision-explainability block (docs/observability.md "Admission
     explain"): the contended scenario's three verdict classes, verdict
@@ -714,6 +734,13 @@ def integrated_stress_bench(
             # truthfulness counter, per-level fragmentation statistics,
             # the what-if flip + its confirming drain, the read-only pin
             "explain": _explain_artifact_block(),
+            # SLO-observatory serving block (docs/observability.md "SLO
+            # observatory"): diurnal + flash-crowd traffic over
+            # autoscaled prefill/decode scaling groups with a composed
+            # node-loss fault — attainment/budget per objective, scale-up
+            # latency, queue wait, the admission-p99-through-the-crowd
+            # gate
+            "serving": _serving_artifact_block(),
             # sharded control-plane block (docs/control-plane.md): the
             # keyspace-sharded store at the ROADMAP's 10× shape, with the
             # fold-depth histogram and the S=1 inert A/B

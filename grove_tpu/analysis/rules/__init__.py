@@ -15,6 +15,7 @@ from grove_tpu.analysis.rules.scheduling import (
     SchedulableMaskRule,
 )
 from grove_tpu.analysis.rules.shardrules import ShardInternalsRule
+from grove_tpu.analysis.rules.slorules import TimeSeriesStateRule
 from grove_tpu.analysis.rules.storepath import (
     StoreLoggedCommitRule,
     StoreWritePathRule,
@@ -37,4 +38,5 @@ ALL_RULES = (
     FrontierStateRule,  # GL014
     GlassBoxStateRule,  # GL015
     ExplainReadonlyRule,  # GL016
+    TimeSeriesStateRule,  # GL017
 )

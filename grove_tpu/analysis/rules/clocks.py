@@ -8,6 +8,12 @@ utils/platform.py) legitimately read wall time and are out of scope.
 `time.perf_counter`/`time.monotonic` are deliberately allowed: they
 measure real latency (tracing, metrics) without steering simulated-time
 logic.
+
+EXCEPT in the strict-scope files (``sim/traffic.py``): a traffic trace
+must replay bit-identically from its seed, so not even a latency
+measurement may read the wall — the SLO observatory's windowed numbers
+(and `make serving-smoke`'s breach schedule) are only reproducible if
+the generator is a pure function of (seed, virtual time).
 """
 
 from __future__ import annotations
@@ -18,6 +24,9 @@ from typing import Iterable, Set
 from grove_tpu.analysis.engine import FileContext, Rule, Violation, dotted
 
 _BANNED_TIME_ATTRS = {"time", "sleep"}
+# additionally banned in the strict scope (pure seed+virtual-time files)
+_STRICT_TIME_ATTRS = {"time", "sleep", "perf_counter", "monotonic",
+                      "monotonic_ns", "perf_counter_ns", "time_ns"}
 _SEEDED_RNG_CTORS = {"Random", "default_rng", "RandomState", "SystemRandom"}
 _DATETIME_ATTRS = {"now", "utcnow", "today"}
 
@@ -33,6 +42,7 @@ class _ImportTracker(ast.NodeVisitor):
         self.datetime: Set[str] = set()
         # names imported FROM those modules (from time import sleep)
         self.from_time: Set[str] = set()
+        self.from_time_strict: Set[str] = set()  # perf_counter/monotonic
         self.from_random: Set[str] = set()
 
     def visit_Import(self, node: ast.Import) -> None:
@@ -52,6 +62,8 @@ class _ImportTracker(ast.NodeVisitor):
             local = alias.asname or alias.name
             if node.module == "time" and alias.name in _BANNED_TIME_ATTRS:
                 self.from_time.add(local)
+            elif node.module == "time" and alias.name in _STRICT_TIME_ATTRS:
+                self.from_time_strict.add(local)
             elif node.module == "random":
                 self.from_random.add(local)
             elif node.module == "datetime" and alias.name == "datetime":
@@ -74,14 +86,19 @@ class ClockDisciplineRule(Rule):
         "grove_tpu/disruption/",
         "grove_tpu/quota/",
     )
+    # strict scope: bit-replayable generators — even perf_counter/
+    # monotonic are wall reads there (the serving traffic trace must be a
+    # pure function of seed + virtual time)
+    strict_paths = ("grove_tpu/sim/traffic.py",)
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         imports = _ImportTracker()
         imports.visit(ctx.tree)
+        strict = ctx.rel in self.strict_paths
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
-            msg = self._classify(node, imports)
+            msg = self._classify(node, imports, strict)
             if msg is not None:
                 yield Violation(
                     rule=self.id,
@@ -91,7 +108,10 @@ class ClockDisciplineRule(Rule):
                     message=msg,
                 )
 
-    def _classify(self, node: ast.Call, imports: _ImportTracker):
+    def _classify(
+        self, node: ast.Call, imports: _ImportTracker, strict: bool = False
+    ):
+        banned_attrs = _STRICT_TIME_ATTRS if strict else _BANNED_TIME_ATTRS
         fn = node.func
         if isinstance(fn, ast.Attribute):
             base = fn.value
@@ -99,12 +119,18 @@ class ClockDisciplineRule(Rule):
             if (
                 isinstance(base, ast.Name)
                 and base.id in imports.time
-                and fn.attr in _BANNED_TIME_ATTRS
+                and fn.attr in banned_attrs
             ):
                 return (
                     f"wall-clock call `{dotted(fn)}()` — use the injectable"
                     " Clock (store.clock / harness clock) so virtual-time"
                     " runs stay deterministic"
+                    + (
+                        " (STRICT scope: traffic traces must replay"
+                        " bit-identically, even latency reads are banned)"
+                        if strict and fn.attr not in _BANNED_TIME_ATTRS
+                        else ""
+                    )
                 )
             # random.<fn>() — only seeded constructors with args pass
             if isinstance(base, ast.Name) and base.id in imports.random:
@@ -143,7 +169,9 @@ class ClockDisciplineRule(Rule):
                         " timestamps from the injectable Clock"
                     )
         elif isinstance(fn, ast.Name):
-            if fn.id in imports.from_time:
+            if fn.id in imports.from_time or (
+                strict and fn.id in imports.from_time_strict
+            ):
                 return (
                     f"wall-clock call `{fn.id}()` (imported from time) —"
                     " use the injectable Clock"

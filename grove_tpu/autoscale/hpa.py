@@ -17,13 +17,17 @@ per-target utilization injected by tests / scenarios.
 from __future__ import annotations
 
 import math
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Protocol
+from typing import Deque, Dict, Optional, Protocol, Tuple
 
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.store import Store
 
 DEFAULT_SCALE_DOWN_STABILIZATION = 300.0  # seconds (kube default)
+
+# one scale decision, as logged: (vt, kind, namespace, name, from, to)
+ScaleEvent = Tuple[float, str, str, str, int, int]
 
 
 class MetricsProvider(Protocol):
@@ -57,6 +61,11 @@ class HorizontalAutoscaler:
         self.scale_down_stabilization = scale_down_stabilization
         # target key -> (proposed lower replicas, since)
         self._scale_down_candidates: Dict[str, tuple] = {}
+        # bounded decision log, stamped with the DECISION's virtual time —
+        # scale-up latency (decision → replicas Ready) is only measurable
+        # if the decision instant survives the converge that absorbs it
+        # (sim/traffic.py and the serving SLO objectives consume this)
+        self.scale_log: Deque[ScaleEvent] = deque(maxlen=4096)
 
     def tick(self, namespace: Optional[str] = None) -> int:
         """Evaluate every HPA once (all namespaces by default); returns the
@@ -135,8 +144,19 @@ class HorizontalAutoscaler:
         )
         if obj is None or obj.metadata.deletion_timestamp is not None:
             return False
+        previous = int(obj.spec.replicas)
         obj.spec.replicas = desired
         self.store.update(obj)  # generation bump → controllers reconcile
+        self.scale_log.append(
+            (
+                self.store.clock.now(),
+                obj.kind,
+                obj.metadata.namespace,
+                obj.metadata.name,
+                previous,
+                desired,
+            )
+        )
         METRICS.inc(f"hpa_scale_total/{key}")
         METRICS.set(f"hpa_replicas/{key}", desired)
         return True

@@ -660,6 +660,77 @@ def _cmd_profile(args) -> int:
     return 0
 
 
+def _print_slo_report(doc: dict) -> None:
+    """Render one SloReport (docs/observability.md "SLO observatory"):
+    a table of objectives, then the non-internal series appendix."""
+    objectives = doc.get("objectives") or []
+    if not objectives:
+        print(
+            "no SLO objectives defined"
+            + ("" if doc.get("enabled") else " (engine disabled)")
+        )
+    for row in objectives:
+        att = row.get("attainment")
+        budget = row.get("budget_remaining")
+        print(
+            f"{row['name']}: {row['state'].upper()}  attainment="
+            + (f"{att:.4f}" if att is not None else "-")
+            + "  budget_remaining="
+            + (f"{budget:.2%}" if budget is not None else "-")
+            + f"  burn fast/slow={row['burn_rate_fast']:g}x/"
+            f"{row['burn_rate_slow']:g}x  breaches={row['breaches']}"
+            f" recoveries={row['recoveries']}"
+        )
+        print(f"    {row['spec']}")
+    series = doc.get("series") or {}
+    shown = 0
+    for name in sorted(series):
+        if name.startswith("slo:"):
+            continue  # engine-internal good/bad indicator series
+        win = series[name]
+        if win.get("kind") == "dist":
+            print(
+                f"  {name}: n={win.get('count', 0)}"
+                + (
+                    f" p50={win['p50']:.4f} p99={win['p99']:.4f}"
+                    f" max={win['max']:.4f}"
+                    if win.get("count")
+                    else ""
+                )
+            )
+        elif win.get("kind") == "gauge" and win.get("n"):
+            print(
+                f"  {name}: n={win['n']} last={win['last']:.4f}"
+                f" mean={win['mean']:.4f} min={win['min']:.4f}"
+                f" max={win['max']:.4f}"
+            )
+        shown += 1
+        if shown >= 24:
+            print("  ...")
+            break
+
+
+def _cmd_slo(args) -> int:
+    """SLO observatory report: per-objective attainment, error budget,
+    burn rates, breach state — from a live apiserver's GET /debug/slo
+    (the engine runs in the operator process)."""
+    if not args.apiserver:
+        print(
+            "slo: --apiserver URL required (the SLO engine lives in the"
+            " operator process; arm it with GROVE_TPU_TIMESERIES=1"
+            " GROVE_TPU_SLO=1)",
+            file=sys.stderr,
+        )
+        return 2
+    doc = _fetch_server_json(
+        args.apiserver, f"/debug/slo?window={args.window}", "slo"
+    )
+    if doc is None:
+        return 1
+    _print_slo_report(doc)
+    return 0
+
+
 def _print_journey(doc: dict) -> None:
     name = f"{doc.get('namespace')}/{doc.get('name')}"
     state = "complete" if doc.get("complete") else "in flight"
@@ -1572,6 +1643,24 @@ def main(argv: List[str] | None = None) -> int:
         help="PodGang name (sim mode defaults to every admitted gang)",
     )
     p.set_defaults(fn=_cmd_journey)
+
+    p = sub.add_parser(
+        "slo",
+        help=(
+            "SLO observatory report: per-objective attainment, error"
+            " budget, burn rates, breach state (GET /debug/slo)"
+        ),
+    )
+    p.add_argument(
+        "--apiserver", help="read /debug/slo from a live server"
+    )
+    p.add_argument(
+        "--window",
+        type=float,
+        default=300.0,
+        help="series-appendix window in seconds (default 300)",
+    )
+    p.set_defaults(fn=_cmd_slo)
 
     p = sub.add_parser(
         "explain",

@@ -24,6 +24,7 @@ can consume PodGangs from.
 from __future__ import annotations
 
 import json
+import math
 import queue
 import threading
 import urllib.parse
@@ -299,6 +300,25 @@ class APIServer:
                     },
                 )
 
+            def _query_float(self, name: str, default: float):
+                """One finite POSITIVE float query parameter, or None
+                when the raw value is unparseable, non-finite, or not
+                positive (callers 400) — every current caller is a
+                window length, where 0 is meaningless."""
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                raw = (query.get(name) or [None])[0]
+                if raw is None:
+                    return default
+                try:
+                    value = float(raw)
+                except ValueError:
+                    return None
+                if not math.isfinite(value) or value <= 0:
+                    return None
+                return value
+
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b"{}"
@@ -544,9 +564,17 @@ class APIServer:
                     # critical-path fold over completed journeys, PLUS
                     # the pending gangs (age, current stage, last explain
                     # verdict when one ran) — stuck gangs are visible
-                    # here instead of silently absent (journey gap fix)
+                    # here instead of silently absent (journey gap fix) —
+                    # plus the per-window admission summary read through
+                    # the SLO observatory's time-series engine (?window=N
+                    # seconds; the SLO layer cites the SAME numbers)
                     from grove_tpu.observability.journey import JOURNEYS
 
+                    window_s = self._query_float("window", 300.0)
+                    if window_s is None:
+                        return self._error(
+                            400, "window must be a positive finite number"
+                        )
                     pending = (
                         server.explain_engine.pending_journeys()
                         if server.explain_engine is not None
@@ -559,8 +587,28 @@ class APIServer:
                             "enabled": JOURNEYS.enabled,
                             "decomposition": JOURNEYS.decomposition(),
                             "critical_path": JOURNEYS.critical_path(),
+                            "window": JOURNEYS.window_summary(window_s),
                             "pending": pending,
                         },
+                    )
+                if path == "/debug/slo":
+                    # SLO observatory (docs/observability.md "SLO
+                    # observatory"): per-objective attainment, error
+                    # budget, burn rates, breach state — plus every live
+                    # time series reduced over one window (?window=N)
+                    from grove_tpu.observability.slo import SLO
+
+                    window_s = self._query_float("window", 300.0)
+                    if window_s is None:
+                        return self._error(
+                            400, "window must be a positive finite number"
+                        )
+                    return self._send_json(
+                        200,
+                        dict(
+                            {"kind": "SloReport"},
+                            **SLO.status(series_window=window_s),
+                        ),
                     )
                 route = self._route()
                 if route is None:

@@ -172,6 +172,18 @@ class OperatorRuntime:
         if self.cluster is not None:
             work += self.cluster.kubelet_tick()
         work += self._drain()
+        # SLO observatory (observability/timeseries.py, slo.py): sampling
+        # + objective evaluation at the round boundary, mirroring the sim
+        # harness's tick-boundary feed — one boolean check while off
+        # (arm with GROVE_TPU_TIMESERIES=1 GROVE_TPU_SLO=1; GET
+        # /debug/slo and `cli slo` read the result)
+        from grove_tpu.observability.slo import SLO
+        from grove_tpu.observability.timeseries import TIMESERIES
+
+        if TIMESERIES.enabled:
+            now = self.store.clock.now()
+            TIMESERIES.sample(now)
+            SLO.evaluate(now)
         if self.leader_lock is not None:
             self.leader_lock.heartbeat()
         return work
@@ -316,6 +328,14 @@ def start_operator(
         leader_lock.acquire_blocking()
 
     store = HttpStore(apiserver_url).start()
+
+    # SLO-observatory clock (observability/timeseries.py): ring ticks come
+    # from the store's clock from the FIRST reconcile round — a journey
+    # completing before the first sampling round must not stamp tick 0
+    from grove_tpu.observability.timeseries import TIMESERIES
+
+    if TIMESERIES.enabled:
+        TIMESERIES.clock = store.clock
 
     # materialize the hierarchy as a CR so wire clients can inspect what the
     # operator schedules against (the reference crashes when the configured

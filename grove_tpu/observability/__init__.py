@@ -14,6 +14,8 @@ from grove_tpu.observability.flightrec import FLIGHTREC, FlightRecorder
 from grove_tpu.observability.journey import JOURNEYS, JourneyTracker
 from grove_tpu.observability.metrics import METRICS, Metrics
 from grove_tpu.observability.profile import PROFILER, WallProfiler
+from grove_tpu.observability.slo import SLO, SloEngine, SloSpec
+from grove_tpu.observability.timeseries import TIMESERIES, TimeSeriesStore
 from grove_tpu.observability.tracing import TRACER, Tracer
 
 __all__ = [
@@ -27,6 +29,11 @@ __all__ = [
     "Metrics",
     "PROFILER",
     "WallProfiler",
+    "SLO",
+    "SloEngine",
+    "SloSpec",
+    "TIMESERIES",
+    "TimeSeriesStore",
     "TRACER",
     "Tracer",
 ]

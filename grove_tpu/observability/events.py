@@ -66,6 +66,15 @@ REASON_WAL_TORN_TAIL = "WalTornTail"
 # flight recorder froze its telemetry rings into a postmortem bundle
 # (invariant violation, reconcile GroveError, breaker open, or explicit)
 REASON_FLIGHT_RECORDED = "FlightRecorderDumped"
+# SLO observatory (docs/observability.md "SLO observatory",
+# observability/slo.py): an objective's compliance-window attainment
+# dropped below target (breach, edge-triggered — also freezes a flight
+# bundle), the multi-window burn rate crossed the paging factor on BOTH
+# the fast and slow windows, and a breached objective re-attaining.
+# grovelint GL017 pins every Slo*-family reason literal to this registry.
+REASON_SLO_BREACH = "SloBreach"
+REASON_SLO_BURN_RATE_HIGH = "SloBurnRateHigh"
+REASON_SLO_RECOVERED = "SloRecovered"
 # operator-component lifecycle reasons (controller/podcliqueset components,
 # rolling update, gang termination) — emitted as literals at the call
 # sites; registered here so grovelint GL006 and the docs-drift test keep

@@ -42,6 +42,11 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from grove_tpu.observability.metrics import METRICS, _quantile
+from grove_tpu.observability.timeseries import (
+    SERIES_ADMISSION,
+    SERIES_ADMISSION_VT,
+    TIMESERIES,
+)
 
 # Canonical journey phases, in causal order — the closed registry
 # tests/test_docs_drift.py pins against the docs/observability.md
@@ -255,6 +260,28 @@ class JourneyTracker:
             while len(self._done) > self.max_completed:
                 self._done.popitem(last=False)
         METRICS.inc("journeys_completed_total")
+        # SLO observatory feed (one boolean check when the engine is off):
+        # the completed journey's admission latency becomes a time-series
+        # observation — wall seconds (the segments' sum, the SAME number
+        # decomposition() reports) and virtual seconds (created→scheduled
+        # on the sim clock, the deterministically replayable signal the
+        # serving objectives judge)
+        if TIMESERIES.enabled:
+            if j.segments is not None:
+                TIMESERIES.observe(
+                    SERIES_ADMISSION, sum(j.segments.values())
+                )
+            created = j.marks.get("created") or j.marks.get("first-scan")
+            sched = j.marks.get("scheduled")
+            if (
+                created is not None
+                and sched is not None
+                and created[1] is not None
+                and sched[1] is not None
+            ):
+                TIMESERIES.observe(
+                    SERIES_ADMISSION_VT, max(sched[1] - created[1], 0.0)
+                )
 
     # -- read side -------------------------------------------------------
 
@@ -307,6 +334,42 @@ class JourneyTracker:
             rows.append(doc)
         rows.sort(key=lambda d: -d["age_s"])
         return rows
+
+    def pending_ages(self) -> List[Tuple[str, float]]:
+        """(namespace, oldest-pending-age) per namespace, virtual seconds
+        (falls back to wall) — the lightweight per-tenant queue-wait
+        signal the serving collector samples every tick (pending() builds
+        full documents; this is two floats per namespace)."""
+        wall_now = self.t()
+        vt_now = self._vt()
+        with self._lock:
+            journeys = list(self._active.values())
+        oldest: Dict[str, float] = {}
+        for j in journeys:
+            origin = j.marks.get("created") or j.marks.get("first-scan")
+            if origin is None:
+                continue
+            if vt_now is not None and origin[1] is not None:
+                age = max(vt_now - origin[1], 0.0)
+            else:
+                age = max(wall_now - origin[0], 0.0)
+            if age > oldest.get(j.namespace, -1.0):
+                oldest[j.namespace] = age
+        return sorted(oldest.items())
+
+    def window_summary(self, seconds: float = 300.0) -> dict:
+        """Per-window admission-latency summary, read THROUGH the SLO
+        observatory's time-series engine — the journey view and the SLO
+        layer cite the same windowed numbers by construction (pinned
+        equal in tests/test_slo_observatory.py). Returns empty shells
+        while the engine is off (decomposition() keeps serving the
+        all-time numbers)."""
+        return {
+            "window_s": seconds,
+            "enabled": TIMESERIES.enabled,
+            "wall": TIMESERIES.window(SERIES_ADMISSION, seconds),
+            "virtual": TIMESERIES.window(SERIES_ADMISSION_VT, seconds),
+        }
 
     def decomposition(self) -> dict:
         """Admission-latency p50/p99 per segment over completed journeys —

@@ -95,12 +95,14 @@ class SimHarness:
         from grove_tpu.observability.events import EVENTS
         from grove_tpu.observability.flightrec import FLIGHTREC
         from grove_tpu.observability.journey import JOURNEYS
+        from grove_tpu.observability.timeseries import TIMESERIES
         from grove_tpu.observability.tracing import TRACER
 
         TRACER.clock = self.clock
         EVENTS.clock = self.clock
         JOURNEYS.clock = self.clock
         FLIGHTREC.clock = self.clock
+        TIMESERIES.clock = self.clock
         self.ctx = OperatorContext(
             store=self.store, clock=self.clock, topology=self.topology
         )
@@ -287,6 +289,8 @@ class SimHarness:
         """Reconcile ⇄ schedule ⇄ kubelet until quiescent. Each tick advances
         virtual time so requeue_after-based waits can fire."""
         from grove_tpu.observability.profile import PROFILER
+        from grove_tpu.observability.slo import SLO
+        from grove_tpu.observability.timeseries import TIMESERIES
 
         ticks = 0
         for _ in range(max_ticks):
@@ -313,6 +317,12 @@ class SimHarness:
                 # cadence (real mode: the background thread)
                 with PROFILER.phase("tick", controller="wal"):
                     self.durability.pump()
+            # SLO observatory (observability/timeseries.py, slo.py): the
+            # sampling round + objective evaluation run at the tick
+            # boundary — one boolean check while the observatory is off
+            if TIMESERIES.enabled:
+                TIMESERIES.sample(self.clock.now())
+                SLO.evaluate(self.clock.now())
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a

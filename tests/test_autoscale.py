@@ -2,6 +2,7 @@
 replicas; PCSG scale-out materializes scaled gangs."""
 
 import pathlib
+from collections import deque
 
 from grove_tpu.api.load import load_podcliqueset_file
 from grove_tpu.api.pod import is_ready
@@ -87,6 +88,34 @@ class TestHPA:
         pclq = harness.store.get("PodClique", "default", "simple1-0-frontend")
         # minReplicas defaulted to template replicas (3)
         assert pclq.spec.replicas == 3
+
+    def test_scale_log_stamps_decisions_with_virtual_time(self):
+        """Every applied scale lands in the autoscaler's bounded decision
+        log stamped with the DECISION's virtual time — scale-up latency
+        (decision → Ready) is only measurable if the instant survives the
+        converge that absorbs it (sim/traffic.py consumes this)."""
+        harness = SimHarness(num_nodes=32)
+        harness.apply(simple1())
+        harness.converge()
+        assert harness.autoscaler.scale_log == deque()
+        t0 = harness.clock.now()
+        harness.metrics_provider.set(
+            "PodClique", "default", "simple1-0-frontend", 160.0
+        )
+        harness.converge()
+        log = list(harness.autoscaler.scale_log)
+        assert len(log) == 1
+        vt, kind, ns, name, previous, desired = log[0]
+        assert (kind, ns, name) == ("PodClique", "default", "simple1-0-frontend")
+        assert (previous, desired) == (3, 5)
+        assert vt >= t0
+        # a scale-down logs too, after stabilization
+        harness.metrics_provider.set(
+            "PodClique", "default", "simple1-0-frontend", 40.0
+        )
+        harness.advance(61.0)
+        harness.converge()
+        assert harness.autoscaler.scale_log[-1][4:6] == (5, 3)
 
     def test_pcsg_scale_down_removes_scaled_gangs(self):
         harness = SimHarness(num_nodes=32)

@@ -264,6 +264,91 @@ class TestExplainDrift:
         )
 
 
+class TestServingSignalDrift:
+    """The SLO observatory's gates (PR 14): the serving-signals registry
+    (observability/timeseries.py SERVING_SIGNALS) ⇄ the
+    docs/observability.md "SLO observatory" table, the event-reason
+    treatment applied to time-series names."""
+
+    def test_signals_documented(self):
+        from grove_tpu.observability.timeseries import SERVING_SIGNALS
+
+        documented = _table_first_cells(
+            _doc_section("SLO observatory"), _DASHED
+        )
+        missing = set(SERVING_SIGNALS) - documented
+        assert not missing, (
+            "serving signals missing from the docs/observability.md"
+            f" 'SLO observatory' table: {sorted(missing)}"
+        )
+
+    def test_docs_signals_not_stale(self):
+        """Every table row naming a series still exists in the registry
+        (the section's one table IS the signals table; prose code spans
+        are not table cells, so the gate stays exact)."""
+        from grove_tpu.observability.timeseries import SERVING_SIGNALS
+
+        documented = _table_first_cells(
+            _doc_section("SLO observatory"), _DASHED
+        )
+        stale = documented - set(SERVING_SIGNALS)
+        assert not stale, (
+            "docs/observability.md 'SLO observatory' table documents"
+            f" series not in SERVING_SIGNALS: {sorted(stale)}"
+        )
+
+    def test_signals_fed(self):
+        """Every registered signal has a feeding site (dead-registry
+        gate): its SERIES_* constant is READ somewhere — a feed site in
+        the journey tracker / serving scenario, or the sampler collector
+        in timeseries.py itself (Load context only, so the registry
+        definitions and the SERVING_SIGNALS tuple don't self-satisfy)."""
+        import ast
+
+        referenced = set()
+        for rel in repo_python_files(ROOT):
+            tree = ast.parse((ROOT / rel).read_text())
+            # the registry tuple's own member list is not a feed
+            skip = set()
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "SERVING_SIGNALS"
+                        for t in node.targets
+                    )
+                ):
+                    skip = {
+                        n for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name)
+                    }
+            for node in ast.walk(tree):
+                if node in skip:
+                    continue
+                name = (
+                    node.id
+                    if isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    else node.attr
+                    if isinstance(node, ast.Attribute)
+                    else None
+                )
+                if name and name.startswith("SERIES_"):
+                    referenced.add(name)
+        from grove_tpu.observability import timeseries as _ts
+
+        dead = {
+            k
+            for k in dir(_ts)
+            if k.startswith("SERIES_") and k not in referenced
+        }
+        assert not dead, (
+            "registered serving signals with no feeding reference"
+            f" outside timeseries.py: {sorted(dead)}"
+        )
+
+
 class TestJourneyPhaseDrift:
     def test_registry_matches_docs(self):
         """Journey phases (and derived segments) ⇄ the docs table — the

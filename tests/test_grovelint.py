@@ -268,6 +268,25 @@ FIXTURES = {
             "    items.append(gang)\n"
         ),
     },
+    "GL017": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def fudge(self):\n"
+            "    TIMESERIES._series['admission_latency'] = None\n"
+            "    TIMESERIES.enabled = True\n"
+            "    self.slo._state.clear()\n"
+            "    EVENTS.record(ref, 'Warning', 'SloImploded', 'm')\n"
+        ),
+        "good": (
+            "def observe(self, ref):\n"
+            "    TIMESERIES.enable()\n"
+            "    TIMESERIES.gauge('ready_fraction', 0.97)\n"
+            "    TIMESERIES.observe('admission_latency', 0.4)\n"
+            "    self.slo.evaluate(self.clock.now())\n"
+            "    EVENTS.record(ref, 'Warning', 'SloBreach', 'm')\n"
+            "    return TIMESERIES.window('ready_fraction', 300)\n"
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -498,6 +517,93 @@ def test_grafting_glassbox_state_write_fails_lint():
         assert "GL015" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
+
+
+def test_grafting_timeseries_state_write_fails_lint():
+    """GL017 live-tree teeth: a rogue helper poking the observatory's
+    ring cells or the SLO engine's objective state from real harness/
+    journey sources must fail lint — the NumPy-oracle reducer pin and
+    the edge-triggered breach machine assume only observability/
+    {timeseries,slo}.py write that state. The owning modules stay
+    exempt; the gauge()/observe()/evaluate() API passes anywhere."""
+    rel = "grove_tpu/sim/harness.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_fabricate_history(name):\n"
+        "    TIMESERIES._series[name] = None\n"
+        "    TIMESERIES._now = 0.0\n"
+        "    TIMESERIES.enabled = True\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL017" in rules_of(report)
+    assert "GL017" not in rules_of(lint_source(src, rel))
+    rel2 = "grove_tpu/observability/journey.py"
+    src2 = (ROOT / rel2).read_text()
+    rogue2 = (
+        "\n\ndef _rogue_silence_breach(slo_engine, name):\n"
+        "    slo_engine._state.pop(name)\n"
+    )
+    report2 = lint_source(src2 + rogue2, rel2)
+    assert "GL017" in rules_of(report2)
+    assert "GL017" not in rules_of(lint_source(src2, rel2))
+    # an UNREGISTERED Slo-family reason in a reason position fires even
+    # in otherwise-clean sources
+    rogue3 = (
+        "\n\ndef _rogue_alert(ref):\n"
+        "    EVENTS.record(ref, 'Warning', 'SloFabricated', 'm')\n"
+    )
+    assert "GL017" in rules_of(lint_source(src + rogue3, rel))
+    # the owning modules may mutate their own state
+    for own_rel in (
+        "grove_tpu/observability/timeseries.py",
+        "grove_tpu/observability/slo.py",
+    ):
+        own = (ROOT / own_rel).read_text()
+        assert "GL017" not in rules_of(lint_source(own, own_rel)), own_rel
+    # precision: slot-named locals, foreign `_state`, wire kinds, class
+    # names, and registered-reason comparisons stay out of scope
+    for ok_src in (
+        "def f(self, slots):\n    self.slots._values = slots\n",
+        "def f(self):\n    self.machine._state = 'open'\n",
+        "def f(self):\n    return {'kind': 'SloReport'}\n",
+        "class SloSpec:\n    pass\n",
+        "def f(self, ev):\n    return ev.reason == 'SloBreach'\n",
+    ):
+        assert "GL017" not in rules_of(
+            lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
+        ), ok_src
+
+
+def test_gl001_strict_scope_bans_perf_counter_in_traffic():
+    """GL001 strict scope: sim/traffic.py may not read even
+    perf_counter/monotonic — a traffic trace must be a pure function of
+    (seed, virtual time). Elsewhere in sim/, latency reads stay legal."""
+    src = (
+        "import time\n\n"
+        "def demand(self, t):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return t0\n"
+    )
+    assert "GL001" in rules_of(lint_source(src, "grove_tpu/sim/traffic.py"))
+    assert "GL001" not in rules_of(
+        lint_source(src, "grove_tpu/sim/cluster.py")
+    )
+    src_from = (
+        "from time import perf_counter\n\n"
+        "def demand(self, t):\n"
+        "    return perf_counter()\n"
+    )
+    assert "GL001" in rules_of(
+        lint_source(src_from, "grove_tpu/sim/traffic.py")
+    )
+    assert "GL001" not in rules_of(
+        lint_source(src_from, "grove_tpu/sim/cluster.py")
+    )
+    # the REAL traffic module is strict-clean
+    rel = "grove_tpu/sim/traffic.py"
+    assert "GL001" not in rules_of(
+        lint_source((ROOT / rel).read_text(), rel)
+    )
 
 
 def test_grafting_explain_mutation_fails_lint():

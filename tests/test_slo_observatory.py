@@ -1,0 +1,766 @@
+"""SLO observatory (docs/observability.md "SLO observatory"):
+
+- **Windowed reducers vs a plain-NumPy oracle** — seeded storms ×3 replay
+  the same samples into the ring engine and an independent NumPy model;
+  every window reduction must match BIT-EXACTLY, through ring wraparound,
+  sparse ticks, and empty windows. The SLO layer's attainment arithmetic
+  is only as honest as these reductions.
+- **SLO engine** — spec grammar, edge-triggered breach/recovery events,
+  multi-window multi-burn-rate alerting, error-budget accounting, the
+  flight-recorder bundle stamped with the breaching objective + window.
+- **Traffic generator** — bit-deterministic from its seed (GL001 strict
+  scope), flash-crowd schedule, prefill:decode ratio drift bounds.
+- **Serving scenario** — HPA actually scales prefill/decode groups under
+  generated load; scale-up latency lands in the observatory.
+- **Journey window pin** — the journey view's per-window admission
+  summary and the SLO objective's indicator cite the SAME numbers.
+- **Disabled-path pins (PR-1 discipline)** — a converge with the
+  observatory off allocates ZERO ring cells (constructors patched to
+  raise), and the journey completion feed stays one boolean check.
+- **Wire shapes** — GET /debug/slo, the /debug/journeys `window` block.
+"""
+
+import json
+import math
+import random
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from grove_tpu.observability.events import EVENTS
+from grove_tpu.observability.flightrec import FLIGHTREC, load_bundle
+from grove_tpu.observability.journey import JOURNEYS
+from grove_tpu.observability.slo import SLO, SloSpec, parse_duration
+from grove_tpu.observability.timeseries import (
+    N_BUCKETS,
+    TIMESERIES,
+    TimeSeriesStore,
+)
+from grove_tpu.observability import timeseries as timeseries_mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_observatory():
+    """Every test starts and ends with the observatory disarmed (the
+    singletons are process-global — leakage between tests is the bug
+    class GL017 exists to prevent in production code)."""
+    TIMESERIES.disable()
+    TIMESERIES.reset()
+    TIMESERIES.tap = None
+    TIMESERIES.clock = None
+    SLO.disable()
+    SLO.reset()
+    JOURNEYS.disable()
+    JOURNEYS.reset()
+    FLIGHTREC.disable()
+    FLIGHTREC.reset()
+    yield
+    TIMESERIES.disable()
+    TIMESERIES.reset()
+    TIMESERIES.tap = None
+    TIMESERIES.clock = None
+    SLO.disable()
+    SLO.reset()
+    JOURNEYS.disable()
+    JOURNEYS.reset()
+    FLIGHTREC.disable()
+    FLIGHTREC.reset()
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracle: an independent model of the ring + reducers
+# ---------------------------------------------------------------------------
+
+
+class NumpyOracle:
+    """Plain-NumPy re-derivation of the windowed reducers from the RAW
+    sample log: retention (last `capacity` ticks), gauge last-write-wins,
+    distribution bucketing, and every reduction — written against the
+    documented semantics, not the engine's code."""
+
+    def __init__(self, capacity: int, resolution: float = 1.0) -> None:
+        self.capacity = capacity
+        self.resolution = resolution
+        self.gauges = {}  # name -> {tick: value}
+        self.dists = {}  # name -> [(tick, value)]
+
+    def tick_of(self, vt: float) -> int:
+        return int(vt // self.resolution)
+
+    def gauge(self, name, value, vt):
+        self.gauges.setdefault(name, {})[self.tick_of(vt)] = float(value)
+
+    def observe(self, name, value, vt):
+        self.dists.setdefault(name, []).append(
+            (self.tick_of(vt), float(value))
+        )
+
+    def window(self, name, seconds, now):
+        t1 = self.tick_of(now)
+        t0 = t1 - max(1, int(round(seconds / self.resolution)))
+        lo = max(t0 + 1, t1 - self.capacity + 1, 0)
+        if name in self.gauges:
+            ticks = sorted(
+                t for t in self.gauges[name] if lo <= t <= t1
+            )
+            vals = np.asarray(
+                [self.gauges[name][t] for t in ticks], dtype=np.float64
+            )
+            if vals.size == 0:
+                return {"kind": "gauge", "n": 0}
+            srt = np.sort(vals)
+
+            def q_idx(q):
+                return min(
+                    vals.size - 1, max(0, math.ceil(q * vals.size) - 1)
+                )
+
+            return {
+                "kind": "gauge",
+                "n": int(vals.size),
+                "mean": float(vals.sum() / vals.size),
+                "max": float(srt[-1]),
+                "min": float(srt[0]),
+                "last": float(vals[-1]),
+                "p50": float(srt[q_idx(0.5)]),
+                "p99": float(srt[q_idx(0.99)]),
+            }
+        # ring retention: only the last `capacity` ticks before the probe
+        # can live (an older tick's slot is either unreachable by the
+        # window scan or stamped by a fresher tick). Probing happens
+        # DURING the storm — at "now", with no future writes — so the
+        # capacity clamp above IS the full recency model.
+        samples = [
+            (t, v) for t, v in self.dists.get(name, []) if lo <= t <= t1
+        ]
+        if not samples:
+            return {"kind": "dist", "count": 0}
+        units = np.asarray(
+            [max(0, int(v * 1e6)) for _, v in samples], dtype=np.int64
+        )
+        buckets = np.zeros(N_BUCKETS, dtype=np.int64)
+        for u in units:
+            idx = int(u).bit_length()
+            buckets[min(idx, N_BUCKETS - 1)] += 1
+        count = int(units.size)
+
+        def quantile(q):
+            target = max(1, int(q * count + 0.5))
+            b = int(np.searchsorted(np.cumsum(buckets), target))
+            return (0.5 if b == 0 else 1.5 * float(1 << (b - 1))) / 1e6
+
+        return {
+            "kind": "dist",
+            "count": count,
+            "rate": float(count) / float(seconds),
+            "mean": float(int(units.sum())) / float(count) / 1e6,
+            "max": float(int(units.max())) / 1e6,
+            "p50": quantile(0.5),
+            "p99": quantile(0.99),
+        }
+
+
+def _storm(seed, engine, oracle, check, n_events=3000):
+    """Replay one seeded storm into both models, invoking ``check(vt)``
+    at checkpoints DURING the storm (windows are always probed at "now",
+    so the oracle's retention model is exactly the capacity clamp).
+    Returns the final vt."""
+    rng = random.Random(seed)
+    vt = 0.0
+    for i in range(n_events):
+        vt += rng.choice([0.0, 0.1, 0.3, 1.0, 2.5, 7.0, 19.0])
+        if rng.random() < 0.5:
+            name = rng.choice(["g:a", "g:b", "ready_fraction"])
+            val = rng.uniform(-2.0, 5.0)
+            engine.gauge(name, val, vt=vt)
+            oracle.gauge(name, val, vt)
+        else:
+            name = rng.choice(["d:lat", "d:wait"])
+            val = rng.uniform(0.0, 30.0) ** 2 / 30.0
+            engine.observe(name, val, vt=vt)
+            oracle.observe(name, val, vt)
+        if i % 97 == 0:
+            check(vt)
+    check(vt)
+    return vt
+
+
+class TestReducersVsNumpyOracle:
+    NAMES = ("g:a", "g:b", "ready_fraction", "d:lat", "d:wait", "never")
+    WINDOWS = (1.0, 5.0, 30.0, 120.0, 1000.0)
+
+    @pytest.mark.parametrize("seed", [7, 1234, 2026])
+    def test_storm_bit_equal(self, seed):
+        """Seeded storm ×3: every (series, window, probe point) reduction
+        bit-equal to the NumPy oracle — NO tolerance."""
+        engine = TimeSeriesStore(capacity=4096)
+        engine.enable()
+        oracle = NumpyOracle(capacity=4096)
+        checked = [0]
+
+        def check(vt):
+            for name in self.NAMES:
+                for w in self.WINDOWS:
+                    got = engine.window(name, w, now=vt)
+                    want = oracle.window(name, w, now=vt)
+                    if want.get("n", 0) == 0 and want.get("count", 0) == 0:
+                        assert (
+                            got.get("n", 0) == 0 and got.get("count", 0) == 0
+                        ), (name, w, vt, got)
+                        continue
+                    assert got == want, (name, w, vt, got, want)
+                    checked[0] += 1
+
+        _storm(seed, engine, oracle, check)
+        assert checked[0] > 50  # the storm actually exercised reductions
+
+    @pytest.mark.parametrize("seed", [3, 99])
+    def test_ring_wraparound_bit_equal(self, seed):
+        """A tiny ring (capacity 32) forced to wrap many times: stale
+        slots must read as absent, never as a previous era's samples —
+        pinned bit-equal against the oracle's recency model."""
+        engine = TimeSeriesStore(capacity=32)
+        engine.enable()
+        oracle = NumpyOracle(capacity=32)
+
+        def check(vt):
+            for name in self.NAMES:
+                for w in (5.0, 31.0, 200.0):
+                    got = engine.window(name, w, now=vt)
+                    want = oracle.window(name, w, now=vt)
+                    if want.get("n", 0) == 0 and want.get("count", 0) == 0:
+                        assert (
+                            got.get("n", 0) == 0 and got.get("count", 0) == 0
+                        ), (name, w, vt, got)
+                        continue
+                    assert got == want, (name, w, vt, got, want)
+
+        end = _storm(seed, engine, oracle, check, n_events=2000)
+        assert end > 32 * 5  # wrapped for sure
+
+    def test_sparse_and_empty_windows(self):
+        engine = TimeSeriesStore(capacity=128)
+        engine.enable()
+        engine.gauge("g", 1.5, vt=10.0)
+        engine.gauge("g", 2.5, vt=100.0)
+        engine.observe("d", 0.25, vt=10.0)
+        # window covering only the gap: empty shells, not zeros
+        assert engine.window("g", 20.0, now=60.0) == {"kind": "gauge", "n": 0}
+        assert engine.window("d", 20.0, now=60.0) == {"kind": "dist", "count": 0}
+        assert engine.reduce("g", "p99", 20.0, now=60.0) is None
+        # sparse window: exactly the one sample
+        doc = engine.window("g", 50.0, now=100.0)
+        assert doc["n"] == 1 and doc["last"] == 2.5
+        # unknown series
+        assert engine.window("nope", 60.0)["n"] == 0
+        # zero/negative windows clamp to one resolution tick — a dist
+        # rate must never divide by zero
+        engine.observe("d2", 0.5, vt=100.0)
+        doc = engine.window("d2", 0.0, now=100.0)
+        assert doc["count"] == 1 and doc["rate"] == 1.0
+        assert engine.window("d2", -5.0, now=100.0)["count"] == 1
+
+    def test_remove_collector(self):
+        engine = TimeSeriesStore(capacity=64)
+        engine.enable()
+        fired = []
+        collector = fired.append
+        engine.add_collector(collector)
+        engine.sample(1.0)
+        engine.remove_collector(collector)
+        engine.remove_collector(collector)  # idempotent
+        engine.sample(2.0)
+        assert fired == [1.0]
+
+    def test_gauge_last_write_wins_within_tick(self):
+        engine = TimeSeriesStore(capacity=64)
+        engine.enable()
+        engine.gauge("g", 1.0, vt=5.2)
+        engine.gauge("g", 9.0, vt=5.8)  # same tick (resolution 1s)
+        doc = engine.window("g", 10.0, now=6.0)
+        assert doc["n"] == 1 and doc["last"] == 9.0
+
+    def test_counter_tracking_produces_rate_series(self):
+        from grove_tpu.observability.metrics import METRICS
+
+        engine = TIMESERIES
+        engine.enable()
+        METRICS.inc("slo_test_counter_total", 5)
+        engine.track_counter("slo_test_counter_total")
+        METRICS.inc("slo_test_counter_total", 3)
+        engine.sample(1.0)
+        METRICS.inc("slo_test_counter_total", 4)
+        engine.sample(2.0)
+        doc = engine.window("rate:slo_test_counter_total", 10.0, now=2.0)
+        assert doc["n"] == 2
+        assert doc["last"] == 4.0 and doc["max"] == 4.0 and doc["min"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# SLO specs + engine
+# ---------------------------------------------------------------------------
+
+
+class TestSloSpec:
+    def test_parse_full_grammar(self):
+        s = SloSpec.parse(
+            "admission_latency_vt:p99 < 1s over 5m target 99.9%"
+            " budget 1h burn 14.4x 5m/1h"
+        )
+        assert s.series == "admission_latency_vt"
+        assert s.reducer == "p99" and s.op == "<" and s.threshold == 1.0
+        assert s.window == 300.0 and s.budget == 3600.0
+        assert s.target == 99.9 / 100.0
+        assert s.burn_factor == 14.4
+        assert s.fast_window == 300.0 and s.slow_window == 3600.0
+
+    def test_parse_defaults(self):
+        s = SloSpec.parse("ready_fraction >= 0.9 over 2m")
+        assert s.reducer is None and s.threshold == 0.9
+        assert s.window == 120.0
+        assert s.budget == 6 * 120.0  # default 6x window
+        assert s.fast_window == s.window and s.slow_window == s.budget
+        assert s.target == 0.99
+
+    def test_parse_units_and_slashed_series(self):
+        s = SloSpec.parse(
+            "ready_fraction/default/serve >= 0.9 over 90s", name="rf"
+        )
+        assert s.name == "rf"
+        assert s.series == "ready_fraction/default/serve"
+        s2 = SloSpec.parse("scaleup_latency_vt:p50 < 500ms over 1m")
+        assert s2.threshold == 0.5
+
+    def test_parse_rejects_garbage(self):
+        for bad in (
+            "no-operator over 5m",
+            "lat:p99 < 1s",  # no window
+            "",
+        ):
+            with pytest.raises(ValueError):
+                SloSpec.parse(bad)
+        with pytest.raises(ValueError):
+            parse_duration("5 parsecs")
+        with pytest.raises(ValueError):
+            SloSpec(name="x", series="s", op="~", threshold=1, window=60)
+        with pytest.raises(ValueError):
+            SloSpec(
+                name="x", series="s", op="<", threshold=1, window=60,
+                target=1.5,
+            )
+
+    def test_duplicate_objective_rejected(self):
+        SLO.add("ready_fraction >= 0.5 over 1m")
+        with pytest.raises(ValueError):
+            SLO.add("ready_fraction >= 0.5 over 1m")
+
+
+def _feed_good_bad(engine, name, vt0, ticks, good=True, threshold=1.0):
+    """Feed `ticks` seconds of per-tick latency observations that are
+    clearly under (good) or over (bad) the threshold; returns the end vt."""
+    vt = vt0
+    for _ in range(int(ticks)):
+        vt += 1.0
+        engine.observe(name, 0.1 * threshold if good else 10.0 * threshold, vt=vt)
+    return vt
+
+
+class TestSloEngine:
+    def _arm(self, spec_text):
+        TIMESERIES.enable()
+        SLO.enable()
+        EVENTS.reset()
+        return SLO.add(spec_text)
+
+    def _run(self, name, pattern, threshold=1.0, vt=0.0):
+        """pattern: [(n_ticks, good?)] — feed and evaluate per tick;
+        returns the end vt (pass it back to continue a run)."""
+        for n_ticks, good in pattern:
+            for _ in range(n_ticks):
+                vt += 1.0
+                TIMESERIES.observe(
+                    name,
+                    0.1 * threshold if good else 10.0 * threshold,
+                    vt=vt,
+                )
+                TIMESERIES.sample(vt)
+                SLO.evaluate(vt)
+        return vt
+
+    def test_breach_and_recovery_edge_triggered(self):
+        self._arm(
+            "lat:p99 < 1s over 10s target 80% budget 60s burn 2x 10s/30s"
+        )
+        # 60 good ticks, then 30 bad (attainment over 60s drops under
+        # 80%), then 120 good (window slides clean -> recovery)
+        self._run("lat", [(60, True), (30, False), (120, True)])
+        status = SLO.status()
+        row = status["objectives"][0]
+        assert row["breaches"] == 1, row
+        assert row["recoveries"] == 1, row
+        assert row["state"] == "ok"
+        breach = EVENTS.list(reason="SloBreach")
+        assert len(breach) == 1 and breach[0].type == "Warning"
+        assert breach[0].kind == "SloObjective"
+        rec = EVENTS.list(reason="SloRecovered")
+        assert len(rec) == 1 and rec[0].type == "Normal"
+        # second breach dedups onto the same event group, count bumps
+        from grove_tpu.observability.metrics import METRICS
+
+        assert METRICS.counters["slo_breaches_total"] >= 1
+
+    def test_attainment_and_budget_math(self):
+        self._arm("lat:p99 < 1s over 5s target 90% budget 100s")
+        # 100 ticks: 95 good then 5 bad -> indicator bad for >=5 ticks
+        vt = self._run("lat", [(95, True), (5, False)])
+        row = SLO.status()["objectives"][0]
+        # the 5s indicator window makes the LAST ticks bad; attainment
+        # over 100s sits in [0.90, 0.96]
+        assert row["attainment"] is not None
+        assert 0.85 <= row["attainment"] <= 0.97
+        expected_remaining = max(
+            0.0, 1.0 - (1.0 - row["attainment"]) / 0.1
+        )
+        assert abs(row["budget_remaining"] - expected_remaining) < 1e-12
+        assert row["evaluations"] == 100
+        assert row["good"] + row["bad"] == 100
+
+    def test_multi_window_burn_alert_needs_both_windows(self):
+        self._arm(
+            "lat:p99 < 1s over 2s target 90% budget 300s burn 3x 10s/60s"
+        )
+        # a 6-tick blip burns the FAST window over 3x but not the slow
+        # one -> no alert; a sustained 60-tick burn trips both -> alert
+        end = self._run("lat", [(120, True), (6, False), (30, True)])
+        assert not EVENTS.list(reason="SloBurnRateHigh")
+        self._run("lat", [(60, False)], vt=end)
+        assert len(EVENTS.list(reason="SloBurnRateHigh")) == 1
+
+    def test_breach_triggers_flight_bundle_with_objective_metadata(
+        self, tmp_path
+    ):
+        FLIGHTREC.enable(out_dir=str(tmp_path))
+        self._arm(
+            "lat:p99 < 1s over 5s target 90% budget 30s burn 2x 5s/15s"
+        )
+        self._run("lat", [(30, True), (30, False)])
+        assert FLIGHTREC.dumps, "breach must freeze a flight bundle"
+        manifest = load_bundle(FLIGHTREC.dumps[0])
+        assert manifest["reason"] == "SloBreach"
+        # bundle metadata names the breaching objective AND window
+        assert "objective=lat" in manifest["detail"]
+        assert "window=30" in manifest["detail"]
+        assert "attainment=" in manifest["detail"]
+        assert "chrome" in manifest
+
+    def test_evaluate_idempotent_within_tick(self):
+        """One verdict per virtual tick: a second evaluate() at the same
+        tick (the scenario's guaranteed post-converge round landing on a
+        tick the converge loop already judged) must not double-count."""
+        self._arm("lat:p99 < 1s over 5s target 90%")
+        vt = self._run("lat", [(10, True)])
+        assert SLO.status()["objectives"][0]["evaluations"] == 10
+        SLO.evaluate(vt)
+        SLO.evaluate(vt)
+        assert SLO.status()["objectives"][0]["evaluations"] == 10
+
+    def test_reducer_kind_mismatch_surfaces_config_error(self):
+        """`rate` on a gauge series parses but can never evaluate — the
+        status must say config-error, not silently report an objective
+        that never breaches."""
+        TIMESERIES.enable()
+        SLO.enable()
+        SLO.add("gauge_series:rate < 1 over 5s target 90%")
+        vt = 0.0
+        for _ in range(10):
+            vt += 1.0
+            TIMESERIES.gauge("gauge_series", 0.5, vt=vt)
+            TIMESERIES.sample(vt)
+            SLO.evaluate(vt)
+        row = SLO.status()["objectives"][0]
+        assert row["state"] == "config-error"
+        assert row["evaluations"] == 0
+
+    def test_no_data_windows_do_not_evaluate(self):
+        self._arm("lat:p99 < 1s over 5s target 90%")
+        SLO.evaluate(100.0)  # nothing fed
+        row = SLO.status()["objectives"][0]
+        assert row["evaluations"] == 0
+        assert row["attainment"] is None
+        assert row["state"] == "ok"
+
+    def test_prometheus_rows(self):
+        from grove_tpu.observability.metrics import METRICS
+
+        self._arm("lat:p99 < 1s over 5s target 90% budget 30s")
+        self._run("lat", [(40, True)])
+        text = METRICS.prometheus_text()
+        assert 'grove_tpu_slo_attainment{name="lat"}' in text
+        assert 'grove_tpu_slo_burn_rate{name="lat"}' in text
+        assert 'grove_tpu_slo_budget_remaining{name="lat"}' in text
+
+
+# ---------------------------------------------------------------------------
+# traffic generator
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficModel:
+    def test_deterministic_from_seed(self):
+        from grove_tpu.sim.traffic import TrafficModel
+
+        a = TrafficModel(42, ["t0", "t1", "t2"])
+        b = TrafficModel(42, ["t0", "t1", "t2"])
+        for t in (0.0, 13.7, 250.0, 999.5, 1799.0):
+            assert a.demand(t) == b.demand(t)
+        assert [
+            (c.start, c.duration, c.magnitude) for c in a.crowds
+        ] == [(c.start, c.duration, c.magnitude) for c in b.crowds]
+        c = TrafficModel(43, ["t0", "t1", "t2"])
+        assert any(a.demand(t) != c.demand(t) for t in (0.0, 500.0))
+
+    def test_flash_crowd_schedule_and_multiplier(self):
+        from grove_tpu.sim.traffic import TrafficModel
+
+        m = TrafficModel(7, ["t0"], flash_crowds=3, flash_magnitude=4.0)
+        assert len(m.crowds) == 3
+        for crowd in m.crowds:
+            mid = crowd.start + crowd.duration / 2
+            assert m.flash_multiplier(mid) > 1.0
+            inside = m.demand(mid)["t0"]
+            # the surge multiplies BOTH roles
+            quiet_t = crowd.start - 1.0
+            if not any(c.active(quiet_t) for c in m.crowds):
+                quiet = m.demand(quiet_t)["t0"]
+                assert (
+                    inside["prefill"] + inside["decode"]
+                    > quiet["prefill"] + quiet["decode"]
+                )
+
+    def test_tenant_skew_and_ratio_drift(self):
+        from grove_tpu.sim.traffic import TrafficModel
+
+        m = TrafficModel(11, [f"t{i}" for i in range(4)], skew=1.0)
+        weights = sorted(m.weights.values())
+        assert abs(sum(weights) - 1.0) < 1e-12
+        assert weights[-1] > weights[0]  # skewed, not uniform
+        shares = [m.prefill_share(t) for t in np.linspace(0, 1800, 50)]
+        assert min(shares) >= 0.05 and max(shares) <= 0.95
+        assert max(shares) - min(shares) > 0.01  # it actually drifts
+
+    def test_demand_positive_and_diurnal(self):
+        from grove_tpu.sim.traffic import TrafficModel
+
+        m = TrafficModel(5, ["t0", "t1"], flash_crowds=0)
+        totals = []
+        for t in np.linspace(0, m.period, 40):
+            d = m.demand(float(t))
+            for role_demand in d.values():
+                assert role_demand["prefill"] >= 0.0
+                assert role_demand["decode"] >= 0.0
+            totals.append(
+                sum(r["prefill"] + r["decode"] for r in d.values())
+            )
+        assert max(totals) / max(min(totals), 1e-9) > 1.5  # a real wave
+
+
+@pytest.mark.slow
+class TestServingScenario:
+    def test_hpa_scales_under_flash_crowd(self):
+        from grove_tpu.sim.traffic import ServingScenario, TrafficModel
+
+        model = TrafficModel(
+            9, ["tenant-0"], base=4.0, flash_crowds=1,
+            flash_magnitude=3.0, horizon=240.0, flash_duration=60.0,
+        )
+        sc = ServingScenario(
+            seed=9, tenants=1, num_nodes=12, model=model
+        )
+        TIMESERIES.enable(clock=sc.harness.clock)
+        JOURNEYS.enable()
+        JOURNEYS.clock = sc.harness.clock
+        sc.run(240.0, dt=10.0)
+        assert sc.scale_ups >= 1, "flash crowd must trigger a scale-up"
+        assert sc.scaleup_samples, "scale-up latency must be measured"
+        assert all(s >= 0.0 for s in sc.scaleup_samples)
+        doc = TIMESERIES.window("scaleup_latency_vt", 1000.0)
+        assert doc["count"] == len(sc.scaleup_samples)
+
+
+# ---------------------------------------------------------------------------
+# journey window pin: the SLO layer and the journey view cite the SAME
+# numbers
+# ---------------------------------------------------------------------------
+
+
+class TestJourneyWindowPin:
+    def test_window_summary_equals_slo_indicator(self):
+        TIMESERIES.enable()
+        SLO.enable()
+        spec = SLO.add(
+            "admission_latency_vt:p99 < 60s over 120s target 90%"
+        )
+        rng = random.Random(4)
+        vt = 0.0
+        for _ in range(200):
+            vt += 1.0
+            TIMESERIES.observe(
+                "admission_latency_vt", rng.uniform(0, 90), vt=vt
+            )
+        TIMESERIES.sample(vt)
+        SLO.evaluate(vt)
+        row = SLO.status()["objectives"][0]
+        summary = JOURNEYS.window_summary(spec.window)
+        assert summary["virtual"]["p99"] == row["value"], (
+            "the journey window view and the SLO indicator must cite the"
+            " same number"
+        )
+        assert summary["window_s"] == spec.window
+
+    def test_journey_completion_feeds_observatory(self):
+        """An end-to-end converge with journeys + observatory armed: the
+        admission series holds exactly the completed journeys, and the
+        wall series' numbers equal the decomposition's totals."""
+        from grove_tpu.api.meta import deep_copy
+        from grove_tpu.models import load_sample
+        from grove_tpu.sim.harness import SimHarness
+
+        h = SimHarness(num_nodes=8)
+        TIMESERIES.enable(clock=h.clock)
+        JOURNEYS.enable()
+        JOURNEYS.clock = h.clock
+        base = load_sample("simple")
+        for i in range(3):
+            pcs = deep_copy(base)
+            pcs.metadata.name = f"obs-{i}"
+            h.apply(pcs)
+        h.converge()
+        n = JOURNEYS.decomposition()["journeys"]
+        assert n >= 3
+        wall = TIMESERIES.window("admission_latency", 10_000.0)
+        virt = TIMESERIES.window("admission_latency_vt", 10_000.0)
+        assert wall["count"] == n
+        assert virt["count"] == n
+        summary = JOURNEYS.window_summary(10_000.0)
+        assert summary["wall"] == wall and summary["virtual"] == virt
+
+
+# ---------------------------------------------------------------------------
+# disabled-path pins (PR-1 discipline)
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPath:
+    def test_disabled_feeds_allocate_no_ring_cells(self, monkeypatch):
+        """With the observatory off, a full converge (journey feed sites
+        included) must construct ZERO ring objects — the one-boolean
+        check is the entire cost."""
+        def _boom(*a, **k):
+            raise AssertionError(
+                "ring cell allocated while the observatory is disabled"
+            )
+
+        monkeypatch.setattr(timeseries_mod._GaugeRing, "__init__", _boom)
+        monkeypatch.setattr(timeseries_mod._DistRing, "__init__", _boom)
+        from grove_tpu.models import load_sample
+        from grove_tpu.sim.harness import SimHarness
+
+        h = SimHarness(num_nodes=8)
+        h.apply(load_sample("simple"))
+        h.converge()
+        # the feed sites are no-ops too
+        TIMESERIES.gauge("g", 1.0)
+        TIMESERIES.observe("d", 1.0)
+        TIMESERIES.sample(1.0)
+        SLO.evaluate(1.0)
+
+    def test_journey_feed_is_one_boolean_check_when_ts_disabled(self):
+        """Journeys ON, observatory OFF: completions must not reach the
+        engine (the PR-12 layers compose, each behind its own flag)."""
+        JOURNEYS.enable()
+        JOURNEYS.note_created("ns", "g")
+        JOURNEYS.note_seen("ns", "g")
+        JOURNEYS.note_round(0.0, 0.1, 0.2)
+        JOURNEYS.note_encoded("ns", "g")
+        JOURNEYS.note_commit("ns", "g")
+        JOURNEYS.note_scheduled("ns", "g")
+        assert JOURNEYS.completed_total == 1
+        assert TIMESERIES.series_names() == []
+
+
+# ---------------------------------------------------------------------------
+# wire shapes
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestSloWire:
+    def test_debug_slo_shape(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        TIMESERIES.enable()
+        SLO.enable()
+        SLO.add("lat:p99 < 1s over 5s target 90% budget 30s")
+        vt = 0.0
+        for _ in range(40):
+            vt += 1.0
+            TIMESERIES.observe("lat", 0.01, vt=vt)
+            TIMESERIES.sample(vt)
+            SLO.evaluate(vt)
+        server = APIServer().start()
+        try:
+            doc = _get_json(server.address + "/debug/slo")
+            assert doc["kind"] == "SloReport"
+            assert doc["enabled"] is True
+            row = doc["objectives"][0]
+            assert set(row) == {
+                "name", "spec", "series", "state", "value", "attainment",
+                "budget_remaining", "burn_rate_fast", "burn_rate_slow",
+                "evaluations", "good", "bad", "breaches", "recoveries",
+            }
+            assert row["name"] == "lat" and row["state"] == "ok"
+            assert row["attainment"] == 1.0
+            assert row["budget_remaining"] == 1.0
+            assert "lat" in doc["series"]
+            assert doc["series"]["lat"]["kind"] == "dist"
+            # ?window= shrinks the series appendix's reduction window
+            doc2 = _get_json(server.address + "/debug/slo?window=1")
+            assert doc2["series"]["lat"]["count"] <= doc["series"]["lat"]["count"]
+            # bad windows -> 400 (unparseable, non-finite, non-positive)
+            for bad in ("banana", "inf", "nan", "0", "-5"):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(
+                        server.address + f"/debug/slo?window={bad}",
+                        timeout=10,
+                    )
+                assert err.value.code == 400, bad
+        finally:
+            server.stop()
+
+    def test_debug_journeys_window_block(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        TIMESERIES.enable()
+        JOURNEYS.enable()
+        TIMESERIES.observe("admission_latency_vt", 2.0, vt=5.0)
+        TIMESERIES.sample(6.0)
+        server = APIServer().start()
+        try:
+            doc = _get_json(server.address + "/debug/journeys?window=60")
+            assert doc["kind"] == "JourneySummary"
+            win = doc["window"]
+            assert win["window_s"] == 60.0
+            assert win["enabled"] is True
+            assert win["virtual"]["count"] == 1
+            assert set(win) == {"window_s", "enabled", "wall", "virtual"}
+        finally:
+            server.stop()
+
+
+import urllib.error  # noqa: E402  (used by the wire tests above)
