@@ -10,7 +10,8 @@ IMAGE ?= grove-tpu:0.2.0
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
         scale-smoke frontier-smoke profile-smoke explain-smoke \
-        serving-smoke probe-debug dryrun docker-build compose-up clean
+        serving-smoke parallel-smoke probe-debug dryrun docker-build \
+        compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -20,13 +21,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke
+check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke parallel-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke, parallel-control-plane smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL017) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL018) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -82,6 +83,9 @@ profile-smoke:   ## glass-box smoke: wall-attribution coverage >=95% of an indep
 
 explain-smoke:   ## admission-explain smoke: contended multi-tenant scenario with >=1 quota-blocked, >=1 fragmentation-blocked, >=1 fits-now verdict; one what-if that flips a verdict, confirmed by an actual drain; explain/what-if burst provably read-only (rv vector + delta fingerprint unchanged)
 	$(CPU_ENV) $(PY) scripts/explain_smoke.py
+
+parallel-smoke:  ## parallel-control-plane smoke: serial-twin A/B bit-identical at every converge boundary (store content, reconcile counts, per-shard WAL acked prefixes), worker-count sweep 1/2/4/8 with us/reconcile + speedup printed, sanitized chaos arm re-run with 3 shards + 2 workers
+	$(CPU_ENV) $(PY) scripts/parallel_smoke.py
 
 serving-smoke:   ## SLO-observatory smoke: seeded diurnal + flash-crowd traffic autoscaling prefill/decode scaling groups with a node crash mid-crowd; >=1 SLO breach (SloBreach + flight bundle stamped with the objective/window, round-tripped) and recovery, windowed percentiles bit-equal to a NumPy oracle, admission p99 <1s through the crowd, all-off overhead <1%
 	$(CPU_ENV) $(PY) scripts/serving_smoke.py

@@ -627,18 +627,37 @@ def _scale_artifact_block(n_sets: int, scale_shape) -> dict:
     seconds."""
     from grove_tpu.sim.scale import scale_artifact
 
+    from grove_tpu.runtime.workers import workers_from_env
+
+    # parallel control plane (docs/control-plane.md §5): full-size runs
+    # default to 4 per-shard reconcile workers UNLESS the operator set
+    # GROVE_TPU_CP_WORKERS explicitly — an explicit =1 must reproduce
+    # the serial PR-10 baseline, so only the UNSET case gets the
+    # full-size default. Smoke shapes are PINNED serial (workers=1 —
+    # explicit, which tears down any env arming): the cp-bench-smoke
+    # sentinel's walls are compared across PRs and must not silently
+    # change executor with the caller's environment.
+    workers_explicit = "GROVE_TPU_CP_WORKERS" in os.environ
+    workers = workers_from_env()
+    shape_1m = None
     if scale_shape is not None:
         sc_sets, sc_nodes, sc_shards = scale_shape
         fab = (max(sc_sets // 2, 32), max(sc_nodes // 2, 32))
     elif n_sets >= 10240:
         sc_sets, sc_nodes, sc_shards = 62_500, 100_000, 8
         fab = (4096, 6400)
+        if workers <= 1 and not workers_explicit:
+            workers = 4
+        # the ROADMAP's next notch: 125k sets × 8 pods = 1M pods — the
+        # gate is that the shape produces a valid artifact at all
+        shape_1m = (125_000, 200_000, 8)
     else:
         sc_sets, sc_nodes, sc_shards = max(n_sets // 2, 32), max(n_sets // 2, 32), 4
         fab = (max(n_sets // 4, 32), max(n_sets // 4, 32))
+        workers = 1
     return scale_artifact(
         n_sets=sc_sets, n_nodes=sc_nodes, num_shards=sc_shards,
-        frontier_ab_shape=fab,
+        frontier_ab_shape=fab, workers=workers, shape_1m=shape_1m,
     )
 
 

@@ -20,6 +20,7 @@ from grove_tpu.analysis.rules.storepath import (
     StoreLoggedCommitRule,
     StoreWritePathRule,
 )
+from grove_tpu.analysis.rules.workerrules import WorkerAffinityRule
 
 ALL_RULES = (
     ClockDisciplineRule,  # GL001
@@ -39,4 +40,5 @@ ALL_RULES = (
     GlassBoxStateRule,  # GL015
     ExplainReadonlyRule,  # GL016
     TimeSeriesStateRule,  # GL017
+    WorkerAffinityRule,  # GL018
 )

@@ -137,6 +137,22 @@ def next_uid() -> str:
     return f"uid-{_UID_TOKEN}-{next(_uid_counter)}"
 
 
+def reset_uid_namespace() -> None:
+    """Restart the uid sequence under a FRESH incarnation token.
+
+    The only sanctioned way to reset `_uid_counter`: resetting the
+    counter alone re-creates (uid, generation) pairs, and every
+    process-global memo keyed on them (api/hashing.py's template-hash
+    cache) would serve another incarnation's stale value — observed as a
+    wrong currentGenerationHash in a later harness when the cache was
+    warm enough that the colliding entry survived eviction. Rotating the
+    token keeps restarted sequences disjoint, exactly like a store
+    restart does."""
+    global _uid_counter, _UID_TOKEN
+    _uid_counter = itertools.count(1)
+    _UID_TOKEN = uuid.uuid4().hex[:8]
+
+
 @dataclass
 class OwnerReference:
     kind: str

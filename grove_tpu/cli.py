@@ -1797,6 +1797,9 @@ def main(argv: List[str] | None = None) -> int:
     p.add_argument(
         "--threaded",
         action="store_true",
+        default=None,  # tri-state: unset defers to GROVE_TPU_CP_WORKERS
+        # (docs/control-plane.md §5 — cluster mode maps the parallel-CP
+        # opt-in onto threaded reconciles); the flag pins True
         help="run concurrent reconciles in real threads (concurrentSyncs)",
     )
     p.add_argument(

@@ -245,7 +245,10 @@ def start_operator(
     with_tls: bool = False,
     with_authorizer: bool = False,
     with_scheduler: bool = True,
-    threaded: bool = False,
+    # tri-state: None = default (single-threaded drain, unless
+    # GROVE_TPU_CP_WORKERS maps onto threaded reconciles — see below);
+    # an explicit True/False always wins over the env knob
+    threaded: Optional[bool] = None,
     apiserver_url: Optional[str] = None,
     leader_lock_path: Optional[str] = None,
     leader_election: Optional[bool] = None,
@@ -363,6 +366,20 @@ def start_operator(
     if not topology.metadata.name:
         topology.metadata.name = "default"
     engine = Engine(store, store.clock)
+    # parallel control plane (docs/control-plane.md §5): the env opt-in
+    # (GROVE_TPU_CP_WORKERS) arms only over a SHARDED in-memory store —
+    # cluster mode drains an HttpStore, where per-shard ownership cannot
+    # be enforced across the wire. Map the same intent onto this tier's
+    # concurrency model instead: MaxConcurrentReconciles-style threaded
+    # reconciles (drain_concurrent), which the thread-safe apiserver
+    # boundary already supports. An EXPLICIT threaded=True/False from the
+    # caller always wins — the env knob names a deterministic feature,
+    # so it must never silently override a caller who pinned the
+    # single-threaded drain; only the unset (None) default maps.
+    if threaded is None:
+        from grove_tpu.runtime.workers import workers_from_env
+
+        threaded = engine.workers is None and workers_from_env() > 1
     ctx = OperatorContext(store=store, clock=store.clock, topology=topology)
     register_controllers(engine, ctx, config)
     if recovered_objects:

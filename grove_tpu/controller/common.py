@@ -9,6 +9,7 @@ controller/common/component/utils/.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
@@ -44,6 +45,13 @@ class OperatorContext:
     )
     events: List[str] = field(default_factory=list)
     _event_seq: int = 0
+    # sequence + memo guards: reconciles run on parallel worker threads
+    # under the concurrent control plane (runtime/workers.py) — a bare
+    # `_event_seq += 1` is a read-modify-write race there, and two workers
+    # building the same desired-memo key must not interleave the eviction
+    # scan. Uncontended lock acquires are the only serial-path cost.
+    _event_lock: object = field(default_factory=threading.Lock)
+    _memo_lock: object = field(default_factory=threading.Lock)
     max_events: int = 1000  # ring buffer (k8s Events have a TTL; we cap)
     # desired-child memo: the EXPECTED PodCliques/PCSGs of a set are a pure
     # function of (pcs uid, generation) — rebuilding the label dicts /
@@ -58,16 +66,24 @@ class OperatorContext:
     def desired_cache(self, key: tuple, build):
         """Memoized desired-children build for `key` (kind, uid, generation).
         A generation bump changes the key; stale generations age out LRU
-        (hits move to the end, so insertion order is recency)."""
-        hit = self._desired_memo.pop(key, None)
-        if hit is not None:
-            self._desired_memo[key] = hit
-            return hit
-        if len(self._desired_memo) >= self._desired_memo_max:
-            # drop the least-recently-used quarter
-            for stale in list(self._desired_memo)[: self._desired_memo_max // 4]:
-                self._desired_memo.pop(stale, None)
-        value = self._desired_memo[key] = build()
+        (hits move to the end, so insertion order is recency). The lock
+        covers the hit-bump and the eviction scan — worker threads from
+        the parallel drain share this memo; `build()` runs outside it (a
+        racing duplicate build is benign, a torn eviction scan is not)."""
+        with self._memo_lock:
+            hit = self._desired_memo.pop(key, None)
+            if hit is not None:
+                self._desired_memo[key] = hit
+                return hit
+            if len(self._desired_memo) >= self._desired_memo_max:
+                # drop the least-recently-used quarter
+                for stale in list(self._desired_memo)[
+                    : self._desired_memo_max // 4
+                ]:
+                    self._desired_memo.pop(stale, None)
+        value = build()
+        with self._memo_lock:
+            self._desired_memo[key] = value
         return value
 
     def record_event(
@@ -95,12 +111,19 @@ class OperatorContext:
         from grove_tpu.api.meta import ObjectMeta
         from grove_tpu.api.types import GenericObject
 
-        self._event_seq += 1
+        # atomic sequence allocation: parallel reconcile workers
+        # (runtime/workers.py) record events concurrently; a torn
+        # read-modify-write here would collide two evt-N names and
+        # silently drop one best-effort Event (and its rv bump) —
+        # breaking the serial-twin commit-count equality
+        with self._event_lock:
+            self._event_seq += 1
+            seq = self._event_seq
         try:
             self.store.create(
                 GenericObject(
                     kind="Event",
-                    metadata=ObjectMeta(name=f"evt-{self._event_seq}"),
+                    metadata=ObjectMeta(name=f"evt-{seq}"),
                     spec={
                         "involvedKind": kind,
                         "reason": reason,
@@ -112,7 +135,7 @@ class OperatorContext:
             )
         except Exception:
             pass  # events are best-effort (conflict on replayed names etc.)
-        expired = self._event_seq - self.max_events
+        expired = seq - self.max_events
         if expired > 0:
             try:
                 self.store.delete("Event", "default", f"evt-{expired}")

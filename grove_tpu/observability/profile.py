@@ -179,8 +179,14 @@ class _Phase:
 
 class WallProfiler:
     """Process-global (``PROFILER``), thread-safe: histogram updates are
-    locked (drain_concurrent runs reconciles on worker threads), the phase
-    stack and attribution context are thread-local."""
+    locked (drain_concurrent and the parallel control plane's per-shard
+    workers — runtime/workers.py — run reconciles on worker threads), the
+    phase stack and attribution context are thread-local, so each
+    worker's reconcile phases attribute independently. Under concurrent
+    workers the summed self-times may legitimately EXCEED the measured
+    wall (lanes overlap); the scale block's per-worker utilization
+    (``attribution.by_worker``) groups shard-scoped rows by the
+    shard → worker map, where each single worker's share stays ≤ 1."""
 
     def __init__(self) -> None:
         self.enabled = os.environ.get("GROVE_TPU_PROFILE", "") not in (

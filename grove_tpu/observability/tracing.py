@@ -96,6 +96,14 @@ class Span:
             shard = getattr(tracer._tls, "shard", None)
             if shard is not None:
                 attrs["shard"] = shard
+        # worker attribution (docs/control-plane.md §5 parallel control
+        # plane): the parallel drain stamps the owning worker around each
+        # group, so spans from concurrent reconciles render as separate
+        # worker lanes alongside the shard column
+        if "worker" not in attrs:
+            worker = getattr(tracer._tls, "worker", None)
+            if worker is not None:
+                attrs["worker"] = worker
         self._done = False
         if SPAN_HOOK is not None:
             SPAN_HOOK.span_opened(self)
@@ -191,6 +199,13 @@ class Tracer:
         when sharded; costs nothing while tracing is off (only called
         behind the enabled check)."""
         self._tls.shard = shard
+
+    def set_worker(self, worker: Optional[int]) -> None:
+        """Per-thread worker identity (the parallel control plane's
+        extension of the shard context, docs/control-plane.md §5): spans
+        opened after this carry the reconcile worker as an attribute
+        until cleared with None. Same cost contract as set_shard."""
+        self._tls.worker = worker
 
     # -- export ----------------------------------------------------------
 

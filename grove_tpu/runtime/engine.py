@@ -20,6 +20,7 @@ semantics in common/flow.go.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Tuple
@@ -90,6 +91,16 @@ class Engine:
         self._backlog_rotation = 0
         self.held_kinds: set = set()
         self._pool = None  # lazy engine-lifetime reconcile thread pool
+        # single-drainer contract (docs/control-plane.md §5): the event
+        # routing + workqueue rotation pointers assume exactly ONE thread
+        # drains at a time — under the parallel control plane that thread
+        # is the coordinator. The non-blocking lock turns a concurrent
+        # second drainer from silent pointer corruption into a loud error.
+        self._router_lock = threading.Lock()
+        # parallel control plane (runtime/workers.py, opt-in via
+        # GROVE_TPU_CP_WORKERS=N): per-shard reconcile workers; None keeps
+        # the historical single-threaded drain byte-identically
+        self.workers = None
         # per-kind routing table (built lazily after registration): an event
         # consults only the entries subscribed to its kind instead of
         # iterating every controller × watch per event — at stress scale
@@ -104,6 +115,33 @@ class Engine:
             store.subscribe(self._event_backlog.append)
         else:
             store.subscribe(self._enqueue_sharded)
+        # opt-in concurrent drain: honored only when the store is sharded
+        # (the shard IS the ownership boundary) and supports the deferred
+        # fan-out capture (in-memory Store; HttpStore keeps
+        # drain_concurrent as its threading model)
+        from grove_tpu.runtime.workers import workers_from_env
+
+        env_workers = workers_from_env()
+        if env_workers > 1:
+            self.enable_workers(env_workers)
+
+    def enable_workers(self, workers: int) -> bool:
+        """Arm the parallel control plane (runtime/workers.py,
+        docs/control-plane.md §5): `drain()` partitions each round's
+        batches over per-shard worker threads. No-op (False) when the
+        store is unsharded or cannot defer its per-shard fan-out — the
+        serial drain is the degenerate W=1 case either way."""
+        if workers <= 1 or self.workers is not None:
+            return self.workers is not None
+        if self.num_shards <= 1:
+            return False
+        if getattr(self.store, "arm_deferred_fanout", None) is None:
+            return False
+        from grove_tpu.runtime.workers import ParallelDrain
+
+        self.store.arm_deferred_fanout()
+        self.workers = ParallelDrain(self, workers)
+        return True
 
     def _enqueue_sharded(self, ev: WatchEvent) -> None:
         # WatchEvent.shard is stamped by the store's _emit — no re-hash
@@ -202,6 +240,18 @@ class Engine:
         return None
 
     def _route_events(self) -> None:
+        # single-drainer contract: the backlog rotation pointer and the
+        # workqueue rotation pointers advance under exactly one routing
+        # thread at a time (the serial drainer, or the parallel drain's
+        # coordinator). A second concurrent drainer would silently corrupt
+        # the deterministic round-robin the serial-twin A/B compares
+        # against — fail loudly instead (pinned in tests/test_workers.py).
+        if not self._router_lock.acquire(blocking=False):
+            raise RuntimeError(
+                "concurrent event routing: the engine's rotation pointers"
+                " assume a single drainer (docs/control-plane.md §5) —"
+                " route/drain only from the coordination plane"
+            )
         # disabled profiling costs exactly this one boolean check per round
         prof = (
             PROFILER.phase("dequeue", controller="engine")
@@ -213,6 +263,7 @@ class Engine:
         finally:
             if prof is not None:
                 prof.end()
+            self._router_lock.release()
 
     def _route_events_inner(self) -> None:
         # Drain via popleft until empty: reconciles (and concurrent watch
@@ -290,7 +341,15 @@ class Engine:
 
     def drain(self, max_rounds: int = 10_000) -> int:
         """Process until no controller has a ready item at the current time.
-        Returns the number of reconciles executed."""
+        Returns the number of reconciles executed. With workers armed
+        (enable_workers / GROVE_TPU_CP_WORKERS) the rounds run through the
+        parallel executor — same pop order, per-shard reconcile groups on
+        worker threads (runtime/workers.py)."""
+        if self.workers is not None:
+            if not PROFILER.enabled:
+                return self.workers.drain(max_rounds)
+            with PROFILER.phase("drain", controller="engine"):
+                return self.workers.drain(max_rounds)
         if not PROFILER.enabled:
             return self._drain_rounds(max_rounds)
         # attribution window: the drain loop's own glue (pops, metrics,
@@ -299,7 +358,31 @@ class Engine:
         with PROFILER.phase("drain", controller="engine"):
             return self._drain_rounds(max_rounds)
 
-    def _drain_rounds(self, max_rounds: int) -> int:
+    def _execute_batch(self, ctrl: Controller, batch: List[Key], now) -> None:
+        """Serial batch executor: reconcile each popped key in pop order
+        on this (the draining) thread. The parallel control plane
+        substitutes its per-shard group dispatch here
+        (runtime/workers.py `ParallelDrain._run_batch`) — everything
+        AROUND the executor is the one shared round loop, so the serial
+        and parallel drains cannot structurally drift."""
+        for key in batch:
+            result = error = None
+            try:
+                result = self._timed(ctrl, key)
+            except Exception as e:
+                error = e
+            self._complete(ctrl, key, result, error, now)
+
+    def _drain_rounds(self, max_rounds: int, execute_batch=None) -> int:
+        """THE round loop, shared by the serial drain and the parallel
+        drain (which passes its own `execute_batch`): route, pop each
+        controller's whole ready set in deterministic order, execute,
+        publish gauges, quiesce. One implementation so a future change
+        (a new gauge, a quiescence tweak) can never silently apply to
+        one drain and not the other — the serial-twin A/B's structural
+        half."""
+        if execute_batch is None:
+            execute_batch = self._execute_batch
         executed = 0
         now = self.clock.now()
         for _ in range(max_rounds):
@@ -328,35 +411,25 @@ class Engine:
                 progressed = True
                 executed += len(batch)
                 METRICS.inc(f"reconcile_total/{ctrl.name}", len(batch))
-                span = (
-                    TRACER.span(
-                        "reconcile.batch",
-                        controller=ctrl.name,
-                        keys=len(batch),
-                    )
-                    if TRACER.enabled
-                    else None
-                )
+                span = None
+                if TRACER.enabled:
+                    attrs = {"controller": ctrl.name, "keys": len(batch)}
+                    if self.workers is not None:
+                        attrs["workers"] = self.workers.workers
+                    span = TRACER.span("reconcile.batch", **attrs)
                 if ctrl.batch_hook is not None:
+                    # per-batch memo built BEFORE any execution (under
+                    # workers: on the coordinator, before any worker
+                    # reads it — read-only afterwards)
                     ctrl.batch_hook(batch)
                 try:
-                    for key in batch:
-                        result = error = None
-                        try:
-                            result = self._timed(ctrl, key)
-                        except Exception as e:
-                            error = e
-                        self._complete(ctrl, key, result, error, now)
+                    execute_batch(ctrl, batch, now)
                 finally:
                     if span is not None:
                         span.end()
             for ctrl in self.controllers:
                 METRICS.set(f"workqueue_depth/{ctrl.name}", len(ctrl.queue))
-            if self.num_shards > 1:
-                # per-shard backlog depth: a hot tenant's shard shows up
-                # here while the rotation keeps the others draining
-                for idx, backlog in enumerate(self._backlogs):
-                    METRICS.set(f"engine_shard_backlog@{idx}", len(backlog))
+            self._set_backlog_gauges()
             if not progressed:
                 # new events may have landed during the last round
                 self._route_events()
@@ -366,6 +439,14 @@ class Engine:
             f"engine did not quiesce within {max_rounds} rounds "
             "(reconcile livelock?)"
         )
+
+    def _set_backlog_gauges(self) -> None:
+        """Per-shard backlog depth gauges, once per drain round (a hot
+        tenant's shard shows up here while the rotation keeps the others
+        draining). Shared by the serial and parallel drains."""
+        if self.num_shards > 1:
+            for idx, backlog in enumerate(self._backlogs):
+                METRICS.set(f"engine_shard_backlog@{idx}", len(backlog))
 
     def _timed(self, ctrl: Controller, key):
         t0 = time.perf_counter()
@@ -424,10 +505,13 @@ class Engine:
         return self._pool
 
     def close(self) -> None:
-        """Release the reconcile thread pool (no-op if never threaded)."""
+        """Release the reconcile thread pools (no-op if never threaded)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
+        if self.workers is not None:
+            self.workers.close()
+            self.workers = None
 
     def drain_concurrent(self, max_iterations: int = 100_000) -> int:
         """Threaded drain: each controller runs up to `concurrent_syncs`

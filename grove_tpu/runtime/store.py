@@ -30,6 +30,7 @@ from __future__ import annotations
 import copy as _copy
 import os
 import pickle
+import threading
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -238,6 +239,13 @@ class Store:
         self._summary_dirty_cached = set(range(self.num_shards))
         self._watchers: List[Callable[[WatchEvent], None]] = []
         self._system_watchers: List[Callable[[WatchEvent], None]] = []
+        # per-shard-fanned consumers (subscribe_system_per_shard) + the
+        # deferred-capture plumbing the parallel control plane arms
+        # (runtime/workers.py): inert — a plain list append and two
+        # attributes — until arm_deferred_fanout() runs
+        self._per_shard_fns: List[Callable[[WatchEvent], None]] = []
+        self._deferred_armed = False
+        self._capture_tls = threading.local()
         # copy-on-write commits skip the canonical pickle blob; under the
         # test-mode store guard (GROVE_TPU_STORE_GUARD, or sanitizer mode
         # GROVE_TPU_SANITIZE which generalizes it) they compute it eagerly
@@ -354,9 +362,18 @@ class Store:
         only shard k's events (its slice of the keyspace), so a per-shard
         consumer (a shard's WAL segment stream) never filters — or waits
         on — another shard's traffic. Delivery order within a shard is
-        identical to the unsharded fan-out."""
+        identical to the unsharded fan-out.
+
+        Store-wide (shard=None) consumers see EVERY shard's stream in
+        one global order — under the parallel control plane that order
+        is deferred-and-replayed in the serial batch order (their fold
+        state, e.g. the sim cluster's not-ready working set, must not
+        inherit a racy worker interleave); per-shard consumers (the WAL
+        streams) stay live, their order is per-shard deterministic."""
         if shard is None:
-            self._system_watchers.append(fn)
+            self._system_watchers.append(
+                self._make_deferrable(fn) if self._deferred_armed else fn
+            )
         else:
             self._shards[shard].system_watchers.append(fn)
 
@@ -367,9 +384,77 @@ class Store:
         the per-shard delivery path — in front of any store-wide
         subscriber's traffic for other shards — without maintaining S
         callbacks themselves. At S=1 this is one subscription on the
-        single shard, same delivery order as subscribe_system."""
+        single shard, same delivery order as subscribe_system.
+
+        These consumers fold SHARED, order-sensitive state (a quota
+        row, the delta free matrix) from every shard's stream — under
+        the parallel control plane (runtime/workers.py) their delivery
+        is deferred-and-replayed in the serial order rather than called
+        live from worker threads, so the registry below records exactly
+        which callbacks `arm_deferred_fanout` must wrap (late
+        registrations — delta state attached after the engine armed
+        workers — wrap at registration time)."""
+        self._per_shard_fns.append(fn)
+        target = self._make_deferrable(fn) if self._deferred_armed else fn
         for s in self._shards:
-            s.system_watchers.append(fn)
+            s.system_watchers.append(target)
+
+    # -- deferred fan-out (runtime/workers.py, docs/control-plane.md §5) --
+
+    def arm_deferred_fanout(self) -> None:
+        """Make every ORDER-SENSITIVE watch consumer capturable: while a
+        thread holds an open capture buffer (a parallel reconcile on a
+        worker), deliveries are buffered instead of called, and the
+        coordinator replays them in the serial batch order. Covered:
+        `subscribe_system_per_shard` consumers (delta state, quota
+        accountant — shared fold state whose float accumulation order
+        must equal the serial drain's) AND store-wide `subscribe_system`
+        consumers (the sim cluster's not-ready working set: a Python
+        set's iteration order is its insertion history, and the kubelet
+        + pending scan iterate it — a racy worker interleave there is a
+        nondeterminism leak even though each add/discard is atomic).
+        Threads with no open buffer (the scheduler, kubelet, component
+        ticks on the coordinator) keep live delivery — the serial
+        path's behavior exactly. Installed once, only when the engine
+        arms workers; the serial drain never pays the extra
+        thread-local read."""
+        if self._deferred_armed:
+            return
+        self._deferred_armed = True
+        wrapped = {fn: self._make_deferrable(fn) for fn in self._per_shard_fns}
+        for s in self._shards:
+            s.system_watchers = [
+                wrapped.get(w, w) for w in s.system_watchers
+            ]
+        self._system_watchers = [
+            self._make_deferrable(w) for w in self._system_watchers
+        ]
+
+    def _make_deferrable(self, fn: Callable[[WatchEvent], None]):
+        tls = self._capture_tls
+
+        def deliver(ev: WatchEvent, _fn=fn, _tls=tls) -> None:
+            buf = getattr(_tls, "buf", None)
+            if buf is None:
+                _fn(ev)
+            else:
+                buf.append((_fn, ev))
+
+        return deliver
+
+    def begin_deferred_capture(self) -> list:
+        """Open a capture buffer on THIS thread (one parallel reconcile's
+        deferred deliveries). Returns the buffer to pass to
+        `end_deferred_capture`."""
+        buf: list = []
+        self._capture_tls.buf = buf
+        return buf
+
+    def end_deferred_capture(self, buf: list) -> list:
+        """Close this thread's capture buffer and return its (fn, event)
+        deliveries for the coordinator's in-order replay."""
+        self._capture_tls.buf = None
+        return buf
 
     def _emit(
         self,

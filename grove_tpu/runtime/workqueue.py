@@ -15,6 +15,15 @@ shard's bucket and get their rotation turn). The delayed heap stays
 global: it is time-ordered, and promotion is by readiness, not shard.
 At ``num_shards=1`` there is one bucket and the pointer is pinned at 0 —
 pop order is the historical FIFO, byte-identical.
+
+Single-drainer contract (docs/control-plane.md §5): the rotation pointer
+and buckets assume exactly ONE popping thread. Under the parallel
+control plane (runtime/workers.py) that thread is the coordinator — it
+pops each round's whole batch in this queue's deterministic order and
+only then fans the per-shard groups out to their owning workers, so the
+pop order (and therefore each shard's reconcile sub-order) is
+byte-identical to the serial drain's. Workers never pop; grovelint
+GL018 keeps the bucket state private to the owning modules.
 """
 
 from __future__ import annotations

@@ -108,6 +108,7 @@ def converge_population(
     frontier: bool = False,
     frontier_selfcheck: bool = False,
     glassbox: bool = False,
+    workers: int = 0,
 ) -> Tuple[SimHarness, dict]:
     """Apply + converge one multi-tenant population on a fresh harness;
     returns (harness, report).
@@ -126,6 +127,12 @@ def converge_population(
     (the smoke's setting — measurement runs keep it off and report the
     overhead ledger as 0).
 
+    ``workers>1`` arms the parallel control plane (runtime/workers.py,
+    docs/control-plane.md §5): per-shard reconcile workers, serial-twin
+    deterministic. 0 defers to the GROVE_TPU_CP_WORKERS env opt-in the
+    engine already honors; the report's ``workers`` field records what
+    actually ran, and armed runs add per-worker busy-share utilization.
+
     ``glassbox=True`` arms the wall-attribution profiler and the
     gang-journey tracer for the CONVERGE window (never the apply loop)
     and adds ``"attribution"`` / ``"admission_latency"`` /
@@ -138,6 +145,14 @@ def converge_population(
     tenants = tenant_namespaces(min(n_tenants, max(n_sets, 1)))
     store = Store(VirtualClock(), cache_lag=True, num_shards=num_shards)
     h = SimHarness(num_nodes=n_nodes, store=store)
+    if workers > 0 and (
+        h.engine.workers is None or h.engine.workers.workers != workers
+    ):
+        # an explicit worker count wins over whatever the env auto-armed
+        # (enable_workers is a no-op once armed, so mismatches re-arm)
+        h.engine.close()
+        if workers > 1:
+            h.engine.enable_workers(workers)
     if frontier:
         h.scheduler.enable_frontier()
         h.scheduler.frontier_selfcheck = frontier_selfcheck
@@ -165,6 +180,14 @@ def converge_population(
             JOURNEYS.enable()
             JOURNEYS.reset()
             JOURNEYS.clock = h.clock
+        # window-align the busy-share utilization with the attribution
+        # cross-check: both cover the CONVERGE only (the profiler arms at
+        # converge start), so the two per-worker numbers are comparable
+        busy0 = (
+            h.engine.workers.busy_snapshot()
+            if h.engine.workers is not None
+            else None
+        )
         t_conv0 = time.perf_counter()
         h.converge(max_ticks=max_ticks or (60 + 8 * n_sets))
         converge_wall = time.perf_counter() - t_conv0
@@ -177,7 +200,15 @@ def converge_population(
             solver_glass = (
                 METRICS.hist_sum.get("gang_solve_seconds", 0.0) - solver_s0
             )
-            glass = glassbox_blocks(converge_wall, solver_glass)
+            glass = glassbox_blocks(
+                converge_wall,
+                solver_glass,
+                worker_of=(
+                    h.engine.workers.worker_of
+                    if h.engine.workers is not None
+                    else None
+                ),
+            )
     finally:
         gc.enable()
         gc.unfreeze()
@@ -212,15 +243,35 @@ def converge_population(
             "after_apply": rss_after_apply,
             "after_converge": rss_after_converge,
         },
+        # the parallel control plane's footprint in this run (1 = the
+        # serial drain; docs/control-plane.md §5)
+        "workers": (
+            h.engine.workers.workers if h.engine.workers is not None else 1
+        ),
     }
+    if h.engine.workers is not None:
+        stats = h.engine.workers.stats()
+        stats["utilization"] = h.engine.workers.utilization(
+            converge_wall, since=busy0
+        )
+        report["parallel"] = stats
     if frontier and h.scheduler.frontier is not None:
         report["frontier"] = h.scheduler.frontier.stats()
     if glassbox and glass is not None:
         report.update(glass)
+        if (
+            h.engine.workers is not None
+            and "by_worker" in report.get("attribution", {})
+        ):
+            report["parallel"]["attributed_utilization"] = report[
+                "attribution"
+            ]["by_worker"]
     return h, report
 
 
-def glassbox_blocks(converge_wall: float, solver_s: float) -> dict:
+def glassbox_blocks(
+    converge_wall: float, solver_s: float, worker_of=None
+) -> dict:
     """Freeze the glass-box layer into bench blocks and disarm it.
 
     ``attribution``: the profiler roll-up with TWO coverage ratios —
@@ -228,11 +279,30 @@ def glassbox_blocks(converge_wall: float, solver_s: float) -> dict:
     solver included on both sides) and ``cp_coverage`` (the same with
     the solve-phase rows subtracted from both sides: the CP-only claim
     the acceptance gate reads). ``admission_latency``/``critical_path``:
-    the journey decomposition and its top-down fold."""
+    the journey decomposition and its top-down fold.
+
+    ``worker_of`` (a shard → worker map, supplied when the parallel
+    control plane ran): adds ``by_worker`` — every shard-scoped
+    self-time row grouped onto its owning reconcile worker as a share
+    of the converge wall, the scale block's per-worker utilization
+    (docs/control-plane.md §5). Computed over the FULL row set, before
+    the artifact keeps only the top sinks."""
     from grove_tpu.observability.journey import JOURNEYS
     from grove_tpu.observability.profile import PROFILER
 
     attribution = PROFILER.report(wall_seconds=converge_wall)
+    if worker_of is not None:
+        by_worker: dict = {}
+        for ph in attribution["phases"]:
+            shard = ph["shard"]
+            if shard is None or shard < 0:
+                continue
+            w = worker_of(shard)
+            by_worker[w] = by_worker.get(w, 0.0) + ph["total_s"]
+        attribution["by_worker"] = {
+            str(w): round(s / max(converge_wall, 1e-9), 4)
+            for w, s in sorted(by_worker.items())
+        }
     solve_attr = sum(
         ph["total_s"]
         for ph in attribution["phases"]
@@ -280,18 +350,30 @@ def inert_ab(
     equal scalar resourceVersion (total commit count), equal admissions.
 
     A throwaway warmup converge runs first so neither side is billed the
-    solver's XLA compile — the wall comparison is control-plane work."""
+    solver's XLA compile — the wall comparison is control-plane work.
+
+    Both arms are PINNED serial (workers=1): this A/B's walls are
+    compared across PRs, and an ambient GROVE_TPU_CP_WORKERS would
+    otherwise arm only the sharded arm's engine — a different executor
+    per arm, exactly what the comparison must exclude."""
     from grove_tpu.sim.recovery import store_dump
 
-    converge_population(min(n_sets, 16), min(n_nodes, 16), num_shards=1)
-    h1, r1 = converge_population(n_sets, n_nodes, num_shards=1)
-    hs, rs = converge_population(n_sets, n_nodes, num_shards=num_shards)
+    _wh, _wr = converge_population(
+        min(n_sets, 16), min(n_nodes, 16), num_shards=1, workers=1
+    )
+    _close_harness(_wh)
+    h1, r1 = converge_population(n_sets, n_nodes, num_shards=1, workers=1)
+    hs, rs = converge_population(
+        n_sets, n_nodes, num_shards=num_shards, workers=1
+    )
     dump1 = _rv_normalized(
         store_dump(h1.store, canonical_uids=True, include_events=False)
     )
     dumps = _rv_normalized(
         store_dump(hs.store, canonical_uids=True, include_events=False)
     )
+    _close_harness(h1)
+    _close_harness(hs)
     return {
         "sets": n_sets,
         "shards_b": num_shards,
@@ -349,16 +431,25 @@ def frontier_ab(
     warmup gangs), so one pow2 batch-lane compile can land in the
     partitioned arm's wall — conservative against the speedup, noted
     rather than hidden."""
-    converge_population(min(n_sets, 16), n_nodes, num_shards=1)
-    converge_population(
-        min(n_sets, 16), n_nodes, num_shards=1, frontier=True
+    # both arms pinned serial (workers=1) for the same reason as
+    # inert_ab: the ≥1.8× wall gate compares against PR-10-era numbers,
+    # so an ambient GROVE_TPU_CP_WORKERS must not change the executor
+    _w1, _r1 = converge_population(
+        min(n_sets, 16), n_nodes, num_shards=1, workers=1
     )
-    _off_h, off = converge_population(n_sets, n_nodes, num_shards)
+    _close_harness(_w1)
+    _w2, _r2 = converge_population(
+        min(n_sets, 16), n_nodes, num_shards=1, frontier=True, workers=1
+    )
+    _close_harness(_w2)
+    _off_h, off = converge_population(n_sets, n_nodes, num_shards, workers=1)
+    _close_harness(_off_h)
     del _off_h
     gc.collect()
     _on_h, on = converge_population(
-        n_sets, n_nodes, num_shards, frontier=True
+        n_sets, n_nodes, num_shards, frontier=True, workers=1
     )
+    _close_harness(_on_h)
     del _on_h
     gc.collect()
     return {
@@ -385,21 +476,34 @@ def scale_artifact(
     num_shards: int = 8,
     ab_sets: int = 192,
     frontier_ab_shape: Tuple[int, int] = (512, 512),
+    workers: int = 0,
+    shape_1m: Optional[Tuple[int, int, int]] = None,
 ) -> dict:
     """The bench ``"scale"`` block: the big sharded converge (partitioned
-    frontier ON — the PR 10 configuration) + the small S=1 inert A/B +
-    the paired frontier on/off A/B. Caller picks the shape (the
-    integrated bench passes the full 100k-node shape only on full-size
-    runs)."""
+    frontier ON — the PR 10 configuration; parallel control plane per
+    ``workers``/GROVE_TPU_CP_WORKERS — the PR 15 configuration) + the
+    small S=1 inert A/B + the paired frontier on/off A/B. Caller picks
+    the shape (the integrated bench passes the full 100k-node shape only
+    on full-size runs).
+
+    ``shape_1m``: (sets, nodes, shards) of the ROADMAP's 1M-pod shape —
+    when given, a second DARK converge runs it (workers + frontier on)
+    and lands under ``"shape_1m"``; the gate is that the shape is
+    benchable at all, so the row reports whatever wall it measures."""
     # glassbox=True: the headline converge ships its own wall-attribution
     # ledger ("attribution": per-(controller, shard, phase) with the
-    # ≥95%-coverage claim) and per-gang admission decomposition — the
-    # before/after evidence the parallel-CP PR is gated on. The A/Bs
+    # ≥95%-coverage claim, plus per-worker utilization when the parallel
+    # control plane ran) and per-gang admission decomposition. The A/Bs
     # below stay dark so their walls are comparable across PRs.
     harness, report = converge_population(
-        n_sets, n_nodes, num_shards, frontier=True, glassbox=True
+        n_sets, n_nodes, num_shards, frontier=True, glassbox=True,
+        workers=workers,
     )
     # release the big population before the A/B runs its twin harnesses
+    # (engine.close() first: GC alone leaves the armed ParallelDrain's
+    # worker threads alive for the process lifetime; the frontier's
+    # device pool likewise)
+    _close_harness(harness)
     del harness
     gc.collect()
     report["inert_ab"] = inert_ab(n_sets=ab_sets, num_shards=num_shards)
@@ -408,4 +512,23 @@ def scale_artifact(
         n_nodes=frontier_ab_shape[1],
         num_shards=num_shards,
     )
+    if shape_1m is not None:
+        m_sets, m_nodes, m_shards = shape_1m
+        gc.collect()
+        m_harness, m_report = converge_population(
+            m_sets, m_nodes, m_shards, frontier=True, workers=workers
+        )
+        _close_harness(m_harness)
+        del m_harness
+        gc.collect()
+        report["shape_1m"] = m_report
     return report
+
+
+def _close_harness(h: SimHarness) -> None:
+    """Release a retired harness's thread pools (the parallel drain's
+    workers, the frontier's device pool) — GC alone leaves executor
+    threads alive until process exit."""
+    h.engine.close()
+    if h.scheduler is not None and h.scheduler.frontier is not None:
+        h.scheduler.frontier.close()

@@ -423,6 +423,7 @@ def build_problem_cached(
     gang_specs: List[dict],
     pad_gangs: Optional[int] = None,
     pad_groups: Optional[int] = None,
+    pre_encoded: Optional[tuple] = None,
 ) -> PackingProblem:
     """Assemble a problem from a cached :class:`NodeEncoding` and an
     externally-maintained free-capacity matrix (the delta-solve hot path:
@@ -432,7 +433,15 @@ def build_problem_cached(
     ``capacity`` must hold the same float32 values a from-scratch encode
     would produce for the current free capacity — the caller (the delta
     state) owns that contract, and the result is then bit-identical to
-    :func:`build_problem`."""
+    :func:`build_problem`.
+
+    ``pre_encoded``: an :func:`encode_gangs` result computed earlier for
+    the SAME (gang_specs, pad_gangs, pad_groups) — the frontier's
+    residual-overlap path encodes the gang tensors while the device
+    executes the partition solves and assembles here once the
+    post-partition capacity is known (docs/solver.md "Residual
+    overlap"). encode_gangs is pure, so reusing its output is
+    bit-identical to recomputing it."""
     return _assemble_problem(
         capacity,
         enc.topo,
@@ -445,6 +454,7 @@ def build_problem_cached(
         gang_specs,
         pad_gangs,
         pad_groups,
+        pre_encoded=pre_encoded,
     )
 
 
@@ -460,9 +470,13 @@ def _assemble_problem(
     gang_specs: List[dict],
     pad_gangs: Optional[int],
     pad_groups: Optional[int],
+    pre_encoded: Optional[tuple] = None,
 ) -> PackingProblem:
     """Gang-side half of the encode (shared by the from-scratch and cached
-    paths so the two can never diverge)."""
+    paths so the two can never diverge). ``pre_encoded`` short-circuits
+    the :func:`encode_gangs` call with a result computed earlier for the
+    same arguments (the frontier's residual-overlap path; encode_gangs is
+    pure, so the tensors are bit-identical either way)."""
     (
         demand,
         count,
@@ -476,7 +490,13 @@ def _assemble_problem(
         spread_required,
         gang_names,
         group_names,
-    ) = encode_gangs(gang_specs, resource_names, level_keys, pad_gangs, pad_groups)
+    ) = (
+        pre_encoded
+        if pre_encoded is not None
+        else encode_gangs(
+            gang_specs, resource_names, level_keys, pad_gangs, pad_groups
+        )
+    )
 
     capacity, demand = _quantize_resources(capacity, demand)
 

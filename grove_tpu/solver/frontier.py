@@ -75,6 +75,31 @@ MIN_SUB_GANG_BUCKET = 8
 RESIDUAL = -1
 
 
+def frontier_devices() -> list:
+    """Devices the stacked lanes spread over (docs/solver.md
+    "Multi-device dispatch"): ``GROVE_TPU_FRONTIER_DEVICES=N`` pins the
+    first N local devices, ``all`` takes every one. Default is the
+    single-device path — byte-identical to PR 10's dispatch, and the
+    right call on the test rig's VIRTUAL 8-device CPU mesh, where every
+    "device" shares one physical core and spreading buys only compile
+    time. ``[None]`` means default placement (no device pinning at
+    all)."""
+    import os
+
+    raw = os.environ.get("GROVE_TPU_FRONTIER_DEVICES", "").strip().lower()
+    if raw in ("", "0", "1"):
+        return [None]
+    import jax
+
+    devs = list(jax.devices())
+    if raw != "all":
+        try:
+            devs = devs[: max(int(raw), 1)]
+        except ValueError:
+            return [None]
+    return devs if len(devs) > 1 else [None]
+
+
 class FrontierPlan:
     """Partition table for one NodeEncoding: the frontier level, its
     contiguous node slabs, and lazily-built per-slab sub-encodings."""
@@ -126,6 +151,21 @@ class FrontierState:
         self.last_residual_fraction = 0.0
         self.last_overlap_occupancy = 0.0
         self.selfcheck_seconds = 0.0
+        # multi-device lane spread (docs/solver.md "Multi-device
+        # dispatch"): the devices stacks are pinned to; [None] = the
+        # single-device default-placement path, byte-identical to PR 10
+        self.devices = frontier_devices()
+        self.last_devices_used = 1
+        # persistent device-dispatch pool (multi-device runs only):
+        # per-bucket executor construction would pay thread spawn/join on
+        # every dispatch of every solve — built lazily once, state-lifetime
+        self._device_pool = None
+        # residual-overlap ledger (docs/solver.md "Residual overlap"):
+        # hits = the speculative gang encode (overlapped with device
+        # execution) was reused; misses = local rejects forced a
+        # re-encode on the serial path
+        self.residual_overlap_hits = 0
+        self.residual_overlap_misses = 0
 
     # -- registration API (GL014) ----------------------------------------
 
@@ -136,6 +176,14 @@ class FrontierState:
         this module)."""
         self._plan = None
         self._plan_enc = None
+
+    def close(self) -> None:
+        """Release the device-dispatch pool (created only by multi-device
+        runs; the mirror of Engine.close's ParallelDrain release —
+        processes that build many schedulers should close retired ones)."""
+        if self._device_pool is not None:
+            self._device_pool.shutdown(wait=False, cancel_futures=True)
+            self._device_pool = None
 
     # -- plan ------------------------------------------------------------
 
@@ -397,19 +445,51 @@ class FrontierState:
             buckets.setdefault((lane["g_pad"], n_pad), []).append(lane)
         bucket_keys = sorted(buckets)
 
+        devices = self.devices
+        devices_used = 1
+
         def encode_bucket(key):
+            """One bucket's lane problems + its per-device stacks:
+            [(device, stack, real_lane_count)]. With one device (the
+            default) this is exactly the PR 10 single-stack path; with
+            D devices the bucket's lanes split into contiguous groups in
+            lane order — each lane's tensors, chunking and seeds are
+            lane-local, so the split composes bit-identically (the same
+            inert-lane property the pow2 batch padding already relies
+            on, and the selfcheck below re-verifies per lane)."""
             g_pad, n_bucket = key
-            for lane in buckets[key]:
+            lanes_k = buckets[key]
+            for lane in lanes_k:
                 lane["problem"] = self._build_lane(
                     enc, free, plan, lane["k"], lane["idxs"], gang_specs,
                     g_pad, pad_groups, n_bucket, resource_names,
                 )
-            return self._stack_bucket(
-                [lane["problem"] for lane in buckets[key]]
-            )
+            n_groups = min(len(devices), len(lanes_k))
+            if n_groups <= 1:
+                return [
+                    (
+                        devices[0],
+                        self._stack_bucket([l["problem"] for l in lanes_k]),
+                        len(lanes_k),
+                    )
+                ]
+            per = (len(lanes_k) + n_groups - 1) // n_groups
+            groups = [
+                lanes_k[i : i + per] for i in range(0, len(lanes_k), per)
+            ]
+            return [
+                (
+                    devices[d],
+                    self._stack_bucket([l["problem"] for l in grp]),
+                    len(grp),
+                )
+                for d, grp in enumerate(groups)
+            ]
 
         # double-buffered pipeline: the device executes bucket k while the
-        # host encodes bucket k+1 (JAX releases the GIL in device compute)
+        # host encodes bucket k+1 (JAX releases the GIL in device compute);
+        # after the LAST bucket's submit the host instead pre-encodes the
+        # residual pass's gang tensors (the "Residual overlap" half)
         from concurrent.futures import ThreadPoolExecutor
 
         from grove_tpu.solver.kernel import solve_waves_stacked
@@ -418,19 +498,88 @@ class FrontierState:
         execute_wall = 0.0
         overlapped = 0.0
         bucket_results: Dict[tuple, dict] = {}
+        # gangs KNOWN residual at assignment time — the speculative
+        # encode target (local rejects, unknowable until results, force
+        # the miss path)
+        assigned_residual = [
+            i for i in range(len(part_of)) if part_of[i] == RESIDUAL
+        ]
+        pre_encoded = None
+        pre_encoded_idxs = None
 
-        def run(stack):
+        def run(stacks):
+            nonlocal devices_used
             t = time.perf_counter()
-            out = solve_waves_stacked(
-                stack, chunk_size=sched.chunk_size,
-                max_waves=sched.max_waves,
-            )
-            out["wall"] = time.perf_counter() - t
-            return out
+            if len(stacks) == 1:
+                # single stack (the default single-device path): return
+                # the kernel output dict directly, exactly PR 10 —
+                # consumers index only real lanes/gangs, so trimming the
+                # padded batch lanes would just copy every result tensor
+                # (alloc is [B,G,P,N]) for nothing
+                dev, stack, _n_real = stacks[0]
+                out = solve_waves_stacked(
+                    stack,
+                    chunk_size=sched.chunk_size,
+                    max_waves=sched.max_waves,
+                    device=dev,
+                )
+                out["wall"] = time.perf_counter() - t
+                return out
+            else:
+                devices_used = max(devices_used, len(stacks))
+                if self._device_pool is None:
+                    self._device_pool = ThreadPoolExecutor(
+                        max_workers=len(self.devices),
+                        thread_name_prefix="frontier-dev",
+                    )
+                futs = [
+                    self._device_pool.submit(
+                        solve_waves_stacked,
+                        stack,
+                        chunk_size=sched.chunk_size,
+                        max_waves=sched.max_waves,
+                        device=dev,
+                    )
+                    for dev, stack, _n in stacks
+                ]
+                outs = [
+                    (fut.result(), n)
+                    for fut, (_d, _s, n) in zip(futs, stacks)
+                ]
+            # merge per-device groups back in lane order (groups are
+            # contiguous lane ranges; padded batch lanes trimmed)
+            merged = {
+                field: np.concatenate(
+                    [out[field][:n] for out, n in outs]
+                )
+                for field in (
+                    "admitted", "placed", "score", "chosen_level", "alloc"
+                )
+            }
+            merged["dispatches"] = sum(out["dispatches"] for out, _n in outs)
+            merged["wall"] = time.perf_counter() - t
+            return merged
 
-        if len(bucket_keys) == 1:
-            # one bucket ⇒ nothing to overlap: run inline rather than
-            # paying thread spawn/join on the common small-tick path
+        def encode_residual():
+            """Speculative residual gang encode, overlapped with device
+            execution; reused by build_problem_cached on the hit path
+            (encode_gangs is pure — bit-identical either way)."""
+            nonlocal pre_encoded, pre_encoded_idxs
+            from grove_tpu.solver.encode import encode_gangs
+
+            pre_encoded_idxs = list(assigned_residual)
+            pre_encoded = encode_gangs(
+                [gang_specs[i] for i in pre_encoded_idxs],
+                resource_names,
+                list(enc.level_keys),
+                None,
+                pad_groups,
+            )
+
+        if len(bucket_keys) == 1 and not assigned_residual:
+            # one bucket and nothing to pre-encode ⇒ nothing to overlap:
+            # run inline rather than paying thread spawn/join on the
+            # common small-tick path
             key = bucket_keys[0]
             out = run(encode_bucket(key))
             bucket_results[key] = out
@@ -439,15 +588,17 @@ class FrontierState:
         elif bucket_keys:
             with ThreadPoolExecutor(max_workers=1) as pool:
                 pending = list(bucket_keys)
-                next_stack = encode_bucket(pending[0])
+                next_stacks = encode_bucket(pending[0])
                 while pending:
                     key = pending.pop(0)
-                    stack = next_stack
+                    stacks = next_stacks
                     t_submit = time.perf_counter()
-                    future = pool.submit(run, stack)
-                    next_stack = None
+                    future = pool.submit(run, stacks)
+                    next_stacks = None
                     if pending:
-                        next_stack = encode_bucket(pending[0])
+                        next_stacks = encode_bucket(pending[0])
+                    elif assigned_residual and pre_encoded is None:
+                        encode_residual()
                     encode_elapsed = time.perf_counter() - t_submit
                     out = future.result()
                     bucket_results[key] = out
@@ -499,13 +650,35 @@ class FrontierState:
             from grove_tpu.solver.encode import build_problem_cached
             from grove_tpu.solver.kernel import solve_waves
 
-            residual_problem = build_problem_cached(
-                enc,
-                free_after,
-                [gang_specs[i] for i in residual_idxs],
-                None,
-                pad_groups,
-            )
+            if pre_encoded is not None and residual_idxs == pre_encoded_idxs:
+                # overlap HIT: the gang tensors were encoded while the
+                # device executed the partition solves — only the
+                # capacity half (which needed the post-partition fold)
+                # is assembled now
+                self.residual_overlap_hits += 1
+                METRICS.inc("frontier_residual_overlap_hits_total")
+                residual_problem = build_problem_cached(
+                    enc,
+                    free_after,
+                    [gang_specs[i] for i in residual_idxs],
+                    None,
+                    pad_groups,
+                    pre_encoded=pre_encoded,
+                )
+            else:
+                # miss: local rejects joined the residual after the
+                # speculative encode (or no bucket overlapped it) —
+                # re-encode on the serial path, exactly PR 10's behavior
+                if pre_encoded is not None:
+                    self.residual_overlap_misses += 1
+                    METRICS.inc("frontier_residual_overlap_misses_total")
+                residual_problem = build_problem_cached(
+                    enc,
+                    free_after,
+                    [gang_specs[i] for i in residual_idxs],
+                    None,
+                    pad_groups,
+                )
             residual_result = solve_waves(
                 residual_problem,
                 chunk_size=sched.chunk_size,
@@ -534,6 +707,8 @@ class FrontierState:
             len(residual_idxs) / max(len(gang_specs), 1)
         )
         self.last_overlap_occupancy = overlapped / max(execute_wall, 1e-9)
+        self.last_devices_used = devices_used
+        METRICS.set("frontier_devices", devices_used)
         METRICS.inc("frontier_solves_total")
         METRICS.set("frontier_subproblems", self.last_subproblems)
         METRICS.set(
@@ -680,5 +855,9 @@ class FrontierState:
             "last_overlap_occupancy": round(
                 self.last_overlap_occupancy, 4
             ),
+            "devices": len(self.devices),
+            "last_devices_used": self.last_devices_used,
+            "residual_overlap_hits": self.residual_overlap_hits,
+            "residual_overlap_misses": self.residual_overlap_misses,
             "ab_overhead_ms": round(self.selfcheck_seconds * 1e3, 1),
         }

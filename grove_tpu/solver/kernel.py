@@ -413,6 +413,7 @@ def solve_waves_stacked(
     stack: Dict[str, np.ndarray],
     chunk_size: int = 32,
     max_waves: int = 16,
+    device=None,
 ) -> Dict[str, np.ndarray]:
     """Wave-parallel solve of a STACK of same-shape subproblems — the
     partitioned frontier's batch execution (solver/frontier.py).
@@ -430,8 +431,22 @@ def solve_waves_stacked(
 
     Bit-identity per lane vs a solo ``solve_waves`` run on the same
     subproblem tensors is the frontier selfcheck's contract
-    (tests/test_frontier.py, ``make frontier-smoke``)."""
+    (tests/test_frontier.py, ``make frontier-smoke``).
+
+    ``device``: an explicit jax device to pin every operand (and so the
+    jitted dispatch) to — the frontier's multi-device lane spread
+    (docs/solver.md "Multi-device dispatch") runs one stack per device
+    concurrently. None keeps default placement — byte-identical to the
+    single-device path."""
     from grove_tpu.ops.packing import solve_wave_chunk_stack
+
+    if device is None:
+        _put = jnp.asarray
+    else:
+        import jax as _jax
+
+        def _put(a, _dev=device):
+            return _jax.device_put(a, _dev)
 
     demand = stack["demand"]
     b, g, p_max, _r = demand.shape
@@ -460,10 +475,10 @@ def solve_waves_stacked(
     spread_seed = pad(stack["spread_seed"])
 
     _maybe_enable_disk_cache()
-    free = jnp.asarray(stack["capacity"])
-    topo = jnp.asarray(stack["topo"])
-    seg_starts = jnp.asarray(stack["seg_starts"])
-    seg_ends = jnp.asarray(stack["seg_ends"])
+    free = _put(stack["capacity"])
+    topo = _put(stack["topo"])
+    seg_starts = _put(stack["seg_starts"])
+    seg_ends = _put(stack["seg_ends"])
     n_levels = stack["topo"].shape[2]
     pending = np.zeros((b, g_pad), dtype=bool)
     pending[:, :g] = True
@@ -484,7 +499,7 @@ def solve_waves_stacked(
 
     chunk_const = [
         tuple(
-            jnp.asarray(a[:, c * chunk_size : (c + 1) * chunk_size])
+            _put(a[:, c * chunk_size : (c + 1) * chunk_size])
             for a in (
                 demand, count, min_count, req_level, pref_level,
                 group_req, group_pin, gang_pin,
@@ -520,9 +535,9 @@ def solve_waves_stacked(
                 out = solve_wave_chunk_stack(
                     free, topo, seg_starts, seg_ends,
                     dem_c, cnt_c, mn_c, rq_c, pf_c,
-                    jnp.asarray(mask),
-                    jnp.asarray(narrow_cap[:, sl]),
-                    jnp.asarray(np.ascontiguousarray(seeds[:, sl])),
+                    _put(mask),
+                    _put(narrow_cap[:, sl]),
+                    _put(np.ascontiguousarray(seeds[:, sl])),
                     grq_c, gpin_c, gangpin_c,
                     slvl_c, smin_c, sreq_c, sseed_c,
                     grouped=grouped, pinned=pinned, spread=spread,

@@ -536,15 +536,27 @@ class GangScheduler:
             # or the exposition would report phantom pending work
             # forever; targeted single-namespace calls leave the gauges
             # alone (they see one namespace, not the round's demand).
+            #
+            # Semantics under the parallel control plane (docs/
+            # control-plane.md §5): the gauges describe the most recent
+            # FULL scheduling round's demand — the scheduler runs only
+            # on the coordination plane, `namespaces` is sorted (the
+            # deterministic order the serial twin compares against), and
+            # the shard-set swap below is a single atomic assignment so
+            # a concurrent reader (explain/introspection off another
+            # thread) never observes a torn previous-round set.
             by_shard: Dict[int, int] = {}
             for ns in namespaces:
                 idx = self.store.shard_index(ns)
                 by_shard[idx] = by_shard.get(idx, 0) + 1
-            for idx in self._pending_ns_shards - set(by_shard):
+            previous, self._pending_ns_shards = (
+                self._pending_ns_shards,
+                set(by_shard),
+            )
+            for idx in sorted(previous - set(by_shard)):
                 METRICS.set(f"pending_namespaces@{idx}", 0)
-            for idx, count in by_shard.items():
+            for idx, count in sorted(by_shard.items()):
                 METRICS.set(f"pending_namespaces@{idx}", count)
-            self._pending_ns_shards = set(by_shard)
         self.cluster._gc_bindings()
         if self.delta is not None:
             # BEFORE the pending scan: a topology change (cordon, flap,

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Parallel-control-plane smoke (`make parallel-smoke`,
+docs/control-plane.md §5).
+
+Acceptance bar for the concurrent shard reconcile workers:
+
+- the serial-twin A/B is bit-identical through a seeded cross-shard
+  event storm at EVERY converge boundary — admissions + store content
+  (canonical uids, Events excluded), reconcile counts, scalar
+  resourceVersion, AND the per-shard WAL acked prefixes;
+- a worker-count sweep (1/2/4/8) over one population converges
+  all-Ready in every arm with identical reconcile counts, printing
+  µs/reconcile + speedup per arm (honest on GIL builds: the sweep
+  proves bounded overhead; free-threaded builds are where the
+  ownership boundaries pay out);
+- the chaos-matrix SANITIZED arm (TrackingLock lock-order, store
+  guard, accountant recounts, span leaks) passes with workers >= 2 on
+  a 3-shard store.
+
+Exit 0 only when every gate holds.
+
+Usage: python scripts/parallel_smoke.py [--sets N] [--workers N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+# CPU pin before jax import: the smoke must not hang on a wedged accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from a checkout without an installed package
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _sanitized_chaos_arm() -> dict:
+    """chaos_smoke --sanitize re-run with workers armed on a sharded
+    store (subprocess: the env opt-ins must bind before any harness
+    builds, and the chaos run swaps whole control planes)."""
+    env = dict(os.environ)
+    env["GROVE_TPU_STORE_SHARDS"] = "3"
+    env["GROVE_TPU_CP_WORKERS"] = "2"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(ROOT, "scripts", "chaos_smoke.py"),
+            "--seeds",
+            "42",
+            "--sanitize",
+            "--sanitize-seed",
+            "42",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    return {
+        "ok": proc.returncode == 0,
+        "returncode": proc.returncode,
+        "tail": proc.stdout.strip().splitlines()[-2:]
+        + proc.stderr.strip().splitlines()[-2:],
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sets", type=int, default=24)
+    parser.add_argument("--nodes", type=int, default=24)
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--skip-chaos", action="store_true")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    from grove_tpu.sim.parallel import parallel_ab, worker_sweep
+
+    problems = []
+
+    # 1. serial-twin A/B with per-shard WALs
+    d_serial = tempfile.mkdtemp(prefix="grove-parallel-ab-s-")
+    d_workers = tempfile.mkdtemp(prefix="grove-parallel-ab-w-")
+    try:
+        ab = parallel_ab(
+            n_sets=args.sets,
+            n_nodes=args.nodes,
+            num_shards=args.shards,
+            workers=args.workers,
+            seed=args.seed,
+            storm_rounds=2,
+            wal_dirs=(d_serial, d_workers),
+        )
+    finally:
+        shutil.rmtree(d_serial, ignore_errors=True)
+        shutil.rmtree(d_workers, ignore_errors=True)
+    problems.extend(ab["problems"])
+    if ab["wal_acked_identical"] is not True:
+        problems.append("WAL acked-prefix comparison did not pass")
+    busy = [n for n in ab["worker_stats"]["reconciles_by_worker"] if n]
+    if len(busy) < 2:
+        problems.append("A/B run never spread reconciles over >=2 workers")
+
+    # 2. worker-count sweep
+    sweep = worker_sweep(
+        n_sets=max(args.sets * 2, 32),
+        n_nodes=max(args.nodes, 32),
+        num_shards=args.shards,
+        worker_counts=(1, 2, 4, 8),
+    )
+    counts = {row["reconciles"] for row in sweep["sweep"]}
+    if len(counts) != 1:
+        problems.append(f"sweep arms reconciled differently: {sorted(counts)}")
+    for row in sweep["sweep"]:
+        if not row["all_ready"]:
+            problems.append(f"workers={row['workers']} arm not all-Ready")
+
+    # 3. sanitized chaos arm with workers >= 2
+    chaos = {"skipped": True}
+    if not args.skip_chaos:
+        chaos = _sanitized_chaos_arm()
+        if not chaos["ok"]:
+            problems.append(
+                f"sanitized chaos arm (3 shards, 2 workers) failed: {chaos}"
+            )
+
+    report = {
+        "ab": ab,
+        "sweep": sweep,
+        "sanitized_chaos": chaos,
+        "problems": problems,
+        "ok": not problems,
+    }
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(
+            f"serial-twin A/B: {ab['boundaries_compared']} converge"
+            f" boundaries compared at workers={args.workers} —"
+            f" identical={ab['identical']},"
+            f" wal_acked_identical={ab['wal_acked_identical']}"
+        )
+        print("worker sweep (same population, identical reconciles):")
+        for row in sweep["sweep"]:
+            util = row.get("utilization")
+            util_s = (
+                " util=" + "/".join(f"{u:.2f}" for u in util)
+                if util
+                else ""
+            )
+            eff = row.get("effective_workers", row["workers"])
+            clamp = (
+                f" (clamped to {eff}: shard count)"
+                if eff != row["workers"]
+                else ""
+            )
+            print(
+                f"  workers={row['workers']}{clamp}:"
+                f" {row['us_per_reconcile']} us/reconcile,"
+                f" wall {row['wall_seconds']}s,"
+                f" speedup {row['speedup']}x{util_s}"
+            )
+        if not chaos.get("skipped"):
+            print(
+                "sanitized chaos arm (3 shards, 2 workers):"
+                f" {'OK' if chaos['ok'] else 'FAILED'}"
+            )
+        if problems:
+            print("PROBLEMS:")
+            for p in problems:
+                print(f"  - {p}")
+    print("parallel smoke OK" if not problems else "parallel smoke FAILED")
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -287,6 +287,32 @@ FIXTURES = {
             "    return TIMESERIES.window('ready_fraction', 300)\n"
         ),
     },
+    "GL018": {
+        "rel": "grove_tpu/controller/fixture.py",
+        "bad": (
+            "def fudge(self, engine, store, wal):\n"
+            "    engine._backlogs[2].append(ev)\n"
+            "    engine._backlog_rotation = 0\n"
+            "    ctrl.queue._buckets[1].popleft()\n"
+            "    self.queue._rotation = 3\n"
+            "    store._capture_tls.buf = []\n"
+            "    store._per_shard_fns.append(fn)\n"
+            "    wal._buffer.clear()\n"
+        ),
+        "good": (
+            "def drive(self, engine, store, wal):\n"
+            "    engine.enable_workers(4)\n"
+            "    engine.drain()\n"
+            "    ctrl.queue.add(key)\n"
+            "    self.queue.pop(now)\n"
+            "    store.subscribe_system_per_shard(fn)\n"
+            "    store.arm_deferred_fanout()\n"
+            "    wal.note_event(ev)\n"
+            "    wal.flush()\n"
+            "    self._buckets = [None]\n"  # non-queue binding: out of scope
+            "    self.slots._buffer = b''\n"  # non-wal binding: out of scope
+        ),
+    },
     "GL010": {
         "rel": "grove_tpu/api/types.py",
         "bad": (
@@ -570,6 +596,61 @@ def test_grafting_timeseries_state_write_fails_lint():
         "def f(self, ev):\n    return ev.reason == 'SloBreach'\n",
     ):
         assert "GL017" not in rules_of(
+            lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
+        ), ok_src
+
+
+def test_grafting_worker_affinity_break_fails_lint():
+    """GL018 live-tree teeth: a rogue helper draining another worker's
+    backlog, popping a foreign shard bucket or tearing a WAL buffer from
+    real scheduler/chaos sources must fail lint — the serial-twin
+    determinism argument (docs/control-plane.md §5) assumes per-shard
+    state is touched only from its owning worker context. The owning
+    runtime/durability modules stay exempt; the public Engine/WorkQueue/
+    Store/WAL APIs pass anywhere."""
+    rel = "grove_tpu/solver/scheduler.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_steal_backlog(engine):\n"
+        "    ev = engine._backlogs[1].popleft()\n"
+        "    engine._backlog_rotation = 0\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL018" in rules_of(report)
+    assert "GL018" not in rules_of(lint_source(src, rel))
+    rel2 = "grove_tpu/sim/chaos.py"
+    src2 = (ROOT / rel2).read_text()
+    rogue2 = (
+        "\n\ndef _rogue_tear_batch(wal):\n"
+        "    wal._buffer.clear()\n"
+    )
+    report2 = lint_source(src2 + rogue2, rel2)
+    assert "GL018" in rules_of(report2)
+    assert "GL018" not in rules_of(lint_source(src2, rel2))
+    # a foreign capture-plumbing poke fires too
+    rogue3 = (
+        "\n\ndef _rogue_capture(store):\n"
+        "    store._capture_tls.buf = []\n"
+    )
+    assert "GL018" in rules_of(lint_source(src + rogue3, rel))
+    # the owning modules may touch their own state
+    for own_rel in (
+        "grove_tpu/runtime/engine.py",
+        "grove_tpu/runtime/workers.py",
+        "grove_tpu/runtime/workqueue.py",
+        "grove_tpu/runtime/store.py",
+        "grove_tpu/durability/wal.py",
+    ):
+        own = (ROOT / own_rel).read_text()
+        assert "GL018" not in rules_of(lint_source(own, own_rel)), own_rel
+    # precision: same attr names on non-engine/queue/wal bindings stay
+    # out of scope
+    for ok_src in (
+        "def f(self):\n    self._buckets = [0]\n",
+        "def f(self, ring):\n    ring._buffer = b''\n",
+        "def f(self):\n    self.machine._rotation = 1\n",
+    ):
+        assert "GL018" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
 

@@ -21,7 +21,6 @@ and every semantic string (names, labels, keys), not the run-dependent
 counters, so unrelated reconcile-order changes can't churn them.
 """
 
-import itertools
 import json
 import os
 import pathlib
@@ -95,7 +94,11 @@ def converged():
     within the run (they're normalized out anyway); the agentic-pipeline
     sample exercises startsAfter → initc injection, the richest pod shape.
     """
-    meta._uid_counter = itertools.count(1)
+    # sanctioned reset: rotates the incarnation token WITH the counter —
+    # a bare `meta._uid_counter = itertools.count(1)` re-creates
+    # (uid, generation) pairs and poisons the process-global template-
+    # hash memo for every later harness in the run (api/meta.py)
+    meta.reset_uid_namespace()
     harness = SimHarness(num_nodes=16)
     harness.apply(
         load_podcliqueset_file(str(REPO / "samples" / "agentic-pipeline.yaml"))

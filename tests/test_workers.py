@@ -1,0 +1,384 @@
+"""Parallel control plane (runtime/workers.py, docs/control-plane.md §5).
+
+The concurrent reconcile workers exist only if they are semantically
+invisible: the serial-twin A/B must be bit-identical (admissions, store
+content, reconcile counts, per-shard WAL acked prefixes) at EVERY
+converge boundary of a seeded cross-shard event storm, per worker count.
+Plus the coordination-plane contracts the executor leans on:
+
+- single-drainer routing (the rotation-pointer bugfix: a concurrent
+  second drainer fails loudly instead of corrupting the deterministic
+  round-robin);
+- per-shard reconcile order under workers == the serial drain's
+  per-shard projection (the workqueue fairness satellite);
+- deferred per-shard fan-out consumers (delta/quota) replayed in the
+  serial delivery order;
+- crash-restart with workers: per-shard WAL recovery + acked-prefix
+  audit unchanged;
+- thread-safety fixes: atomic event sequence, locked desired memo.
+"""
+
+import os
+import shutil
+import tempfile
+import threading
+
+import pytest
+
+from grove_tpu.runtime.clock import Clock, VirtualClock
+from grove_tpu.runtime.engine import Controller, Engine
+from grove_tpu.runtime.flow import continue_reconcile
+from grove_tpu.runtime.store import Store
+from grove_tpu.sim.parallel import (
+    durable_state_normalized,
+    parallel_ab,
+    worker_sweep,
+)
+
+
+def _sharded_store(num_shards=4):
+    return Store(VirtualClock(), cache_lag=True, num_shards=num_shards)
+
+
+class TestSerialTwin:
+    """The A/B contract: workers ∈ {2, 4, 8}, seeds ×3, every converge
+    boundary of the storm compared (sim/parallel.py)."""
+
+    @pytest.mark.parametrize(
+        "workers,seed",
+        [(2, 1234), (4, 7), (8, 2026)],
+    )
+    def test_storm_equivalence(self, workers, seed):
+        rep = parallel_ab(
+            n_sets=18,
+            n_nodes=16,
+            num_shards=5,
+            workers=workers,
+            seed=seed,
+            storm_rounds=2,
+        )
+        assert rep["identical"], rep["problems"]
+        assert rep["boundaries_compared"] >= 3
+        # identical reconcile counts at every boundary, not just totals
+        for serial_n, parallel_n in rep["reconciles"]:
+            assert serial_n == parallel_n
+        # the run genuinely spread work over more than one worker
+        busy = [
+            n for n in rep["worker_stats"]["reconciles_by_worker"] if n > 0
+        ]
+        assert len(busy) >= 2
+
+    def test_wal_acked_prefixes_identical(self):
+        d1 = tempfile.mkdtemp(prefix="grove-par-ab-s-")
+        d2 = tempfile.mkdtemp(prefix="grove-par-ab-w-")
+        try:
+            rep = parallel_ab(
+                n_sets=12,
+                n_nodes=16,
+                num_shards=3,
+                workers=4,
+                storm_rounds=1,
+                wal_dirs=(d1, d2),
+            )
+            assert rep["identical"], rep["problems"]
+            assert rep["wal_acked_identical"] is True
+        finally:
+            shutil.rmtree(d1, ignore_errors=True)
+            shutil.rmtree(d2, ignore_errors=True)
+
+    def test_crash_recovery_with_workers(self):
+        """Crash-point behavior is unchanged under workers: a workers
+        converge with per-shard WALs, killed with a torn tail, recovers
+        to a clean acked prefix (audit empty) that matches the serial
+        twin's durable state."""
+        from grove_tpu.durability import recover_store, verify_acked_prefix
+        from grove_tpu.sim.parallel import _make_harness, _populate
+        from grove_tpu.sim.scale import tenant_namespaces
+
+        d_serial = tempfile.mkdtemp(prefix="grove-par-crash-s-")
+        d_workers = tempfile.mkdtemp(prefix="grove-par-crash-w-")
+        try:
+            tenants = tenant_namespaces(6)
+            runs = {}
+            for workers, directory in ((1, d_serial), (4, d_workers)):
+                h = _make_harness(16, 3, workers, directory)
+                _populate(h, 10, tenants)
+                h.converge(max_ticks=200)
+                h.durability.simulate_crash(torn_tail_bytes=23)
+                recovered, report = recover_store(
+                    directory, clock=h.clock, cache_lag=True
+                )
+                assert verify_acked_prefix(directory, recovered) == []
+                assert report.torn_tail
+                runs[workers] = durable_state_normalized(directory)
+                h.engine.close()
+            assert runs[1] == runs[4]
+        finally:
+            shutil.rmtree(d_serial, ignore_errors=True)
+            shutil.rmtree(d_workers, ignore_errors=True)
+
+
+class TestCoordinationPlane:
+    """Ownership + determinism contracts of the coordinator."""
+
+    def _spread_namespaces(self, num_shards, want=3):
+        by_shard = {}
+        i = 0
+        from grove_tpu.runtime.shards import shard_of
+
+        while len(by_shard) < want:
+            ns = f"ns-{i}"
+            by_shard.setdefault(shard_of(ns, num_shards), ns)
+            i += 1
+        return list(by_shard.values())
+
+    def _engine_with_tracker(self, num_shards, workers):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import GenericObject
+
+        store = _sharded_store(num_shards)
+        engine = Engine(store, store.clock)
+        if workers > 1:
+            assert engine.enable_workers(workers)
+        order = []
+        lock = threading.Lock()
+
+        def reconcile(key):
+            with lock:
+                order.append(key)
+            return continue_reconcile()
+
+        engine.register(
+            Controller(name="track", kind="Service", reconcile=reconcile)
+        )
+        return store, engine, order
+
+    def _traffic(self, store, namespaces, per_ns=5):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import GenericObject
+
+        for i in range(per_ns):
+            for ns in namespaces:
+                store.create(
+                    GenericObject(
+                        kind="Service",
+                        metadata=ObjectMeta(name=f"svc-{i}", namespace=ns),
+                        spec={"i": i},
+                    )
+                )
+
+    def test_per_shard_order_matches_serial_projection(self):
+        """The fairness satellite: under concurrent drain, each shard's
+        reconcile sub-sequence equals the serial drain's projection onto
+        that shard (pop order is coordinator-owned and identical; only
+        cross-shard interleave may differ)."""
+        num_shards = 4
+        namespaces = self._spread_namespaces(num_shards)
+        runs = {}
+        for workers in (1, 4):
+            store, engine, order = self._engine_with_tracker(
+                num_shards, workers
+            )
+            self._traffic(store, namespaces)
+            engine.drain()
+            runs[workers] = order
+            engine.close()
+        assert sorted(runs[1]) == sorted(runs[4])
+        for ns in namespaces:
+            serial_proj = [k for k in runs[1] if k[1] == ns]
+            parallel_proj = [k for k in runs[4] if k[1] == ns]
+            assert serial_proj == parallel_proj
+
+    def test_concurrent_routing_raises(self):
+        """The rotation-pointer bugfix pinned: the pointers assume ONE
+        drainer — concurrent routing is a loud error, not silent
+        corruption."""
+        store, engine, _order = self._engine_with_tracker(3, 1)
+        engine._router_lock.acquire()  # simulate an in-flight drainer
+        try:
+            with pytest.raises(RuntimeError, match="single drainer"):
+                engine._route_events()
+        finally:
+            engine._router_lock.release()
+        # released: routing works again
+        engine._route_events()
+        engine.close()
+
+    def test_deferred_consumers_replayed_in_serial_order(self):
+        """Order-sensitive cross-shard consumers (the delta/quota
+        registration path) see the SAME global delivery order with
+        workers as the serial drain produces."""
+        num_shards = 3
+        namespaces = self._spread_namespaces(num_shards)
+        runs = {}
+        for workers in (1, 4):
+            store, engine, _order = self._engine_with_tracker(
+                num_shards, workers
+            )
+            seen = []
+            store.subscribe_system_per_shard(
+                lambda ev, _seen=seen: _seen.append(
+                    (ev.type, ev.obj.metadata.namespace, ev.obj.metadata.name)
+                )
+            )
+            # events emitted DURING reconciles: have the reconciler write
+            # a shadow object so deliveries originate on worker threads
+            def reconcile(key, _store=store):
+                from grove_tpu.api.meta import ObjectMeta
+                from grove_tpu.api.types import GenericObject
+
+                _kind, ns, name = key
+                shadow = f"shadow-{name}"
+                if _store.get("Shadow", ns, shadow) is None:
+                    _store.create(
+                        GenericObject(
+                            kind="Shadow",
+                            metadata=ObjectMeta(name=shadow, namespace=ns),
+                            spec={},
+                        )
+                    )
+                return continue_reconcile()
+
+            engine.controllers[0].reconcile = reconcile
+            self._traffic(store, namespaces, per_ns=3)
+            engine.drain()
+            runs[workers] = seen
+            engine.close()
+        assert runs[1] == runs[4]
+
+    def test_enable_workers_requires_sharded_capture_store(self):
+        store = Store(VirtualClock(), cache_lag=True, num_shards=1)
+        engine = Engine(store, store.clock)
+        assert engine.enable_workers(4) is False
+        assert engine.workers is None
+        engine.close()
+
+    def test_env_opt_in(self, monkeypatch):
+        monkeypatch.setenv("GROVE_TPU_CP_WORKERS", "3")
+        store = _sharded_store(4)
+        engine = Engine(store, store.clock)
+        assert engine.workers is not None
+        assert engine.workers.workers == 3
+        engine.close()
+        assert engine.workers is None
+
+    def test_pending_namespaces_gauge_semantics_under_workers(self):
+        """Gauge semantics pinned (docs/control-plane.md §5): the
+        per-shard pending feed reflects the most recent FULL scheduling
+        round — namespaces with pending pods or live gangs, counted onto
+        their owning shards — identically with workers armed; shards
+        whose namespaces drained read 0."""
+        from grove_tpu.observability.metrics import METRICS
+        from grove_tpu.runtime.shards import shard_of
+        from grove_tpu.sim.parallel import _make_harness, _populate
+
+        tenants = ["tenant-000", "tenant-001", "tenant-002"]
+        num_shards = 4
+        readings = {}
+        for workers in (1, 4):
+            METRICS.reset()
+            h = _make_harness(16, num_shards, workers)
+            _populate(h, 8, tenants)
+            h.converge(max_ticks=120)
+            readings[workers] = {
+                k: v
+                for k, v in METRICS.gauges.items()
+                if k.startswith("pending_namespaces@")
+            }
+            h.engine.close()
+        assert readings[1] == readings[4]
+        gauges = readings[4]
+        assert gauges, "sharded run must expose the per-shard pending feed"
+        # converged: the round's namespaces are exactly the tenants with
+        # live gangs, attributed to their owning shards
+        expected = {}
+        for ns in tenants:
+            idx = shard_of(ns, num_shards)
+            expected[idx] = expected.get(idx, 0) + 1
+        for idx in range(num_shards):
+            assert gauges.get(
+                f"pending_namespaces@{idx}", 0
+            ) == expected.get(idx, 0)
+
+
+class TestThreadSafetyAudit:
+    """The singleton/shared-state fixes the worker concurrency audit
+    landed (docs/control-plane.md §5 audit table)."""
+
+    def test_event_seq_atomic_under_threads(self):
+        from grove_tpu.controller.common import OperatorContext
+
+        store = Store(Clock())
+        ctx = OperatorContext(store=store, clock=store.clock)
+        n_threads, per_thread = 8, 50
+        threads = [
+            threading.Thread(
+                target=lambda: [
+                    ctx.record_event("PodGang", "GangAdmitted", f"m-{i}")
+                    for i in range(per_thread)
+                ]
+            )
+            for _ in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # no torn sequence: every allocation produced exactly one Event
+        events = list(store.scan("Event"))
+        assert len(events) == n_threads * per_thread
+        assert ctx._event_seq == n_threads * per_thread
+
+    def test_desired_memo_locked(self):
+        from grove_tpu.controller.common import OperatorContext
+
+        store = Store(Clock())
+        ctx = OperatorContext(store=store, clock=store.clock)
+        ctx._desired_memo_max = 64
+        errors = []
+
+        def hammer(tid):
+            try:
+                for i in range(400):
+                    ctx.desired_cache(("kind", tid, i % 96), lambda: object())
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,)) for t in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_worker_span_context(self):
+        """PR 12's per-thread shard context extended to worker identity:
+        spans opened under a worker stamp carry the lane."""
+        from grove_tpu.observability.tracing import TRACER
+
+        TRACER.enabled = True
+        try:
+            TRACER.set_worker(3)
+            with TRACER.span("test.worker") as span:
+                pass
+            assert span.attrs["worker"] == 3
+        finally:
+            TRACER.set_worker(None)
+            TRACER.enabled = False
+            TRACER.reset()
+
+
+class TestWorkerSweep:
+    def test_sweep_reports_all_arms(self):
+        rep = worker_sweep(
+            n_sets=8, n_nodes=16, num_shards=4, worker_counts=(1, 2)
+        )
+        assert [row["workers"] for row in rep["sweep"]] == [1, 2]
+        assert all(row["all_ready"] for row in rep["sweep"])
+        assert all(row["reconciles"] > 0 for row in rep["sweep"])
+        # identical schedules: the arms reconcile the same amount
+        counts = {row["reconciles"] for row in rep["sweep"]}
+        assert len(counts) == 1
+        assert "utilization" in rep["sweep"][1]
