@@ -10,8 +10,8 @@ IMAGE ?= grove-tpu:0.2.0
         control-plane-bench cp-bench-smoke trace-smoke quota-smoke \
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
         scale-smoke frontier-smoke profile-smoke explain-smoke \
-        serving-smoke parallel-smoke probe-debug dryrun docker-build \
-        compose-up clean
+        serving-smoke parallel-smoke remediate-smoke probe-debug dryrun \
+        docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -21,13 +21,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke parallel-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke, parallel-control-plane smoke
+check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke parallel-smoke remediate-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke, parallel-control-plane smoke, forecast-driven remediation smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL018) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL019) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -59,8 +59,8 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md)
-	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail; seed 99 runs with the remediation controller armed live through the schedule — its actions must keep every invariant green): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md)
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7 --remediate-seed 99
 	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 $(PY) scripts/chaos_smoke.py --seeds 7 --cp-crash-seed 7
 
 recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tail (prints replayed records + recovery wall time), acked-prefix audit, inert WAL A/B
@@ -89,6 +89,9 @@ parallel-smoke:  ## parallel-control-plane smoke: serial-twin A/B bit-identical 
 
 serving-smoke:   ## SLO-observatory smoke: seeded diurnal + flash-crowd traffic autoscaling prefill/decode scaling groups with a node crash mid-crowd; >=1 SLO breach (SloBreach + flight bundle stamped with the objective/window, round-tripped) and recovery, windowed percentiles bit-equal to a NumPy oracle, admission p99 <1s through the crowd, all-off overhead <1%
 	$(CPU_ENV) $(PY) scripts/serving_smoke.py
+
+remediate-smoke: ## forecast-driven remediation smoke: the everything-at-once serving day OFF then ON from one seed — ON must recover error budget OFF burns (delta printed), every action ledger-chained (structural ones with a proven what-if flip) with >=1 measured effect, zero disruption-budget violations, forecasts beat the persistence baseline, disabled-remediator A/B byte-identical
+	$(CPU_ENV) $(PY) scripts/remediate_smoke.py
 
 probe-debug:     ## accelerator-probe debugger: availability precheck + subprocess jit probe against the REAL env (no CPU scrub), full child traceback printed; rc 0 healthy / 2 retryable / 3 config error
 	$(PY) scripts/probe_debug.py

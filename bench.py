@@ -502,6 +502,25 @@ def _serving_artifact_block() -> dict:
     return doc
 
 
+def _remediation_artifact_block() -> dict:
+    """Forecast-driven remediation block (docs/observability.md
+    "Remediation & ledger"): the everything-at-once serving day run OFF
+    then ON — ledger tallies by action kind, flip-confirmed rate, mean
+    measured budget delta, forecast skill vs the persistence baseline
+    (the "forecasts beat naive" gate), and the ON/OFF error-budget
+    comparison. Isolated harnesses; every layer is disarmed after."""
+    import time as _time
+
+    from grove_tpu.sim.remediation import remediation_artifact
+
+    t0 = _time.perf_counter()
+    doc = remediation_artifact(
+        seed=2026, tenants=3, num_nodes=24, duration=1200.0
+    )
+    doc["wall_s"] = round(_time.perf_counter() - t0, 2)
+    return doc
+
+
 def _explain_artifact_block() -> dict:
     """Decision-explainability block (docs/observability.md "Admission
     explain"): the contended scenario's three verdict classes, verdict
@@ -760,6 +779,12 @@ def integrated_stress_bench(
             # latency, queue wait, the admission-p99-through-the-crowd
             # gate
             "serving": _serving_artifact_block(),
+            # remediation block (docs/observability.md "Remediation &
+            # ledger"): the closed detect→diagnose→simulate→act→account
+            # loop ON vs OFF over the serving day — ledger tallies,
+            # flip-confirmed rate, measured budget deltas, forecast
+            # skill vs persistence, budget-recovery ratio
+            "remediation": _remediation_artifact_block(),
             # sharded control-plane block (docs/control-plane.md): the
             # keyspace-sharded store at the ROADMAP's 10× shape, with the
             # fold-depth histogram and the S=1 inert A/B

@@ -8,6 +8,7 @@ from grove_tpu.analysis.rules.explainrule import ExplainReadonlyRule
 from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
 from grove_tpu.analysis.rules.glassbox import GlassBoxStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
+from grove_tpu.analysis.rules.ledgerrules import ActMustLogRule
 from grove_tpu.analysis.rules.locks import LockOrderRule
 from grove_tpu.analysis.rules.observability import EventReasonRule, SpanLeakRule
 from grove_tpu.analysis.rules.scheduling import (
@@ -41,4 +42,5 @@ ALL_RULES = (
     ExplainReadonlyRule,  # GL016
     TimeSeriesStateRule,  # GL017
     WorkerAffinityRule,  # GL018
+    ActMustLogRule,  # GL019
 )

@@ -85,11 +85,16 @@ class ClockDisciplineRule(Rule):
         "grove_tpu/runtime/",
         "grove_tpu/disruption/",
         "grove_tpu/quota/",
+        "grove_tpu/observability/forecast.py",
     )
     # strict scope: bit-replayable generators — even perf_counter/
     # monotonic are wall reads there (the serving traffic trace must be a
-    # pure function of seed + virtual time)
-    strict_paths = ("grove_tpu/sim/traffic.py",)
+    # pure function of seed + virtual time; the forecaster is pinned
+    # bit-equal to a NumPy oracle over that same virtual timeline)
+    strict_paths = (
+        "grove_tpu/sim/traffic.py",
+        "grove_tpu/observability/forecast.py",
+    )
 
     def check(self, ctx: FileContext) -> Iterable[Violation]:
         imports = _ImportTracker()

@@ -88,6 +88,24 @@ class HorizontalAutoscaler:
             for _, since in self._scale_down_candidates.values()
         )
 
+    def scale_target(
+        self, kind: str, namespace: str, name: str, replicas: int
+    ) -> bool:
+        """Direct scale request from a policy controller (the remediator's
+        preemptive scale-up ahead of a forecast peak): same mechanics as
+        an HPA decision — re-get, write ``spec.replicas``, log, count — so
+        the decision log and the hpa_* metrics see one unified stream.
+        Returns False when the target is absent, terminating, or already
+        at the requested size."""
+        view = self.store.get(kind, namespace, name, readonly=True)
+        if view is None or view.metadata.deletion_timestamp is not None:
+            return False
+        if int(view.spec.replicas) == int(replicas):
+            return False
+        key = f"{kind}/{namespace}/{name}"
+        self._scale_down_candidates.pop(key, None)
+        return self._apply_scale(view, int(replicas), key)
+
     # -- core ------------------------------------------------------------
 
     def _evaluate(self, namespace: str, hpa) -> bool:

@@ -731,6 +731,125 @@ def _cmd_slo(args) -> int:
     return 0
 
 
+def _print_forecast_report(doc: dict) -> None:
+    state = "enabled" if doc.get("enabled") else "disabled"
+    print(
+        f"forecaster: {state}, period={doc.get('period_s', 0):g}s"
+        f" horizon={doc.get('horizon_s', 0):g}s"
+        f" history={doc.get('history_s', 0):g}s"
+    )
+    forecasts = doc.get("forecasts", [])
+    if not forecasts:
+        print(
+            "  no series (watch some via FORECASTER.watch() or pass"
+            " --series)"
+        )
+        return
+    for fc in forecasts:
+        line = (
+            f"  {fc['series']}: model={fc.get('model', '?')}"
+            f" n={fc.get('n', 0)}"
+        )
+        if "last" in fc:
+            line += f" last={fc['last']:.4f} sigma={fc.get('sigma', 0):.4f}"
+        if "skill" in fc:
+            line += (
+                f" mae={fc['mae']:.4f} vs naive={fc['persistence_mae']:.4f}"
+                f" skill={fc['skill']:+.4f}"
+            )
+        print(line)
+        peak = fc.get("peak")
+        if peak is not None:
+            print(
+                f"    peak {peak['mean']:.4f} at t={peak['at_s']:.0f}s;"
+                f" {len(fc.get('points', []))} point(s), band ±"
+                f"{2.0 * fc.get('sigma', 0.0):.4f}"
+            )
+
+
+def _cmd_forecast(args) -> int:
+    """Per-series horizon forecasts with confidence bands + skill vs the
+    persistence baseline — from a live apiserver's GET /debug/forecast
+    (the forecaster reads the operator process's time-series rings)."""
+    if not args.apiserver:
+        print(
+            "forecast: --apiserver URL required (the forecaster lives in"
+            " the operator process; arm it with GROVE_TPU_TIMESERIES=1"
+            " GROVE_TPU_FORECAST=1)",
+            file=sys.stderr,
+        )
+        return 2
+    query = "&".join(f"series={s}" for s in (args.series or []))
+    if args.horizon:
+        query += ("&" if query else "") + f"horizon={args.horizon}"
+    doc = _fetch_server_json(
+        args.apiserver,
+        "/debug/forecast" + (f"?{query}" if query else ""),
+        "forecast",
+    )
+    if doc is None:
+        return 1
+    _print_forecast_report(doc)
+    return 0
+
+
+def _print_ledger_report(doc: dict) -> None:
+    state = "enabled" if doc.get("enabled") else "disabled"
+    flip = doc.get("flip_confirmed_rate")
+    delta = doc.get("mean_budget_delta")
+    print(
+        f"ledger: {state}, {doc.get('recorded_total', 0)} recorded"
+        f" ({doc.get('retained', 0)} retained),"
+        f" {doc.get('executed', 0)} executed /"
+        f" {doc.get('skipped', 0)} skipped"
+        + (f", flip-confirmed {flip:.0%}" if flip is not None else "")
+        + (
+            f", mean budget delta {delta:+.4f}"
+            if delta is not None
+            else ""
+        )
+    )
+    rows = []
+    for e in doc.get("entries", []):
+        eff = e.get("effect") or {}
+        d = eff.get("budget_delta")
+        rows.append(
+            (
+                str(e["id"]),
+                f"{e['vt']:g}",
+                e["trigger"]["kind"],
+                e["action"]["kind"],
+                e["action"].get("target", "") or "-",
+                e["outcome"],
+                f"{d:+.4f}" if d is not None else (e.get("reason") or "-"),
+            )
+        )
+    if rows:
+        _print_table(
+            ("ID", "VT", "TRIGGER", "ACTION", "TARGET", "OUTCOME",
+             "ΔBUDGET/REASON"),
+            rows,
+        )
+
+
+def _cmd_ledger(args) -> int:
+    """The causal decision→effect ledger: every remediation the
+    controller considered, as trigger→diagnosis→simulation→action→effect
+    chains — from a live apiserver's GET /debug/ledger."""
+    if not args.apiserver:
+        print(
+            "ledger: --apiserver URL required (the ledger lives in the"
+            " operator process; arm it with GROVE_TPU_LEDGER=1)",
+            file=sys.stderr,
+        )
+        return 2
+    doc = _fetch_server_json(args.apiserver, "/debug/ledger", "ledger")
+    if doc is None:
+        return 1
+    _print_ledger_report(doc)
+    return 0
+
+
 def _print_journey(doc: dict) -> None:
     name = f"{doc.get('namespace')}/{doc.get('name')}"
     state = "complete" if doc.get("complete") else "in flight"
@@ -1661,6 +1780,43 @@ def main(argv: List[str] | None = None) -> int:
         help="series-appendix window in seconds (default 300)",
     )
     p.set_defaults(fn=_cmd_slo)
+
+    p = sub.add_parser(
+        "forecast",
+        help=(
+            "per-series horizon forecasts: diurnal+trend predictions with"
+            " confidence bands and skill vs the persistence baseline"
+            " (GET /debug/forecast)"
+        ),
+    )
+    p.add_argument(
+        "--apiserver", help="read /debug/forecast from a live server"
+    )
+    p.add_argument(
+        "--series",
+        action="append",
+        help="series to forecast (repeatable; default: the watched set)",
+    )
+    p.add_argument(
+        "--horizon",
+        type=float,
+        default=0.0,
+        help="forecast horizon in seconds (default: the forecaster's)",
+    )
+    p.set_defaults(fn=_cmd_forecast)
+
+    p = sub.add_parser(
+        "ledger",
+        help=(
+            "causal decision→effect ledger: every remediation considered,"
+            " as trigger→diagnosis→simulation→action→effect chains"
+            " (GET /debug/ledger)"
+        ),
+    )
+    p.add_argument(
+        "--apiserver", help="read /debug/ledger from a live server"
+    )
+    p.set_defaults(fn=_cmd_ledger)
 
     p = sub.add_parser(
         "explain",

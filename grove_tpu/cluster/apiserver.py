@@ -610,6 +610,48 @@ class APIServer:
                             **SLO.status(series_window=window_s),
                         ),
                     )
+                if path == "/debug/forecast":
+                    # diurnal+trend forecaster (docs/observability.md
+                    # "Remediation & ledger"): per-series horizon
+                    # predictions with confidence bands + skill vs the
+                    # persistence baseline (?series=a&series=b&horizon=N;
+                    # defaults to the watched set) — read-only: the skill
+                    # ring is fed by the remediator's scoring calls,
+                    # never by this surface
+                    from grove_tpu.observability.forecast import FORECASTER
+
+                    horizon_s = self._query_float("horizon", 0.0)
+                    if horizon_s is None:
+                        return self._error(
+                            400, "horizon must be a positive finite number"
+                        )
+                    fc_query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query
+                    )
+                    names = [
+                        s for s in fc_query.get("series", []) if s
+                    ] or None
+                    return self._send_json(
+                        200,
+                        dict(
+                            {"kind": "ForecastReport"},
+                            **FORECASTER.report(
+                                names=names,
+                                horizon=horizon_s or None,
+                            ),
+                        ),
+                    )
+                if path == "/debug/ledger":
+                    # causal decision→effect ledger (docs/observability.md
+                    # "Remediation & ledger"): the bounded ring of
+                    # trigger→diagnosis→simulation→action→effect chains
+                    # plus per-kind/per-outcome tallies
+                    from grove_tpu.observability.ledger import LEDGER
+
+                    return self._send_json(
+                        200,
+                        dict({"kind": "LedgerReport"}, **LEDGER.status()),
+                    )
                 route = self._route()
                 if route is None:
                     return self._error(404, f"unknown path {self.path}")

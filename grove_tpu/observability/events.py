@@ -93,6 +93,13 @@ REASON_PCSG_CREATE_SUCCESSFUL = "PCSGCreateSuccessful"
 REASON_PCSG_DELETE_SUCCESSFUL = "PCSGDeleteSuccessful"
 REASON_PODGANG_CREATE_SUCCESSFUL = "PodGangCreateSuccessful"
 REASON_PODGANG_DELETE_SUCCESSFUL = "PodGangDeleteSuccessful"
+# remediation loop (docs/observability.md "Remediation & ledger",
+# controller/remediate.py via observability/ledger.py): a ledger entry
+# closed with an executed action (what-if-proven, broker-granted), or a
+# considered remediation skipped with the reason recorded (not flipped,
+# breaker open, budget denied, cooldown)
+REASON_REMEDIATION_EXECUTED = "RemediationExecuted"
+REASON_REMEDIATION_SKIPPED = "RemediationSkipped"
 
 # The closed set of event reasons this codebase may emit. grovelint's
 # GL006 rule checks every record()/record_event() call site against it,

@@ -493,10 +493,13 @@ class ExplainEngine:
 
         # 5. disruption --------------------------------------------------
         broker = sched.broker
+        # breaker_open is a property — calling it raised TypeError on any
+        # explain taken while the broker was armed (latent until the
+        # remediator started arming the broker on ordinary runs)
         breaker_open = bool(
             broker is not None
             and broker.active()
-            and broker.breaker_open()
+            and broker.breaker_open
         )
         dis_detail = (
             "gang is in the node-health monitor's requeue backoff"

@@ -18,8 +18,10 @@ captured AT the failure, from state the process was already keeping.
 ``flight.json`` (manifest + rings + recent events + profiler/journey
 snapshots when those layers are on) plus ``trace.json`` — a Chrome
 ``trace_event`` array of the ring's spans with per-shard lanes, loadable
-in chrome://tracing / Perfetto. Dump count is capped per process
-(``max_dumps``) so a GroveError storm cannot disk-spam.
+in chrome://tracing / Perfetto. Dump count is capped PER TRIGGER KIND
+(``max_dumps`` bundles for each distinct reason string) so a GroveError
+storm cannot disk-spam — and a chatty remediation trigger cannot starve
+the chaos-invariant budget (each kind draws from its own pool).
 
 Wired triggers: chaos invariant violations (``ChaosRunner``), a
 GroveError escaping a reconcile (engine), the disruption breaker
@@ -57,6 +59,9 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=_DEFAULT_CAPACITY)
         self._errors: deque = deque(maxlen=256)
         self._dump_seq = 0
+        # per-trigger-kind dump budget: reason string -> bundles shipped.
+        # max_dumps caps each kind separately, not the process total.
+        self._kind_dumps: dict = {}
         self._origin = time.perf_counter()
         env_dir = os.environ.get("GROVE_TPU_FLIGHTREC", "")
         if env_dir not in ("", "0", "false"):
@@ -114,6 +119,7 @@ class FlightRecorder:
             self._errors.clear()
             self.dumps = []
             self._dump_seq = 0
+            self._kind_dumps = {}
 
     # -- feeds (one boolean check each when disabled) --------------------
 
@@ -197,12 +203,14 @@ class FlightRecorder:
 
     def trigger(self, reason: str, detail: str = "") -> Optional[str]:
         """Freeze the rings into a postmortem bundle. Returns the bundle
-        directory, or None (disabled / dump budget exhausted)."""
+        directory, or None (disabled / this trigger kind's dump budget
+        exhausted — other kinds keep their own budgets)."""
         if not self.enabled:
             return None
         with self._lock:
-            if self._dump_seq >= self.max_dumps:
+            if self._kind_dumps.get(reason, 0) >= self.max_dumps:
                 return None
+            self._kind_dumps[reason] = self._kind_dumps.get(reason, 0) + 1
             self._dump_seq += 1
             seq = self._dump_seq
             shards = [

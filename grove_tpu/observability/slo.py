@@ -362,6 +362,49 @@ class SloEngine:
 
     # -- read side -------------------------------------------------------
 
+    def burning(self) -> List[dict]:
+        """Objectives currently in a burn-rate alert or breached — the
+        remediation controller's trigger read (engine state stays private
+        to this module, GL017). Each row carries what a remediator needs
+        to decide and to account: the objective name, its series, the
+        alert state, and the error budget remaining."""
+        with self._lock:
+            states = list(self._state.values())
+        out = []
+        for st in states:
+            if not (st.burning or st.breached):
+                continue
+            spec = st.spec
+            att = st.last_attainment
+            out.append(
+                {
+                    "name": spec.name,
+                    "series": spec.series,
+                    "breached": st.breached,
+                    "burning": st.burning,
+                    "burn_rate_fast": st.last_burn_fast,
+                    "burn_rate_slow": st.last_burn_slow,
+                    "budget_remaining": (
+                        max(0.0, 1.0 - (1.0 - att) / (1.0 - spec.target))
+                        if att is not None
+                        else None
+                    ),
+                }
+            )
+        return out
+
+    def budget_remaining(self, name: str) -> Optional[float]:
+        """Error budget remaining for one objective (None before its
+        first attainment round) — the ledger's effect-measurement read."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is None or st.last_attainment is None:
+                return None
+            return max(
+                0.0,
+                1.0 - (1.0 - st.last_attainment) / (1.0 - st.spec.target),
+            )
+
     def status(self, series_window: float = 300.0) -> dict:
         """The ``GET /debug/slo`` document: one row per objective plus the
         series appendix (every live series reduced over one window)."""

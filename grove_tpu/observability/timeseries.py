@@ -120,6 +120,18 @@ class _GaugeRing:
                 out.append(self._values[slot])
         return out
 
+    def window_samples(self, t0: int, t1: int) -> List[Tuple[int, float]]:
+        """Like window_values, but keeping each sample's tick — the
+        forecaster fits trend/seasonality against tick positions, so
+        sparse rings must not collapse into a dense sequence."""
+        lo = max(t0 + 1, t1 - self.capacity + 1, 0)
+        out = []
+        for tick in range(lo, t1 + 1):
+            slot = tick % self.capacity
+            if self._stamps[slot] == tick:
+                out.append((tick, self._values[slot]))
+        return out
+
 
 class _DistRing:
     """One distribution series: per-tick (count, total, max, buckets)
@@ -394,6 +406,24 @@ class TimeSeriesStore:
         if doc.get("n", 0) == 0 and doc.get("count", 0) == 0:
             return None
         return doc.get(reducer)
+
+    def gauge_samples(
+        self, name: str, seconds: float, now: Optional[float] = None
+    ) -> List[Tuple[int, float]]:
+        """Raw per-tick ``(tick, value)`` gauge samples over the ticks in
+        ``(now - seconds, now]`` — the forecaster's read primitive (ring
+        internals stay private to this module, GL017). Returns ``[]`` for
+        absent series and for distribution series (forecasting reduces
+        gauges only; dist windows go through ``window()``)."""
+        seconds = max(float(seconds), self.resolution)
+        vt = now if now is not None else self._vt()
+        t1 = self.tick_of(vt)
+        t0 = t1 - max(1, int(round(seconds / self.resolution)))
+        with self._lock:
+            ring = self._series.get(name)
+            if ring is None or ring.kind != "gauge":
+                return []
+            return ring.window_samples(t0, t1)
 
     def series_names(self) -> List[str]:
         with self._lock:

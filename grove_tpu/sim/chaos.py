@@ -200,6 +200,11 @@ class ChaosReport:
     # violation burst, docs/observability.md "Flight recorder") — the
     # postmortem evidence a failing matrix seed ships with its verdict
     flight_bundles: List[str] = field(default_factory=list)
+    # remediator-armed mode: ledger entries written while the remediation
+    # controller ran live through the fault schedule (executed + skipped)
+    remediator_armed: bool = False
+    remediations_executed: int = 0
+    remediations_skipped: int = 0
 
     @property
     def ok(self) -> bool:
@@ -238,6 +243,9 @@ class ChaosReport:
             "scheduler_errors": self.scheduler_errors,
             "invariant_violations": self.invariant_violations,
             "flight_bundles": self.flight_bundles,
+            "remediator_armed": self.remediator_armed,
+            "remediations_executed": self.remediations_executed,
+            "remediations_skipped": self.remediations_skipped,
             "converged": self.converged,
             "signature_matches_fault_free": self.signature_matches_fault_free,
             "ok": self.ok,
@@ -299,6 +307,7 @@ class ChaosRunner:
         lost_after: float = 15.0,
         controlplane_crash: bool = False,
         durability_dir: Optional[str] = None,
+        remediator: bool = False,
     ) -> None:
         self.seed = seed
         self.num_nodes = num_nodes
@@ -310,6 +319,11 @@ class ChaosRunner:
         # kill store+engine mid-convergence — recovery must rebuild the
         # control plane from disk (docs/robustness.md durability section)
         self.controlplane_crash = controlplane_crash
+        # remediator-armed mode (docs/observability.md "Remediation &
+        # ledger"): the SLO observatory + remediation controller run live
+        # through the WHOLE fault schedule — every action it takes must
+        # keep the chaos invariants (budget invariant 4 above all) green
+        self.remediator_armed = remediator
         self._own_durability_dir = controlplane_crash and durability_dir is None
         if self._own_durability_dir:
             import tempfile
@@ -541,6 +555,11 @@ class ChaosRunner:
         # the rebuilt monitor re-primes holds from persisted conditions
         # with the chaos-speed grace windows in place
         restarted.node_monitor.resync()
+        if self.remediator_armed:
+            # the recovered control plane comes up with a fresh (disabled)
+            # remediator — re-arm it; the ledger itself is process-global
+            # and survives the crash (it is observability, not leader state)
+            self._arm_remediator(restarted)
         self.harness = restarted
         report.recoveries += 1
 
@@ -628,7 +647,42 @@ class ChaosRunner:
         h.autoscaler = HorizontalAutoscaler(
             h.store, h.metrics_provider, scale_down_stabilization=60.0
         )
+        # remediator + its explain engine are leader memory over the
+        # swapped components — rebuild both (policy config carries over;
+        # cooldowns/pending effect windows die with the deposed leader)
+        self._rebuild_remediator(h)
         self.report.failovers += 1
+
+    def _rebuild_remediator(self, h: SimHarness) -> None:
+        """Fresh explain engine + remediation controller over the current
+        component set, re-armed with the chaos policy if this run has the
+        remediator armed (harness-built ones start disabled)."""
+        from grove_tpu.controller.remediate import RemediationController
+        from grove_tpu.observability.explain import ExplainEngine
+
+        h.explain = ExplainEngine(h.scheduler)
+        h.remediator = RemediationController(
+            h.store,
+            h.cluster,
+            h.scheduler,
+            h.drainer,
+            h.disruption,
+            h.autoscaler,
+            h.explain,
+        )
+        if self.remediator_armed:
+            self._arm_remediator(h)
+
+    def _arm_remediator(self, h: SimHarness) -> None:
+        """Chaos-speed remediation policy: tight cooldown (the whole run
+        is ~1 virtual minute), fragmentation trigger live, effects
+        measured against the ready_fraction budget."""
+        h.remediator.enable(
+            effect_slo="ready_fraction",
+            effect_window=10.0,
+            cooldown=5.0,
+            frag_threshold=0.6,
+        )
 
     # -- invariants -------------------------------------------------------
 
@@ -759,6 +813,16 @@ class ChaosRunner:
                         " matching committed binding"
                     )
 
+    def _remediation_tick(self, h: SimHarness) -> int:
+        """One observatory round + one policy round, in harness order:
+        sample → judge burns → remediate on THIS tick's verdicts."""
+        from grove_tpu.observability.slo import SLO
+        from grove_tpu.observability.timeseries import TIMESERIES
+
+        TIMESERIES.sample(h.clock.now())
+        SLO.evaluate(h.clock.now())
+        return self._guarded(h.remediator.tick)
+
     def _guarded(self, fn) -> int:
         """Run one control-plane component; a transient store error models
         that component's process crash-looping (it retries next tick)."""
@@ -810,6 +874,26 @@ class ChaosRunner:
             )
 
         h.converge(max_ticks=120)  # steady state before the first fault
+        if self.remediator_armed:
+            # arm the detect→act loop for the CHAOTIC run only, from the
+            # steady state on: observatory sampling + burn judging run in
+            # the manual tick loop below, remediation actions flow through
+            # the same broker/drainer/autoscaler the invariants police
+            from grove_tpu.observability.ledger import LEDGER
+            from grove_tpu.observability.slo import SLO
+            from grove_tpu.observability.timeseries import TIMESERIES
+            from grove_tpu.sim.traffic import default_slos
+
+            report.remediator_armed = True
+            TIMESERIES.reset()
+            SLO.reset()
+            LEDGER.reset()
+            TIMESERIES.enable(clock=h.clock)
+            SLO.enable()
+            for text in default_slos():
+                SLO.add(text)
+            LEDGER.enable(clock=h.clock)
+            self._arm_remediator(h)
         t0 = h.clock.now()
         faults = self.build_schedule(rng)
         i = 0
@@ -830,6 +914,8 @@ class ChaosRunner:
             bound = self._guarded(h.schedule)
             started = self._guarded(h.cluster.kubelet_tick)
             work += self._guarded(h.engine.drain)
+            if self.remediator_armed:
+                work += self._remediation_tick(h)
             if h.durability is not None:
                 # group commit at the tick boundary (the sim committer)
                 h.durability.pump()
@@ -844,6 +930,7 @@ class ChaosRunner:
                         h.autoscaler.next_deadline(),
                         h.node_monitor.next_deadline(),
                         h.drainer.next_deadline(),
+                        h.remediator.next_deadline(),
                     )
                     if w is not None
                 ]
@@ -907,6 +994,23 @@ class ChaosRunner:
             report.invariant_violations.extend(
                 f"sanitizer: {p}" for p in sanitize.harness_problems(h)
             )
+        if self.remediator_armed:
+            # tally the causal chains, then disarm the process-global
+            # layers (same discipline as the flight recorder below)
+            from grove_tpu.observability.ledger import LEDGER
+            from grove_tpu.observability.slo import SLO
+            from grove_tpu.observability.timeseries import TIMESERIES
+
+            report.remediations_executed = len(
+                LEDGER.entries(outcome="executed")
+            )
+            report.remediations_skipped = len(
+                LEDGER.entries(outcome="skipped")
+            )
+            h.remediator.disable()
+            LEDGER.disable()
+            SLO.disable()
+            TIMESERIES.disable()
         if self.flight_recorder:
             # disarm the process-global recorder (dumped bundles stay on
             # disk; the report carries their paths) so later runs/tests in
@@ -929,6 +1033,7 @@ def run_chaos(
     n_each: int = 2,
     max_ticks: int = 400,
     controlplane_crash: bool = False,
+    remediator: bool = False,
 ) -> ChaosReport:
     """One seeded end-to-end chaos run (the `make chaos-smoke` core)."""
     return ChaosRunner(
@@ -936,6 +1041,7 @@ def run_chaos(
         num_nodes=num_nodes,
         n_each=n_each,
         controlplane_crash=controlplane_crash,
+        remediator=remediator,
     ).run(max_ticks=max_ticks)
 
 

@@ -187,6 +187,22 @@ class SimHarness:
         self.autoscaler = HorizontalAutoscaler(
             self.store, self.metrics_provider, scale_down_stabilization=60.0
         )
+        # remediation controller (controller/remediate.py,
+        # docs/observability.md "Remediation & ledger"): detect→diagnose→
+        # simulate→act→account over the existing mechanism layer. Always
+        # constructed, OFF by default — a disabled remediator is provably
+        # inert (one boolean check per tick, byte-identical A/B pinned).
+        from grove_tpu.controller.remediate import RemediationController
+
+        self.remediator = RemediationController(
+            self.store,
+            self.cluster,
+            self.scheduler,
+            self.drainer,
+            self.disruption,
+            self.autoscaler,
+            self.explain,
+        )
 
     def schedule(self) -> int:
         if self.scheduler is not None:
@@ -323,6 +339,11 @@ class SimHarness:
             if TIMESERIES.enabled:
                 TIMESERIES.sample(self.clock.now())
                 SLO.evaluate(self.clock.now())
+            # remediation runs AFTER the observatory round so it reads
+            # this tick's verdicts, not last tick's (one boolean when off)
+            if self.remediator.enabled:
+                with PROFILER.phase("tick", controller="remediator"):
+                    work += self.remediator.tick()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a
@@ -336,6 +357,7 @@ class SimHarness:
                         self.autoscaler.next_deadline(),
                         self.node_monitor.next_deadline(),
                         self.drainer.next_deadline(),
+                        self.remediator.next_deadline(),
                     )
                     if w is not None
                 ]

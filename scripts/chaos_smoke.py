@@ -81,6 +81,20 @@ def main() -> int:
         help="with --seeds: the one seed of the matrix that runs the"
         " controlplane_crash fault (the `make chaos-matrix` mode)",
     )
+    parser.add_argument(
+        "--remediate",
+        action="store_true",
+        help="arm the forecast-driven remediation controller through the"
+        " fault schedule: the SLO observatory + policy loop run live and"
+        " every action it takes must keep the chaos invariants green"
+        " (disruption budgets above all)",
+    )
+    parser.add_argument(
+        "--remediate-seed",
+        type=int,
+        help="with --seeds: the one seed of the matrix that runs with the"
+        " remediator armed (the `make chaos-matrix` mode)",
+    )
     args = parser.parse_args()
 
     if args.seeds:
@@ -89,10 +103,12 @@ def main() -> int:
             seed = int(raw.strip())
             sanitized = args.sanitize or seed == args.sanitize_seed
             cp_crash = args.cp_crash or seed == args.cp_crash_seed
+            remediate = args.remediate or seed == args.remediate_seed
             tag = " [sanitize]" if sanitized else ""
             tag += " [cp-crash]" if cp_crash else ""
+            tag += " [remediator]" if remediate else ""
             print(f"=== chaos seed {seed}{tag} ===", flush=True)
-            rc = run_one(seed, args.json, sanitized, cp_crash)
+            rc = run_one(seed, args.json, sanitized, cp_crash, remediate)
             if rc:
                 return rc
         return rc
@@ -102,11 +118,16 @@ def main() -> int:
         args.json,
         args.sanitize or args.seed == args.sanitize_seed,
         args.cp_crash or args.seed == args.cp_crash_seed,
+        args.remediate or args.seed == args.remediate_seed,
     )
 
 
 def run_one(
-    seed: int, as_json: bool, sanitized: bool = False, cp_crash: bool = False
+    seed: int,
+    as_json: bool,
+    sanitized: bool = False,
+    cp_crash: bool = False,
+    remediate: bool = False,
 ) -> int:
     from grove_tpu.sim.chaos import run_chaos
 
@@ -115,7 +136,9 @@ def run_one(
 
         sanitize.install()
     try:
-        report = run_chaos(seed=seed, controlplane_crash=cp_crash)
+        report = run_chaos(
+            seed=seed, controlplane_crash=cp_crash, remediator=remediate
+        )
     finally:
         if sanitized:
             from grove_tpu.analysis import sanitize
@@ -124,6 +147,7 @@ def run_one(
     doc = report.as_dict()
     doc["sanitized"] = sanitized
     doc["cp_crash"] = cp_crash
+    doc["remediate"] = remediate
 
     problems = []
     if report.node_losses < 2:
@@ -196,6 +220,13 @@ def run_one(
             f"tree_matches_fault_free={report.signature_matches_fault_free} "
             f"violations={len(report.invariant_violations)}"
         )
+        if remediate:
+            print(
+                "remediator armed:"
+                f" {report.remediations_executed} executed /"
+                f" {report.remediations_skipped} skipped remediation(s)"
+                " (invariants above cover every action)"
+            )
 
     if problems:
         print(

@@ -376,3 +376,81 @@ class TestJourneyPhaseDrift:
             "journey segments undescribed in docs/observability.md:"
             f" {missing}"
         )
+
+
+class TestLedgerActionDrift:
+    """The remediation loop's gates (PR 16): the ledger's trigger/action
+    registries (observability/ledger.py TRIGGER_KINDS / ACTION_KINDS) ⇄
+    the docs/observability.md "Remediation & ledger" kind tables — the
+    event-reason treatment applied to the causal ledger's vocabulary, so
+    a new action kind cannot ship without its mechanics documented."""
+
+    @property
+    def _documented(self):
+        return _table_first_cells(
+            _doc_section("Remediation & ledger"), _DASHED
+        )
+
+    def test_kinds_documented(self):
+        from grove_tpu.observability.ledger import (
+            ACTION_KINDS,
+            TRIGGER_KINDS,
+        )
+
+        registered = set(TRIGGER_KINDS) | set(ACTION_KINDS)
+        missing = registered - self._documented
+        assert not missing, (
+            "ledger trigger/action kinds missing from the"
+            " docs/observability.md 'Remediation & ledger' tables:"
+            f" {sorted(missing)}"
+        )
+
+    def test_docs_kinds_not_stale(self):
+        """The section's tables ARE the kind tables: every first-column
+        code span must name a registered trigger or action kind."""
+        from grove_tpu.observability.ledger import (
+            ACTION_KINDS,
+            TRIGGER_KINDS,
+        )
+
+        registered = set(TRIGGER_KINDS) | set(ACTION_KINDS)
+        stale = self._documented - registered
+        assert not stale, (
+            "docs/observability.md 'Remediation & ledger' tables document"
+            f" kinds not in the ledger registries: {sorted(stale)}"
+        )
+
+    def test_kinds_used_by_the_controller(self):
+        """Dead-registry gate: every registered kind constant is READ in
+        the controller or its owning module's callers — a kind nobody can
+        emit is documentation theater. String-level check: the literal
+        value appears outside ledger.py (the controller imports the
+        ACTION_*/TRIGGER_* constants, smokes assert against the tuples)."""
+        from grove_tpu.observability.ledger import (
+            ACTION_KINDS,
+            TRIGGER_KINDS,
+        )
+
+        corpus = ""
+        for rel in repo_python_files(ROOT):
+            if rel.endswith("observability/ledger.py"):
+                continue
+            corpus += (ROOT / rel).read_text()
+        constants = {
+            "slo-burn": "TRIGGER_SLO_BURN",
+            "forecast-peak": "TRIGGER_FORECAST_PEAK",
+            "frag-threshold": "TRIGGER_FRAG_THRESHOLD",
+            "drain-node": "ACTION_DRAIN_NODE",
+            "migrate-gang": "ACTION_MIGRATE_GANG",
+            "scale-up": "ACTION_SCALE_UP",
+        }
+        dead = [
+            kind
+            for kind in (*TRIGGER_KINDS, *ACTION_KINDS)
+            if constants.get(kind, "\x00") not in corpus
+            and f'"{kind}"' not in corpus
+        ]
+        assert not dead, (
+            "ledger kinds with no emitting/asserting reference outside"
+            f" ledger.py: {dead}"
+        )

@@ -324,12 +324,29 @@ class TestFlightRecorder:
         # ring is full — 200 commits, only the most recent 16 retained
         assert [len(ring) for ring in FLIGHTREC._rings] == [16, 16]
 
-    def test_dump_budget_caps_bundles(self, tmp_path):
+    def test_dump_budget_caps_bundles_per_kind(self, tmp_path):
+        """max_dumps budgets each trigger KIND separately: a chatty kind
+        exhausts its own pool without starving other kinds."""
         FLIGHTREC.enable(out_dir=str(tmp_path), max_dumps=2)
-        assert FLIGHTREC.trigger("one") is not None
-        assert FLIGHTREC.trigger("two") is not None
-        assert FLIGHTREC.trigger("three") is None
+        assert FLIGHTREC.trigger("chatty") is not None
+        assert FLIGHTREC.trigger("chatty") is not None
+        assert FLIGHTREC.trigger("chatty") is None
         assert len(FLIGHTREC.dumps) == 2
+
+    def test_dump_budget_contention_between_kinds(self, tmp_path):
+        """Both kinds still dump under contention: the remediation trigger
+        spamming its budget flat leaves the chaos-invariant budget whole."""
+        FLIGHTREC.enable(out_dir=str(tmp_path), max_dumps=2)
+        for _ in range(10):
+            FLIGHTREC.trigger("RemediationExecuted", "remediation storm")
+        assert len(FLIGHTREC.dumps) == 2  # chatty kind capped at its pool
+        # the quiet kind's budget is untouched — its bundles still ship
+        assert FLIGHTREC.trigger("chaos-invariant", "overcommit") is not None
+        assert FLIGHTREC.trigger("chaos-invariant", "again") is not None
+        assert FLIGHTREC.trigger("chaos-invariant", "capped") is None
+        assert len(FLIGHTREC.dumps) == 4
+        kinds = {load_bundle(p)["reason"] for p in FLIGHTREC.dumps}
+        assert kinds == {"RemediationExecuted", "chaos-invariant"}
 
     def test_breaker_open_triggers_dump(self, tmp_path):
         """The disruption breaker's open transition ships its bundle."""

@@ -2,7 +2,8 @@
 
 Three layers (docs/static-analysis.md):
 
-1. **Fixture teeth** — for every rule GL001..GL013, a known-bad snippet
+1. **Fixture teeth** — for every enforced rule (GL001..GL019), a
+   known-bad snippet
    must fire and its known-good twin must pass. This is what pins
    "deleting any single enforced invariant makes `make lint` fail".
 2. **Live-tree mutations** — the real invariants (the `schedulable`
@@ -311,6 +312,18 @@ FIXTURES = {
             "    wal.flush()\n"
             "    self._buckets = [None]\n"  # non-queue binding: out of scope
             "    self.slots._buffer = b''\n"  # non-wal binding: out of scope
+        ),
+    },
+    "GL019": {
+        "rel": "grove_tpu/controller/remediate.py",
+        "bad": (
+            "def _act(self, node):\n"
+            "    self.drainer.request_drain(node)\n"
+        ),
+        "good": (
+            "def _act(self, node):\n"
+            "    self.drainer.request_drain(node)\n"
+            "    LEDGER.record('slo-burn', 'drain-node', 'executed')\n"
         ),
     },
     "GL010": {
@@ -651,6 +664,62 @@ def test_grafting_worker_affinity_break_fails_lint():
         "def f(self):\n    self.machine._rotation = 1\n",
     ):
         assert "GL018" not in rules_of(
+            lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
+        ), ok_src
+
+
+def test_grafting_unlogged_act_fails_lint():
+    """GL019 live-tree teeth: grafting an act call (request_drain /
+    scale_target / grant) without an in-function LEDGER.record() onto the
+    REAL remediation controller must fail lint — a silent actuator breaks
+    the decision→effect chain exactly where it matters. The privacy tooth
+    catches rogue ledger/forecaster state pokes anywhere in grove_tpu/;
+    the owning observability modules stay exempt."""
+    rel = "grove_tpu/controller/remediate.py"
+    src = (ROOT / rel).read_text()
+    rogue = (
+        "\n\ndef _rogue_quiet_drain(self, node):\n"
+        "    self.drainer.request_drain(node)\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert "GL019" in rules_of(report)
+    # the untouched controller logs every act in-function
+    assert "GL019" not in rules_of(lint_source(src, rel))
+    # an unlogged scale-up act fires too
+    rogue2 = (
+        "\n\ndef _rogue_quiet_scale(self, kind, ns, name, n):\n"
+        "    return self.autoscaler.scale_target(kind, ns, name, n)\n"
+    )
+    assert "GL019" in rules_of(lint_source(src + rogue2, rel))
+    # privacy tooth: rogue ledger/forecaster internals writes from real
+    # harness source fail lint
+    rel3 = "grove_tpu/sim/harness.py"
+    src3 = (ROOT / rel3).read_text()
+    rogue3 = (
+        "\n\ndef _rogue_rewrite_history():\n"
+        "    LEDGER._seq = 0\n"
+        "    FORECASTER._watched.clear()\n"
+        "    LEDGER.enabled = True\n"
+    )
+    report3 = lint_source(src3 + rogue3, rel3)
+    assert "GL019" in rules_of(report3)
+    assert len([v for v in report3.violations if v.rule == "GL019"]) == 3
+    assert "GL019" not in rules_of(lint_source(src3, rel3))
+    # the owning modules may mutate their own state
+    for own_rel in (
+        "grove_tpu/observability/ledger.py",
+        "grove_tpu/observability/forecast.py",
+    ):
+        own = (ROOT / own_rel).read_text()
+        assert "GL019" not in rules_of(lint_source(own, own_rel)), own_rel
+    # precision: the same attr names through non-ledger/forecast chains
+    # stay out of scope
+    for ok_src in (
+        "def f(self):\n    self._entries = []\n",
+        "def f(self):\n    self.machine.enabled = True\n",
+        "def f(self, d):\n    self.forecast.update(d)\n",
+    ):
+        assert "GL019" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
 

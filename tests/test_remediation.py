@@ -1,0 +1,814 @@
+"""Forecast-driven remediation: the closed loop, pinned
+(docs/observability.md "Remediation & ledger").
+
+- **Forecaster vs a plain-NumPy oracle** — seeded storms replay the same
+  gauge samples into the ring and an independent NumPy model; every
+  forecast document must match BIT-EXACTLY (trend, seasonal bins, bands,
+  peak, skill), through ring wraparound, sparse windows (persistence
+  degrade) and empty windows (absent shell). The remediator's preemptive
+  scale-ups are only as honest as these numbers.
+- **SLO burn across wraparound** — a burn+breach+recovery cycle on a
+  ring whose capacity is a small fraction of the run length: attainment
+  and burn-rate arithmetic must survive many ring eras.
+- **Ledger** — causal chains: ids, bounded eviction, effect deltas,
+  flip-confirmed-rate accounting, the Prometheus counter.
+- **Remediator policy** — the deterministic contended scenario
+  (sim/multitenant.build_explain_scenario): a burn-triggered defrag
+  executes only on a PROVEN what-if flip, skips are ledger-chained with
+  machine-readable reasons (no-flipping-candidate / breaker-open /
+  budget-denied), effects are measured as SLO budget deltas; forecast
+  scale-ups go through the autoscaler with cooldown damping.
+- **Inertness** — a disabled remediator does nothing: tick() == 0, zero
+  ledger writes, and the OFF day's cluster signature is byte-identical
+  with the tick sabotaged (it is never consulted).
+- **Wire shapes** — GET /debug/forecast, GET /debug/ledger.
+"""
+
+import json
+import math
+import random
+import urllib.error
+import urllib.request
+from bisect import bisect_right
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.load import load_podcliqueset_file
+from grove_tpu.controller import remediate as remediate_mod
+from grove_tpu.observability.flightrec import FLIGHTREC
+from grove_tpu.observability.forecast import (
+    BAND_Z,
+    FORECASTER,
+    MIN_SAMPLES,
+    N_PHASE_BINS,
+    N_POINTS,
+)
+from grove_tpu.observability.journey import JOURNEYS
+from grove_tpu.observability.ledger import (
+    ACTION_DRAIN_NODE,
+    ACTION_MIGRATE_GANG,
+    ACTION_SCALE_UP,
+    LEDGER,
+    OUTCOME_EXECUTED,
+    OUTCOME_SKIPPED,
+    TRIGGER_FORECAST_PEAK,
+    TRIGGER_SLO_BURN,
+)
+from grove_tpu.observability import ledger as ledger_mod
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.observability.slo import SLO
+from grove_tpu.observability.timeseries import DEFAULT_CAPACITY, TIMESERIES
+from grove_tpu.sim.harness import SimHarness
+from grove_tpu.sim.multitenant import build_explain_scenario
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _reset_observatory():
+    """Every test starts and ends with the whole observatory disarmed —
+    the singletons are process-global, and some tests shrink the ring
+    (enable(capacity=...)) or the ledger, so the teardown restores the
+    default geometry through the public enable() path."""
+
+    def _clear():
+        TIMESERIES.enable(capacity=DEFAULT_CAPACITY, resolution=1.0)
+        TIMESERIES.disable()
+        TIMESERIES.reset()
+        TIMESERIES.tap = None
+        TIMESERIES.clock = None
+        SLO.disable()
+        SLO.reset()
+        JOURNEYS.disable()
+        JOURNEYS.reset()
+        FLIGHTREC.disable()
+        FLIGHTREC.reset()
+        LEDGER.enable(capacity=ledger_mod.DEFAULT_CAPACITY)
+        LEDGER.disable()
+        LEDGER.reset()
+        FORECASTER.disable()
+        FORECASTER.reset()
+
+    _clear()
+    yield
+    _clear()
+
+
+def _get_json(url: str):
+    with urllib.request.urlopen(url) as resp:
+        return json.loads(resp.read().decode())
+
+
+class _Clock:
+    """A fixed virtual clock for surfaces that fall back to now()=0."""
+
+    def __init__(self, t: float) -> None:
+        self._t = t
+
+    def now(self) -> float:
+        return self._t
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracle: an independent model of the forecaster
+# ---------------------------------------------------------------------------
+
+
+def oracle_forecast(
+    log,
+    name,
+    now,
+    *,
+    capacity,
+    resolution=1.0,
+    period=600.0,
+    horizon=300.0,
+    history=1800.0,
+):
+    """Plain-NumPy re-derivation of one forecast document from the RAW
+    sample log (``{tick: value}``, gauge last-write-wins), written against
+    the documented semantics: ring retention over the training window,
+    closed-form OLS trend, phase-binned seasonal residuals, ±2σ bands,
+    strict-first peak, and the lag-horizon skill score."""
+    res = resolution
+    t1 = int(now // res)
+    seconds = max(float(history), res)
+    t0 = t1 - max(1, int(round(seconds / res)))
+    lo = max(t0 + 1, t1 - capacity + 1, 0)
+    samples = log.get(name, {})
+    ticks = sorted(t for t in samples if lo <= t <= t1)
+    vals = np.asarray([samples[t] for t in ticks], dtype=np.float64)
+    doc = {
+        "series": name,
+        "n": len(ticks),
+        "now": now,
+        "horizon_s": float(horizon),
+        "period_s": period,
+    }
+    if not ticks:
+        doc["model"] = "absent"
+        return doc
+    period_ticks = max(2, int(round(period / res)))
+    horizon_ticks = max(1, int(round(float(horizon) / res)))
+    last = float(vals[-1])
+    if len(ticks) < MIN_SAMPLES:
+        mean_v = float(vals.sum()) / vals.size
+        dev = vals - mean_v
+        sigma = float(np.sqrt((dev * dev).sum() / vals.size))
+        intercept, slope = last, 0.0
+        seasonal = np.zeros(1, dtype=np.float64)
+        n_bins = 1
+        doc["model"] = "persistence"
+        flat = True
+    else:
+        x = np.asarray(ticks, dtype=np.float64)
+        n = float(x.size)
+        sx = float(x.sum())
+        sy = float(vals.sum())
+        sxx = float((x * x).sum())
+        sxy = float((x * vals).sum())
+        denom = n * sxx - sx * sx
+        slope = (n * sxy - sx * sy) / denom if denom != 0.0 else 0.0
+        intercept = (sy - slope * sx) / n
+        resid = vals - (intercept + slope * x)
+        n_bins = min(N_PHASE_BINS, period_ticks)
+        bins = np.asarray(
+            [(t % period_ticks) * n_bins // period_ticks for t in ticks],
+            dtype=np.int64,
+        )
+        seasonal = np.zeros(n_bins, dtype=np.float64)
+        for b in range(n_bins):
+            mask = bins == b
+            cnt = int(mask.sum())
+            if cnt:
+                seasonal[b] = float(resid[mask].sum()) / cnt
+        adj = resid - seasonal[bins]
+        sigma = float(np.sqrt((adj * adj).sum() / n))
+        doc["model"] = "diurnal-trend"
+        flat = False
+    doc.update({"last": last, "slope_per_s": slope / res, "sigma": sigma})
+    step = max(1, horizon_ticks // N_POINTS)
+    points = []
+    peak = None
+    for tf in range(t1 + step, t1 + horizon_ticks + 1, step):
+        if flat:
+            mean = last
+        else:
+            b = (tf % period_ticks) * n_bins // period_ticks
+            mean = intercept + slope * float(tf) + float(seasonal[b])
+        row = {
+            "at_s": tf * res,
+            "mean": mean,
+            "lo": mean - BAND_Z * sigma,
+            "hi": mean + BAND_Z * sigma,
+        }
+        points.append(row)
+        if peak is None or mean > peak["mean"]:
+            peak = {"at_s": row["at_s"], "mean": mean}
+    doc["points"] = points
+    doc["peak"] = peak
+    if doc["model"] == "diurnal-trend":
+        pairs_i, pairs_j = [], []
+        for i, t in enumerate(ticks):
+            j = bisect_right(ticks, t - horizon_ticks) - 1
+            if j >= 0:
+                pairs_i.append(i)
+                pairs_j.append(j)
+        if pairs_i:
+            xi = np.asarray([ticks[i] for i in pairs_i], dtype=np.float64)
+            bi = np.asarray(
+                [
+                    (ticks[i] % period_ticks) * n_bins // period_ticks
+                    for i in pairs_i
+                ],
+                dtype=np.int64,
+            )
+            yi = vals[np.asarray(pairs_i, dtype=np.int64)]
+            yj = vals[np.asarray(pairs_j, dtype=np.int64)]
+            fitted = intercept + slope * xi + seasonal[bi]
+            doc["mae"] = float(np.abs(yi - fitted).sum()) / yi.size
+            doc["persistence_mae"] = float(np.abs(yi - yj).sum()) / yi.size
+            doc["skill"] = doc["persistence_mae"] - doc["mae"]
+    return doc
+
+
+def _storm(seed, n_events, log, name="demand"):
+    """Seeded diurnal+trend+noise gauge storm with irregular vt gaps
+    (zero-gaps exercise same-tick last-write-wins); yields checkpoint
+    instants every 97 events."""
+    rng = random.Random(seed)
+    vt = 0.0
+    for i in range(n_events):
+        vt += rng.choice([0.0, 0.1, 0.3, 1.0, 2.5, 7.0, 19.0])
+        value = (
+            5.0
+            + 0.004 * vt
+            + 2.0 * math.sin(2.0 * math.pi * vt / 600.0)
+            + rng.gauss(0.0, 0.3)
+        )
+        TIMESERIES.gauge(name, value, vt=vt)
+        log.setdefault(name, {})[int(vt // 1.0)] = float(value)
+        if i and i % 97 == 0:
+            yield vt
+    yield vt
+
+
+class TestForecastVsNumpyOracle:
+    @pytest.mark.parametrize("seed", [7, 1234, 2026])
+    def test_storm_bit_equal(self, seed):
+        TIMESERIES.enable()
+        FORECASTER.enable()
+        log = {}
+        for vt in _storm(seed, 600, log):
+            for horizon in (None, 120.0):
+                got = FORECASTER.forecast("demand", horizon=horizon, now=vt)
+                want = oracle_forecast(
+                    log,
+                    "demand",
+                    vt,
+                    capacity=DEFAULT_CAPACITY,
+                    horizon=horizon if horizon is not None else 300.0,
+                )
+                assert got == want, f"seed={seed} vt={vt} horizon={horizon}"
+
+    @pytest.mark.parametrize("seed", [3, 99])
+    def test_wraparound_bit_equal(self, seed):
+        # capacity 32 << the storm's tick span: the training window is
+        # clamped by ring retention, and the clamp must match the oracle's
+        TIMESERIES.enable(capacity=32)
+        FORECASTER.enable()
+        log = {}
+        for vt in _storm(seed, 500, log):
+            got = FORECASTER.forecast("demand", now=vt)
+            want = oracle_forecast(log, "demand", vt, capacity=32)
+            assert got == want, f"seed={seed} vt={vt}"
+            assert want["n"] <= 32
+
+    def test_sparse_window_degrades_to_persistence(self):
+        TIMESERIES.enable()
+        FORECASTER.enable()
+        log = {}
+        for t in range(MIN_SAMPLES - 1):
+            TIMESERIES.gauge("thin", 3.0 + t, vt=float(t))
+            log.setdefault("thin", {})[t] = 3.0 + t
+        vt = float(MIN_SAMPLES - 2)
+        got = FORECASTER.forecast("thin", now=vt)
+        assert got == oracle_forecast(
+            log, "thin", vt, capacity=DEFAULT_CAPACITY
+        )
+        assert got["model"] == "persistence"
+        assert got["n"] == MIN_SAMPLES - 1
+        # flat at the last sample, dispersion band, no skill verdict
+        assert all(p["mean"] == got["last"] for p in got["points"])
+        assert got["sigma"] > 0.0
+        assert "skill" not in got and "mae" not in got
+
+    def test_empty_window_is_absent_shell(self):
+        TIMESERIES.enable()
+        FORECASTER.enable()
+        got = FORECASTER.forecast("ghost", now=10.0)
+        assert got == {
+            "series": "ghost",
+            "n": 0,
+            "now": 10.0,
+            "horizon_s": 300.0,
+            "period_s": 600.0,
+            "model": "absent",
+        }
+
+    def test_skill_positive_on_clean_diurnal_trend(self):
+        # a noiseless diurnal+trend signal: the fitted model's MAE is near
+        # zero while the lag-horizon persistence baseline is off by the
+        # trend + phase shift — skill must come out positive
+        TIMESERIES.enable()
+        FORECASTER.enable()
+        for t in range(900):
+            v = 10.0 + 0.01 * t + 3.0 * math.sin(2.0 * math.pi * t / 600.0)
+            TIMESERIES.gauge("clean", v, vt=float(t))
+        got = FORECASTER.forecast("clean", now=899.0)
+        assert got["model"] == "diurnal-trend"
+        assert got["skill"] > 0.0
+        assert got["persistence_mae"] > got["mae"]
+
+    def test_feed_writes_skill_series_and_reads_do_not(self):
+        TIMESERIES.enable()
+        FORECASTER.enable()
+        for t in range(600):
+            v = 1.0 + 0.01 * t + math.sin(2.0 * math.pi * t / 600.0)
+            TIMESERIES.gauge("fed", v, vt=float(t))
+        doc = FORECASTER.forecast("fed", now=599.0)
+        assert "skill" in doc  # pairs exist at the default horizon
+        assert "forecast_skill/fed" not in TIMESERIES.series_names()
+        FORECASTER.forecast("fed", now=599.0, feed=True)
+        assert "forecast_skill/fed" in TIMESERIES.series_names()
+        row = TIMESERIES.window("forecast_skill/fed", 5.0, now=599.0)
+        assert row["last"] == doc["skill"]
+
+    def test_report_sweeps_watched_series(self):
+        TIMESERIES.enable()
+        FORECASTER.enable(clock=_Clock(5.0))
+        TIMESERIES.gauge("a", 1.0, vt=5.0)
+        FORECASTER.watch("a")
+        FORECASTER.watch("b")
+        doc = FORECASTER.report()
+        assert doc["enabled"] is True
+        assert [f["series"] for f in doc["forecasts"]] == ["a", "b"]
+        assert doc["forecasts"][1]["model"] == "absent"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn across ring wraparound
+# ---------------------------------------------------------------------------
+
+
+class TestSloBurnAcrossWraparound:
+    def test_burn_breach_recovery_on_tiny_ring(self):
+        # capacity 64 vs a 700-tick run: the indicator series AND the
+        # slo:<name>:good verdict series wrap ~11 times before the fault
+        TIMESERIES.enable(capacity=64)
+        SLO.enable()
+        SLO.add(
+            "ready_fraction >= 0.5 over 5s target 90% budget 60s"
+            " burn 2x 5s/30s"
+        )
+        for t in range(1, 601):
+            TIMESERIES.gauge("ready_fraction", 1.0, vt=float(t))
+            SLO.evaluate(float(t))
+        row = SLO.status()["objectives"][0]
+        assert row["state"] == "ok"
+        assert row["attainment"] == 1.0
+        assert row["budget_remaining"] == 1.0
+        assert SLO.burning() == []
+        # the fault: 15 bad ticks burn the whole 10% error budget
+        for t in range(601, 616):
+            TIMESERIES.gauge("ready_fraction", 0.0, vt=float(t))
+            SLO.evaluate(float(t))
+        burning = SLO.burning()
+        assert burning and burning[0]["name"] == "ready_fraction"
+        assert burning[0]["breached"] is True
+        assert burning[0]["burn_rate_fast"] >= 2.0
+        assert SLO.budget_remaining("ready_fraction") == 0.0
+        row = SLO.status()["objectives"][0]
+        assert row["state"] == "breached" and row["breaches"] == 1
+        # recovery: the budget window drains the bad era across more wraps
+        for t in range(616, 701):
+            TIMESERIES.gauge("ready_fraction", 1.0, vt=float(t))
+            SLO.evaluate(float(t))
+        row = SLO.status()["objectives"][0]
+        assert row["state"] == "ok" and row["recoveries"] == 1
+        assert row["evaluations"] == 700
+        assert SLO.budget_remaining("ready_fraction") == 1.0
+        assert SLO.burning() == []
+
+
+# ---------------------------------------------------------------------------
+# Ledger
+# ---------------------------------------------------------------------------
+
+
+class TestLedger:
+    def test_disabled_record_returns_none(self):
+        assert LEDGER.record("slo-burn", "drain-node", "executed") is None
+        LEDGER.enable()
+        assert LEDGER.status()["recorded_total"] == 0
+
+    def test_chain_shape_and_tallies(self):
+        LEDGER.enable()
+        e1 = LEDGER.record(
+            TRIGGER_SLO_BURN,
+            ACTION_MIGRATE_GANG,
+            OUTCOME_EXECUTED,
+            trigger_detail="slo probe burn",
+            diagnosis={"gang": "default/g", "binding_constraint": "topology"},
+            simulation={"flipped": True},
+            action={"target": "node-3"},
+            now=7.0,
+        )
+        e2 = LEDGER.record(
+            TRIGGER_SLO_BURN,
+            ACTION_MIGRATE_GANG,
+            OUTCOME_SKIPPED,
+            reason="breaker-open",
+            now=8.0,
+        )
+        assert (e1, e2) == (1, 2)
+        rows = LEDGER.entries()
+        assert [e["id"] for e in rows] == [1, 2]
+        assert rows[0]["vt"] == 7.0
+        assert rows[0]["action"] == {"kind": ACTION_MIGRATE_GANG, "target": "node-3"}
+        assert rows[0]["effect"] is None
+        assert rows[1]["reason"] == "breaker-open"
+        assert len(LEDGER.entries(outcome=OUTCOME_EXECUTED)) == 1
+        assert len(LEDGER.entries(action_kind=ACTION_MIGRATE_GANG)) == 2
+        st = LEDGER.status()
+        assert st["executed"] == 1 and st["skipped"] == 1
+        assert st["by_kind"][ACTION_MIGRATE_GANG] == {
+            OUTCOME_EXECUTED: 1,
+            OUTCOME_SKIPPED: 1,
+        }
+
+    def test_effect_closes_the_chain(self):
+        LEDGER.enable()
+        eid = LEDGER.record(
+            TRIGGER_SLO_BURN, ACTION_DRAIN_NODE, OUTCOME_EXECUTED, now=1.0
+        )
+        assert LEDGER.effect(eid, 30.0, 0.2, 0.7, now=31.0) is True
+        eff = LEDGER.entries()[0]["effect"]
+        assert eff["vt"] == 31.0 and eff["window_s"] == 30.0
+        assert eff["budget_delta"] == pytest.approx(0.5)
+        assert LEDGER.status()["mean_budget_delta"] == pytest.approx(0.5)
+        # unknown / evicted ids: False, nothing written
+        assert LEDGER.effect(999, 30.0, 0.0, 1.0) is False
+        # unmeasured endpoints leave the delta None (not zero)
+        eid2 = LEDGER.record(
+            TRIGGER_SLO_BURN, ACTION_DRAIN_NODE, OUTCOME_EXECUTED, now=2.0
+        )
+        assert LEDGER.effect(eid2, 30.0, None, 0.9, now=32.0) is True
+        assert LEDGER.entries()[1]["effect"]["budget_delta"] is None
+
+    def test_bounded_eviction_keeps_ids_monotonic(self):
+        LEDGER.enable(capacity=8)
+        for i in range(20):
+            LEDGER.record(
+                TRIGGER_SLO_BURN, ACTION_DRAIN_NODE, OUTCOME_SKIPPED,
+                now=float(i),
+            )
+        st = LEDGER.status()
+        assert st["recorded_total"] == 20 and st["retained"] == 8
+        assert [e["id"] for e in st["entries"]] == list(range(13, 21))
+
+    def test_flip_confirmed_rate_over_simulated_only(self):
+        # scale-ups carry flipped=None and must not dilute the rate
+        LEDGER.enable()
+        for flipped in (True, True, False):
+            LEDGER.record(
+                TRIGGER_SLO_BURN,
+                ACTION_MIGRATE_GANG,
+                OUTCOME_EXECUTED,
+                simulation={"flipped": flipped},
+            )
+        for _ in range(2):
+            LEDGER.record(
+                TRIGGER_FORECAST_PEAK,
+                ACTION_SCALE_UP,
+                OUTCOME_EXECUTED,
+                simulation={"flipped": None},
+            )
+        assert LEDGER.status()["flip_confirmed_rate"] == pytest.approx(2 / 3)
+
+    def test_prometheus_counter_bumped(self):
+        LEDGER.enable()
+        key = f"remediation_actions_total/{ACTION_DRAIN_NODE}/{OUTCOME_EXECUTED}"
+        before = METRICS.counters.get(key, 0.0)
+        LEDGER.record(TRIGGER_SLO_BURN, ACTION_DRAIN_NODE, OUTCOME_EXECUTED)
+        assert METRICS.counters[key] == before + 1.0
+
+
+# ---------------------------------------------------------------------------
+# Remediator policy: burn-triggered defrag on the contended scenario
+# ---------------------------------------------------------------------------
+
+_BURN_SPEC = "probe >= 0.5 over 1s target 90% budget 10s burn 1x 1s/5s"
+
+
+@pytest.fixture()
+def burn_scenario():
+    """The deterministic contended cluster (every explain verdict class
+    live) with the observatory armed on the harness clock and a fast
+    1s/5s burn objective ready to force."""
+    harness, refs = build_explain_scenario()
+    TIMESERIES.enable(clock=harness.clock)
+    SLO.enable()
+    SLO.add(_BURN_SPEC)
+    LEDGER.enable(clock=harness.clock)
+    return harness, refs
+
+
+def _force_burn(harness, ticks=10, good=False):
+    """Drive the probe indicator bad (or good) for `ticks` 1s rounds —
+    10 bad rounds exhaust the 10% budget and fire both burn windows."""
+    for _ in range(ticks):
+        now = harness.clock.now()
+        TIMESERIES.gauge("probe", 0.0 if not good else 1.0, vt=now)
+        SLO.evaluate(now)
+        harness.clock.advance(1.0)
+
+
+class TestRemediatorDefrag:
+    def test_executed_defrag_needs_proven_flip_and_measures_effect(
+        self, burn_scenario, monkeypatch
+    ):
+        harness, refs = burn_scenario
+        # the default candidate bound (3) only reaches fill-only nodes
+        # whose removal flips nothing; widen it to reach the bridge hosts
+        monkeypatch.setattr(remediate_mod, "MAX_DRAIN_CANDIDATES", 8)
+        r = harness.remediator
+        r.enable(effect_slo="probe", effect_window=12.0, cooldown=300.0)
+        _force_burn(harness)
+        assert SLO.burning()
+        assert r.tick() >= 1
+        executed = LEDGER.entries(outcome=OUTCOME_EXECUTED)
+        assert len(executed) == 1
+        e = executed[0]
+        # healthy filler => pure defrag migration, chained end to end
+        assert e["action"]["kind"] == ACTION_MIGRATE_GANG
+        assert e["trigger"]["kind"] == TRIGGER_SLO_BURN
+        assert e["trigger"]["detail"].startswith("slo probe burn")
+        assert e["diagnosis"]["gang"] == f"default/{refs['frag']}"
+        assert e["simulation"]["flipped"] is True
+        assert e["action"]["victims"]  # the budget-gated victim set
+        target = e["action"]["target"]
+        assert harness.cluster.node(target).cordoned is True
+        # effect: budget 0 at action time, fully recovered after 14 good
+        # rounds -> delta +1.0 lands on the entry at the next tick
+        assert e["effect"] is None
+        _force_burn(harness, ticks=14, good=True)
+        assert r.tick() >= 1
+        e = LEDGER.entries(outcome=OUTCOME_EXECUTED)[0]
+        assert e["effect"]["budget_delta"] == pytest.approx(1.0)
+        assert e["effect"]["window_s"] == 12.0
+
+    def test_cooldown_damps_retrigger(self, burn_scenario, monkeypatch):
+        harness, _refs = burn_scenario
+        monkeypatch.setattr(remediate_mod, "MAX_DRAIN_CANDIDATES", 8)
+        r = harness.remediator
+        r.enable(effect_slo="probe", effect_window=1000.0, cooldown=300.0)
+        _force_burn(harness)
+        r.tick()
+        total = LEDGER.status()["recorded_total"]
+        assert total >= 1
+        # still burning, but the diagnosed gang is cooling: no new chain
+        r.tick()
+        assert LEDGER.status()["recorded_total"] == total
+
+    def test_no_flipping_candidate_skips_with_evidence(self, burn_scenario):
+        harness, refs = burn_scenario
+        # default bound: the 3 least-loaded nodes are fill-only — their
+        # removal frees nothing contiguous, every trial says no flip
+        r = harness.remediator
+        r.enable(effect_slo="probe", cooldown=0.0)
+        _force_burn(harness)
+        assert r.tick() >= 1
+        assert LEDGER.entries(outcome=OUTCOME_EXECUTED) == []
+        skips = LEDGER.entries(outcome=OUTCOME_SKIPPED)
+        assert len(skips) == 1
+        e = skips[0]
+        assert e["reason"] == "no-flipping-candidate"
+        assert e["simulation"]["flipped"] is False
+        assert len(e["simulation"]["tried"]) == 3
+        assert e["diagnosis"]["gang"] == f"default/{refs['frag']}"
+        assert not any(n.cordoned for n in harness.cluster.nodes)
+
+    def test_open_breaker_pauses_remediation(self, burn_scenario, monkeypatch):
+        harness, _refs = burn_scenario
+        monkeypatch.setattr(remediate_mod, "MAX_DRAIN_CANDIDATES", 8)
+        harness.disruption.arm()
+        harness.disruption.note_failure(weight=1e9, reason="storm")
+        assert harness.disruption.breaker_open is True
+        r = harness.remediator
+        r.enable(effect_slo="probe", cooldown=0.0)
+        _force_burn(harness)
+        assert r.tick() >= 1
+        skips = LEDGER.entries(outcome=OUTCOME_SKIPPED)
+        assert len(skips) == 1 and skips[0]["reason"] == "breaker-open"
+        assert LEDGER.entries(outcome=OUTCOME_EXECUTED) == []
+        assert not any(n.cordoned for n in harness.cluster.nodes)
+
+    def test_budget_denied_victim_blocks_the_drain(
+        self, burn_scenario, monkeypatch
+    ):
+        harness, _refs = burn_scenario
+        monkeypatch.setattr(remediate_mod, "MAX_DRAIN_CANDIDATES", 8)
+        monkeypatch.setattr(
+            harness.disruption, "would_allow", lambda gang, now=None: False
+        )
+        r = harness.remediator
+        r.enable(effect_slo="probe", cooldown=0.0)
+        _force_burn(harness)
+        assert r.tick() >= 1
+        skips = LEDGER.entries(outcome=OUTCOME_SKIPPED)
+        assert len(skips) == 1
+        e = skips[0]
+        assert e["reason"].startswith("budget-denied for ")
+        # the flip WAS proven — the budget gate vetoed it afterwards
+        assert e["simulation"]["flipped"] is True
+        assert not any(n.cordoned for n in harness.cluster.nodes)
+
+
+# ---------------------------------------------------------------------------
+# Remediator policy: forecast-peak preemptive scale-up
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def scaled_harness():
+    """simple1 converged on 32 nodes, observatory on the harness clock,
+    and 20 rounds of a rising demand gauge the forecaster can fit."""
+    harness = SimHarness(num_nodes=32)
+    harness.apply(
+        load_podcliqueset_file(str(REPO / "samples" / "simple1.yaml"))
+    )
+    harness.converge()
+    TIMESERIES.enable(clock=harness.clock)
+    LEDGER.enable(clock=harness.clock)
+    for i in range(20):
+        TIMESERIES.gauge("demand", 1.0 + 0.1 * i, vt=harness.clock.now())
+        harness.clock.advance(1.0)
+    return harness
+
+
+class TestRemediatorScaleUp:
+    TARGET = ("PodCliqueScalingGroup", "default", "simple1-0-workers")
+
+    def _replicas(self, harness):
+        return int(harness.store.get(*self.TARGET).spec.replicas)
+
+    def test_forecast_peak_scales_up_then_caps(self, scaled_harness):
+        harness = scaled_harness
+        current = self._replicas(harness)
+        r = harness.remediator
+        r.enable(cooldown=0.0)
+        r.add_scale_policy(
+            "demand", 2.0, *self.TARGET, max_replicas=current + 1
+        )
+        assert r.tick() >= 1
+        executed = LEDGER.entries(outcome=OUTCOME_EXECUTED)
+        assert len(executed) == 1
+        e = executed[0]
+        assert e["action"]["kind"] == ACTION_SCALE_UP
+        assert e["trigger"]["kind"] == TRIGGER_FORECAST_PEAK
+        assert "forecast peak" in e["trigger"]["detail"]
+        assert (e["action"]["from"], e["action"]["to"]) == (
+            current, current + 1,
+        )
+        # scale-ups carry no what-if flip — the forecast IS the evidence
+        assert e["simulation"]["flipped"] is None
+        assert e["simulation"]["forecast"]["model"] == "diurnal-trend"
+        assert e["simulation"]["forecast"]["peak"]["mean"] >= 2.0
+        assert self._replicas(harness) == current + 1
+        # next round: already at the policy cap -> chained skip
+        harness.clock.advance(1.0)
+        assert r.tick() >= 1
+        skips = LEDGER.entries(outcome=OUTCOME_SKIPPED)
+        assert len(skips) == 1 and skips[0]["reason"] == "at-max-replicas"
+        assert self._replicas(harness) == current + 1
+
+    def test_absent_target_is_a_chained_skip(self, scaled_harness):
+        harness = scaled_harness
+        r = harness.remediator
+        r.enable(cooldown=0.0)
+        r.add_scale_policy(
+            "demand", 2.0, "PodCliqueScalingGroup", "default", "nope",
+            max_replicas=9,
+        )
+        assert r.tick() >= 1
+        skips = LEDGER.entries(outcome=OUTCOME_SKIPPED)
+        assert len(skips) == 1 and skips[0]["reason"] == "target-absent"
+        assert LEDGER.entries(outcome=OUTCOME_EXECUTED) == []
+
+    def test_cooldown_spaces_scale_ups(self, scaled_harness):
+        harness = scaled_harness
+        current = self._replicas(harness)
+        r = harness.remediator
+        r.enable(cooldown=300.0)
+        r.add_scale_policy(
+            "demand", 2.0, *self.TARGET, max_replicas=current + 4
+        )
+        assert r.tick() >= 1
+        harness.clock.advance(1.0)
+        assert r.tick() == 0  # cooling: not even a skip entry
+        assert LEDGER.status()["recorded_total"] == 1
+        assert self._replicas(harness) == current + 1
+
+
+# ---------------------------------------------------------------------------
+# Inertness: disabled == absent
+# ---------------------------------------------------------------------------
+
+
+class TestInert:
+    def test_disabled_tick_is_a_noop(self):
+        harness, _refs = build_explain_scenario()
+        LEDGER.enable(clock=harness.clock)
+        assert harness.remediator.enabled is False
+        assert harness.remediator.tick() == 0
+        assert harness.remediator.next_deadline() is None
+        assert LEDGER.status()["recorded_total"] == 0
+        assert not any(n.cordoned for n in harness.cluster.nodes)
+
+    @pytest.mark.slow
+    def test_inert_ab_signatures_match(self):
+        from grove_tpu.sim.remediation import inert_ab
+
+        sig_a, sig_b = inert_ab(seed=7, duration=120.0)
+        assert sig_a == sig_b
+
+
+# ---------------------------------------------------------------------------
+# Wire shapes
+# ---------------------------------------------------------------------------
+
+
+class TestRemediationWire:
+    def test_debug_forecast(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        TIMESERIES.enable()
+        for t in range(20):
+            TIMESERIES.gauge("wire_demand", 1.0 + 0.1 * t, vt=float(t))
+        FORECASTER.enable(clock=_Clock(19.0))
+        FORECASTER.watch("wire_demand")
+        server = APIServer().start()
+        try:
+            doc = _get_json(server.address + "/debug/forecast")
+            assert doc["kind"] == "ForecastReport"
+            assert doc["enabled"] is True
+            fc = doc["forecasts"][0]
+            assert fc["series"] == "wire_demand"
+            assert fc["model"] == "diurnal-trend"
+            assert len(fc["points"]) == N_POINTS
+            # explicit series + horizon override the watched sweep
+            doc = _get_json(
+                server.address + "/debug/forecast?series=ghost&horizon=60"
+            )
+            assert doc["horizon_s"] == 60.0
+            assert [f["series"] for f in doc["forecasts"]] == ["ghost"]
+            assert doc["forecasts"][0]["model"] == "absent"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get_json(server.address + "/debug/forecast?horizon=bogus")
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_debug_ledger(self):
+        from grove_tpu.cluster.apiserver import APIServer
+
+        LEDGER.enable(clock=_Clock(5.0))
+        eid = LEDGER.record(
+            TRIGGER_SLO_BURN,
+            ACTION_DRAIN_NODE,
+            OUTCOME_EXECUTED,
+            simulation={"flipped": True},
+            action={"target": "node-1"},
+        )
+        LEDGER.record(
+            TRIGGER_FORECAST_PEAK, ACTION_SCALE_UP, OUTCOME_SKIPPED,
+            reason="target-absent",
+        )
+        LEDGER.effect(eid, 60.0, 0.1, 0.4, now=65.0)
+        server = APIServer().start()
+        try:
+            doc = _get_json(server.address + "/debug/ledger")
+            assert doc["kind"] == "LedgerReport"
+            assert doc["recorded_total"] == 2
+            assert doc["executed"] == 1 and doc["skipped"] == 1
+            chain = doc["entries"][0]
+            assert set(chain) == {
+                "id", "vt", "trigger", "diagnosis", "simulation",
+                "action", "outcome", "reason", "effect",
+            }
+            assert chain["effect"]["budget_delta"] == pytest.approx(0.3)
+        finally:
+            server.stop()
