@@ -27,7 +27,7 @@ check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL019) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL020) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -59,9 +59,10 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail; seed 99 runs with the remediation controller armed live through the schedule — its actions must keep every invariant green): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md)
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail; seed 99 runs with the remediation controller armed live through the schedule — its actions must keep every invariant green): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md). The third line re-runs one seed on the worker-PROCESS executor, which arms the worker_crash fault: a reconcile worker SIGKILLed mid-round, repatriated + re-executed inline, run still converging to the fault-free tree
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7 --remediate-seed 99
 	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 $(PY) scripts/chaos_smoke.py --seeds 7 --cp-crash-seed 7
+	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 GROVE_TPU_CP_WORKERS=2 GROVE_TPU_CP_BACKEND=process $(PY) scripts/chaos_smoke.py --seeds 1234
 
 recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tail (prints replayed records + recovery wall time), acked-prefix audit, inert WAL A/B
 	$(CPU_ENV) $(PY) scripts/recovery_smoke.py
@@ -84,8 +85,9 @@ profile-smoke:   ## glass-box smoke: wall-attribution coverage >=95% of an indep
 explain-smoke:   ## admission-explain smoke: contended multi-tenant scenario with >=1 quota-blocked, >=1 fragmentation-blocked, >=1 fits-now verdict; one what-if that flips a verdict, confirmed by an actual drain; explain/what-if burst provably read-only (rv vector + delta fingerprint unchanged)
 	$(CPU_ENV) $(PY) scripts/explain_smoke.py
 
-parallel-smoke:  ## parallel-control-plane smoke: serial-twin A/B bit-identical at every converge boundary (store content, reconcile counts, per-shard WAL acked prefixes), worker-count sweep 1/2/4/8 with us/reconcile + speedup printed, sanitized chaos arm re-run with 3 shards + 2 workers
+parallel-smoke:  ## parallel-control-plane smoke, BOTH executors: thread arm (serial-twin A/B bit-identical at every converge boundary — store content, reconcile counts, per-shard WAL acked prefixes — worker sweep 1/2/4/8, sanitized chaos with 3 shards + 2 workers) then the worker-process arm (same A/B + 1/2 sweep on forked shared-nothing workers crossing only the wire codec; chaos covered by chaos-matrix). Both print the "host" tail-honesty block
 	$(CPU_ENV) $(PY) scripts/parallel_smoke.py
+	$(CPU_ENV) $(PY) scripts/parallel_smoke.py --backend=process --skip-chaos
 
 serving-smoke:   ## SLO-observatory smoke: seeded diurnal + flash-crowd traffic autoscaling prefill/decode scaling groups with a node crash mid-crowd; >=1 SLO breach (SloBreach + flight bundle stamped with the objective/window, round-tripped) and recovery, windowed percentiles bit-equal to a NumPy oracle, admission p99 <1s through the crowd, all-off overhead <1%
 	$(CPU_ENV) $(PY) scripts/serving_smoke.py

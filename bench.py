@@ -256,6 +256,21 @@ def _trace_artifact(top: int = 8) -> dict:
     }
 
 
+def _host_block_for(harness) -> dict:
+    """The artifact's "host" block, stamped with the control-plane
+    executor backend the harness actually ran (observability/hostinfo.py
+    — tail honesty for every speedup/overhead claim)."""
+    from grove_tpu.observability.hostinfo import host_block
+
+    return host_block(
+        backend=(
+            harness.engine.workers.backend
+            if harness.engine.workers is not None
+            else "serial"
+        )
+    )
+
+
 def build_stress_problem(n_nodes: int, n_gangs: int, seed: int = 0):
     # single shared generator (grove_tpu.models) so bench and tests can't
     # silently fork the stress shape
@@ -350,6 +365,10 @@ def _run_population_bench(n_sets, n_nodes, make_pcs, metric_fn, extra_fn=None):
         "gangs": len(harness.store.list("PodGang")),
         "control_plane": control_plane,
         "trace": _trace_artifact(),
+        # tail-honesty (docs/control-plane.md §5): the box + executor
+        # backend these numbers came from — a 1-core container cannot
+        # show parallel speedup, and the artifact must say so
+        "host": _host_block_for(harness),
     }
     if extra_fn is not None:
         payload.update(extra_fn(harness, elapsed, applied_s))
@@ -928,6 +947,7 @@ def main() -> None:
 
     import jax
 
+    from grove_tpu.observability.hostinfo import host_block
     from grove_tpu.solver.kernel import solve, solve_waves_stats
 
     n_nodes, n_gangs = (512, 1024) if args.small else (5120, 10240)
@@ -1019,6 +1039,7 @@ def main() -> None:
                 "backend": _backend_block(backend_note),
                 "probe": PROBE_LOG.as_json(),
                 "trace": _trace_artifact(),
+                "host": host_block(),
             }
         )
     )

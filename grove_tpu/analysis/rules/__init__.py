@@ -11,6 +11,7 @@ from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
 from grove_tpu.analysis.rules.ledgerrules import ActMustLogRule
 from grove_tpu.analysis.rules.locks import LockOrderRule
 from grove_tpu.analysis.rules.observability import EventReasonRule, SpanLeakRule
+from grove_tpu.analysis.rules.procrules import ProcessBoundaryRule
 from grove_tpu.analysis.rules.scheduling import (
     BrokerGrantRule,
     SchedulableMaskRule,
@@ -43,4 +44,5 @@ ALL_RULES = (
     TimeSeriesStateRule,  # GL017
     WorkerAffinityRule,  # GL018
     ActMustLogRule,  # GL019
+    ProcessBoundaryRule,  # GL020
 )

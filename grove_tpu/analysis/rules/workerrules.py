@@ -42,14 +42,26 @@ from typing import Iterable
 
 from grove_tpu.analysis.engine import FileContext, Rule, Violation, dotted
 
-# attr set -> (binding-leaf substring, owning-module prefixes)
-_ENGINE_OWNERS = ("grove_tpu/runtime/engine.py", "grove_tpu/runtime/workers.py")
+# attr set -> (binding-leaf substring, owning-module prefixes); the
+# process executor (runtime/procworkers.py) is a peer owner of the
+# thread executor — its worker lanes and repatriation path ARE the
+# owning worker context on the far side of the fork
+_ENGINE_OWNERS = (
+    "grove_tpu/runtime/engine.py",
+    "grove_tpu/runtime/workers.py",
+    "grove_tpu/runtime/procworkers.py",
+)
 _QUEUE_OWNERS = (
     "grove_tpu/runtime/workqueue.py",
     "grove_tpu/runtime/engine.py",
     "grove_tpu/runtime/workers.py",
+    "grove_tpu/runtime/procworkers.py",
 )
-_STORE_OWNERS = ("grove_tpu/runtime/store.py", "grove_tpu/runtime/workers.py")
+_STORE_OWNERS = (
+    "grove_tpu/runtime/store.py",
+    "grove_tpu/runtime/workers.py",
+    "grove_tpu/runtime/procworkers.py",
+)
 _WAL_OWNERS = ("grove_tpu/durability/",)
 
 _ENGINE_PRIVATE = {

@@ -16,6 +16,7 @@ reference embeds (/root/reference/operator/api/core/v1alpha1/crds/,
 from __future__ import annotations
 
 import dataclasses
+import functools
 import typing
 from typing import Any, Dict, Optional
 
@@ -186,6 +187,44 @@ def _coerce_scalar(hint: type, value: Any, quantity: bool = False) -> Any:
     return value
 
 
+# -- per-class decode plans (the process-boundary codec shave) --------------
+#
+# `typing.get_type_hints` re-evaluates every stringified annotation (PEP 563)
+# through `_eval_type` on EVERY call — profiled at >75% of decode wall on the
+# worker-process boundary, where the coordinator decodes each worker commit
+# envelope and every worker decodes the sync stream (docs/control-plane.md
+# §5). Hints, field tables and Optional-unwrapped per-field hints are all
+# pure functions of the class object, so they memoize exactly once.
+#
+# NO_MEMO restores the pre-shave reflective path (fresh get_type_hints /
+# fields walk per decode). It exists ONLY so the bench's paired codec A/B
+# (sim/parallel.py process_codec_ab) can measure the shave honestly inside
+# one process — same build, same population, toggled per arm. Decoded
+# output is identical either way (pinned by the A/B's content check).
+NO_MEMO = False
+
+
+@functools.lru_cache(maxsize=None)
+def _class_hints(cls: type) -> Dict[str, Any]:
+    return typing.get_type_hints(cls)
+
+
+@functools.lru_cache(maxsize=None)
+def _class_fields(cls: type) -> Dict[str, Any]:
+    return {f.name: f for f in dataclasses.fields(cls)}
+
+
+@functools.lru_cache(maxsize=None)
+def _field_hint(cls: type, fname: str) -> Any:
+    """The field's hint with Optional[X] pre-unwrapped to X — the per-value
+    decoder then skips the Union branch entirely on the hot path."""
+    hint = _class_hints(cls)[fname]
+    if typing.get_origin(hint) is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        return args[0] if args else Any
+    return hint
+
+
 def _decode_value(hint: Any, value: Any) -> Any:
     if value is None:
         return None
@@ -222,8 +261,12 @@ def decode_dataclass(cls: type, doc: Dict[str, Any]):
         res = doc.pop("resources") or {}
         doc.setdefault("requests", res.get("requests") or {})
         doc.setdefault("limits", res.get("limits") or {})
-    hints = typing.get_type_hints(cls)
-    fields = {f.name: f for f in dataclasses.fields(cls)}
+    if NO_MEMO:  # pre-shave reference path (bench codec A/B only)
+        hints = typing.get_type_hints(cls)
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+    else:
+        hints = None
+        fields = _class_fields(cls)
     aliases = _FIELD_ALIASES.get(cls, {})
     kwargs: Dict[str, Any] = {}
     leftovers: Dict[str, Any] = {}
@@ -232,7 +275,8 @@ def decode_dataclass(cls: type, doc: Dict[str, Any]):
             key if key in fields else _snake(key)
         )
         if fname in fields:
-            kwargs[fname] = _decode_value(hints[fname], value)
+            hint = hints[fname] if hints is not None else _field_hint(cls, fname)
+            kwargs[fname] = _decode_value(hint, value)
         else:
             leftovers[key] = value
     # unmodeled keys pass through into `extra` when the type carries one
@@ -264,12 +308,18 @@ def decode_object(doc: Dict[str, Any]):
         meta.namespace = ""
     if cls is GenericObject:
         return GenericObject(kind=kind, metadata=meta, spec=dict(doc.get("spec") or {}))
-    hints = typing.get_type_hints(cls)
+    hints = typing.get_type_hints(cls) if NO_MEMO else _class_hints(cls)
     kwargs: Dict[str, Any] = {"metadata": meta}
     if "spec" in hints and doc.get("spec") is not None:
-        kwargs["spec"] = _decode_value(hints["spec"], doc["spec"])
+        kwargs["spec"] = _decode_value(
+            hints["spec"] if NO_MEMO else _field_hint(cls, "spec"),
+            doc["spec"],
+        )
     if "status" in hints and doc.get("status") is not None:
-        kwargs["status"] = _decode_value(hints["status"], doc["status"])
+        kwargs["status"] = _decode_value(
+            hints["status"] if NO_MEMO else _field_hint(cls, "status"),
+            doc["status"],
+        )
     obj = cls(**kwargs)
     return obj
 

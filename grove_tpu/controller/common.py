@@ -10,6 +10,7 @@ controller/common/component/utils/.
 from __future__ import annotations
 
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
@@ -27,8 +28,52 @@ from grove_tpu.runtime.store import Store
 
 FINALIZER = "grove.io/operator"
 
+# live OperatorContext registry: the worker-PROCESS backend
+# (runtime/procworkers.py) forks children that inherit every context's
+# _event_seq verbatim — without a per-process offset, a child and the
+# coordinator would both allocate the same evt-N Event name, the loser's
+# best-effort create would conflict away, and the serial-twin
+# commit-count equality would break. Weak values: contexts die with
+# their harness; the registry must not pin them. Keyed by a monotonic
+# registration id so iteration order is deterministic AND identical in a
+# forked child (WeakSet iteration order is address-dependent).
+_LIVE_CONTEXTS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_CTX_SEQ = 0
 
-@dataclass
+# spacing between per-slot Event name ranges; far above any sim's Event
+# volume (the ring buffer caps live Events at max_events=1000)
+EVENT_SEQ_STRIDE = 10_000_000
+
+
+def live_contexts() -> List["OperatorContext"]:
+    return [ctx for _, ctx in sorted(_LIVE_CONTEXTS.items())]
+
+
+def contexts_of_store(store) -> List["OperatorContext"]:
+    """The live contexts operating a given store, registration order —
+    how the process backend finds the expectations/event state belonging
+    to the engine it drains (a test process may hold several harnesses)."""
+    return [ctx for ctx in live_contexts() if ctx.store is store]
+
+
+def rebase_event_sequences(slot: int) -> None:
+    """Move every live context's Event sequence into the disjoint range
+    owned by `slot` (the coordinator's slot 0 keeps the natural range).
+    Called once per freshly forked worker process, before it reconciles
+    anything — the analogue of api/meta.reset_uid_namespace() for the
+    evt-N namespace. `slot` must be unique per (fork generation, worker):
+    a previous generation's Events live on in the inherited store, so a
+    reused range would re-collide with them."""
+    if slot <= 0:
+        return
+    for ctx in live_contexts():
+        with ctx._event_lock:
+            ctx._event_seq += slot * EVENT_SEQ_STRIDE
+
+
+# eq=False: keep identity __eq__/__hash__ (a value-eq dataclass is
+# unhashable, and the weak registry below needs to hold instances)
+@dataclass(eq=False)
 class OperatorContext:
     """Everything a component needs (the reference passes client/scheme/
     eventRecorder; we pass the store + clock + topology + expectations)."""
@@ -62,6 +107,11 @@ class OperatorContext:
     # sized above the live population at stress scale (10,240 sets × 2
     # entries each) so steady state never evicts a live key
     _desired_memo_max: int = 65536
+
+    def __post_init__(self) -> None:
+        global _CTX_SEQ
+        _CTX_SEQ += 1
+        _LIVE_CONTEXTS[_CTX_SEQ] = self
 
     def desired_cache(self, key: tuple, build):
         """Memoized desired-children build for `key` (kind, uid, generation).

@@ -66,6 +66,33 @@ from grove_tpu.sim.cluster import (
 GangKey = Tuple[str, str]  # (namespace, gang name)
 
 
+class _EpochSet(set):
+    """Set that counts its effective mutations. The scheduler's overlap
+    pump keys speculative spec reuse on hold-state staleness: any
+    hold/release between speculation and the real encode must invalidate
+    the speculated spec (``gang_held`` gates encoding), and the epoch is
+    the O(1) way to observe that."""
+
+    def __init__(self, *args) -> None:
+        super().__init__(*args)
+        self.epoch = 0
+
+    def add(self, item) -> None:
+        if item not in self:
+            self.epoch += 1
+        super().add(item)
+
+    def discard(self, item) -> None:
+        if item in self:
+            self.epoch += 1
+        super().discard(item)
+
+    def clear(self) -> None:
+        if self:
+            self.epoch += 1
+        super().clear()
+
+
 class NodeHealthMonitor:
     """Grace-period node lifecycle + gang-aware failure recovery over a
     SimCluster. One instance per scheduler/cluster pair."""
@@ -89,7 +116,7 @@ class NodeHealthMonitor:
         # not the reconcile queues' 5ms curve — a gang retrying every drain
         # while capacity is gone would just burn solver rounds
         self.requeue = WorkQueue(base_backoff=1.0, max_backoff=60.0)
-        self._held: Set[GangKey] = set()
+        self._held: Set[GangKey] = _EpochSet()
         # gangs whose triage (status flip / pod teardown) hit a transient
         # store error: retried level-triggered on the next tick
         self._triage_retry: Dict[GangKey, str] = {}
@@ -114,6 +141,13 @@ class NodeHealthMonitor:
         """True while the gang sits in requeue backoff — the scheduler
         skips encoding it (its pods stay pending, untouched)."""
         return (namespace, name) in self._held
+
+    @property
+    def holds_epoch(self) -> int:
+        """Mutation counter of the requeue-hold set: any hold or release
+        bumps it, so the scheduler's overlap pump can fold hold-state
+        into its staleness token without copying the set."""
+        return self._held.epoch
 
     def hold_gang(self, key: GangKey) -> None:
         """Put a gang into rate-limited requeue backoff from OUTSIDE the

@@ -303,6 +303,10 @@ class StoreDurability:
         lock=None,
     ) -> None:
         self.store = store
+        # backref for the worker-process backend (runtime/procworkers.py):
+        # the drain splits WAL stream ownership across processes and needs
+        # the live attachment, which nothing else hangs off the store
+        store._durability = self
         self.directory = directory
         # sharded stores (docs/control-plane.md) get one self-contained
         # WAL stream PER KEYSPACE SHARD, each subscribed to that shard's
@@ -345,10 +349,24 @@ class StoreDurability:
     def pump(self) -> int:
         """One group-commit round: flush (fsync) the buffered batch of
         every shard stream, then snapshot + truncate when due. Returns
-        records made durable."""
+        records made durable.
+
+        Worker-process backend (runtime/procworkers.py): worker
+        generations are drain-scoped — each generation final-flushes the
+        streams it owns and ships the watermarks back before the drain
+        returns, so by the time the tick-boundary pump runs here every
+        stream is local again and nothing special happens. The one
+        defensive gate: if a pump ever races a live generation (a
+        background committer misconfigured alongside process workers),
+        remote streams no-op their flush and auto-snapshot is parked — a
+        snapshot would truncate segments another process still holds a
+        stale segment index into."""
         flushed = 0
         for wal in self.wals:
             flushed += wal.flush()
+        drain = getattr(self.store, "_process_drain", None)
+        if drain is not None and drain.active:
+            return flushed
         if (
             sum(w.flushed_bytes for w in self.wals)
             - self._flushed_at_last_snapshot
@@ -428,6 +446,15 @@ class StoreDurability:
         # stream dies with the one process; the torn frame lands on shard
         # 0's stream (always carries traffic — cluster-scoped keys pin
         # there), the others crash with clean tails.
+        # worker-process backend: the whole control plane dies as one
+        # failure domain — SIGKILL the worker processes FIRST so their
+        # buffered (never-acked) records die with them, exactly like the
+        # coordinator's own buffer below. kill_all repatriates the
+        # streams (remote -> local) so the _dead marking lands on live
+        # handles.
+        drain = getattr(self.store, "_process_drain", None)
+        if drain is not None and drain.active:
+            drain.kill_all()
         lost = 0
         for i, wal in enumerate(self.wals):
             lost += wal.simulate_crash(

@@ -310,6 +310,13 @@ class WriteAheadLog:
         self._buffer: List[tuple] = []  # (seq, op, committed obj)
         self._seq = 0
         self._dead = False  # simulate_crash: the process is gone
+        # worker-process backend (runtime/procworkers.py): a stream whose
+        # shard is owned by ANOTHER process is marked remote — that
+        # process appends to the same directory (one writer per stream
+        # still holds; this handle just goes inert, keeping watermarks the
+        # owner ships back). Flipped off on repatriation after a worker
+        # crash, when the coordinator takes the stream back.
+        self.remote = False
         self.durable_seq = 0
         self.durable_rv = 0
         self.flushed_bytes = 0
@@ -329,7 +336,7 @@ class WriteAheadLog:
         serialization — and the old/new subtree-sharing comparison that
         turns a commit into a small patch record — is safely deferred to
         flush()."""
-        if self._dead:
+        if self._dead or self.remote:
             return
         if ev.kind == "Event":
             # fire-and-forget Event objects are best-effort by contract
@@ -470,7 +477,7 @@ class WriteAheadLog:
         return [tuple(coalesced[key]) for key in order]
 
     def _flush_locked(self) -> int:
-        if self._dead:
+        if self._dead or self.remote:
             return 0
         with self._lock:
             batch, self._buffer = self._buffer, []

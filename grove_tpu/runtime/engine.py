@@ -101,6 +101,14 @@ class Engine:
         # GROVE_TPU_CP_WORKERS=N): per-shard reconcile workers; None keeps
         # the historical single-threaded drain byte-identically
         self.workers = None
+        # scheduler overlap pump (runtime/procworkers.py + sim/scheduler):
+        # the process drain calls this between dispatching a round's
+        # remote batches and collecting replies — the coordinator spends
+        # worker flight time on speculative gang encode instead of idling
+        self.overlap_hook = None
+        # round-boundary callback for the process drain's cache watermark
+        # (see _drain_rounds)
+        self.round_hook = None
         # per-kind routing table (built lazily after registration): an event
         # consults only the entries subscribed to its kind instead of
         # iterating every controller × watch per event — at stress scale
@@ -125,22 +133,35 @@ class Engine:
         if env_workers > 1:
             self.enable_workers(env_workers)
 
-    def enable_workers(self, workers: int) -> bool:
-        """Arm the parallel control plane (runtime/workers.py,
-        docs/control-plane.md §5): `drain()` partitions each round's
-        batches over per-shard worker threads. No-op (False) when the
-        store is unsharded or cannot defer its per-shard fan-out — the
-        serial drain is the degenerate W=1 case either way."""
+    def enable_workers(self, workers: int, backend: str = None) -> bool:
+        """Arm the parallel control plane (docs/control-plane.md §5):
+        `drain()` partitions each round's batches over per-shard worker
+        groups. `backend` picks the executor — "thread"
+        (runtime/workers.py, the default) or "process"
+        (runtime/procworkers.py, shared-nothing worker processes over the
+        wire codec); unset falls back to GROVE_TPU_CP_BACKEND. No-op
+        (False) when the store is unsharded or cannot defer its per-shard
+        fan-out — the serial drain is the degenerate W=1 case either
+        way."""
         if workers <= 1 or self.workers is not None:
             return self.workers is not None
         if self.num_shards <= 1:
             return False
         if getattr(self.store, "arm_deferred_fanout", None) is None:
             return False
-        from grove_tpu.runtime.workers import ParallelDrain
+        if backend is None:
+            from grove_tpu.runtime.procworkers import backend_from_env
 
+            backend = backend_from_env()
         self.store.arm_deferred_fanout()
-        self.workers = ParallelDrain(self, workers)
+        if backend == "process":
+            from grove_tpu.runtime.procworkers import ProcessDrain
+
+            self.workers = ProcessDrain(self, workers)
+        else:
+            from grove_tpu.runtime.workers import ParallelDrain
+
+            self.workers = ParallelDrain(self, workers)
         return True
 
     def _enqueue_sharded(self, ev: WatchEvent) -> None:
@@ -387,6 +408,12 @@ class Engine:
         now = self.clock.now()
         for _ in range(max_rounds):
             self._route_events()
+            if self.round_hook is not None:
+                # routing IS the round's cache-advance boundary: the
+                # process drain records its sync-log watermark here so
+                # worker mirrors advance their caches at the same
+                # boundary the serial drain does
+                self.round_hook()
             progressed = False
             for ctrl in self.controllers:
                 # BATCHED drain: pop the controller's whole ready set up

@@ -82,6 +82,7 @@ class StoreShard:
         "index",
         "lock",
         "rv",
+        "emitted",
         "committed",
         "cache",
         "blob",
@@ -106,6 +107,10 @@ class StoreShard:
         # this shard's OWN resourceVersion sequence (the merge rule is
         # documented in the module docstring / docs/control-plane.md)
         self.rv = 0
+        # count of EVERY event emitted on this shard — unlike rv it moves
+        # on hard deletes too, so it is the staleness signal speculative
+        # consumers (the scheduler's overlap pump) key their reuse on
+        self.emitted = 0
         # kind -> "ns/name" -> obj (plus the canonical pickled blobs and
         # the lagged informer-cache twins), exactly the unsharded store's
         # layout scoped to this shard's namespaces

@@ -327,6 +327,13 @@ class Store:
         """One shard's rv sequence (per-shard durability watermark)."""
         return self._shards[index].rv
 
+    def shard_emitted(self, index: int) -> int:
+        """One shard's emitted-event count. Moves on every commit AND on
+        hard deletes (which rv skips) — the staleness token speculative
+        readers (solver/scheduler.py overlap pump) compare before
+        trusting work computed against an earlier view of the shard."""
+        return self._shards[index].emitted
+
     def resource_version_vector(self) -> Tuple[int, ...]:
         """Per-shard resourceVersion vector — the exact form of the merge
         rule `resource_version` collapses to a scalar (docs/control-plane.md)."""
@@ -473,6 +480,7 @@ class Store:
             type=type_, kind=obj.kind, obj=obj, blob=blob, old=old,
             shard=shard.index,
         )
+        shard.emitted += 1
         # the committed view just mutated: fold the delta into the OWNING
         # SHARD's level-1 aggregate (kind-gated inside; `old` is the
         # previous committed object). The level-2 summary tree refolds
@@ -719,6 +727,74 @@ class Store:
             # cached pod aggregate
             self.sync_cache()
         return n
+
+    # -- remote mirror apply (runtime/procworkers.py) --------------------
+
+    def apply_remote_event(self, etype: str, envelope: dict) -> "WatchEvent":
+        """Mirror-apply ONE wire-encoded commit from a peer control-plane
+        process (the worker-process backend, docs/control-plane.md §5).
+
+        The process boundary is crossed only by the api/serialize.py wire
+        codec — the same ``object_envelope``/``decode_envelope`` pair the
+        WAL uses — so this is the single sanctioned entry for replicating
+        a peer's commit into this process's mirror: decode, RESTAMP the
+        object with this mirror's next rv, commit through the normal
+        internal plumbing (indices, aggregates, canonical blob) and emit
+        through the normal ``_emit`` fan-out so every consumer (WAL
+        streams, engine backlogs, delta/quota folds, flight recorder)
+        sees the commit exactly as if it had been made locally.
+
+        Restamp, not replay-the-peer's-rv: best-effort Event objects are
+        the one sanctioned cross-shard write (controller/common.py
+        record_event), so two processes can interleave commits on the
+        Event shard in different local orders — per-object rv VALUES are
+        mirror-local. What every mirror agrees on is the COUNTS: each
+        ADDED/MODIFIED apply bumps its shard's sequence by exactly one
+        (hard deletes by zero, same as the local paths), so the scalar rv
+        and per-shard final rv the serial-twin A/B compares are identical,
+        and optimistic-concurrency rv checks never cross a process (each
+        shard's non-Event writes happen in exactly one process).
+
+        Returns the WatchEvent for the applied commit. The informer CACHE
+        is deliberately NOT advanced here: cache advance is a ROUND
+        boundary in the serial drain (route time), so the caller — the
+        worker process, which never routes — holds the returned event
+        and applies it to the cache when the coordinator's sync watermark
+        says its round boundary has passed.
+        """
+        from grove_tpu.durability.wal import decode_envelope
+
+        obj = decode_envelope(envelope)
+        shard = self._shard_of_obj(obj)
+        with shard.lock:
+            key = obj_key(obj)
+            old = shard.committed.get(obj.kind, {}).get(key)
+            if etype == DELETED:
+                # hard deletes do not bump the shard's rv sequence (they
+                # have no new committed state) — mirror that exactly
+                if old is None:
+                    raise GroveError(
+                        ERR_CONFLICT,
+                        f"remote delete of unknown {obj.kind} {key}:"
+                        " mirror diverged from the committing process",
+                        "apply-remote",
+                    )
+                blob = self._uncommit(shard, old)
+                self._emit(DELETED, old, blob, shard=shard)
+                return WatchEvent(
+                    type=DELETED, kind=old.kind, obj=old, blob=blob,
+                    old=None, shard=shard.index,
+                )
+            shard.rv += 1
+            obj.metadata.resource_version = shard.rv
+            if old is not None:
+                self._index_remove(shard, old)
+            blob = self._commit(shard, obj)
+            self._emit(etype, obj, blob, old=old, shard=shard)
+            return WatchEvent(
+                type=etype, kind=obj.kind, obj=obj, blob=blob,
+                old=None, shard=shard.index,
+            )
 
     # -- CRUD -----------------------------------------------------------
 

@@ -65,12 +65,12 @@ Workers are threads. On free-threaded builds (and for the C-heavy
 slices of the reconcile path — pickling, fsync, numpy — even under the
 GIL) they overlap for real; on GIL builds the drain stays correct and
 deterministic with bounded overhead, which is what the worker-count
-sweep in ``make parallel-smoke`` reports honestly. A worker-PROCESS
-fallback (one process per shard group over the per-shard WAL streams as
-the shipping lanes) shares this module's ownership map and coordination
-points by design; it is documented in docs/control-plane.md §5 and left
-to a follow-up — the thread executor is the semantic contract either
-backend must meet.
+sweep in ``make parallel-smoke`` reports honestly. The worker-PROCESS
+backend (runtime/procworkers.py, GROVE_TPU_CP_BACKEND=process) shares
+this module's ownership map and coordination points and crosses its
+boundary only through the wire codec — the thread executor here is the
+semantic contract both backends meet, pinned by the serial-twin A/B at
+both backend settings.
 
 Worker-pool internals are PRIVATE to runtime/ (grovelint GL018
 ``worker-affinity``): per-shard state may only be touched from its
@@ -103,6 +103,8 @@ class ParallelDrain:
     armed. Lifetime: the pool is engine-lifetime (``close()`` releases
     it with ``Engine.close()``)."""
 
+    backend = "thread"
+
     def __init__(self, engine, workers: int) -> None:
         self.engine = engine
         # clamp to the shard count: `worker_of = shard % W` can never
@@ -118,6 +120,7 @@ class ParallelDrain:
         self.reconciles_by_worker = [0] * self.workers
         self._worker_busy_s = [0.0] * self.workers
         METRICS.set("cp_workers", self.workers)
+        METRICS.set("cp_backend_process", 0)
 
     # -- ownership map ---------------------------------------------------
 
@@ -232,6 +235,7 @@ class ParallelDrain:
     def stats(self) -> dict:
         """Lifetime counters (the bench/smoke "parallel" block)."""
         return {
+            "backend": self.backend,
             "workers": self.workers,
             "reconciles_by_worker": list(self.reconciles_by_worker),
             "busy_seconds_by_worker": [

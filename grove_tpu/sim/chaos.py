@@ -205,6 +205,12 @@ class ChaosReport:
     remediator_armed: bool = False
     remediations_executed: int = 0
     remediations_skipped: int = 0
+    # worker_crash fault (process executor only): reconcile-worker
+    # processes SIGKILLed mid-round and repatriated by the coordinator
+    # (runtime/procworkers.py); scheduled only when the process drain is
+    # armed, and then REQUIRED to have fired
+    worker_crashes: int = 0
+    require_worker_crashes: int = 0
 
     @property
     def ok(self) -> bool:
@@ -220,6 +226,7 @@ class ChaosReport:
             and self.drains_completed >= 1
             and self.failovers >= 1
             and self.recoveries >= self.require_recoveries
+            and self.worker_crashes >= self.require_worker_crashes
         )
 
     def as_dict(self) -> dict:
@@ -246,6 +253,7 @@ class ChaosReport:
             "remediator_armed": self.remediator_armed,
             "remediations_executed": self.remediations_executed,
             "remediations_skipped": self.remediations_skipped,
+            "worker_crashes": self.worker_crashes,
             "converged": self.converged,
             "signature_matches_fault_free": self.signature_matches_fault_free,
             "ok": self.ok,
@@ -445,6 +453,22 @@ class ChaosRunner:
                 note="failover mid-drain",
             )
         )
+        # worker-process executor armed (GROVE_TPU_CP_BACKEND=process):
+        # SIGKILL a reconcile worker while the late re-admission burst is
+        # in flight — the coordinator must repatriate its shards and
+        # re-execute its keys inline, deterministically (never hang).
+        # Scheduled AFTER the leader crash: failover swaps the engine,
+        # and a kill armed on the deposed drain would be torn down unfired
+        if hasattr(self.harness.engine.workers, "chaos_kill_worker"):
+            self.report.require_worker_crashes = 1
+            faults.append(
+                Fault(
+                    dead_dwell + rng.uniform(1.0, 2.0),
+                    "worker_crash",
+                    note="SIGKILL reconcile worker mid-round (process"
+                    " executor); repatriate + inline re-execution",
+                )
+            )
         # lost nodes come back late — capacity returns, requeued gangs must
         # re-admit atomically
         for i, node in enumerate((loss1, loss2)):
@@ -506,7 +530,32 @@ class ChaosRunner:
             self._leader_failover()
         elif fault.kind == "controlplane_crash":
             self._controlplane_crash()
+        elif fault.kind == "worker_crash":
+            self._worker_crash()
         self.report.faults.append(fault.as_dict())
+
+    def _worker_crash(self) -> None:
+        """Arm the process executor's chaos hook: the reconcile worker
+        owning the workload shard is SIGKILLed right after the next batch
+        is dispatched to it (runtime/procworkers.py `chaos_kill_worker`).
+        Thread-backend and serial control planes have no worker process
+        to kill — the fault degrades to a no-op there, and the schedule
+        only requires a crash when the process drain is armed."""
+        h = self.harness
+        drain = h.engine.workers
+        if drain is None or not hasattr(drain, "chaos_kill_worker"):
+            return
+        # the chaos workload lives in one namespace, so its shard's owner
+        # is the worker guaranteed to receive batches; lane 0 is the
+        # coordinator itself (no process), so fall back to worker 1
+        victim = drain.worker_of(h.store.shard_index("default"))
+        drain.chaos_kill_worker = victim if victim != 0 else 1
+        # the kill fires at the next batch DISPATCHED to the victim — a
+        # quiet engine would never give it one. Storm the queue first:
+        # requeue_all is a level-triggered re-list (semantically a no-op
+        # for idempotent controllers), so this tick's drain is guaranteed
+        # to have a round in flight for the SIGKILL to land mid-round
+        h.engine.requeue_all()
 
     # -- control-plane crash (tentpole: durability + recovery) -------------
 
@@ -845,6 +894,7 @@ class ChaosRunner:
         drains_done_before = METRICS.counters.get(
             "node_drains_completed_total", 0
         )
+        wcrashes_before = METRICS.counters.get("cp_worker_crashes_total", 0)
 
         # fault-free twin FIRST (same workload, converged, untouched): the
         # convergence target the chaotic run must reproduce
@@ -963,6 +1013,10 @@ class ChaosRunner:
         report.drains_completed = int(
             METRICS.counters.get("node_drains_completed_total", 0)
             - drains_done_before
+        )
+        report.worker_crashes = int(
+            METRICS.counters.get("cp_worker_crashes_total", 0)
+            - wcrashes_before
         )
         report.rescues = self._archived_rescues + list(h.node_monitor.rescues)
         report.pin_verified_rescues = sum(

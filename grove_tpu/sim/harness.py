@@ -160,6 +160,11 @@ class SimHarness:
 
         self.node_monitor = NodeHealthMonitor(self.store, self.cluster)
         self.scheduler.monitor = self.node_monitor
+        # overlap pump (docs/control-plane.md §5): the process-backend
+        # drain spends worker flight time on speculative gang encode.
+        # Inert on the serial engine and the thread backend — only
+        # ProcessDrain ever invokes the hook.
+        self.engine.overlap_hook = self.scheduler.speculate_encode
         # voluntary-disruption layer (grove_tpu/disruption): one broker
         # gates every voluntary evictor — preemption/reclaim (scheduler),
         # rolling update (ctx), node drain (the controller below). Inert

@@ -54,6 +54,7 @@ def _make_harness(
     num_shards: int,
     workers: int,
     durability_dir: Optional[str] = None,
+    backend: str = "thread",
 ) -> SimHarness:
     """Harness with EXACTLY `workers` drain lanes (1 = the serial drain).
 
@@ -67,12 +68,14 @@ def _make_harness(
         num_nodes=n_nodes, store=store, durability_dir=durability_dir
     )
     if h.engine.workers is not None and (
-        workers <= 1 or h.engine.workers.workers != workers
+        workers <= 1
+        or h.engine.workers.workers != workers
+        or h.engine.workers.backend != backend
     ):
         h.engine.close()  # drop the env-armed pool (enable_workers below
-        # re-arms fresh when this scenario wants a different count)
+        # re-arms fresh when this scenario wants a different count/backend)
     if workers > 1 and h.engine.workers is None:
-        armed = h.engine.enable_workers(workers)
+        armed = h.engine.enable_workers(workers, backend=backend)
         assert armed, "worker arming requires a sharded in-memory store"
     return h
 
@@ -230,9 +233,14 @@ def parallel_ab(
     storm_rounds: int = 3,
     wal_dirs: Optional[Tuple[str, str]] = None,
     max_ticks: Optional[int] = None,
+    backend: str = "thread",
 ) -> dict:
     """Lockstep serial-vs-workers twin run; compares at EVERY converge
     boundary. Returns the report; ``problems`` empty ⇔ bit-identical.
+
+    ``backend`` picks the worker twin's executor ("thread" |
+    "process") — the serial twin is always the single-threaded drain,
+    so one scenario pins BOTH executors to the same contract.
 
     ``wal_dirs=(serial_dir, workers_dir)`` additionally attaches
     per-shard WAL streams to both twins and compares the durable acked
@@ -242,7 +250,11 @@ def parallel_ab(
         n_nodes, num_shards, 1, wal_dirs[0] if wal_dirs else None
     )
     parallel = _make_harness(
-        n_nodes, num_shards, workers, wal_dirs[1] if wal_dirs else None
+        n_nodes,
+        num_shards,
+        workers,
+        wal_dirs[1] if wal_dirs else None,
+        backend=backend,
     )
     tenants = tenant_namespaces(n_tenants)
     problems: List[str] = []
@@ -331,6 +343,7 @@ def parallel_ab(
         "sets": n_sets,
         "shards": num_shards,
         "workers": workers,
+        "backend": backend,
         "seed": seed,
         "boundaries_compared": boundaries,
         "reconciles": reconciles,
@@ -351,6 +364,7 @@ def worker_sweep(
     n_nodes: int = 64,
     num_shards: int = 8,
     worker_counts: Tuple[int, ...] = (1, 2, 4, 8),
+    backend: str = "thread",
 ) -> dict:
     """One population converged per worker count; µs/reconcile + speedup
     vs the serial arm. A throwaway warmup converge absorbs the solver's
@@ -369,7 +383,7 @@ def worker_sweep(
     rows = []
     base_wall = None
     for workers in worker_counts:
-        h = _make_harness(n_nodes, num_shards, workers)
+        h = _make_harness(n_nodes, num_shards, workers, backend=backend)
         solver0 = METRICS.hist_sum.get("gang_solve_seconds", 0.0)
         r0 = _reconcile_count()
         gc.collect()
@@ -418,5 +432,103 @@ def worker_sweep(
         "sets": n_sets,
         "nodes": n_nodes,
         "shards": num_shards,
+        "backend": backend,
         "sweep": rows,
+    }
+
+
+def process_codec_ab(
+    n_sets: int = 256,
+    n_nodes: int = 256,
+    num_shards: int = 4,
+    workers: int = 2,
+) -> dict:
+    """Paired coordinator-overlap + boundary-codec A/B at the PR-2
+    control-plane bench shape (docs/control-plane.md §5).
+
+    Two process-backend converges of the SAME population, same build:
+
+    - **off**: the pre-shave reflective wire decoder
+      (``api/wire.py NO_MEMO``) and the overlap pump unhooked — the
+      boundary/coordinator cost profile the process backend had before
+      the shave;
+    - **on**: memoized per-class decode plans + the scheduler's
+      speculative-encode overlap pump (``engine.overlap_hook``).
+
+    Reports µs/reconcile per arm (control-plane time: wall minus solver,
+    exactly the worker_sweep metric) and the paired reduction — the
+    ≥10%-reduction gate's evidence row, stamped with the ``"host"``
+    block so a 1-core bounded-overhead claim and a multi-core speedup
+    claim are distinguishable after the fact. Both arms must reconcile
+    identically (same deterministic schedule) or the comparison is
+    meaningless and the row says so."""
+    from grove_tpu.api import wire
+    from grove_tpu.observability.hostinfo import host_block
+
+    tenants = tenant_namespaces(min(16, n_sets))
+    # warmup absorbs the solver's XLA compile at the measured node count
+    # (chunk kernel compiles per (chunk, nodes) shape) — without it the
+    # compile bills to the first arm and fabricates a reduction
+    _warm = _make_harness(n_nodes, num_shards, 1)
+    _populate(_warm, n_sets, tenants)
+    _warm.converge(max_ticks=60 + 8 * n_sets)
+    _warm.engine.close()
+    del _warm
+    gc.collect()
+
+    def _arm(shaved: bool) -> dict:
+        h = _make_harness(n_nodes, num_shards, workers, backend="process")
+        wire.NO_MEMO = not shaved
+        if not shaved:
+            h.engine.overlap_hook = None
+        solver0 = METRICS.hist_sum.get("gang_solve_seconds", 0.0)
+        r0 = _reconcile_count()
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            _populate(h, n_sets, tenants)
+            h.converge(max_ticks=60 + 8 * n_sets)
+            wall = time.perf_counter() - t0
+        finally:
+            gc.enable()
+            gc.unfreeze()
+            gc.collect()
+            wire.NO_MEMO = False
+        reconciles = _reconcile_count() - r0
+        solver_s = METRICS.hist_sum.get("gang_solve_seconds", 0.0) - solver0
+        cp = max(wall - solver_s, 0.0)
+        stats = h.engine.workers.stats() if h.engine.workers else {}
+        h.engine.close()
+        del h
+        gc.collect()
+        return {
+            "wall_seconds": round(wall, 3),
+            "control_plane_seconds": round(cp, 3),
+            "reconciles": reconciles,
+            "us_per_reconcile": round(1e6 * cp / max(reconciles, 1), 1),
+            "boundary_bytes": stats.get("boundary_bytes"),
+        }
+
+    off = _arm(shaved=False)
+    on = _arm(shaved=True)
+    reduction = 1.0 - (
+        on["us_per_reconcile"] / max(off["us_per_reconcile"], 1e-9)
+    )
+    return {
+        "shape": {
+            "sets": n_sets,
+            "nodes": n_nodes,
+            "shards": num_shards,
+            "workers": workers,
+            "backend": "process",
+        },
+        "off": off,
+        "on": on,
+        "reconciles_identical": off["reconciles"] == on["reconciles"],
+        "us_per_reconcile_reduction_pct": round(100.0 * reduction, 1),
+        "gate_10pct_reduction": reduction >= 0.10
+        and off["reconciles"] == on["reconciles"],
+        "host": host_block(backend="process"),
     }

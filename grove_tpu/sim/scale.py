@@ -29,6 +29,7 @@ from typing import List, Optional, Tuple
 from grove_tpu.api.load import load_podcliquesets
 from grove_tpu.api.meta import deep_copy
 from grove_tpu.api.pod import is_ready
+from grove_tpu.observability.hostinfo import host_block
 from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.clock import VirtualClock
 from grove_tpu.runtime.store import Store
@@ -247,6 +248,15 @@ def converge_population(
         # serial drain; docs/control-plane.md §5)
         "workers": (
             h.engine.workers.workers if h.engine.workers is not None else 1
+        ),
+        # tail-honesty: the box that produced these numbers, with the
+        # executor backend that actually ran (observability/hostinfo.py)
+        "host": host_block(
+            backend=(
+                h.engine.workers.backend
+                if h.engine.workers is not None
+                else "serial"
+            )
         ),
     }
     if h.engine.workers is not None:
@@ -512,6 +522,13 @@ def scale_artifact(
         n_nodes=frontier_ab_shape[1],
         num_shards=num_shards,
     )
+    # worker-process backend: the paired overlap+codec A/B at the PR-2
+    # control-plane shape (docs/control-plane.md §5) — the ≥10%
+    # µs/reconcile-reduction gate's evidence row, host-stamped
+    from grove_tpu.sim.parallel import process_codec_ab
+
+    gc.collect()
+    report["process_ab"] = process_codec_ab()
     if shape_1m is not None:
         m_sets, m_nodes, m_shards = shape_1m
         gc.collect()

@@ -340,6 +340,16 @@ class DeltaSolveState:
         METRICS.inc("delta_warm_start_hits_total")
         return entry["spec"], entry["pods"]
 
+    def has_clean_spec(self, namespace: str, gang_name: str) -> bool:
+        """Read-only peek at whether ``cached_spec`` COULD hit for this
+        gang (clean + present; the pod-name check still runs at the real
+        lookup). The scheduler's overlap pump uses it to skip speculating
+        gangs the warm-start cache already covers — without this pure
+        variant the speculation pass would perturb the warm-start hit
+        accounting relative to the serial twin."""
+        key = (namespace, gang_name)
+        return key not in self._dirty_gangs and key in self._specs
+
     def store_spec(
         self,
         namespace: str,

@@ -17,9 +17,19 @@ Acceptance bar for the concurrent shard reconcile workers:
   guard, accountant recounts, span leaks) passes with workers >= 2 on
   a 3-shard store.
 
+With ``--backend=process`` the same serial-twin A/B and a reduced
+sweep run on the shared-nothing worker-PROCESS executor
+(runtime/procworkers.py): fork-per-generation workers, wire-codec-only
+boundary, crash repatriation. `make check` runs both arms.
+
+Every report carries the ``"host"`` block (nproc, cgroup CPU quota,
+Python version, free-threading flag, backend) — the tail-honesty stamp
+for any speedup/overhead reading of the sweep table.
+
 Exit 0 only when every gate holds.
 
-Usage: python scripts/parallel_smoke.py [--sets N] [--workers N] [--json]
+Usage: python scripts/parallel_smoke.py [--sets N] [--workers N]
+       [--backend thread|process] [--json]
 """
 
 from __future__ import annotations
@@ -40,13 +50,14 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def _sanitized_chaos_arm() -> dict:
+def _sanitized_chaos_arm(backend: str = "thread") -> dict:
     """chaos_smoke --sanitize re-run with workers armed on a sharded
     store (subprocess: the env opt-ins must bind before any harness
     builds, and the chaos run swaps whole control planes)."""
     env = dict(os.environ)
     env["GROVE_TPU_STORE_SHARDS"] = "3"
     env["GROVE_TPU_CP_WORKERS"] = "2"
+    env["GROVE_TPU_CP_BACKEND"] = backend
     proc = subprocess.run(
         [
             sys.executable,
@@ -77,10 +88,19 @@ def main() -> int:
     parser.add_argument("--shards", type=int, default=4)
     parser.add_argument("--workers", type=int, default=4)
     parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument(
+        "--backend",
+        choices=("thread", "process"),
+        default="thread",
+        help="control-plane executor under test; process = the"
+        " shared-nothing worker-process backend (fork generations,"
+        " wire-codec boundary)",
+    )
     parser.add_argument("--skip-chaos", action="store_true")
     parser.add_argument("--json", action="store_true")
     args = parser.parse_args()
 
+    from grove_tpu.observability.hostinfo import host_block
     from grove_tpu.sim.parallel import parallel_ab, worker_sweep
 
     problems = []
@@ -97,6 +117,7 @@ def main() -> int:
             seed=args.seed,
             storm_rounds=2,
             wal_dirs=(d_serial, d_workers),
+            backend=args.backend,
         )
     finally:
         shutil.rmtree(d_serial, ignore_errors=True)
@@ -108,12 +129,17 @@ def main() -> int:
     if len(busy) < 2:
         problems.append("A/B run never spread reconciles over >=2 workers")
 
-    # 2. worker-count sweep
+    # 2. worker-count sweep (process arm stays lean: every worker is a
+    # forked interpreter per drain generation, so 1/2 covers the
+    # serial-vs-multi claim without an 8-way fork storm in the smoke)
     sweep = worker_sweep(
         n_sets=max(args.sets * 2, 32),
         n_nodes=max(args.nodes, 32),
         num_shards=args.shards,
-        worker_counts=(1, 2, 4, 8),
+        worker_counts=(
+            (1, 2) if args.backend == "process" else (1, 2, 4, 8)
+        ),
+        backend=args.backend,
     )
     counts = {row["reconciles"] for row in sweep["sweep"]}
     if len(counts) != 1:
@@ -125,13 +151,16 @@ def main() -> int:
     # 3. sanitized chaos arm with workers >= 2
     chaos = {"skipped": True}
     if not args.skip_chaos:
-        chaos = _sanitized_chaos_arm()
+        chaos = _sanitized_chaos_arm(backend=args.backend)
         if not chaos["ok"]:
             problems.append(
                 f"sanitized chaos arm (3 shards, 2 workers) failed: {chaos}"
             )
 
+    host = host_block(backend=args.backend)
     report = {
+        "backend": args.backend,
+        "host": host,
         "ab": ab,
         "sweep": sweep,
         "sanitized_chaos": chaos,
@@ -141,6 +170,14 @@ def main() -> int:
     if args.json:
         print(json.dumps(report, indent=1, default=str))
     else:
+        quota = host["cgroup_cpu_quota"]
+        print(
+            f"host: nproc={host['nproc']}"
+            f" cgroup_cpu_quota={'none' if quota is None else quota}"
+            f" python={host['python']}"
+            f" free_threading={host['free_threading']}"
+            f" backend={args.backend}"
+        )
         print(
             f"serial-twin A/B: {ab['boundaries_compared']} converge"
             f" boundaries compared at workers={args.workers} —"
@@ -176,7 +213,10 @@ def main() -> int:
             print("PROBLEMS:")
             for p in problems:
                 print(f"  - {p}")
-    print("parallel smoke OK" if not problems else "parallel smoke FAILED")
+    print(
+        f"parallel smoke ({args.backend}) "
+        + ("OK" if not problems else "FAILED")
+    )
     return 0 if not problems else 1
 
 

@@ -2,7 +2,7 @@
 
 Three layers (docs/static-analysis.md):
 
-1. **Fixture teeth** — for every enforced rule (GL001..GL019), a
+1. **Fixture teeth** — for every enforced rule (GL001..GL020), a
    known-bad snippet
    must fire and its known-good twin must pass. This is what pins
    "deleting any single enforced invariant makes `make lint` fail".
@@ -324,6 +324,24 @@ FIXTURES = {
             "def _act(self, node):\n"
             "    self.drainer.request_drain(node)\n"
             "    LEDGER.record('slo-burn', 'drain-node', 'executed')\n"
+        ),
+    },
+    "GL020": {
+        "rel": "grove_tpu/runtime/fixture.py",
+        "bad": (
+            "import multiprocessing as mp\n"
+            "import pickle\n\n"
+            "def push(conn, obj):\n"
+            "    q = mp.Queue()\n"
+            "    conn.send(obj)\n"
+            "    return conn.recv()\n"
+        ),
+        "good": (
+            "import json\n"
+            "import multiprocessing as mp\n\n"
+            "def push(conn, doc):\n"
+            "    conn.send_bytes(json.dumps(doc).encode('utf-8'))\n"
+            "    return json.loads(conn.recv_bytes().decode('utf-8'))\n"
         ),
     },
     "GL010": {
@@ -650,6 +668,7 @@ def test_grafting_worker_affinity_break_fails_lint():
     for own_rel in (
         "grove_tpu/runtime/engine.py",
         "grove_tpu/runtime/workers.py",
+        "grove_tpu/runtime/procworkers.py",
         "grove_tpu/runtime/workqueue.py",
         "grove_tpu/runtime/store.py",
         "grove_tpu/durability/wal.py",
@@ -722,6 +741,57 @@ def test_grafting_unlogged_act_fails_lint():
         assert "GL019" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
+
+
+def test_grafting_pickled_boundary_fails_lint():
+    """GL020 live-tree teeth: grafting a pickle import, a pickling
+    `conn.send`, or a transparently-pickling multiprocessing.Queue onto
+    the REAL process-executor source must fail lint — the worker
+    boundary is wire-codec bytes only (docs/control-plane.md §5), and
+    the serial-twin bit-identity argument dies the moment a live object
+    crosses it. Modules that never import multiprocessing (store.py's
+    in-process canonical pickle blobs) stay out of scope."""
+    rel = "grove_tpu/runtime/procworkers.py"
+    src = (ROOT / rel).read_text()
+    assert "GL020" not in rules_of(lint_source(src, rel))
+    rogue = "\n\nimport pickle\n"
+    assert "GL020" in rules_of(lint_source(src + rogue, rel))
+    rogue2 = (
+        "\n\ndef _rogue_ship_object(conn, obj):\n"
+        "    conn.send(obj)\n"
+        "    return conn.recv()\n"
+    )
+    report2 = lint_source(src + rogue2, rel)
+    assert len([v for v in report2.violations if v.rule == "GL020"]) == 2
+    rogue3 = (
+        "\n\ndef _rogue_queue():\n"
+        "    return multiprocessing.Queue()\n"
+    )
+    assert "GL020" in rules_of(lint_source(src + rogue3, rel))
+    # privacy tooth: a foreign poke at the drain's channel/generation
+    # state from real non-owner source fails lint (the documented
+    # chaos_kill_worker hook stays legal — sim/chaos.py uses it)
+    rel4 = "grove_tpu/sim/chaos.py"
+    src4 = (ROOT / rel4).read_text()
+    assert "GL020" not in rules_of(lint_source(src4, rel4))
+    rogue4 = (
+        "\n\ndef _rogue_tear_channel(drain):\n"
+        "    drain._conns.clear()\n"
+        "    drain._gen_active = False\n"
+    )
+    report4 = lint_source(src4 + rogue4, rel4)
+    assert len([v for v in report4.violations if v.rule == "GL020"]) == 2
+    # scope: pickle use in a module WITHOUT multiprocessing is GL020-free
+    # (store.py's committed-blob pickle is the canonical in-process case)
+    own_rel = "grove_tpu/runtime/store.py"
+    own = (ROOT / own_rel).read_text()
+    assert "GL020" not in rules_of(lint_source(own, own_rel))
+    assert "GL020" not in rules_of(
+        lint_source(
+            "import pickle\n\ndef f(x):\n    return pickle.dumps(x)\n",
+            "grove_tpu/autoscale/fixture.py",
+        )
+    )
 
 
 def test_gl001_strict_scope_bans_perf_counter_in_traffic():

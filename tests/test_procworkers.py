@@ -1,0 +1,276 @@
+"""Worker-process control plane (runtime/procworkers.py,
+docs/control-plane.md §5).
+
+The shared-nothing process executor exists only if it is semantically
+invisible, like the thread backend before it (tests/test_workers.py) —
+but with a harder boundary: worker processes share NOTHING with the
+coordinator except the wire codec. Pinned here:
+
+- serial-twin storm A/B bit-identical (admissions, store content with
+  canonical uids, scalar rv, per-shard WAL acked prefixes) at workers
+  ∈ {2, 4} across three seeds;
+- cold-restart recovery over WAL streams the WORKERS wrote (stream
+  ownership travels across the fork boundary and back);
+- clean shutdown: no orphaned worker processes after close();
+- chaos ``worker_crash``: a worker SIGKILLed mid-round is repatriated
+  and its keys re-execute inline, deterministically — the converged
+  store equals the crash-free serial twin's, and the run never hangs;
+- the coordinator overlap pump (scheduler.speculate_encode): a
+  speculative spec is byte-identical to the serial build, consumption
+  falls back to the serial re-encode on ANY staleness (forced here),
+  and quiet rounds keep hitting.
+"""
+
+import multiprocessing
+import shutil
+import tempfile
+
+import pytest
+
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.names import LABEL_PODGANG
+from grove_tpu.api.types import GenericObject
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.sim.parallel import (
+    _dump,
+    _make_harness,
+    _populate,
+    durable_state_normalized,
+    parallel_ab,
+)
+from grove_tpu.sim.scale import tenant_namespaces
+
+
+class TestProcessSerialTwin:
+    """The A/B contract over the wire-codec boundary: workers ∈ {2, 4},
+    seeds ×3, every converge boundary of the storm compared."""
+
+    @pytest.mark.parametrize(
+        "workers,seed",
+        [(2, 1234), (4, 7), (4, 2026)],
+    )
+    def test_storm_equivalence(self, workers, seed):
+        rep = parallel_ab(
+            n_sets=18,
+            n_nodes=16,
+            num_shards=5,
+            workers=workers,
+            seed=seed,
+            storm_rounds=2,
+            backend="process",
+        )
+        assert rep["identical"], rep["problems"]
+        assert rep["boundaries_compared"] >= 3
+        for serial_n, process_n in rep["reconciles"]:
+            assert serial_n == process_n
+        stats = rep["worker_stats"]
+        assert stats["backend"] == "process"
+        assert stats["worker_crashes"] == 0
+        # work genuinely crossed the boundary: remote lanes reconciled,
+        # and every crossing was wire-codec bytes (counted per frame)
+        assert sum(stats["reconciles_by_worker"][1:]) > 0
+        assert stats["boundary_bytes"] > 0
+
+    def test_wal_acked_prefixes_identical(self):
+        d1 = tempfile.mkdtemp(prefix="grove-proc-ab-s-")
+        d2 = tempfile.mkdtemp(prefix="grove-proc-ab-w-")
+        try:
+            rep = parallel_ab(
+                n_sets=12,
+                n_nodes=16,
+                num_shards=3,
+                workers=2,
+                storm_rounds=1,
+                wal_dirs=(d1, d2),
+                backend="process",
+            )
+            assert rep["identical"], rep["problems"]
+            assert rep["wal_acked_identical"] is True
+        finally:
+            shutil.rmtree(d1, ignore_errors=True)
+            shutil.rmtree(d2, ignore_errors=True)
+
+
+class TestCrashRecovery:
+    def test_cold_restart_over_worker_written_wals(self):
+        """Stream ownership round-trips through the fork boundary: the
+        workers wrote their shards' WAL streams; after a crash with a
+        torn tail, recovery from those files yields a clean acked prefix
+        equal to the serial twin's durable state."""
+        from grove_tpu.durability import recover_store, verify_acked_prefix
+
+        d_serial = tempfile.mkdtemp(prefix="grove-proc-crash-s-")
+        d_workers = tempfile.mkdtemp(prefix="grove-proc-crash-w-")
+        try:
+            tenants = tenant_namespaces(6)
+            runs = {}
+            for workers, directory in ((1, d_serial), (2, d_workers)):
+                h = _make_harness(
+                    16, 3, workers, directory, backend="process"
+                )
+                _populate(h, 10, tenants)
+                h.converge(max_ticks=200)
+                h.durability.simulate_crash(torn_tail_bytes=23)
+                recovered, report = recover_store(
+                    directory, clock=h.clock, cache_lag=True
+                )
+                assert verify_acked_prefix(directory, recovered) == []
+                assert report.torn_tail
+                runs[workers] = durable_state_normalized(directory)
+                h.engine.close()
+            assert runs[1] == runs[2]
+        finally:
+            shutil.rmtree(d_serial, ignore_errors=True)
+            shutil.rmtree(d_workers, ignore_errors=True)
+
+
+class TestShutdown:
+    def test_clean_shutdown_leaves_no_orphans(self):
+        """Generations are torn down at every drain exit and close() is
+        idempotent: after a converge + close, no cp-worker process is
+        alive anywhere in this interpreter."""
+        h = _make_harness(16, 3, 2, backend="process")
+        _populate(h, 6, tenant_namespaces(3))
+        h.converge(max_ticks=200)
+        drain = h.engine.workers
+        assert drain is not None and not drain.active
+        h.engine.close()
+        assert drain._procs == {}
+        orphans = [
+            p
+            for p in multiprocessing.active_children()
+            if p.name.startswith("cp-worker-")
+        ]
+        assert orphans == []
+
+
+class TestWorkerCrash:
+    def test_sigkill_mid_round_reexecutes_deterministically(self):
+        """The chaos ``worker_crash`` path (sim/chaos.py schedules it on
+        the process executor): SIGKILL a worker right after a batch is
+        dispatched to it. The coordinator must repatriate its shards and
+        re-execute its keys inline — converging to a store bit-identical
+        to an uncrashed serial run, never hanging."""
+        tenants = tenant_namespaces(4)
+        serial = _make_harness(16, 3, 1)
+        _populate(serial, 8, tenants)
+        serial.converge(max_ticks=200)
+
+        crashes0 = METRICS.counters.get("cp_worker_crashes_total", 0)
+        h = _make_harness(16, 3, 2, backend="process")
+        h.engine.workers.chaos_kill_worker = 1
+        _populate(h, 8, tenants)
+        h.converge(max_ticks=200)
+        stats = h.engine.workers.stats()
+        assert stats["worker_crashes"] == 1
+        assert (
+            METRICS.counters.get("cp_worker_crashes_total", 0)
+            == crashes0 + 1
+        )
+        assert _dump(h) == _dump(serial)
+        h.engine.close()
+        serial.engine.close()
+
+
+_BLOCKED_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: blocked
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: big
+        spec:
+          roleName: big
+          replicas: 2
+          podSpec:
+            containers:
+              - name: big
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 64
+"""
+
+
+class TestOverlapPump:
+    """scheduler.speculate_encode + its consumption in _encode_pending:
+    purity, hit-on-quiet-round, forced-stale fallback to the serial
+    re-encode."""
+
+    def _blocked_harness(self):
+        # cpu 64 > any sim node's capacity (8): the gang stays pending
+        # forever, giving the pump a stable pending set to speculate on
+        h = _make_harness(4, 3, 1)
+        h.apply(load_podcliquesets(_BLOCKED_YAML)[0])
+        for _ in range(6):
+            h.engine.drain()
+            h.schedule()
+            h.cluster.kubelet_tick()
+            h.clock.advance(1.0)
+        # the delta warm-start cache would cover this quiet gang first —
+        # disable it so consumption exercises the overlap entry itself
+        h.scheduler.delta = None
+        return h
+
+    def test_speculated_spec_is_byte_identical(self):
+        h = self._blocked_harness()
+        sched = h.scheduler
+        assert sched.speculate_encode() == 1
+        ((ns, gname), entry) = next(iter(sched._overlap_cache.items()))
+        pods = [
+            p
+            for p in sched._pending_pods(ns)
+            if p.metadata.labels.get(LABEL_PODGANG) == gname
+        ]
+        fresh = sched._build_gang_spec(ns, gname, pods)
+        assert fresh is not None
+        assert fresh[0] == entry[2]
+        assert dict(fresh[1]) == entry[3]
+        h.engine.close()
+
+    def test_quiet_round_hits_and_keeps_entry(self):
+        h = self._blocked_harness()
+        sched = h.scheduler
+        sched.speculate_encode()
+        hits0 = METRICS.counters.get("cp_overlap_hits_total", 0)
+        stale0 = METRICS.counters.get("cp_overlap_stale_total", 0)
+        h.schedule()
+        assert METRICS.counters.get("cp_overlap_hits_total", 0) == hits0 + 1
+        assert METRICS.counters.get("cp_overlap_stale_total", 0) == stale0
+        # the entry survives a hit: the next quiet round hits again
+        # without re-speculating
+        h.schedule()
+        assert METRICS.counters.get("cp_overlap_hits_total", 0) == hits0 + 2
+        h.engine.close()
+
+    def test_forced_stale_falls_back_to_serial_reencode(self):
+        h = self._blocked_harness()
+        sched = h.scheduler
+        sched.speculate_encode()
+        key = next(iter(sched._overlap_cache))
+        ns = key[0]
+        hits0 = METRICS.counters.get("cp_overlap_hits_total", 0)
+        stale0 = METRICS.counters.get("cp_overlap_stale_total", 0)
+        # ANY commit touching the namespace's shard between speculation
+        # and consumption bumps the shard's emitted count — the token
+        # mismatches and consumption must rebuild serially
+        h.store.create(
+            GenericObject(
+                kind="Service",
+                metadata=ObjectMeta(name="stale-poke", namespace=ns),
+                spec={},
+            )
+        )
+        h.schedule()
+        assert METRICS.counters.get("cp_overlap_hits_total", 0) == hits0
+        assert (
+            METRICS.counters.get("cp_overlap_stale_total", 0) == stale0 + 1
+        )
+        # the stale entry was evicted; a fresh speculation re-fills it
+        assert key not in sched._overlap_cache
+        assert sched.speculate_encode() == 1
+        h.engine.close()
