@@ -540,6 +540,26 @@ def _remediation_artifact_block() -> dict:
     return doc
 
 
+def _federation_artifact_block() -> dict:
+    """Multi-cluster federation block (docs/federation.md): a seeded
+    3-region placement storm with per-region phase offsets and a
+    mid-run cluster_crash + rejoin — spillover/re-route counters, the
+    decision-ledger length, the level-3 quota-fold depth histogram, and
+    the crash's victim/re-routed/stranded split. Isolated router; the
+    host tail-honesty block rides along (PR-17 idiom) so cross-machine
+    artifact diffs stay explainable."""
+    import time as _time
+
+    from grove_tpu.federation import federation_artifact
+    from grove_tpu.observability.hostinfo import host_block
+
+    t0 = _time.perf_counter()
+    doc = federation_artifact(seed=2026, regions=3, num_nodes=8)
+    doc["host"] = host_block()
+    doc["wall_s"] = round(_time.perf_counter() - t0, 2)
+    return doc
+
+
 def _explain_artifact_block() -> dict:
     """Decision-explainability block (docs/observability.md "Admission
     explain"): the contended scenario's three verdict classes, verdict
@@ -804,6 +824,10 @@ def integrated_stress_bench(
             # flip-confirmed rate, measured budget deltas, forecast
             # skill vs persistence, budget-recovery ratio
             "remediation": _remediation_artifact_block(),
+            # federation block (docs/federation.md): seeded 3-region
+            # storm through the global gang router — spillovers,
+            # crash re-routes, decision-ledger length, quota-fold depth
+            "federation": _federation_artifact_block(),
             # sharded control-plane block (docs/control-plane.md): the
             # keyspace-sharded store at the ROADMAP's 10× shape, with the
             # fold-depth histogram and the S=1 inert A/B

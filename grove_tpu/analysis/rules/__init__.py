@@ -5,6 +5,7 @@ from grove_tpu.analysis.rules.apiwire import WireRoundTripRule
 from grove_tpu.analysis.rules.clocks import BlockingTickRule, ClockDisciplineRule
 from grove_tpu.analysis.rules.dirtymask import DirtyMaskRegistrationRule
 from grove_tpu.analysis.rules.explainrule import ExplainReadonlyRule
+from grove_tpu.analysis.rules.federationrule import FederationStateRule
 from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
 from grove_tpu.analysis.rules.glassbox import GlassBoxStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
@@ -45,4 +46,5 @@ ALL_RULES = (
     WorkerAffinityRule,  # GL018
     ActMustLogRule,  # GL019
     ProcessBoundaryRule,  # GL020
+    FederationStateRule,  # GL021
 )

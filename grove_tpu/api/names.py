@@ -32,6 +32,10 @@ LABEL_POD_INDEX = "grove.io/pod-index"
 # them to Pods) and PodGangs so the scheduler and the usage accountant can
 # attribute every gang/pod to its queue without extra lookups
 LABEL_QUEUE = "scheduler.grove.io/queue"
+# home-cluster affinity (federation tier, docs/federation.md): set by
+# users on the PodCliqueSet; the FederationRouter places the workload in
+# this region unless it is Lost or its explain verdict blocks admission
+LABEL_FEDERATION_HOME = "federation.grove.io/home"
 
 # component values set against LABEL_COMPONENT
 COMPONENT_HEADLESS_SERVICE = "pcs-headless-service"

@@ -731,6 +731,58 @@ def _cmd_slo(args) -> int:
     return 0
 
 
+def _print_federation_status(doc: dict) -> None:
+    print(
+        f"federation: {len(doc.get('clusters', []))} cluster(s),"
+        f" spillovers={doc.get('spillovers', 0)}"
+        f" reroutes={doc.get('reroutes', 0)}"
+        f" decisions={doc.get('decisions', 0)}"
+    )
+    rows = [("REGION", "STATE", "PHASE", "PLACEMENTS", "PENDING", "NODES")]
+    for cl in doc.get("clusters", []):
+        rows.append(
+            (
+                cl.get("region", "?"),
+                cl.get("state", "?"),
+                f"{cl.get('phaseOffset', 0.0):g}s",
+                str(cl.get("placements", 0)),
+                str(cl.get("pendingGangs", "-")),
+                str(cl.get("nodes", "-")),
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for row in rows:
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    usage = doc.get("globalUsage") or {}
+    for queue in sorted(usage):
+        vec = ", ".join(
+            f"{r}={usage[queue][r]:g}" for r in sorted(usage[queue])
+        )
+        print(f"  queue {queue}: {vec or 'idle'}")
+
+
+def _cmd_federation(args) -> int:
+    """Federation registry + routing ledger roll-up: per-region state,
+    placements, spillover/re-route counters, and the global (level-3
+    fold) per-queue usage — from a live apiserver's GET /federation."""
+    if not args.apiserver:
+        print(
+            "federation: --apiserver URL required (the router lives in"
+            " the operator process; single-cluster deployments serve"
+            " 404 here)",
+            file=sys.stderr,
+        )
+        return 2
+    doc = _fetch_server_json(args.apiserver, "/federation", "federation")
+    if doc is None:
+        return 1
+    if args.output == "json":
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    _print_federation_status(doc)
+    return 0
+
+
 def _print_forecast_report(doc: dict) -> None:
     state = "enabled" if doc.get("enabled") else "disabled"
     print(
@@ -1780,6 +1832,26 @@ def main(argv: List[str] | None = None) -> int:
         help="series-appendix window in seconds (default 300)",
     )
     p.set_defaults(fn=_cmd_slo)
+
+    p = sub.add_parser(
+        "federation",
+        help=(
+            "multi-cluster federation status: per-region state and"
+            " placements, spillover/re-route counters, global per-queue"
+            " usage (GET /federation)"
+        ),
+    )
+    p.add_argument(
+        "--apiserver", help="read /federation from a live server"
+    )
+    p.add_argument(
+        "-o",
+        "--output",
+        choices=("table", "json"),
+        default="table",
+        help="output format (default table)",
+    )
+    p.set_defaults(fn=_cmd_federation)
 
     p = sub.add_parser(
         "forecast",

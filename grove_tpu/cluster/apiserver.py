@@ -159,6 +159,13 @@ class APIServer:
         # by contract (grovelint GL016), so handlers run WITHOUT
         # server.lock — an explain burst must never stall writes.
         self.explain_engine = None
+        # federation tier (federation/router.py, docs/federation.md):
+        # GET /federation serves the router's status() document — region
+        # registry, placement counts, spillover/re-route counters, the
+        # decision-ledger length, and the global quota fold. Arrives by
+        # callback like node_provider (the router is sim infrastructure,
+        # not a store object). Unset → 404 (no federation tier).
+        self.federation_provider: Optional[Callable[[], dict]] = None
         # config-gated like the reference pprof listener (manager.go:108-113)
         # and serialized: concurrent samplers would degrade the whole
         # control plane (every 100Hz stack walk contends on the GIL)
@@ -590,6 +597,20 @@ class APIServer:
                             "window": JOURNEYS.window_summary(window_s),
                             "pending": pending,
                         },
+                    )
+                if path == "/federation":
+                    # federation tier (docs/federation.md): the router's
+                    # registry + ledger roll-up — per-region state/
+                    # placements/pending, spillovers, re-routes, global
+                    # quota fold
+                    if server.federation_provider is None:
+                        return self._error(
+                            404,
+                            "no federation router attached to this"
+                            " server (single-cluster deployment)",
+                        )
+                    return self._send_json(
+                        200, server.federation_provider()
                     )
                 if path == "/debug/slo":
                     # SLO observatory (docs/observability.md "SLO

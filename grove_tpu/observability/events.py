@@ -101,6 +101,13 @@ REASON_PODGANG_DELETE_SUCCESSFUL = "PodGangDeleteSuccessful"
 REASON_REMEDIATION_EXECUTED = "RemediationExecuted"
 REASON_REMEDIATION_SKIPPED = "RemediationSkipped"
 
+# federation tier (docs/federation.md, grove_tpu/federation/router.py):
+# a gang moved off its home cluster because the home explain verdict
+# said it cannot admit now; an entire region killed/restored
+REASON_GANG_SPILLED = "GangSpilled"
+REASON_CLUSTER_LOST = "ClusterLost"
+REASON_CLUSTER_REJOINED = "ClusterRejoined"
+
 # The closed set of event reasons this codebase may emit. grovelint's
 # GL006 rule checks every record()/record_event() call site against it,
 # and tests/test_docs_drift.py pins it against docs/observability.md.

@@ -8,6 +8,7 @@ the store/delta state — every stage a read-only recount of exactly the
 input the next scheduling round will consume (the data layer lives in
 ``solver/introspect.py``):
 
+    cluster      which cluster owns the gang and why (federation tier)
     node-health  schedulable mask (cordon / NotReady / Lost)
     capacity     per-resource raw free capacity vs the gang floor
     topology     largest contiguous required-level domain packability
@@ -60,6 +61,7 @@ from grove_tpu.observability.events import (
 # tests/test_docs_drift.py pins against the docs/observability.md
 # "Admission explain" stage table.
 FUNNEL_STAGES = (
+    "cluster",
     "node-health",
     "capacity",
     "topology",
@@ -102,6 +104,12 @@ class ExplainEngine:
         # lifetime counters (the bench "explain" block)
         self.explains_total = 0
         self.whatifs_total = 0
+        # federation hook (grove_tpu/federation): the router installs a
+        # ``(namespace, name) -> str`` callback per cluster so the
+        # funnel's opening "cluster" stage answers WHICH cluster owns
+        # this gang and why it was routed there. None on a bare harness
+        # — the stage then reports the single-cluster degenerate case.
+        self.cluster_context = None
 
     # -- wire faces ------------------------------------------------------
 
@@ -306,6 +314,22 @@ class ExplainEngine:
                     "detail": detail,
                 }
             )
+
+        # 0. cluster -----------------------------------------------------
+        # the federation tier's "which cluster and why" stage: never a
+        # blocker (a gang that reached this engine IS in this cluster);
+        # the detail cites the router's placement decision when a
+        # FederationRouter installed cluster_context, else the
+        # single-cluster degenerate case. surviving = the whole node
+        # population so the funnel stays monotone from the top.
+        stage(
+            "cluster",
+            view.total_nodes,
+            True,
+            self.cluster_context(namespace, name)
+            if self.cluster_context is not None
+            else "single-cluster (no federation tier)",
+        )
 
         # 1. node-health -------------------------------------------------
         n_sched = len(view.nodes)

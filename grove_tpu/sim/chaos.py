@@ -1107,3 +1107,277 @@ def chaos_artifact(seed: int = 1234) -> dict:
     doc.pop("invariant_violations", None)
     doc["invariant_violation_count"] = len(report.invariant_violations)
     return doc
+
+
+# -- federation chaos (docs/federation.md "cluster_crash") -------------------
+
+
+@dataclass
+class FederationChaosReport:
+    """Verdict of one seeded federation chaos run: a whole REGION is
+    killed mid-traffic and later restored, with the router's re-route
+    machinery under the per-tick invariants below."""
+
+    seed: int
+    regions: int = 0
+    ticks: int = 0
+    faults: List[dict] = field(default_factory=list)
+    applied: int = 0
+    cluster_crashes: int = 0
+    rejoins: int = 0
+    reroutes: int = 0
+    spillovers: int = 0
+    stranded: int = 0
+    invariant_checks: int = 0
+    invariant_violations: List[str] = field(default_factory=list)
+    converged: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.invariant_violations
+            and self.converged
+            and self.cluster_crashes >= 1
+            and self.rejoins >= 1
+            and self.reroutes >= 1
+            and self.stranded == 0
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "regions": self.regions,
+            "ticks": self.ticks,
+            "faults": self.faults,
+            "applied": self.applied,
+            "cluster_crashes": self.cluster_crashes,
+            "rejoins": self.rejoins,
+            "reroutes": self.reroutes,
+            "spillovers": self.spillovers,
+            "stranded": self.stranded,
+            "invariant_checks": self.invariant_checks,
+            "invariant_violations": self.invariant_violations,
+            "converged": self.converged,
+            "ok": self.ok,
+        }
+
+
+class FederationChaosRunner:
+    """One seeded chaos run over a fresh FederationRouter.
+
+    The fault schedule is the `cluster_crash` fault: a second traffic
+    wave lands, the busiest region is killed while that wave is still
+    converging (genuinely mid-traffic), the survivors absorb the
+    re-routes under the ordinary broker/budget machinery, and a later
+    `cluster_rejoin` restores the region with a fresh control plane
+    (a post-rejoin wave homed there proves it serves again). Two
+    federation-specific per-tick invariants ride on top of the
+    single-cluster set (quota drift, disruption budgets):
+
+    F1. no gang is placed in — and no placement record points at — a
+        dead cluster (a Lost region's harness is gone entirely);
+    F2. the global quota fold's root equals the sum of independent
+        per-cluster usage recounts (the level-3 analogue of the
+        accountant-vs-oracle exactness check).
+    """
+
+    def __init__(
+        self,
+        seed: int = 1234,
+        regions: int = 3,
+        num_nodes: int = 8,
+        n_each: int = 2,
+        spill_after: float = 5.0,
+    ) -> None:
+        from grove_tpu.federation import FederationRouter
+
+        self.seed = seed
+        self.n_each = n_each
+        self.region_names = [f"region-{i}" for i in range(regions)]
+        self.rng = random.Random(seed ^ 0xFEDE)
+        self.router = FederationRouter(
+            self.region_names,
+            num_nodes=num_nodes,
+            phase_offsets=[i * 200.0 for i in range(regions)],
+            spill_after=spill_after,
+        )
+        self.report = FederationChaosReport(seed=seed, regions=regions)
+
+    # -- invariants ------------------------------------------------------
+
+    def _check_invariants(self, t0: float) -> None:
+        router = self.router
+        rep = self.report
+        rep.invariant_checks += 1
+        rel_now = router.clock.now() - t0
+        violations = rep.invariant_violations
+        states = {cl.region: cl for cl in router.clusters()}
+        # F1: no placement in a dead cluster; Lost regions hold no
+        # harness (nothing CAN be bound there), and every placement's
+        # PCS actually lives in its recorded Ready region
+        for (ns, name), region in sorted(router.placements().items()):
+            cl = states.get(region)
+            if cl is None or cl.state != "Ready" or cl.harness is None:
+                violations.append(
+                    f"t={rel_now:.0f}s: placement {ns}/{name} points at"
+                    f" dead cluster {region}"
+                )
+                continue
+            if cl.harness.store.get("PodCliqueSet", ns, name) is None:
+                violations.append(
+                    f"t={rel_now:.0f}s: placement {ns}/{name} missing"
+                    f" from cluster {region}'s store"
+                )
+        for cl in router.clusters():
+            if cl.state == "Lost" and cl.harness is not None:
+                violations.append(
+                    f"t={rel_now:.0f}s: lost cluster {cl.region} still"
+                    " holds a live harness"
+                )
+        # F2: the global fold's root equals the sum of independent
+        # per-cluster recounts (usage_oracle over each store's pods) —
+        # and each cluster's own accountant has no local drift either
+        from grove_tpu.quota.oracle import usage_oracle
+
+        recount: dict = {}
+        for cl in router.clusters():
+            if cl.harness is None:
+                continue
+            h = cl.harness
+            for problem in accountant_drift(
+                h.scheduler.quota.accountant, h.store
+            ):
+                violations.append(
+                    f"t={rel_now:.0f}s: [{cl.region}] {problem}"
+                )
+            oracle = usage_oracle(
+                h.store.scan("Pod"),
+                h.scheduler.quota.accountant.default_queue,
+            )
+            for q, usage in oracle.items():
+                row = recount.setdefault(q, {})
+                for r, v in usage.items():
+                    row[r] = row.get(r, 0.0) + v
+        global_usage = router.global_usage()
+        for q in sorted(set(global_usage) | set(recount)):
+            a = global_usage.get(q, {})
+            b = recount.get(q, {})
+            for r in sorted(set(a) | set(b)):
+                if abs(a.get(r, 0.0) - b.get(r, 0.0)) > 1e-6:
+                    violations.append(
+                        f"t={rel_now:.0f}s: global fold queue {q}"
+                        f" usage {r}: root {a.get(r, 0.0)} != sum of"
+                        f" per-cluster recounts {b.get(r, 0.0)}"
+                    )
+        # per-cluster disruption budgets (chaos invariant 4, unchanged:
+        # a crash re-route must never spend voluntary disruption)
+        for cl in router.clusters():
+            if cl.harness is None:
+                continue
+            h = cl.harness
+            for pcs in h.store.scan("PodCliqueSet"):
+                budget = pcs.spec.template.disruption_budget
+                if budget is None:
+                    continue
+                key = (pcs.metadata.namespace, pcs.metadata.name)
+                disrupted = h.disruption.voluntarily_disrupted_gangs(key)
+                cap = budget.max_unavailable_gangs or 0
+                if disrupted > cap:
+                    violations.append(
+                        f"t={rel_now:.0f}s: [{cl.region}] PCS"
+                        f" {key[0]}/{key[1]} has {disrupted}"
+                        f" voluntarily-disrupted gang(s), budget"
+                        f" allows {cap}"
+                    )
+
+    def _all_scheduled(self) -> bool:
+        for cl in self.router.clusters():
+            if cl.harness is None:
+                continue
+            for gang in cl.harness.store.list("PodGang"):
+                cond = get_condition(
+                    gang.status.conditions, COND_PODGANG_SCHEDULED
+                )
+                if cond is None or not cond.is_true():
+                    return False
+        return True
+
+    def _apply_wave(self, suffix: str, home: Optional[str] = None) -> None:
+        from grove_tpu.api import names as namegen
+
+        for pcs in chaos_workload(n_each=self.n_each):
+            if suffix:
+                pcs.metadata.name = f"{pcs.metadata.name}{suffix}"
+            pcs.metadata.labels[namegen.LABEL_FEDERATION_HOME] = (
+                home if home is not None else self.rng.choice(
+                    self.region_names
+                )
+            )
+            self.router.apply(pcs)
+            self.report.applied += 1
+
+    # -- the run ---------------------------------------------------------
+
+    def run(self, max_ticks: int = 400) -> FederationChaosReport:
+        router = self.router
+        rep = self.report
+        t0 = router.clock.now()
+        budget = max_ticks
+        # wave 1: steady state across seeded homes
+        self._apply_wave("")
+        rep.ticks += router.converge(max_ticks=min(60, budget))
+        self._check_invariants(t0)
+        # wave 2 lands, then the busiest region dies MID-convergence
+        self._apply_wave("-w2")
+        rep.ticks += router.converge(max_ticks=3, tick_seconds=1.0)
+        counts = {name: 0 for name in self.region_names}
+        for region in router.placements().values():
+            counts[region] += 1
+        victim = max(
+            self.region_names, key=lambda name: (counts[name], name)
+        )
+        rep.faults.append(
+            Fault(
+                at=router.clock.now() - t0,
+                kind="cluster_crash",
+                target=victim,
+                note=f"{counts[victim]} placements",
+            ).as_dict()
+        )
+        crash = router.crash_cluster(victim)
+        rep.cluster_crashes += 1
+        rep.stranded += len(crash["stranded"])
+        rep.ticks += router.converge(max_ticks=min(120, budget))
+        self._check_invariants(t0)
+        # late restart: fresh control plane, then traffic homed there
+        rep.faults.append(
+            Fault(
+                at=router.clock.now() - t0,
+                kind="cluster_rejoin",
+                target=victim,
+            ).as_dict()
+        )
+        router.rejoin_cluster(victim)
+        rep.rejoins += 1
+        rep.ticks += router.converge(max_ticks=40)
+        self._check_invariants(t0)
+        self._apply_wave("-late", home=victim)
+        rep.ticks += router.converge(max_ticks=min(160, budget))
+        self._check_invariants(t0)
+        rep.reroutes = router.reroutes
+        rep.spillovers = router.spillovers
+        rep.converged = self._all_scheduled()
+        return rep
+
+
+def run_federation_chaos(
+    seed: int = 1234,
+    regions: int = 3,
+    num_nodes: int = 8,
+    n_each: int = 2,
+    max_ticks: int = 400,
+) -> FederationChaosReport:
+    """One seeded federation chaos run (`chaos_smoke.py --federation`)."""
+    return FederationChaosRunner(
+        seed=seed, regions=regions, num_nodes=num_nodes, n_each=n_each
+    ).run(max_ticks=max_ticks)

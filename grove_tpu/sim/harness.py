@@ -306,67 +306,83 @@ class SimHarness:
 
     # -- convergence loop ------------------------------------------------
 
-    def converge(self, max_ticks: int = 60, tick_seconds: float = 1.0) -> int:
-        """Reconcile ⇄ schedule ⇄ kubelet until quiescent. Each tick advances
-        virtual time so requeue_after-based waits can fire."""
+    def tick_once(self):
+        """One tick of the convergence loop WITHOUT any clock advance:
+        reconcile ⇄ schedule ⇄ kubelet ⇄ WAL pump ⇄ observatory round.
+        Returns ``(work, bound, started)`` so callers can apply the same
+        idle test converge() uses. Extracted so a federation tier can
+        drive K harnesses in lockstep on one shared virtual clock — the
+        body is byte-for-byte the old converge() tick."""
         from grove_tpu.observability.profile import PROFILER
         from grove_tpu.observability.slo import SLO
         from grove_tpu.observability.timeseries import TIMESERIES
 
+        # wall attribution (docs/observability.md "Wall-attribution
+        # profiler"): every component of the tick gets a top-level
+        # phase (engine/scheduler/WAL open their own finer phases
+        # inside), so the roll-up's coverage vs an independent wall
+        # measurement is arithmetic. phase() is the shared no-op while
+        # profiling is off, and this runs per TICK, not per event —
+        # the hot paths keep the `if PROFILER.enabled` guard.
+        work = self.engine.drain()
+        with PROFILER.phase("tick", controller="autoscaler"):
+            work += self.autoscaler.tick()
+        with PROFILER.phase("tick", controller="node-monitor"):
+            work += self.node_monitor.tick()
+        with PROFILER.phase("tick", controller="drain"):
+            work += self.drainer.tick()
+        bound = self.schedule()
+        with PROFILER.phase("tick", controller="kubelet"):
+            started = self.cluster.kubelet_tick()
+        work += self.engine.drain()
+        if self.durability is not None:
+            # group commit at the tick boundary — the sim's committer
+            # cadence (real mode: the background thread)
+            with PROFILER.phase("tick", controller="wal"):
+                self.durability.pump()
+        # SLO observatory (observability/timeseries.py, slo.py): the
+        # sampling round + objective evaluation run at the tick
+        # boundary — one boolean check while the observatory is off
+        if TIMESERIES.enabled:
+            TIMESERIES.sample(self.clock.now())
+            SLO.evaluate(self.clock.now())
+        # remediation runs AFTER the observatory round so it reads
+        # this tick's verdicts, not last tick's (one boolean when off)
+        if self.remediator.enabled:
+            with PROFILER.phase("tick", controller="remediator"):
+                work += self.remediator.tick()
+        return work, bound, started
+
+    def next_wake(self) -> Optional[float]:
+        """Earliest pending deadline across every deadline source — the
+        idle-jump target converge() (and the federation router) uses.
+        None means nothing is scheduled to fire."""
+        wakes = [
+            w
+            for w in (
+                self.engine.next_wakeup(),
+                self.autoscaler.next_deadline(),
+                self.node_monitor.next_deadline(),
+                self.drainer.next_deadline(),
+                self.remediator.next_deadline(),
+            )
+            if w is not None
+        ]
+        return min(wakes) if wakes else None
+
+    def converge(self, max_ticks: int = 60, tick_seconds: float = 1.0) -> int:
+        """Reconcile ⇄ schedule ⇄ kubelet until quiescent. Each tick advances
+        virtual time so requeue_after-based waits can fire."""
         ticks = 0
         for _ in range(max_ticks):
-            # wall attribution (docs/observability.md "Wall-attribution
-            # profiler"): every component of the tick gets a top-level
-            # phase (engine/scheduler/WAL open their own finer phases
-            # inside), so the roll-up's coverage vs an independent wall
-            # measurement is arithmetic. phase() is the shared no-op while
-            # profiling is off, and this runs per TICK, not per event —
-            # the hot paths keep the `if PROFILER.enabled` guard.
-            work = self.engine.drain()
-            with PROFILER.phase("tick", controller="autoscaler"):
-                work += self.autoscaler.tick()
-            with PROFILER.phase("tick", controller="node-monitor"):
-                work += self.node_monitor.tick()
-            with PROFILER.phase("tick", controller="drain"):
-                work += self.drainer.tick()
-            bound = self.schedule()
-            with PROFILER.phase("tick", controller="kubelet"):
-                started = self.cluster.kubelet_tick()
-            work += self.engine.drain()
-            if self.durability is not None:
-                # group commit at the tick boundary — the sim's committer
-                # cadence (real mode: the background thread)
-                with PROFILER.phase("tick", controller="wal"):
-                    self.durability.pump()
-            # SLO observatory (observability/timeseries.py, slo.py): the
-            # sampling round + objective evaluation run at the tick
-            # boundary — one boolean check while the observatory is off
-            if TIMESERIES.enabled:
-                TIMESERIES.sample(self.clock.now())
-                SLO.evaluate(self.clock.now())
-            # remediation runs AFTER the observatory round so it reads
-            # this tick's verdicts, not last tick's (one boolean when off)
-            if self.remediator.enabled:
-                with PROFILER.phase("tick", controller="remediator"):
-                    work += self.remediator.tick()
+            work, bound, started = self.tick_once()
             ticks += 1
             if bound == 0 and started == 0 and work == 0:
                 # idle now — but short-horizon requeues (gate retries), a
                 # held HPA scale-down, a node-grace deadline, a gang
                 # requeue backoff, or an in-flight drain may be pending;
                 # jump to the earliest wakeup rather than stopping early
-                wakes = [
-                    w
-                    for w in (
-                        self.engine.next_wakeup(),
-                        self.autoscaler.next_deadline(),
-                        self.node_monitor.next_deadline(),
-                        self.drainer.next_deadline(),
-                        self.remediator.next_deadline(),
-                    )
-                    if w is not None
-                ]
-                wake = min(wakes) if wakes else None
+                wake = self.next_wake()
                 if wake is not None and wake - self.clock.now() <= 120.0:
                     self.clock.advance(max(wake - self.clock.now(), 0.0))
                     continue

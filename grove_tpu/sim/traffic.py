@@ -164,7 +164,16 @@ class TrafficModel:
     """Pure demand function ``demand(t)`` — seeded at construction, then
     deterministic in virtual time. Units are *replica-equivalents*: a
     demand of 3.0 on a scaling group means three replicas' worth of work
-    is arriving."""
+    is arriving.
+
+    ``phase_offset`` shifts the whole model along the virtual-time axis
+    (the federation tier's per-REGION diurnal offset — each cluster's
+    load peaks at a different virtual hour, so follow-the-sun spillover
+    is directly benchable): ``TrafficModel(..., phase_offset=dx)`` at
+    ``t`` equals the unshifted model at ``t + dx`` exactly, including
+    flash crowds, and the seeded construction draws are untouched by
+    the offset (same seed ⇒ same weights/phases/crowds at any offset).
+    """
 
     def __init__(
         self,
@@ -180,6 +189,7 @@ class TrafficModel:
         ratio: float = 0.55,
         ratio_drift: float = 0.25,
         horizon: float = 1800.0,
+        phase_offset: float = 0.0,
     ) -> None:
         rng = random.Random(seed)
         self.tenants = list(tenants)
@@ -189,6 +199,7 @@ class TrafficModel:
         self.ratio = ratio
         self.ratio_drift = ratio_drift
         self.horizon = horizon
+        self.phase_offset = phase_offset
         # tenant skew: Zipf-ish 1/(rank+1)^skew weights, rank order seeded
         ranks = list(range(len(self.tenants)))
         rng.shuffle(ranks)
@@ -216,6 +227,7 @@ class TrafficModel:
         )
 
     def flash_multiplier(self, t: float) -> float:
+        t = t + self.phase_offset
         m = 1.0
         for crowd in self.crowds:
             if crowd.active(t):
@@ -226,18 +238,21 @@ class TrafficModel:
         """Share of demand landing on prefill at ``t`` (drifts in
         [ratio - drift/2, ratio + drift/2], clamped to (0.05, 0.95))."""
         share = self.ratio + 0.5 * self.ratio_drift * math.sin(
-            2.0 * math.pi * t / (self.period * 1.7)
+            2.0 * math.pi * (t + self.phase_offset) / (self.period * 1.7)
         )
         return min(0.95, max(0.05, share))
 
     def demand(self, t: float) -> Dict[str, Dict[str, float]]:
         """tenant -> {"prefill": d, "decode": d} replica-equivalents."""
+        # flash_multiplier/prefill_share apply the region offset
+        # internally — pass raw t so the shift lands exactly once
         flash = self.flash_multiplier(t)
+        local = t + self.phase_offset
         out: Dict[str, Dict[str, float]] = {}
         n = max(1, len(self.tenants))
         for tenant in self.tenants:
             wave = 1.0 + self.amplitude * math.sin(
-                2.0 * math.pi * (t + self.phases[tenant]) / self.period
+                2.0 * math.pi * (local + self.phases[tenant]) / self.period
             )
             total = self.base * n * self.weights[tenant] * wave * flash
             share = self.prefill_share(t)

@@ -95,7 +95,29 @@ def main() -> int:
         help="with --seeds: the one seed of the matrix that runs with the"
         " remediator armed (the `make chaos-matrix` mode)",
     )
+    parser.add_argument(
+        "--federation",
+        action="store_true",
+        help="run the FEDERATION chaos scenario instead: a 3-region"
+        " FederationRouter under the cluster_crash fault (whole-region"
+        " kill mid-traffic + late restart) with the two federation"
+        " invariants — no gang placed in a dead cluster, global quota"
+        " fold equals the sum of per-cluster recounts"
+        " (docs/federation.md)",
+    )
     args = parser.parse_args()
+
+    if args.federation:
+        if args.seeds:
+            rc = 0
+            for raw in args.seeds.split(","):
+                seed = int(raw.strip())
+                print(f"=== federation chaos seed {seed} ===", flush=True)
+                rc = run_federation_one(seed, args.json)
+                if rc:
+                    return rc
+            return rc
+        return run_federation_one(args.seed, args.json)
 
     if args.seeds:
         rc = 0
@@ -120,6 +142,66 @@ def main() -> int:
         args.cp_crash or args.seed == args.cp_crash_seed,
         args.remediate or args.seed == args.remediate_seed,
     )
+
+
+def run_federation_one(seed: int, as_json: bool) -> int:
+    from grove_tpu.sim.chaos import run_federation_chaos
+
+    report = run_federation_chaos(seed=seed)
+    doc = report.as_dict()
+
+    problems = []
+    if report.cluster_crashes < 1:
+        problems.append("no cluster_crash fault fired")
+    if report.rejoins < 1:
+        problems.append("the lost region never rejoined")
+    if report.reroutes < 1:
+        problems.append("the crash re-routed zero gangs")
+    if report.stranded:
+        problems.append(
+            f"{report.stranded} placement(s) stranded (survivable gangs"
+            " must re-route)"
+        )
+    if report.invariant_violations:
+        problems.append(
+            f"{len(report.invariant_violations)} invariant violation(s): "
+            + "; ".join(report.invariant_violations[:5])
+        )
+    if not report.converged:
+        problems.append("the federation did not converge after rejoin")
+
+    if as_json:
+        print(json.dumps({"federation_chaos": doc, "ok": not problems}))
+    else:
+        print(
+            f"seed={report.seed} regions={report.regions}"
+            f" ticks={report.ticks} applied={report.applied}"
+            f" crashes={report.cluster_crashes} rejoins={report.rejoins}"
+            f" reroutes={report.reroutes} spillovers={report.spillovers}"
+        )
+        for fault in doc["faults"]:
+            note = f" ({fault['note']})" if fault["note"] else ""
+            print(
+                f"  t={fault['at']:>6.2f}s {fault['kind']:<14}"
+                f" {fault['target']}{note}"
+            )
+        print(
+            f"converged={report.converged}"
+            f" violations={len(report.invariant_violations)}"
+        )
+
+    if problems:
+        print(
+            f"\nCHAOS SMOKE FAILED (replay with --federation --seed"
+            f" {seed}):",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not as_json:
+        print("federation chaos smoke OK")
+    return 0
 
 
 def run_one(

@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Federation smoke test: the multi-cluster tier end to end
+(the `make federation-smoke` target; tests/test_federation.py pins the
+same machinery at pytest speed).
+
+Asserts the federation subsystem's acceptance bar (docs/federation.md):
+- a seeded 3-region diurnal day (per-region phase offsets — each
+  cluster peaks at a different virtual hour) produces >= 1
+  follow-the-sun spillover: a gang pending at its loaded home region
+  moves to a sibling in its trough, routed by the frontier score;
+- a cluster_crash kills the busiest region mid-traffic; every
+  survivable gang re-routes under the ordinary broker/budget machinery
+  with ZERO disruption-budget violations, the global SLO layer records
+  the availability dent (breach) and the recovery after rejoin;
+- K=1 is inert: a single-region federation is byte-identical to a bare
+  SimHarness — same admissions, same store content, same scalar
+  resourceVersion, same WAL durable prefixes.
+
+On failure the seed is printed so the exact run replays:
+    python scripts/federation_smoke.py --seed <N>
+
+Usage: python scripts/federation_smoke.py [--seed N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# CPU pin before jax import: the smoke must not hang on a wedged accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable from a checkout without an installed package (make federation-smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REGIONS = ["us", "eu", "ap"]
+PERIOD = 600.0  # diurnal period (s): offsets stagger the peaks by 1/3 day
+STEP = 30.0  # day-loop cadence: apply/remove workloads every virtual 30s
+
+# one gang = 2 pods x cpu:6 — exactly one pod per 8-cpu node, so a
+# 4-node region holds two gangs and a diurnal peak of 3+ MUST overflow
+_PCS_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: job
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 2
+          minAvailable: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 6
+"""
+
+
+def _fresh_pcs(name: str, home: str):
+    from grove_tpu.api import names as namegen
+    from grove_tpu.api.load import load_podcliquesets
+
+    pcs = load_podcliquesets(_PCS_YAML)[0]
+    pcs.metadata.name = name
+    pcs.metadata.labels[namegen.LABEL_FEDERATION_HOME] = home
+    return pcs
+
+
+def _scheduled_fraction(router) -> float:
+    """Fraction of live PodGangs (across Ready clusters) that are
+    Scheduled — the smoke's availability indicator. Crash re-routes
+    re-apply victims to survivors immediately, so a dent shows up as
+    pending gangs in survivor stores, not as vanished objects."""
+    from grove_tpu.api.meta import get_condition
+    from grove_tpu.api.types import COND_PODGANG_SCHEDULED
+
+    total = sched = 0
+    for cl in router.clusters():
+        if cl.state != "Ready" or cl.harness is None:
+            continue
+        for gang in cl.harness.store.list("PodGang"):
+            total += 1
+            cond = get_condition(
+                gang.status.conditions, COND_PODGANG_SCHEDULED
+            )
+            if cond is not None and cond.is_true():
+                sched += 1
+    return sched / total if total else 1.0
+
+
+def _budget_violations(router) -> list:
+    """Chaos invariant 4 over every Ready cluster: no disruptionBudget
+    exceeded (the crash re-route must ride the ordinary voluntary-
+    disruption machinery, never bulldoze it)."""
+    out = []
+    for cl in router.clusters():
+        if cl.state != "Ready" or cl.harness is None:
+            continue
+        h = cl.harness
+        for pcs in h.store.list("PodCliqueSet"):
+            budget = pcs.spec.template.disruption_budget
+            if budget is None:
+                continue
+            key = (pcs.metadata.namespace, pcs.metadata.name)
+            disrupted = h.disruption.voluntarily_disrupted_gangs(key)
+            cap = budget.max_unavailable_gangs or 0
+            if disrupted > cap:
+                out.append(
+                    f"{cl.region}: {key[1]} has {disrupted} voluntarily-"
+                    f"disrupted gang(s), budget {cap}"
+                )
+    return out
+
+
+def _pump(router, rounds: int, dt: float = 3.0) -> None:
+    """Observation rounds: advance virtual time and tick so the SLO
+    layer gets fresh samples at distinct ticks (each converge tick runs
+    TIMESERIES.sample + SLO.evaluate behind the enabled check)."""
+    for _ in range(rounds):
+        router.clock.advance(dt)
+        router.converge(max_ticks=2)
+
+
+def run_day(router, seed: int) -> dict:
+    """One phase-offset diurnal day: per-region active-job targets come
+    from TrafficModel(phase_offset=i*PERIOD/3) so each region peaks at a
+    different virtual hour and peaks overflow into sibling troughs."""
+    from grove_tpu.sim.traffic import TrafficModel
+
+    models = {
+        cl.region: TrafficModel(
+            seed,
+            ["fleet"],
+            base=1.6,
+            amplitude=0.9,
+            period=PERIOD,
+            flash_crowds=0,
+            phase_offset=cl.phase_offset,
+        )
+        for cl in router.clusters()
+    }
+    live: dict = {r: [] for r in REGIONS}  # region -> [pcs names], FIFO
+    serial = 0
+    t0 = router.clock.now()
+    steps = int(PERIOD / STEP)
+    for i in range(steps):
+        t_step = t0 + i * STEP
+        if router.clock.now() < t_step:
+            router.clock.advance(t_step - router.clock.now())
+        for region, model in models.items():
+            d = model.demand(i * STEP)["fleet"]
+            target = max(0, round(d["prefill"] + d["decode"]))
+            while len(live[region]) < target:
+                name = f"day-{region}-{serial:03d}"
+                serial += 1
+                router.apply(_fresh_pcs(name, region))
+                live[region].append(name)
+            while len(live[region]) > target:
+                router.delete(live[region].pop(0))
+        router.converge(max_ticks=30)
+    # drain the day's tail so the crash stage starts from steady state
+    for region in REGIONS:
+        while live[region]:
+            router.delete(live[region].pop(0))
+    router.converge(max_ticks=30)
+    return {"steps": steps, "applied": serial, "spillovers": router.spillovers}
+
+
+def run_crash_stage(router, problems: list) -> dict:
+    """Steady full fleet -> crash the busiest region mid-traffic ->
+    SLO breach while the re-routed gangs queue on full survivors ->
+    rejoin -> the spillover machinery moves them to the fresh capacity
+    -> SLO recovery. Zero budget violations throughout."""
+    from grove_tpu.observability.slo import SLO
+    from grove_tpu.observability.timeseries import (
+        SERIES_READY_FRACTION,
+        TIMESERIES,
+    )
+
+    # steady state: every region full (2 gangs each) and Scheduled
+    for i, region in enumerate(REGIONS):
+        for j in range(2):
+            router.apply(_fresh_pcs(f"steady-{region}-{j}", region))
+    router.converge(max_ticks=60)
+    if _scheduled_fraction(router) < 1.0:
+        problems.append("crash stage: steady fleet did not fully schedule")
+
+    TIMESERIES.reset()
+    SLO.reset()
+    TIMESERIES.enable(clock=router.clock)
+    SLO.enable()
+
+    def _collect(now: float) -> None:
+        TIMESERIES.gauge(
+            SERIES_READY_FRACTION, _scheduled_fraction(router), vt=now
+        )
+
+    TIMESERIES.add_collector(_collect)
+    SLO.add(
+        f"{SERIES_READY_FRACTION}:mean >= 0.9 over 15s"
+        " target 90% budget 60s burn 2x 30s/60s"
+    )
+    try:
+        _pump(router, 25)  # good baseline fills the budget window
+
+        busiest = max(
+            router.clusters(),
+            key=lambda cl: (
+                sum(1 for r in router.placements().values() if r == cl.region),
+                cl.region,
+            ),
+        )
+        crash = router.crash_cluster(busiest.region)
+        if crash["stranded"]:
+            problems.append(
+                f"crash stranded {len(crash['stranded'])} placement(s)"
+            )
+        if not crash["rerouted"]:
+            problems.append("crash re-routed zero placements")
+        # survivors are full: the re-routed gangs queue -> the dent
+        _pump(router, 25)
+        dent = _scheduled_fraction(router)
+        if dent >= 1.0:
+            problems.append("crash produced no availability dent")
+
+        router.rejoin_cluster(busiest.region)
+        router.converge(max_ticks=120)
+        if _scheduled_fraction(router) < 1.0:
+            problems.append(
+                "re-routed gangs never rescheduled after rejoin"
+            )
+        _pump(router, 30)  # good samples drain the bad budget window
+
+        obj = SLO.status()["objectives"][0]
+        if obj["breaches"] < 1:
+            problems.append("SLO layer recorded no breach for the crash")
+        if obj["recoveries"] < 1:
+            problems.append("SLO layer recorded no recovery after rejoin")
+        violations = _budget_violations(router)
+        for v in violations:
+            problems.append(f"disruption budget violated: {v}")
+        return {
+            "crashed": busiest.region,
+            "rerouted": len(crash["rerouted"]),
+            "stranded": len(crash["stranded"]),
+            "dent_ready_fraction": round(dent, 4),
+            "slo_breaches": obj["breaches"],
+            "slo_recoveries": obj["recoveries"],
+            "budget_violations": len(violations),
+        }
+    finally:
+        SLO.disable()
+        TIMESERIES.disable()
+        TIMESERIES.remove_collector(_collect)
+
+
+def run_k1_ab(problems: list) -> dict:
+    """K=1 inertness: a single-region federation vs a bare SimHarness
+    driven through the same applies/converges must be byte-identical —
+    store dumps, scalar resourceVersion, tick counts, WAL prefixes."""
+    from grove_tpu.federation import FederationRouter
+    from grove_tpu.runtime.clock import VirtualClock
+    from grove_tpu.runtime.store import Store
+    from grove_tpu.sim.chaos import chaos_workload
+    from grove_tpu.sim.harness import SimHarness
+    from grove_tpu.sim.parallel import _dump, durable_state_normalized
+
+    rounds = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        fed_root = os.path.join(tmp, "fed")
+        bare_dir = os.path.join(tmp, "bare")
+        router = FederationRouter(
+            ["solo"], num_nodes=8, durability_root=fed_root
+        )
+        clock = VirtualClock()
+        bare = SimHarness(
+            num_nodes=8,
+            store=Store(clock, cache_lag=True),
+            durability_dir=bare_dir,
+        )
+        for rnd in range(2):
+            for pcs_f, pcs_b in zip(
+                chaos_workload(n_each=1), chaos_workload(n_each=1)
+            ):
+                pcs_f.metadata.name += f"-{rnd}"
+                pcs_b.metadata.name += f"-{rnd}"
+                router.apply(pcs_f)
+                bare.apply(pcs_b)
+            t_f = router.converge(max_ticks=80)
+            t_b = bare.converge(max_ticks=80)
+            rounds += 1
+            if t_f != t_b:
+                problems.append(
+                    f"K=1 tick counts diverge round {rnd}: {t_f} != {t_b}"
+                )
+            solo = router.cluster("solo").harness
+            if _dump(solo) != _dump(bare):
+                problems.append(f"K=1 store dumps diverge round {rnd}")
+            if solo.store.resource_version != bare.store.resource_version:
+                problems.append(
+                    f"K=1 resourceVersion diverges round {rnd}:"
+                    f" {solo.store.resource_version}"
+                    f" != {bare.store.resource_version}"
+                )
+        wal_f = durable_state_normalized(os.path.join(fed_root, "solo"))
+        wal_b = durable_state_normalized(bare_dir)
+        if wal_f != wal_b:
+            problems.append("K=1 WAL durable prefixes diverge")
+        solo = router.cluster("solo").harness
+        solo.engine.close()
+        bare.engine.close()
+    return {"rounds": rounds, "spillovers_must_be_zero": 0}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--seed", type=int, default=2026,
+        help="traffic-model seed (printed on failure for replay)",
+    )
+    parser.add_argument("--json", action="store_true", help="emit one JSON line")
+    args = parser.parse_args()
+
+    from grove_tpu.federation import FederationRouter
+
+    problems: list = []
+
+    ab = run_k1_ab(problems)
+
+    router = FederationRouter(
+        REGIONS,
+        num_nodes=4,
+        phase_offsets=[i * PERIOD / 3.0 for i in range(len(REGIONS))],
+        spill_after=20.0,
+    )
+    day = run_day(router, args.seed)
+    if day["spillovers"] < 1:
+        problems.append(
+            "the diurnal day produced no follow-the-sun spillover"
+        )
+    crash = run_crash_stage(router, problems)
+
+    doc = {
+        "seed": args.seed,
+        "regions": len(REGIONS),
+        "day": day,
+        "crash": crash,
+        "k1_ab": ab,
+        "decisions": len(router.decisions()),
+        "ok": not problems,
+    }
+    if args.json:
+        print(json.dumps({"federation": doc}))
+    else:
+        print(
+            f"seed={args.seed} regions={len(REGIONS)}"
+            f" day_applied={day['applied']} spillovers={day['spillovers']}"
+        )
+        print(
+            f"crash={crash['crashed']} rerouted={crash['rerouted']}"
+            f" dent={crash['dent_ready_fraction']}"
+            f" breaches={crash['slo_breaches']}"
+            f" recoveries={crash['slo_recoveries']}"
+            f" budget_violations={crash['budget_violations']}"
+        )
+        print(f"k1 A/B rounds={ab['rounds']} byte-identical")
+
+    if problems:
+        print(
+            f"\nFEDERATION SMOKE FAILED (replay with --seed {args.seed}):",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("federation smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@ catalog).
 Two stages, both on by default:
 
 1. **Static analysis** — the grovelint rule engine over every .py in
-   grove_tpu/ (GL001..GL020; suppressions require `-- justification`).
+   grove_tpu/ (GL001..GL021; suppressions require `-- justification`).
 2. **Drift checks** (skip with --no-check) — `deploy/crds/*.yaml`, the
    chart copies under `deploy/charts/grove-tpu/crds/`, and
    `docs/api-reference.md` must be byte-identical to what

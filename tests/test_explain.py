@@ -205,7 +205,12 @@ class TestVerdicts:
         assert not doc["fits_now"]
         assert doc["binding_constraint"] == "node-health"
         assert doc["detail"] == DETAIL_NO_NODES
-        assert doc["funnel"][0]["ok"] is False
+        # funnel[0] is the federation "cluster" stage (never a blocker);
+        # node-health is the first stage that can fail
+        assert doc["funnel"][0]["stage"] == "cluster"
+        assert doc["funnel"][0]["ok"] is True
+        assert doc["funnel"][1]["stage"] == "node-health"
+        assert doc["funnel"][1]["ok"] is False
 
     def test_read_only_pin(self, scenario):
         """The hard contract: an explain/capacity/what-if burst leaves the

@@ -2,7 +2,7 @@
 
 Three layers (docs/static-analysis.md):
 
-1. **Fixture teeth** — for every enforced rule (GL001..GL020), a
+1. **Fixture teeth** — for every enforced rule (GL001..GL021), a
    known-bad snippet
    must fire and its known-good twin must pass. This is what pins
    "deleting any single enforced invariant makes `make lint` fail".
@@ -342,6 +342,21 @@ FIXTURES = {
             "def push(conn, doc):\n"
             "    conn.send_bytes(json.dumps(doc).encode('utf-8'))\n"
             "    return json.loads(conn.recv_bytes().decode('utf-8'))\n"
+        ),
+    },
+    "GL021": {
+        "rel": "grove_tpu/sim/fixture.py",
+        "bad": (
+            "def shortcut(self, key, region):\n"
+            "    self.router._placements[key] = region\n"
+            "    self.router._clusters.pop(region)\n"
+            "    self.router.spillovers += 1\n"
+        ),
+        "good": (
+            "def shortcut(self, pcs, region):\n"
+            "    self.router.apply(pcs, home=region)\n"
+            "    where = self.router.placements()\n"
+            "    return self.router.status(), where\n"
         ),
     },
     "GL010": {
@@ -792,6 +807,40 @@ def test_grafting_pickled_boundary_fails_lint():
             "grove_tpu/autoscale/fixture.py",
         )
     )
+
+
+def test_grafting_federation_state_write_fails_lint():
+    """GL021 live-tree teeth: a rogue helper rewriting the federation
+    router's placement map from a non-owner source must fail lint — a
+    placement no per-cluster store backs (or a move the decision ledger
+    never recorded) breaks the chaos invariants ticks after the causing
+    write is gone. The owning package mutates its own state freely."""
+    rel = "grove_tpu/sim/chaos.py"
+    src = (ROOT / rel).read_text()
+    assert "GL021" not in rules_of(lint_source(src, rel))
+    rogue = (
+        "\n\ndef _rogue_move(router, key, region):\n"
+        "    router._placements[key] = region\n"
+        "    del router._specs[key]\n"
+        "    router._decisions.append({'kind': 'fake'})\n"
+        "    router.reroutes += 1\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert len([v for v in report.violations if v.rule == "GL021"]) == 4
+    # the owning package may mutate its own state
+    own_rel = "grove_tpu/federation/router.py"
+    own = (ROOT / own_rel).read_text()
+    assert "GL021" not in rules_of(lint_source(own, own_rel))
+    # precision: foreign bindings with the same generic field names stay
+    # out of scope — only a federation-named chain segment is in scope
+    for ok_src in (
+        "def f(self, k, v):\n    self._placements[k] = v\n",
+        "def f(self, q):\n    self.scheduler._queues.update(q)\n",
+        "def f(self):\n    self.stats.reroutes = 0\n",
+    ):
+        assert "GL021" not in rules_of(
+            lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
+        ), ok_src
 
 
 def test_gl001_strict_scope_bans_perf_counter_in_traffic():
