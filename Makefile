@@ -11,7 +11,7 @@ IMAGE ?= grove-tpu:0.2.0
         chaos-smoke chaos-matrix drain-smoke recovery-smoke delta-smoke \
         scale-smoke frontier-smoke profile-smoke explain-smoke \
         serving-smoke parallel-smoke remediate-smoke federation-smoke \
-        probe-debug dryrun docker-build compose-up clean
+        grayfail-smoke probe-debug dryrun docker-build compose-up clean
 
 test:            ## full suite (CPU-pinned; 8-device virtual mesh via conftest)
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
@@ -21,13 +21,13 @@ test-fast:       ## skip the slow e2e tiers
 	    --ignore=tests/test_cluster_mode.py \
 	    --ignore=tests/test_update_stress.py
 
-check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke parallel-smoke remediate-smoke federation-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke, parallel-control-plane smoke, forecast-driven remediation smoke, multi-cluster federation smoke
+check: lint scale-smoke frontier-smoke profile-smoke explain-smoke serving-smoke parallel-smoke remediate-smoke federation-smoke grayfail-smoke ## drift gates: grovelint, CRDs, api-docs, wire fixtures, CRD conformance, sharded-store smoke, partitioned-frontier smoke, glass-box smoke, admission-explain smoke, SLO-observatory serving smoke, parallel-control-plane smoke, forecast-driven remediation smoke, multi-cluster federation smoke, gray-failure degradation-ladder smoke
 	$(CPU_ENV) $(PY) -m pytest -q \
 	    tests/test_cluster_mode.py::TestCRDManifests \
 	    tests/test_config_cli_auth.py \
 	    tests/test_wire_fixtures.py tests/test_crd_conformance.py
 
-lint:            ## grovelint static analysis (GL001..GL021) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
+lint:            ## grovelint static analysis (GL001..GL022) + CRD/api-docs drift byte-compare; exits non-zero on any violation or bare suppression
 	$(CPU_ENV) $(PY) scripts/lint.py
 
 crds:            ## regenerate deploy/crds/ from the typed model (+ chart copy)
@@ -59,11 +59,12 @@ quota-smoke:     ## 3-tenant contended fair-share run: each queue must converge 
 chaos-smoke:     ## seeded chaos run: >=2 losses + flap + store outage + drain + leader failover, per-tick invariants, convergence to the fault-free tree (prints the seed on failure for replay)
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py
 
-chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail; seed 99 runs with the remediation controller armed live through the schedule — its actions must keep every invariant green): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md). The third line re-runs one seed on the worker-PROCESS executor, which arms the worker_crash fault: a reconcile worker SIGKILLed mid-round, repatriated + re-executed inline, run still converging to the fault-free tree. The fourth line runs the FEDERATION chaos scenario: a 3-region router under the cluster_crash fault with the two federation invariants checked every converge boundary
-	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7 --remediate-seed 99
+chaos-matrix:    ## the chaos smoke across 5 fixed seeds (seed 42 runs under the runtime sanitizer: lock order, store guard, recounts, leaked spans/holds; seed 7 adds the controlplane_crash fault: WAL-backed store killed mid-convergence, recovered from disk with a torn tail; seed 99 runs with the remediation controller armed live through the schedule — its actions must keep every invariant green): catches schedule-dependent regressions the single-seed smoke misses. The second line re-runs the cp-crash seed on a 3-shard store (per-shard WAL dirs, merged recovery — docs/control-plane.md). The third line re-runs one seed on the worker-PROCESS executor, which arms the worker_crash fault: a reconcile worker SIGKILLed mid-round, repatriated + re-executed inline, run still converging to the fault-free tree. The fourth line runs the FEDERATION chaos scenario: a 3-region router under the cluster_crash fault with the two federation invariants checked every converge boundary. The fifth line runs the PARTITION chaos scenario: the busiest region goes unreachable-but-alive (gray failure) mid-wave — pending gangs spill after the suspicion timeout, Scheduled gangs keep their placement across the heal, split-brain invariant F3 checked every slice. Seed 2026 of the matrix additionally arms the fail-slow (gray node) fault: late-but-inside-grace heartbeats must flip the node Degraded via the suspicion EWMA and back after heal
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --seeds 1234,7,42,99,2026 --sanitize-seed 42 --cp-crash-seed 7 --remediate-seed 99 --failslow-seed 2026
 	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 $(PY) scripts/chaos_smoke.py --seeds 7 --cp-crash-seed 7
 	$(CPU_ENV) GROVE_TPU_STORE_SHARDS=3 GROVE_TPU_CP_WORKERS=2 GROVE_TPU_CP_BACKEND=process $(PY) scripts/chaos_smoke.py --seeds 1234
 	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --federation --seed 4242
+	$(CPU_ENV) $(PY) scripts/chaos_smoke.py --partition --seed 4242
 
 recovery-smoke:  ## durability smoke: crash-recover-converge with a torn WAL tail (prints replayed records + recovery wall time), acked-prefix audit, inert WAL A/B
 	$(CPU_ENV) $(PY) scripts/recovery_smoke.py
@@ -95,6 +96,9 @@ serving-smoke:   ## SLO-observatory smoke: seeded diurnal + flash-crowd traffic 
 
 remediate-smoke: ## forecast-driven remediation smoke: the everything-at-once serving day OFF then ON from one seed — ON must recover error budget OFF burns (delta printed), every action ledger-chained (structural ones with a proven what-if flip) with >=1 measured effect, zero disruption-budget violations, forecasts beat the persistence baseline, disabled-remediator A/B byte-identical
 	$(CPU_ENV) $(PY) scripts/remediate_smoke.py
+
+grayfail-smoke:  ## gray-failure smoke (docs/robustness.md "Gray failures"): fail-slow detection ON beats OFF on wave-2 attainment with zero budget spend and every steady-state binding untouched; seeded partition chaos (pending spills, Scheduled stays put, split-brain F3 every slice); WAL ladder ok→degraded→ok and ok→read-only→ok with the acked prefix audited; all-off inert A/B (armed-but-quiet detection byte-identical, zero-rate boundary injection byte-identical on the process backend)
+	$(CPU_ENV) $(PY) scripts/grayfail_smoke.py
 
 federation-smoke: ## multi-cluster federation smoke: seeded 3-region phase-offset diurnal day with >=1 follow-the-sun spillover, cluster_crash of the busiest region mid-traffic (every survivable gang re-routed, zero disruption-budget violations, SLO breach + recovery measured), K=1 single-region A/B byte-identical to a bare harness
 	$(CPU_ENV) $(PY) scripts/federation_smoke.py

@@ -8,6 +8,7 @@ from grove_tpu.analysis.rules.explainrule import ExplainReadonlyRule
 from grove_tpu.analysis.rules.federationrule import FederationStateRule
 from grove_tpu.analysis.rules.frontierrule import FrontierStateRule
 from grove_tpu.analysis.rules.glassbox import GlassBoxStateRule
+from grove_tpu.analysis.rules.grayfail import GrayFailStateRule
 from grove_tpu.analysis.rules.jaxrules import JitHygieneRule
 from grove_tpu.analysis.rules.ledgerrules import ActMustLogRule
 from grove_tpu.analysis.rules.locks import LockOrderRule
@@ -47,4 +48,5 @@ ALL_RULES = (
     ActMustLogRule,  # GL019
     ProcessBoundaryRule,  # GL020
     FederationStateRule,  # GL021
+    GrayFailStateRule,  # GL022
 )

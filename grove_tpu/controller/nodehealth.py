@@ -47,9 +47,11 @@ from grove_tpu.observability.events import (
     REASON_GANG_RELEASED,
     REASON_GANG_REQUEUED,
     REASON_GANG_RESCUED,
+    REASON_NODE_DEGRADED,
     REASON_NODE_LOST,
     REASON_NODE_NOT_READY,
     REASON_NODE_READY,
+    REASON_NODE_RECOVERED,
     TYPE_NORMAL,
     TYPE_WARNING,
 )
@@ -57,6 +59,7 @@ from grove_tpu.observability.metrics import METRICS
 from grove_tpu.runtime.errors import ERR_CONFLICT, ERR_NOT_FOUND, GroveError
 from grove_tpu.runtime.workqueue import WorkQueue
 from grove_tpu.sim.cluster import (
+    NODE_DEGRADED,
     NODE_LOST,
     NODE_NOT_READY,
     NODE_READY,
@@ -103,12 +106,37 @@ class NodeHealthMonitor:
         cluster: SimCluster,
         not_ready_after: float = 10.0,
         lost_after: float = 30.0,
+        failslow_threshold: Optional[float] = None,
+        failslow_recover: Optional[float] = None,
+        failslow_alpha: float = 0.3,
     ) -> None:
         assert lost_after >= not_ready_after
         self.store = store
         self.cluster = cluster
         self.not_ready_after = not_ready_after
         self.lost_after = lost_after
+        # gray-failure (fail-slow) detection, docs/robustness.md "Gray
+        # failures". OFF by default (threshold None): the suspicion lane is
+        # one boolean check and the monitor is byte-identical to before.
+        # When armed, each tick folds the node's heartbeat LATENESS (age at
+        # observation — late-but-inside-grace heartbeats that the binary
+        # lifecycle ignores) into an EWMA suspicion score; score above
+        # `failslow_threshold` seconds flips Ready → Degraded (masked from
+        # new placements via `Node.schedulable`, nothing evicted); decay
+        # below `failslow_recover` (hysteresis, default threshold/2) flips
+        # back. Eviction is NOT this monitor's call — only the remediation
+        # controller may drain a Degraded node, behind a what-if-proven
+        # flip and the disruption budget (TRIGGER_FAILSLOW).
+        self.failslow_threshold = failslow_threshold
+        self.failslow_recover = (
+            failslow_recover
+            if failslow_recover is not None
+            else (failslow_threshold / 2.0 if failslow_threshold else None)
+        )
+        self.failslow_alpha = failslow_alpha
+        # node name -> EWMA suspicion score (seconds of smoothed lateness).
+        # Private state: only this monitor writes it (grovelint GL022).
+        self._suspicion: Dict[str, float] = {}
         # requeued gangs in rate-limited backoff: the workqueue's delayed
         # heap paces re-admission; _held is what the scheduler consults
         # (gang_held) to keep a backing-off gang out of the solve. Gang
@@ -275,6 +303,15 @@ class NodeHealthMonitor:
         wake = self.requeue.next_delayed_at()
         if wake is not None:
             deadlines.append(wake)
+        if self.failslow_threshold is not None and (
+            self.cluster.failslow_names()
+            or any(s > 0.0 for s in self._suspicion.values())
+        ):
+            # suspicion only moves when a tick observes it: while a
+            # fail-slow fault is armed (or a score is still decaying) the
+            # harness must keep ticking through idle periods, or Degraded
+            # entry/exit would stall with virtual time
+            deadlines.append(self.store.clock.now() + 1.0)
         return min(deadlines) if deadlines else None
 
     # -- tick -------------------------------------------------------------
@@ -286,8 +323,8 @@ class NodeHealthMonitor:
         now = self.store.clock.now()
         actions = 0
         actions += self._check_probation()
-        newly_lost, recovered = self._refresh_node_states(now)
-        actions += len(newly_lost)
+        newly_lost, recovered, gray_moves = self._refresh_node_states(now)
+        actions += len(newly_lost) + gray_moves
         if recovered and self._held:
             # capacity just returned (a lost node rejoined): waiting out
             # the rest of the backoff would idle a placeable gang — release
@@ -325,11 +362,34 @@ class NodeHealthMonitor:
 
     # -- node lifecycle ---------------------------------------------------
 
-    def _refresh_node_states(self, now: float) -> Tuple[List, bool]:
+    def _refresh_node_states(self, now: float) -> Tuple[List, bool, int]:
         newly_lost = []
         recovered = False
+        gray_moves = 0
+        hb_floor = 0.0
+        if self.failslow_threshold is not None:
+            # peer-relative baseline: the healthiest live kubelet's
+            # heartbeat age. Observation cadence and idle-time jumps
+            # inflate every node's age equally — subtracting the floor
+            # cancels them, so a healthy cohort scores 0 and a fail-slow
+            # node's lateness is exactly its extra lag over its peers
+            live_ages = [
+                now - n.last_heartbeat
+                for n in self.cluster.nodes
+                if not n.crashed
+            ]
+            hb_floor = min(live_ages) if live_ages else 0.0
         for node in self.cluster.nodes:
             if not node.crashed:
+                if self.failslow_threshold is not None:
+                    # suspicion lane (gray failures): Ready ⇄ Degraded is
+                    # decided by the EWMA, entirely outside the binary
+                    # want-compare below — a Degraded node must not emit a
+                    # spurious NodeReady while its heartbeats are merely
+                    # late-but-inside-grace
+                    gray_moves += self._suspect(node, now, hb_floor)
+                    if node.state == NODE_DEGRADED:
+                        continue
                 # a live kubelet heartbeats by definition (heartbeat_tick
                 # refreshes the timestamp); large virtual-time jumps must
                 # never read as cluster-wide heartbeat loss
@@ -346,6 +406,12 @@ class NodeHealthMonitor:
                     want = NODE_NOT_READY
                 else:
                     want = NODE_LOST
+            if want == NODE_READY and node.state == NODE_DEGRADED:
+                # crashed fail-slow node still inside the grace window:
+                # keep the Degraded mask (recovery goes through the
+                # suspicion hysteresis once the kubelet is back, not
+                # through the binary lane)
+                continue
             if want == node.state:
                 continue
             ref = ("Node", "", node.name)
@@ -381,7 +447,55 @@ class NodeHealthMonitor:
                 elif node.state == NODE_LOST:
                     recovered = True  # capacity returned to the pool
             node.state = want
-        return newly_lost, recovered
+        return newly_lost, recovered, gray_moves
+
+    def _suspect(self, node, now: float, hb_floor: float) -> int:
+        """Fold one heartbeat-lateness observation into the node's EWMA
+        suspicion score and apply the Ready ⇄ Degraded hysteresis. Returns
+        the number of state transitions (0 or 1).
+
+        Lateness is PEER-RELATIVE: this node's heartbeat age minus the
+        healthiest live node's (`hb_floor`) — fail-slow means "slow
+        compared to the cohort", and the subtraction makes the score
+        independent of tick cadence and virtual-time jumps. The score is
+        a PURE function of the observed lateness trace:
+        s ← α·lateness + (1−α)·s, s₀ = 0 — the storm test replays the
+        seeded trace through a NumPy oracle and pins equality."""
+        lateness = max(0.0, (now - node.last_heartbeat) - hb_floor)
+        s = self.failslow_alpha * lateness + (
+            1.0 - self.failslow_alpha
+        ) * self._suspicion.get(node.name, 0.0)
+        if s < 1e-3:
+            # clamp the asymptotic decay tail to a true zero so an idle
+            # cluster quiesces (next_deadline stops scheduling wake-ups)
+            s = 0.0
+        self._suspicion[node.name] = s
+        ref = ("Node", "", node.name)
+        if node.state == NODE_READY and s > self.failslow_threshold:
+            node.state = NODE_DEGRADED
+            EVENTS.record(
+                ref,
+                TYPE_WARNING,
+                REASON_NODE_DEGRADED,
+                f"fail-slow suspicion {s:.2f}s exceeds"
+                f" {self.failslow_threshold:g}s (EWMA of heartbeat"
+                " lateness); masking from new placements, running pods"
+                " stay bound",
+            )
+            METRICS.inc("node_degraded_total")
+            return 1
+        if node.state == NODE_DEGRADED and s < self.failslow_recover:
+            node.state = NODE_READY
+            EVENTS.record(
+                ref,
+                TYPE_NORMAL,
+                REASON_NODE_RECOVERED,
+                f"fail-slow suspicion decayed to {s:.2f}s (below"
+                f" {self.failslow_recover:g}s); schedulable again",
+            )
+            METRICS.inc("node_recovered_total")
+            return 1
+        return 0
 
     def _evict_lost_node(self, node, affected: Dict[GangKey, str]) -> int:
         """Fail every pod bound to the Lost node: delete it (the PCLQ
@@ -430,9 +544,14 @@ class NodeHealthMonitor:
     def _group_survivors(self, gang) -> Dict[str, int]:
         # a pod only counts as a survivor on a HEALTHY node: a binding that
         # outlived a failed eviction attempt (store outage) must not make a
-        # doomed gang look rescuable
+        # doomed gang look rescuable. Degraded is NOT unhealthy here — a
+        # fail-slow node's pods are alive and running (that is the whole
+        # point of the state); counting them dead would terminate gangs a
+        # gray failure never broke
         unhealthy = {
-            n.name for n in self.cluster.nodes if n.state != NODE_READY
+            n.name
+            for n in self.cluster.nodes
+            if n.state in (NODE_NOT_READY, NODE_LOST)
         }
         out: Dict[str, int] = {}
         for group in gang.spec.pod_groups:
@@ -684,7 +803,12 @@ class NodeHealthMonitor:
     # -- observability -----------------------------------------------------
 
     def _export_gauges(self, now: float) -> None:
-        counts = {NODE_READY: 0, NODE_NOT_READY: 0, NODE_LOST: 0}
+        counts = {
+            NODE_READY: 0,
+            NODE_NOT_READY: 0,
+            NODE_LOST: 0,
+            NODE_DEGRADED: 0,
+        }
         max_age = 0.0
         for node in self.cluster.nodes:
             counts[node.state] = counts.get(node.state, 0) + 1
@@ -693,6 +817,11 @@ class NodeHealthMonitor:
         METRICS.set("nodes_ready", counts[NODE_READY])
         METRICS.set("nodes_not_ready", counts[NODE_NOT_READY])
         METRICS.set("nodes_lost", counts[NODE_LOST])
+        METRICS.set("nodes_degraded", counts[NODE_DEGRADED])
+        METRICS.set(
+            "node_suspicion_max_seconds",
+            max(self._suspicion.values()) if self._suspicion else 0.0,
+        )
         METRICS.set("node_heartbeat_age_max_seconds", max_age)
         METRICS.set("gangs_in_requeue_backoff", len(self._held))
         METRICS.set("gang_rescues_pending", len(self._rescue_pending))
@@ -717,6 +846,8 @@ class NodeHealthMonitor:
                 # "" | Draining | Drained (docs/robustness.md drain flow)
                 "drain": drains.get(n.name, ""),
                 "heartbeatAgeSeconds": round(max(0.0, now - n.last_heartbeat), 3),
+                # EWMA fail-slow suspicion (0.0 while detection is off)
+                "suspicion": round(self._suspicion.get(n.name, 0.0), 3),
                 "capacity": dict(n.capacity),
                 "labels": dict(n.labels),
                 "boundPods": bound_counts.get(n.name, 0),

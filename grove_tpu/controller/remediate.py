@@ -48,11 +48,13 @@ from grove_tpu.observability.ledger import (
     LEDGER,
     OUTCOME_EXECUTED,
     OUTCOME_SKIPPED,
+    TRIGGER_FAILSLOW,
     TRIGGER_FORECAST_PEAK,
     TRIGGER_FRAG_THRESHOLD,
     TRIGGER_SLO_BURN,
 )
 from grove_tpu.observability.slo import SLO
+from grove_tpu.sim.cluster import NODE_DEGRADED
 
 DEFAULT_EFFECT_WINDOW = 120.0  # seconds from action to effect measurement
 DEFAULT_COOLDOWN = 60.0  # per (action kind, target) re-trigger damping
@@ -166,9 +168,16 @@ class RemediationController:
         work = self._measure_effects(now)
         burning = SLO.burning()
         if burning:
-            work += self._on_burn(burning[0], now)
+            structural = self._on_burn(burning[0], now)
         elif self.frag_threshold is not None:
-            work += self._on_frag(now)
+            structural = self._on_frag(now)
+        else:
+            structural = 0
+        if not structural:
+            # fail-slow drains ride the same one-structural-action-per-
+            # tick discipline as burn/frag defrags
+            structural = self._on_failslow(now)
+        work += structural
         work += self._on_forecast(now)
         return work
 
@@ -229,6 +238,119 @@ class RemediationController:
             if acted:
                 return acted
         return 0
+
+    def _on_failslow(self, now: float) -> int:
+        """Fail-slow trigger (docs/robustness.md "Gray failures"): a node
+        the suspicion EWMA flipped to Degraded is already masked from new
+        placements; this decides whether to also DRAIN it — only when the
+        what-if engine proves every victim gang re-places on the remaining
+        healthy capacity (the scheduled-gang analogue of a verdict flip:
+        Scheduled → fits-elsewhere), and every victim clears the
+        disruption broker's budget. A gray failure never justifies
+        breaking a gang the failure itself did not break."""
+        degraded = sorted(
+            n.name
+            for n in self.cluster.nodes
+            if n.state == NODE_DEGRADED
+        )
+        work = 0
+        for node in degraded:
+            if self._cooling("failslow", node, now):
+                continue
+            victims = self._bound_gangs(node)
+            if not victims:
+                # nothing bound: the schedulable mask alone contains the
+                # gray failure; draining an empty node is pure churn
+                self._cool("failslow", node, now)
+                continue
+            trigger_detail = (
+                f"node {node} Degraded (fail-slow suspicion over threshold)"
+            )
+            diagnosis = {
+                "node": node,
+                "victims": [f"{vns}/{vname}" for vns, vname in victims],
+            }
+            if self.broker.active() and self.broker.breaker_open:
+                self._cool("failslow", node, now)
+                LEDGER.record(
+                    TRIGGER_FAILSLOW, ACTION_DRAIN_NODE, OUTCOME_SKIPPED,
+                    trigger_detail=trigger_detail, diagnosis=diagnosis,
+                    reason="breaker-open", now=now,
+                )
+                work += 1
+                continue
+            proven = True
+            afters = []
+            for vns, vname in victims:
+                report = self.explain.whatif(
+                    {
+                        "gang": {"namespace": vns, "name": vname},
+                        "actions": [
+                            {"action": "drain-node", "node": node}
+                        ],
+                    }
+                )
+                afters.append(
+                    {
+                        "gang": f"{vns}/{vname}",
+                        "fits_after": bool(
+                            report["after"].get("fits_now")
+                        ),
+                        "after": report["after"].get(
+                            "binding_constraint"
+                        ),
+                    }
+                )
+                if not report["after"].get("fits_now"):
+                    proven = False
+                    break
+            self._cool("failslow", node, now)
+            simulation = {"flipped": proven, "victims": afters}
+            if not proven:
+                LEDGER.record(
+                    TRIGGER_FAILSLOW, ACTION_DRAIN_NODE, OUTCOME_SKIPPED,
+                    trigger_detail=trigger_detail, diagnosis=diagnosis,
+                    simulation=simulation,
+                    reason="not-flipped", now=now,
+                )
+                work += 1
+                continue
+            denied = False
+            for vns, vname in victims:
+                gang = self.store.get("PodGang", vns, vname, readonly=True)
+                if gang is not None and not self.broker.would_allow(
+                    gang, now
+                ):
+                    LEDGER.record(
+                        TRIGGER_FAILSLOW, ACTION_DRAIN_NODE,
+                        OUTCOME_SKIPPED,
+                        trigger_detail=trigger_detail, diagnosis=diagnosis,
+                        simulation=simulation,
+                        action={"target": node},
+                        reason=f"budget-denied for {vns}/{vname}", now=now,
+                    )
+                    denied = True
+                    break
+            if denied:
+                work += 1
+                continue
+            self.drainer.request_drain(node)
+            entry = LEDGER.record(
+                TRIGGER_FAILSLOW, ACTION_DRAIN_NODE, OUTCOME_EXECUTED,
+                trigger_detail=trigger_detail, diagnosis=diagnosis,
+                simulation=simulation,
+                action={
+                    "target": node,
+                    "mechanism": "drain",
+                    "victims": [
+                        f"{vns}/{vname}" for vns, vname in victims
+                    ],
+                },
+                now=now,
+            )
+            self._schedule_effect(entry, self.effect_slo, now)
+            return work + 1
+        return work
 
     def _on_forecast(self, now: float) -> int:
         """Forecast peaks: preemptive scale-up ahead of the predicted

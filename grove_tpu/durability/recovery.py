@@ -45,6 +45,8 @@ from grove_tpu.observability.events import (
     EVENTS,
     REASON_RECOVERY_COMPLETED,
     REASON_SNAPSHOT_TAKEN,
+    REASON_WAL_DEGRADED,
+    REASON_WAL_RECOVERED,
     REASON_WAL_TORN_TAIL,
     TYPE_NORMAL,
     TYPE_WARNING,
@@ -343,6 +345,15 @@ class StoreDurability:
         self.snapshots_taken = 0
         self._committer: Optional[threading.Thread] = None
         self._committer_stop: Optional[threading.Event] = None
+        # the degradation ladder (docs/robustness.md "Gray failures"):
+        # ok -> degraded (fsync latency over SLO: loud, still durable)
+        # -> read-only (disk full: mutations rejected via the store's
+        # error injectors, deletes still allowed — they free space).
+        # Every rung transition emits a registered WalDegraded /
+        # WalRecovered event; healthy stores never enter this code.
+        self.degraded_mode = "ok"  # ok | degraded | read-only
+        self.fsync_slo_seconds = 0.5
+        self._saved_injectors: dict = {}
 
     # -- committer --------------------------------------------------------
 
@@ -362,10 +373,38 @@ class StoreDurability:
         snapshot would truncate segments another process still holds a
         stale segment index into."""
         flushed = 0
+        flush_failed = False
+        why = ""
         for wal in self.wals:
-            flushed += wal.flush()
+            try:
+                flushed += wal.flush()
+            except OSError as exc:
+                # records stay buffered in the stream (nothing acked,
+                # nothing lost) — step to read-only instead of crashing
+                flush_failed = True
+                why = str(exc)
+        if flush_failed:
+            self._set_degraded_mode("read-only", why)
+            return flushed
+        lag = max((w.last_fsync_lag for w in self.wals), default=0.0)
+        if lag > self.fsync_slo_seconds:
+            # durable but SLOW (the fail-slow disk): loud rung — acks
+            # still land, operators get the signal before it tips over
+            self._set_degraded_mode(
+                "degraded",
+                f"fsync latency {lag:.3f}s over SLO"
+                f" {self.fsync_slo_seconds:.3f}s",
+            )
+        elif self.degraded_mode != "ok":
+            self._set_degraded_mode(
+                "ok", "flush healthy; retained buffer drained"
+            )
         drain = getattr(self.store, "_process_drain", None)
         if drain is not None and drain.active:
+            return flushed
+        if self.degraded_mode != "ok":
+            # snapshots write to the same sick disk — park auto-snapshot
+            # until the ladder steps back to ok
             return flushed
         if (
             sum(w.flushed_bytes for w in self.wals)
@@ -374,6 +413,71 @@ class StoreDurability:
         ):
             self.snapshot()
         return flushed
+
+    # -- degradation ladder ----------------------------------------------
+
+    _LADDER = ("ok", "degraded", "read-only")
+
+    def _set_degraded_mode(self, mode: str, why: str) -> None:
+        """One rung transition: gauge + registered event + (for the
+        read-only rung) the store-side write fence. Idempotent — pump
+        calls it every round; same-rung calls are free."""
+        if mode == self.degraded_mode:
+            return
+        prev = self.degraded_mode
+        self.degraded_mode = mode
+        METRICS.set(
+            "wal_degraded_mode", float(self._LADDER.index(mode))
+        )
+        if mode == "read-only":
+            self._fence_writes()
+        elif prev == "read-only":
+            self._unfence_writes()
+        if mode == "ok":
+            EVENTS.record(
+                _STORE_REF,
+                TYPE_NORMAL,
+                REASON_WAL_RECOVERED,
+                f"WAL recovered from {prev}: {why}",
+            )
+        else:
+            METRICS.inc("wal_degraded_total")
+            EVENTS.record(
+                _STORE_REF,
+                TYPE_WARNING,
+                REASON_WAL_DEGRADED,
+                f"WAL {mode} (was {prev}): {why}",
+            )
+
+    def _fence_writes(self) -> None:
+        """Read-only rung: reject create/update through the store's
+        fault-injection seam (the one hook every write path already
+        runs). Deletes stay allowed — they free the space that got us
+        here, same as etcd's NOSPACE alarm semantics."""
+
+        def _reject(_obj):
+            METRICS.inc("wal_read_only_writes_rejected_total")
+            return GroveError(
+                ERR_CONFLICT,
+                "store is read-only: WAL cannot make writes durable"
+                " (disk full); retry after the disk recovers",
+                "wal-read-only",
+            )
+
+        self._saved_injectors = {}
+        for op in ("create", "update"):
+            self._saved_injectors[op] = self.store.error_injectors.get(
+                op
+            )
+            self.store.error_injectors[op] = _reject
+
+    def _unfence_writes(self) -> None:
+        for op, prev in self._saved_injectors.items():
+            if prev is None:
+                self.store.error_injectors.pop(op, None)
+            else:
+                self.store.error_injectors[op] = prev
+        self._saved_injectors = {}
 
     def snapshot(self) -> str:
         """Snapshot now (scan serialized against concurrent writers when a
@@ -482,4 +586,5 @@ class StoreDurability:
             ),
             "snapshots_taken": self.snapshots_taken,
             "shards": self.num_shards,
+            "degraded_mode": self.degraded_mode,
         }

@@ -317,6 +317,20 @@ class WriteAheadLog:
         # owner ships back). Flipped off on repatriation after a worker
         # crash, when the coordinator takes the stream back.
         self.remote = False
+        # gray-failure injection (docs/robustness.md "Gray failures"):
+        # chaos faults set these; the degradation ladder in
+        # StoreDurability reads the symptoms and steps rungs. Both
+        # default off — the healthy flush path is byte-identical.
+        # fault_slow_fsync models N seconds of extra fsync latency (the
+        # fail-slow disk): flush still succeeds, the modeled lag lands
+        # in last_fsync_lag for the ladder's SLO compare. No real sleep
+        # — determinism and test wall-time both forbid it.
+        self.fault_slow_fsync = 0.0
+        # fault_disk_full makes flush raise ENOSPC with the batch still
+        # BUFFERED — nothing acked, nothing lost; the ladder's read-only
+        # rung decides what the store does about it.
+        self.fault_disk_full = False
+        self.last_fsync_lag = 0.0
         self.durable_seq = 0
         self.durable_rv = 0
         self.flushed_bytes = 0
@@ -479,6 +493,10 @@ class WriteAheadLog:
     def _flush_locked(self) -> int:
         if self._dead or self.remote:
             return 0
+        if self.fault_disk_full:
+            # the batch stays buffered: nothing was acked, so nothing is
+            # lost — the ladder turns this into read-only, not a crash
+            raise OSError(28, "No space left on device (injected)")
         with self._lock:
             batch, self._buffer = self._buffer, []
         if not batch:
@@ -498,7 +516,13 @@ class WriteAheadLog:
         fh.write(data)
         fh.flush()
         os.fsync(fh.fileno())
-        METRICS.observe("wal_fsync_seconds", time.perf_counter() - t0)
+        fsync_lag = time.perf_counter() - t0
+        if self.fault_slow_fsync > 0.0:
+            # the fail-slow disk: model the extra latency (observed, not
+            # slept) so the ladder's SLO compare sees the symptom
+            fsync_lag += self.fault_slow_fsync
+        self.last_fsync_lag = fsync_lag
+        METRICS.observe("wal_fsync_seconds", fsync_lag)
         METRICS.inc("wal_flushed_bytes_total", len(data))
         METRICS.inc("wal_records_total", len(batch))
         self._segment_bytes += len(data)

@@ -41,7 +41,9 @@ from grove_tpu.api.types import COND_PODGANG_SCHEDULED, PodCliqueSet
 from grove_tpu.federation.quota import GlobalQuotaFold
 from grove_tpu.observability.events import (
     EVENTS,
+    REASON_CLUSTER_HEALED,
     REASON_CLUSTER_LOST,
+    REASON_CLUSTER_PARTITIONED,
     REASON_CLUSTER_REJOINED,
     REASON_GANG_REQUEUED,
     REASON_GANG_SPILLED,
@@ -67,9 +69,17 @@ class FederatedCluster:
     harness: Optional[SimHarness]
     phase_offset: float = 0.0
     index: int = 0
-    state: str = "Ready"  # Ready | Lost
+    state: str = "Ready"  # Ready | Lost | Partitioned
     lost_at: Optional[float] = None
     crashes: int = 0
+    # partition ≠ crash (docs/federation.md): an unreachable region's
+    # harness stays ALIVE and keeps converging on the shared clock — the
+    # router just cannot talk to it. `reachable` is the fault plane;
+    # `state` flips to Partitioned only once the router's suspicion
+    # timeout expires.
+    reachable: bool = True
+    unreachable_since: Optional[float] = None
+    partitions: int = 0
 
 
 def pcs_floor_demand(pcs: PodCliqueSet) -> Dict[str, float]:
@@ -99,6 +109,7 @@ class FederationRouter:
         num_nodes: int = 16,
         phase_offsets: Optional[List[float]] = None,
         spill_after: float = 30.0,
+        partition_suspect_after: float = 30.0,
         durability_root: Optional[str] = None,
         harness_factory: Optional[Callable] = None,
     ) -> None:
@@ -108,6 +119,10 @@ class FederationRouter:
             raise ValueError("federation: duplicate region names")
         self.clock = VirtualClock()
         self.spill_after = spill_after
+        # how long a region may be unreachable before the router suspects
+        # a partition (fences + spills its still-pending gangs); matches
+        # the region's own lease expiry on the shared clock
+        self.partition_suspect_after = partition_suspect_after
         self.num_nodes = num_nodes
         self._durability_root = durability_root
         self._factory = harness_factory
@@ -123,9 +138,14 @@ class FederationRouter:
         # the routing ledger: every place/spill/reroute/strand/rejoin,
         # vt-stamped, with score inputs and the home verdict that drove it
         self._decisions: List[dict] = []
+        # (ns, pcs name) spilled off a partitioned region, by region: the
+        # stale copies still sitting in the unreachable store that heal
+        # reconciliation must delete (the one write a partition forbids)
+        self._partition_spills: Dict[str, List[Tuple[str, str]]] = {}
         # lifetime counters (bench "federation" block / GET /federation)
         self.spillovers = 0
         self.reroutes = 0
+        self.partition_spills = 0
         self.fold = GlobalQuotaFold(len(regions))
         offsets = phase_offsets or [0.0] * len(regions)
         if len(offsets) != len(regions):
@@ -225,8 +245,24 @@ class FederationRouter:
         return [dict(d) for d in self._decisions]
 
     def _ready(self) -> List[FederatedCluster]:
+        """Clusters the router may ROUTE to/through: Ready AND reachable.
+        An unreachable region drops out of routing the instant the fault
+        lands (the router's calls to it would hang), even before the
+        suspicion timeout flips its state to Partitioned."""
         return [
-            cl for cl in self._clusters.values() if cl.state == "Ready"
+            cl
+            for cl in self._clusters.values()
+            if cl.state == "Ready" and cl.reachable
+        ]
+
+    def _live(self) -> List[FederatedCluster]:
+        """Clusters whose control plane is RUNNING (everything but Lost):
+        a partitioned region keeps converging on the shared clock — it
+        just cannot be routed to or read by the router."""
+        return [
+            cl
+            for cl in self._clusters.values()
+            if cl.state != "Lost" and cl.harness is not None
         ]
 
     def _record(self, kind: str, namespace: str, name: str, **kw) -> dict:
@@ -314,9 +350,14 @@ class FederationRouter:
         """
         ticks = 0
         for _ in range(max_ticks):
+            work = self._partition_suspect_tick()
             ready = self._ready()
-            work = bound = started = 0
-            for cl in ready:
+            live = self._live()
+            bound = started = 0
+            # EVERY live harness ticks — a partitioned region's control
+            # plane keeps converging on the shared clock (partition ≠
+            # crash); only routing below is restricted to `ready`
+            for cl in live:
                 w, b, s = cl.harness.tick_once()
                 work += w
                 bound += b
@@ -328,10 +369,13 @@ class FederationRouter:
                 wakes = [
                     w
                     for w in (
-                        cl.harness.next_wake() for cl in ready
+                        cl.harness.next_wake() for cl in live
                     )
                     if w is not None
                 ]
+                suspect_wake = self._next_suspect_deadline()
+                if suspect_wake is not None:
+                    wakes.append(suspect_wake)
                 if len(ready) > 1:
                     # a pending gang becomes spill-eligible at
                     # creation + spill_after: that moment is a wake
@@ -354,6 +398,132 @@ class FederationRouter:
             for cl in self._ready():
                 cl.harness.store.verify_readonly_integrity()
         return ticks
+
+    # -- partition suspicion ---------------------------------------------
+
+    def _next_suspect_deadline(self) -> Optional[float]:
+        """Earliest instant an unreachable-but-not-yet-Partitioned region
+        crosses ``partition_suspect_after`` — a converge wake deadline,
+        or the loop would idle out before ever suspecting."""
+        best: Optional[float] = None
+        for cl in self._clusters.values():
+            if (
+                cl.state == "Ready"
+                and not cl.reachable
+                and cl.unreachable_since is not None
+            ):
+                due = cl.unreachable_since + self.partition_suspect_after
+                if best is None or due < best:
+                    best = due
+        return best
+
+    def _partition_suspect_tick(self) -> int:
+        """Flip Ready-but-unreachable regions past the suspicion timeout
+        to Partitioned: fence their admission (the region's own lease
+        expiry on the shared clock — it may no longer flip gangs to
+        Scheduled), then spill ONLY its still-pending placements.
+        Anything the region already Scheduled stays bound there —
+        invariant F3: no PodGang is ever Scheduled in two clusters
+        across a partition/heal cycle. Because the fence lands before
+        the store read, the Scheduled set cannot grow under us, so
+        "pending at suspect time" is an honest one-shot judgment."""
+        now = self.clock.now()
+        work = 0
+        for cl in self._clusters.values():
+            if (
+                cl.state != "Ready"
+                or cl.reachable
+                or cl.unreachable_since is None
+                or now - cl.unreachable_since
+                < self.partition_suspect_after
+            ):
+                continue
+            cl.state = "Partitioned"
+            cl.partitions += 1
+            # fence FIRST: a fenced scheduler cannot newly bind, so the
+            # pending/Scheduled split read below is final (F3 holds by
+            # construction, not by luck of tick ordering)
+            cl.harness.scheduler.admission_fenced = True
+            METRICS.inc("federation_cluster_partitions_total")
+            METRICS.set(
+                "federation_clusters_ready", float(len(self._ready()))
+            )
+            EVENTS.record(
+                ("Cluster", "", cl.region),
+                "Warning",
+                REASON_CLUSTER_PARTITIONED,
+                f"region {cl.region} partitioned after"
+                f" {self.partition_suspect_after:.0f}s unreachable;"
+                " admission fenced, spilling pending gangs",
+            )
+            work += 1
+            work += self._spill_partitioned(cl)
+        return work
+
+    def _spill_partitioned(self, cl: FederatedCluster) -> int:
+        """Move the partitioned region's placements whose PCS has NO
+        Scheduled gang to the best surviving sibling. The stale copy
+        cannot be deleted from the unreachable store — heal
+        reconciliation does that — so remember each spilled key in
+        ``_partition_spills``."""
+        region = cl.region
+        moved = 0
+        victims = sorted(
+            key for key, r in self._placements.items() if r == region
+        )
+        for key in victims:
+            ns, pcs_name = key
+            gangs = [
+                g
+                for g in cl.harness.store.list("PodGang")
+                if g.metadata.labels.get(namegen.LABEL_PART_OF)
+                == pcs_name
+                and g.metadata.namespace == ns
+            ]
+            if any(
+                (
+                    c := get_condition(
+                        g.status.conditions, COND_PODGANG_SCHEDULED
+                    )
+                )
+                is not None
+                and c.is_true()
+                for g in gangs
+            ):
+                # already Scheduled inside the partition: it stays bound
+                # there (F3) — the region keeps running it behind the
+                # partition and nothing re-routes
+                continue
+            template, home = self._specs[key]
+            ranked = self._rank_targets(
+                pcs_floor_demand(template), exclude=region
+            )
+            if not ranked:
+                continue  # stays pending behind the fence until heal
+            _sortkey, target, inputs, _admits = ranked[0]
+            self._clusters[target].harness.apply(deep_copy(template))
+            self._placements[key] = target
+            self._partition_spills.setdefault(region, []).append(key)
+            self.partition_spills += 1
+            METRICS.inc("federation_partition_spills_total")
+            EVENTS.record(
+                ("PodCliqueSet", ns, pcs_name),
+                "Warning",
+                REASON_GANG_SPILLED,
+                f"partition-spilled {region} -> {target}"
+                " (pending behind partition)",
+            )
+            self._record(
+                "partition-spill",
+                ns,
+                pcs_name,
+                home=home,
+                to=target,
+                score=dict(inputs),
+                **{"from": region},
+            )
+            moved += 1
+        return moved
 
     # -- spillover core --------------------------------------------------
 
@@ -380,7 +550,11 @@ class FederationRouter:
         tenant's deserved share global."""
         partials: List[dict] = [{} for _ in range(self.fold.num_clusters)]
         for cl in self._clusters.values():
-            if cl.state == "Ready" and cl.index < len(partials):
+            if (
+                cl.state == "Ready"
+                and cl.reachable
+                and cl.index < len(partials)
+            ):
                 partials[cl.index] = introspect.queue_usage(
                     cl.harness.scheduler
                 )
@@ -631,21 +805,84 @@ class FederationRouter:
             "stranded": [list(k) for k in stranded],
         }
 
+    def partition_cluster(self, region: str) -> FederatedCluster:
+        """Cut the router's link to a Ready region. Unlike
+        ``crash_cluster`` the harness stays ALIVE and keeps converging
+        on the shared clock — only the router's view goes dark. Nothing
+        moves yet: the suspicion timeout in ``_partition_suspect_tick``
+        decides when (and what) to spill."""
+        cl = self._clusters.get(region)
+        if cl is None or cl.state != "Ready" or not cl.reachable:
+            raise ValueError(
+                f"federation: cannot partition {region!r}"
+                " (not Ready/reachable)"
+            )
+        cl.reachable = False
+        cl.unreachable_since = self.clock.now()
+        METRICS.set(
+            "federation_clusters_ready", float(len(self._ready()))
+        )
+        return cl
+
+    def heal_cluster(self, region: str) -> dict:
+        """Heal a partition: unfence admission, reconcile by deleting
+        the stale copies of PCS keys the suspect pass spilled elsewhere
+        (the one write the partition forbade), and return the region to
+        routing. Spilled placements do NOT fail back — same no-fail-back
+        rule as crash/rejoin — so each key ends Scheduled in exactly one
+        cluster (F3)."""
+        cl = self._clusters.get(region)
+        if cl is None or cl.reachable or cl.harness is None:
+            raise ValueError(
+                f"federation: cannot heal {region!r} (not partitioned)"
+            )
+        stale = self._partition_spills.pop(region, [])
+        for ns, pcs_name in stale:
+            cl.harness.delete(pcs_name, ns)
+        # tenant Queues applied while the region was dark never reached
+        # it — re-apply the full set so the DRF trees agree again
+        for queue in self._queues.values():
+            cl.harness.apply_queue(deep_copy(queue))
+        cl.reachable = True
+        cl.unreachable_since = None
+        cl.state = "Ready"
+        cl.harness.scheduler.admission_fenced = False
+        METRICS.set(
+            "federation_clusters_ready", float(len(self._ready()))
+        )
+        EVENTS.record(
+            ("Cluster", "", region),
+            "Normal",
+            REASON_CLUSTER_HEALED,
+            f"region {region} healed; reconciled {len(stale)} stale"
+            " spilled copies",
+        )
+        self._record(
+            "heal", "", region, reconciled=[list(k) for k in stale]
+        )
+        return {
+            "region": region,
+            "reconciled": [list(k) for k in stale],
+        }
+
     def rejoin_cluster(self, region: str) -> FederatedCluster:
         """Restore a Lost region with a FRESH harness on the shared
         clock (tenant Queues re-applied so the DRF trees agree again).
-        No fail-back: placements stay where the crash re-routed them."""
+        No fail-back: placements stay where the crash re-routed them.
+        The Ready flip is LAST — a spillover walk interleaved with this
+        call must never route into a half-built region (the rejoin/spill
+        race pin in tests/test_grayfail.py)."""
         cl = self._clusters.get(region)
         if cl is None or cl.state != "Lost":
             raise ValueError(
                 f"federation: cannot rejoin {region!r} (not Lost)"
             )
         cl.harness = self._build_harness(region)
-        cl.state = "Ready"
-        cl.lost_at = None
         self._install_context(cl)
         for queue in self._queues.values():
             cl.harness.apply_queue(deep_copy(queue))
+        cl.state = "Ready"
+        cl.lost_at = None
         METRICS.set(
             "federation_clusters_ready", float(len(self._ready()))
         )
@@ -681,6 +918,8 @@ class FederationRouter:
                 "state": cl.state,
                 "phaseOffset": cl.phase_offset,
                 "crashes": cl.crashes,
+                "reachable": cl.reachable,
+                "partitions": cl.partitions,
                 "placements": sum(
                     1
                     for r in self._placements.values()
@@ -703,6 +942,7 @@ class FederationRouter:
             "clusters": clusters,
             "spillovers": self.spillovers,
             "reroutes": self.reroutes,
+            "partitionSpills": self.partition_spills,
             "decisions": len(self._decisions),
             "foldDepthHistogram": self.fold.fold_depth_histogram(),
             "globalUsage": self.global_usage(),

@@ -108,6 +108,21 @@ REASON_GANG_SPILLED = "GangSpilled"
 REASON_CLUSTER_LOST = "ClusterLost"
 REASON_CLUSTER_REJOINED = "ClusterRejoined"
 
+# gray failures (docs/robustness.md "Gray failures"): the fail-slow
+# suspicion EWMA masking/unmasking a node (controller/nodehealth.py), a
+# federation region suspected partitioned vs. healed (federation/
+# router.py — partition ≠ crash: the region is alive but unreachable),
+# and the WAL degradation ladder (durability/wal.py — slow-fsync /
+# disk-full faults step the store through a loud degraded / read-only
+# mode instead of crashing). Every degraded-mode entry/exit site MUST
+# emit one of these (grovelint GL022).
+REASON_NODE_DEGRADED = "NodeDegraded"
+REASON_NODE_RECOVERED = "NodeRecovered"
+REASON_CLUSTER_PARTITIONED = "ClusterPartitioned"
+REASON_CLUSTER_HEALED = "ClusterHealed"
+REASON_WAL_DEGRADED = "WalDegraded"
+REASON_WAL_RECOVERED = "WalRecovered"
+
 # The closed set of event reasons this codebase may emit. grovelint's
 # GL006 rule checks every record()/record_event() call site against it,
 # and tests/test_docs_drift.py pins it against docs/observability.md.

@@ -48,11 +48,13 @@ from grove_tpu.observability.metrics import METRICS
 TRIGGER_SLO_BURN = "slo-burn"  # SloBurnRateHigh from the observatory
 TRIGGER_FORECAST_PEAK = "forecast-peak"  # forecast band crosses threshold
 TRIGGER_FRAG_THRESHOLD = "frag-threshold"  # fragmentation score too high
+TRIGGER_FAILSLOW = "fail-slow"  # node Degraded by the suspicion EWMA
 
 TRIGGER_KINDS = (
     TRIGGER_SLO_BURN,
     TRIGGER_FORECAST_PEAK,
     TRIGGER_FRAG_THRESHOLD,
+    TRIGGER_FAILSLOW,
 )
 
 ACTION_DRAIN_NODE = "drain-node"  # drain a flapping/filler node

@@ -175,6 +175,23 @@ class ProcessDrain:
         self.crashes = 0
         # boundary accounting (docs/observability.md)
         self.boundary_bytes = 0
+        # gray-failure injection (runtime/boundary.py): None = the
+        # fault-free channel code, byte-identical to the pre-fault
+        # build; armed, every frame carries a sequence number and the
+        # dedup/retransmit protocol below tolerates drop/dup/delay
+        self._faults = None
+        self.boundary_fault_counts = {
+            "drop": 0,
+            "dup": 0,
+            "delay": 0,
+            "retransmits": 0,
+            "deduped": 0,
+        }
+        self._tx_seq: Dict[int, int] = {}  # per-worker request seqs
+        self._rx_seq: Dict[int, int] = {}  # per-worker reply high-water
+        self._last_sent: Dict[int, bytes] = {}  # retransmit buffer
+        self._crx_high = 0  # child: request high-water mark
+        self._creply_cache: Dict[int, bytes] = {}  # child: seq -> reply
         # cache watermark: sync-log position at the last routing boundary.
         # Records before it are cache-advanceable in worker mirrors (the
         # serial drain advanced its cache for them at that routing);
@@ -227,6 +244,26 @@ class ProcessDrain:
             self.engine.store._process_drain = None
         if self.engine.round_hook == self._on_round:
             self.engine.round_hook = None
+
+    def inject_boundary_faults(
+        self,
+        seed: int,
+        drop_rate: float = 0.0,
+        dup_rate: float = 0.0,
+        delay_rate: float = 0.0,
+    ) -> None:
+        """Arm seeded drop/dup/delay injection on the wire boundary
+        (chaos ``boundary_faults`` arm). Must be armed before the drain
+        whose generation should see faults — children inherit the plan
+        at fork and compute identical verdicts."""
+        from grove_tpu.runtime.boundary import BoundaryFaults
+
+        self._faults = BoundaryFaults(
+            seed,
+            drop_rate=drop_rate,
+            dup_rate=dup_rate,
+            delay_rate=delay_rate,
+        )
 
     def _on_round(self) -> None:
         """Engine round hook: routing just ran — everything logged so far
@@ -317,6 +354,11 @@ class ProcessDrain:
         self._cursors = {}
         self._cache_mark = -1
         self._dead = set()
+        self._tx_seq = {}
+        self._rx_seq = {}
+        self._last_sent = {}
+        self._crx_high = 0
+        self._creply_cache = {}
         child_shards = [
             i for i in range(self.engine.num_shards) if self.worker_of(i) != 0
         ]
@@ -485,25 +527,70 @@ class ProcessDrain:
     # -- channel ----------------------------------------------------------
 
     def _send(self, w: int, msg: dict) -> None:
-        payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+        if self._faults is None:
+            payload = json.dumps(msg, separators=(",", ":")).encode(
+                "utf-8"
+            )
+            self.boundary_bytes += len(payload)
+            METRICS.inc("cp_boundary_bytes_total", len(payload))
+            self._conns[w].send_bytes(payload)
+            return
+        # armed: frame with a per-channel sequence number and let the
+        # fault plan decide. drop/delay withhold the frame — the
+        # retrying _recv below retransmits it (that IS the delay) —
+        # dup transmits twice (the worker's seq dedup eats the copy).
+        seq = self._tx_seq.get(w, 0) + 1
+        self._tx_seq[w] = seq
+        payload = json.dumps(
+            {"fs": seq, "fm": msg}, separators=(",", ":")
+        ).encode("utf-8")
+        self._last_sent[w] = payload
+        verdict = self._faults.decide("c2w", w, seq)
+        if verdict in ("drop", "delay"):
+            self.boundary_fault_counts[verdict] += 1
+            METRICS.inc("cp_boundary_faults_total")
+            return
         self.boundary_bytes += len(payload)
         METRICS.inc("cp_boundary_bytes_total", len(payload))
         self._conns[w].send_bytes(payload)
+        if verdict == "dup":
+            self.boundary_fault_counts["dup"] += 1
+            METRICS.inc("cp_boundary_faults_total")
+            self._conns[w].send_bytes(payload)
 
     def _recv(self, w: int, timeout: float) -> Optional[dict]:
         """One framed reply from worker `w`, deadline-bounded. None means
         the channel is dead (caller repatriates); a live-but-stalled
-        worker past the deadline fails CLOSED."""
+        worker past the deadline fails CLOSED. With boundary faults
+        armed this loop also DEDUPS (stale reply seqs are duplicates)
+        and RETRANSMITS the last request on a BackoffPolicy pace —
+        withheld or lost frames heal here, inside the same deadline."""
         conn = self._conns[w]
         proc = self._procs[w]
+        armed = self._faults is not None
         deadline = time.monotonic() + timeout
+        attempt = 0
+        next_retx = (
+            time.monotonic() + self._faults.retransmit_after(w, 0)
+            if armed
+            else None
+        )
         while True:
             try:
                 if conn.poll(0.05):
                     data = conn.recv_bytes()
                     self.boundary_bytes += len(data)
                     METRICS.inc("cp_boundary_bytes_total", len(data))
-                    return json.loads(data)
+                    doc = json.loads(data)
+                    if armed and isinstance(doc, dict) and "fs" in doc:
+                        seq = doc["fs"]
+                        if seq <= self._rx_seq.get(w, 0):
+                            # duplicate of a reply already consumed
+                            self.boundary_fault_counts["deduped"] += 1
+                            continue
+                        self._rx_seq[w] = seq
+                        return doc["fm"]
+                    return doc
             except (EOFError, OSError):
                 return None
             if not proc.is_alive():
@@ -514,12 +601,29 @@ class ProcessDrain:
                 except (EOFError, OSError):
                     pass
                 return None
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if now > deadline:
                 raise GroveError(
                     ERR_TRANSPORT,
                     f"worker {w} stalled past the {timeout:.0f}s batch"
                     " deadline; failing closed (flight bundle dumped)",
                     "process-drain",
+                )
+            if armed and now >= next_retx:
+                last = self._last_sent.get(w)
+                if last is not None:
+                    # retransmits bypass injection: one fault per frame
+                    # seq models gray loss, and the retry path must be
+                    # the reliable one or nothing ever converges
+                    try:
+                        conn.send_bytes(last)
+                    except (OSError, ValueError):
+                        return None
+                    self.boundary_fault_counts["retransmits"] += 1
+                    METRICS.inc("cp_boundary_retransmits_total")
+                attempt += 1
+                next_retx = now + self._faults.retransmit_after(
+                    w, attempt
                 )
 
     # -- coordinator batch path -------------------------------------------
@@ -704,19 +808,40 @@ class ProcessDrain:
                     child_conn.close()
             self._child_setup(me)
             while True:
-                msg = json.loads(conn.recv_bytes())
+                frame = json.loads(conn.recv_bytes())
+                seq = 0
+                if (
+                    self._faults is not None
+                    and isinstance(frame, dict)
+                    and "fs" in frame
+                ):
+                    seq = frame["fs"]
+                    if seq <= self._crx_high:
+                        # retransmit of a request already executed:
+                        # answer from the cached reply — idempotent, the
+                        # batch must never run twice
+                        cached = self._creply_cache.get(seq)
+                        if cached is not None:
+                            conn.send_bytes(cached)
+                        continue
+                    self._crx_high = seq
+                    msg = frame["fm"]
+                else:
+                    msg = frame
                 if msg["cmd"] == "batch":
-                    conn.send_bytes(
-                        json.dumps(
-                            self._child_batch(msg), separators=(",", ":")
-                        ).encode("utf-8")
+                    self._child_reply(
+                        conn, me, seq, self._child_batch(msg)
                     )
                 elif msg["cmd"] == "stop":
-                    conn.send_bytes(
-                        json.dumps(
-                            {"cmd": "bye", "wal": self._child_final_flush(me)},
-                            separators=(",", ":"),
-                        ).encode("utf-8")
+                    # the stop handshake carries the WAL watermarks —
+                    # never inject on it (the child exits right after,
+                    # so the retransmit path could not heal a drop)
+                    self._child_reply(
+                        conn,
+                        me,
+                        seq,
+                        {"cmd": "bye", "wal": self._child_final_flush(me)},
+                        faultable=False,
                     )
                     os._exit(0)
         except EOFError:
@@ -733,6 +858,34 @@ class ProcessDrain:
             except OSError:
                 pass
             os._exit(1)
+
+    def _child_reply(
+        self, conn, me: int, seq: int, msg: dict, faultable: bool = True
+    ) -> None:
+        """Send one reply frame from a worker. Armed: frame with the
+        request's seq (monotone — the coordinator dedups on it), cache
+        the payload for retransmit-triggered resends, and let the fault
+        plan withhold or duplicate the transmit."""
+        if self._faults is None:
+            conn.send_bytes(
+                json.dumps(msg, separators=(",", ":")).encode("utf-8")
+            )
+            return
+        payload = json.dumps(
+            {"fs": seq, "fm": msg}, separators=(",", ":")
+        ).encode("utf-8")
+        # cache keyed by request seq: a retransmitted request resends
+        # this exact payload (the cached-reply path bypasses injection)
+        self._creply_cache = {seq: payload}
+        if faultable:
+            verdict = self._faults.decide("w2c", me, seq)
+            if verdict in ("drop", "delay"):
+                return  # withheld: the coordinator's retransmit heals it
+            conn.send_bytes(payload)
+            if verdict == "dup":
+                conn.send_bytes(payload)
+            return
+        conn.send_bytes(payload)
 
     def _child_setup(self, me: int) -> None:
         from grove_tpu.api.meta import reset_uid_namespace
@@ -911,4 +1064,5 @@ class ProcessDrain:
             ],
             "worker_crashes": self.crashes,
             "boundary_bytes": self.boundary_bytes,
+            "boundary_faults": dict(self.boundary_fault_counts),
         }

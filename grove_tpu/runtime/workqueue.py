@@ -30,28 +30,23 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import zlib
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
+# the rate-limiter curve and its constants live in runtime/backoff.py —
+# the one deterministic-jitter policy shared by every retry loop in the
+# tree; re-exported here because this was their historical home and
+# consumers (and tests) import them from the queue
+from grove_tpu.runtime.backoff import (  # noqa: F401  (re-export)
+    BASE_BACKOFF,
+    JITTER_FRAC,
+    MAX_BACKOFF,
+    BackoffPolicy,
+)
 from grove_tpu.runtime.shards import shard_of
 
 Key = Tuple[str, str, str]  # (kind, namespace, name)
-
-BASE_BACKOFF = 0.005
-# HARD cap on the rate-limited delay, applied AFTER jitter: no key ever
-# waits longer than this between retries, however many times it failed
-# (tests/test_runtime.py pins the cap and the monotone growth toward it)
-MAX_BACKOFF = 1000.0
-# multiplicative jitter span on the exponential backoff: many keys failing
-# in the same instant (a node loss requeueing every affected gang, a store
-# outage failing a whole drain round) must not retry in one synchronized
-# burst. DETERMINISTIC per (key, failures) — crc32, not random or hash():
-# virtual-time replays and cross-process runs (PYTHONHASHSEED) must see
-# identical schedules. <1.0 keeps growth strictly monotone: the worst case
-# 2^f*(1+J) vs 2^(f+1)*1 still grows since 1+J < 2.
-JITTER_FRAC = 0.1
 # a zero (or negative) requeue delay would make the key ready again within
 # the SAME engine drain round — `Engine.drain` freezes `now` per call and
 # drains each controller's whole ready set, so the re-add would livelock
@@ -80,8 +75,7 @@ class WorkQueue:
         # per-instance rate-limiter curve: reconcile queues keep the
         # client-go-style 5ms base, while coarser consumers (gang requeue
         # after node failure) pick a second-scale base with a tighter cap
-        self.base_backoff = base_backoff
-        self.max_backoff = max_backoff
+        self.policy = BackoffPolicy(base=base_backoff, cap=max_backoff)
         self.num_shards = max(1, num_shards)
         # per-shard ready buckets + rotation pointer (module docstring);
         # one bucket at num_shards=1 keeps the historical FIFO exactly
@@ -97,6 +91,14 @@ class WorkQueue:
         self._delayed: List[_Delayed] = []
         self._seq = itertools.count()
         self._failures: Dict[Key, int] = {}
+
+    @property
+    def base_backoff(self) -> float:
+        return self.policy.base
+
+    @property
+    def max_backoff(self) -> float:
+        return self.policy.cap
 
     def _bucket_of(self, key: Key) -> Deque[Key]:
         if self.num_shards == 1:
@@ -122,18 +124,11 @@ class WorkQueue:
     def add_rate_limited(self, key: Key, now: float) -> None:
         """Exponential per-key backoff with deterministic jitter, capped at
         MAX_BACKOFF (client-go ItemExponentialFailureRateLimiter + the
-        bucket limiter's ceiling). delay = min(BASE·2^failures·(1+J·u),
-        MAX_BACKOFF) where u ∈ [0,1) is a crc32 of (key, failures) — stable
-        across processes and replays, monotone in failures, and desynced
-        across keys that fail together."""
+        bucket limiter's ceiling). The curve is runtime/backoff.py's
+        BackoffPolicy — byte-identical to the formula that used to live
+        inline here (tests/test_runtime.py pins the A/B)."""
         failures = self._failures.get(key, 0)
-        u = (
-            zlib.crc32(f"{key}:{failures}".encode()) & 0xFFFF
-        ) / float(1 << 16)
-        delay = min(
-            self.base_backoff * (2**failures) * (1.0 + JITTER_FRAC * u),
-            self.max_backoff,
-        )
+        delay = self.policy.delay(key, failures)
         self._failures[key] = failures + 1
         self.add_after(key, delay, now)
 

@@ -46,7 +46,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from grove_tpu.analysis.sanitize import accountant_drift, stranded_holds
 from grove_tpu.analysis import sanitize
@@ -211,6 +211,13 @@ class ChaosReport:
     # armed, and then REQUIRED to have fired
     worker_crashes: int = 0
     require_worker_crashes: int = 0
+    # failslow fault (gray failure, docs/robustness.md): a node's
+    # heartbeats run late without ever crossing the binary NotReady
+    # grace — the suspicion EWMA must flip it Degraded (masked from new
+    # placements, running gangs untouched) and back after the heal
+    failslow_degraded: int = 0
+    failslow_recovered: int = 0
+    require_failslow: int = 0
 
     @property
     def ok(self) -> bool:
@@ -227,6 +234,8 @@ class ChaosReport:
             and self.failovers >= 1
             and self.recoveries >= self.require_recoveries
             and self.worker_crashes >= self.require_worker_crashes
+            and self.failslow_degraded >= self.require_failslow
+            and self.failslow_recovered >= self.require_failslow
         )
 
     def as_dict(self) -> dict:
@@ -254,6 +263,8 @@ class ChaosReport:
             "remediations_executed": self.remediations_executed,
             "remediations_skipped": self.remediations_skipped,
             "worker_crashes": self.worker_crashes,
+            "failslow_degraded": self.failslow_degraded,
+            "failslow_recovered": self.failslow_recovered,
             "converged": self.converged,
             "signature_matches_fault_free": self.signature_matches_fault_free,
             "ok": self.ok,
@@ -316,8 +327,15 @@ class ChaosRunner:
         controlplane_crash: bool = False,
         durability_dir: Optional[str] = None,
         remediator: bool = False,
+        failslow: bool = False,
     ) -> None:
         self.seed = seed
+        # failslow: arm the gray-failure arm — suspicion EWMA on the
+        # monitor, a seeded fail-slow node in the schedule, Degraded →
+        # heal → Ready required by the verdict
+        self.failslow = failslow
+        self.failslow_threshold = 1.5
+        self.failslow_recover = 0.75
         self.num_nodes = num_nodes
         self.n_each = n_each
         self.tick_seconds = tick_seconds
@@ -340,7 +358,9 @@ class ChaosRunner:
         self.durability_dir = durability_dir
         self.harness = self._build_harness(durable=controlplane_crash)
         self.report = ChaosReport(
-            seed=seed, require_recoveries=1 if controlplane_crash else 0
+            seed=seed,
+            require_recoveries=1 if controlplane_crash else 0,
+            require_failslow=1 if failslow else 0,
         )
         self._breach_since: Dict[Tuple[str, str], float] = {}
         self._outage_ops = ("create", "update")
@@ -370,9 +390,18 @@ class ChaosRunner:
             h.durability.snapshot_every_bytes = 256 * 1024
         h.node_monitor.not_ready_after = self.not_ready_after
         h.node_monitor.lost_after = self.lost_after
+        if self.failslow:
+            self._arm_failslow_monitor(h.node_monitor)
         for pcs in chaos_workload(self.n_each):
             h.apply(pcs)
         return h
+
+    def _arm_failslow_monitor(self, monitor) -> None:
+        """Turn the suspicion EWMA on with chaos-speed thresholds: the
+        injected lag band sits BELOW the binary NotReady grace, so only
+        this detector can see the sick node."""
+        monitor.failslow_threshold = self.failslow_threshold
+        monitor.failslow_recover = self.failslow_recover
 
     # -- schedule construction -------------------------------------------
 
@@ -501,6 +530,32 @@ class ChaosRunner:
                 "drained node returns to service",
             )
         )
+        if self.failslow:
+            # gray failure: a FOURTH node goes fail-slow mid-run — late
+            # heartbeats inside the NotReady grace (binary detector
+            # blind), healed only after everything else recovered. Drawn
+            # last so the unarmed schedule keeps its exact rng sequence.
+            gray = self._node_of_one_pod(
+                "packed-", used
+            ) or self._node_of_one_pod("plain-", used)
+            assert gray, "no candidate node for the fail-slow fault"
+            used.add(gray)
+            faults.append(
+                Fault(
+                    rng.uniform(11, 13),
+                    "failslow_begin",
+                    gray,
+                    "gray failure: heartbeats late, below binary grace",
+                )
+            )
+            faults.append(
+                Fault(
+                    dead_dwell + rng.uniform(9.0, 10.0),
+                    "failslow_end",
+                    gray,
+                    "fail-slow healed (suspicion must decay to Ready)",
+                )
+            )
         faults.sort(key=lambda f: f.at)
         return faults
 
@@ -532,6 +587,18 @@ class ChaosRunner:
             self._controlplane_crash()
         elif fault.kind == "worker_crash":
             self._worker_crash()
+        elif fault.kind == "failslow_begin":
+            # lag band strictly below not_ready_after=5.0: the binary
+            # detector must stay blind for the arm to prove anything
+            h.cluster.inject_failslow(
+                fault.target,
+                seed=self.seed,
+                lag_min=2.0,
+                lag_max=4.5,
+                start_penalty=10.0,
+            )
+        elif fault.kind == "failslow_end":
+            h.cluster.heal_failslow(fault.target)
         self.report.faults.append(fault.as_dict())
 
     def _worker_crash(self) -> None:
@@ -601,6 +668,14 @@ class ChaosRunner:
         restarted.durability.snapshot_every_bytes = 256 * 1024
         restarted.node_monitor.not_ready_after = self.not_ready_after
         restarted.node_monitor.lost_after = self.lost_after
+        if self.failslow:
+            self._arm_failslow_monitor(restarted.node_monitor)
+        # an armed fail-slow fault is node state: it rides through the
+        # control-plane crash onto the rebuilt SimCluster
+        for name in sorted(h.cluster.failslow_names()):
+            restarted.cluster.inject_failslow(
+                name, *h.cluster.failslow_spec(name)
+            )
         # the rebuilt monitor re-primes holds from persisted conditions
         # with the chaos-speed grace windows in place
         restarted.node_monitor.resync()
@@ -663,6 +738,11 @@ class ChaosRunner:
         engine.requeue_all()
         cluster = SimCluster(store=h.store, nodes=h.cluster.nodes)
         cluster.rebuild_bindings()
+        # fail-slow is NODE state, not leader memory — an armed gray
+        # fault must survive the SimCluster rebuild (public accessor:
+        # GL022 bans grafting the registry directly)
+        for name in sorted(h.cluster.failslow_names()):
+            cluster.inject_failslow(name, *h.cluster.failslow_spec(name))
         scheduler = GangScheduler(
             h.store,
             cluster,
@@ -677,6 +757,8 @@ class ChaosRunner:
             not_ready_after=self.not_ready_after,
             lost_after=self.lost_after,
         )
+        if self.failslow:
+            self._arm_failslow_monitor(monitor)
         scheduler.monitor = monitor
         broker = DisruptionBroker(h.store)
         scheduler.broker = broker
@@ -895,6 +977,8 @@ class ChaosRunner:
             "node_drains_completed_total", 0
         )
         wcrashes_before = METRICS.counters.get("cp_worker_crashes_total", 0)
+        degraded_before = METRICS.counters.get("node_degraded_total", 0)
+        recovered_before = METRICS.counters.get("node_recovered_total", 0)
 
         # fault-free twin FIRST (same workload, converged, untouched): the
         # convergence target the chaotic run must reproduce
@@ -1018,6 +1102,13 @@ class ChaosRunner:
             METRICS.counters.get("cp_worker_crashes_total", 0)
             - wcrashes_before
         )
+        report.failslow_degraded = int(
+            METRICS.counters.get("node_degraded_total", 0) - degraded_before
+        )
+        report.failslow_recovered = int(
+            METRICS.counters.get("node_recovered_total", 0)
+            - recovered_before
+        )
         report.rescues = self._archived_rescues + list(h.node_monitor.rescues)
         report.pin_verified_rescues = sum(
             1 for r in report.rescues if r.get("rejoined_domain")
@@ -1088,6 +1179,7 @@ def run_chaos(
     max_ticks: int = 400,
     controlplane_crash: bool = False,
     remediator: bool = False,
+    failslow: bool = False,
 ) -> ChaosReport:
     """One seeded end-to-end chaos run (the `make chaos-smoke` core)."""
     return ChaosRunner(
@@ -1096,6 +1188,7 @@ def run_chaos(
         n_each=n_each,
         controlplane_crash=controlplane_crash,
         remediator=remediator,
+        failslow=failslow,
     ).run(max_ticks=max_ticks)
 
 
@@ -1379,5 +1472,298 @@ def run_federation_chaos(
 ) -> FederationChaosReport:
     """One seeded federation chaos run (`chaos_smoke.py --federation`)."""
     return FederationChaosRunner(
+        seed=seed, regions=regions, num_nodes=num_nodes, n_each=n_each
+    ).run(max_ticks=max_ticks)
+
+
+# -- partition chaos (docs/robustness.md "Gray failures") --------------------
+
+
+@dataclass
+class PartitionChaosReport:
+    """Verdict of one seeded partition chaos run: a region becomes
+    UNREACHABLE (its control plane stays alive and converging — the
+    gray cousin of `cluster_crash`), pending work spills, the region
+    heals, and the split-brain invariant F3 is policed every tick."""
+
+    seed: int
+    regions: int = 0
+    ticks: int = 0
+    faults: List[dict] = field(default_factory=list)
+    applied: int = 0
+    partitions: int = 0
+    heals: int = 0
+    partition_spills: int = 0
+    placements_kept: int = 0
+    placements_in_partition: int = 0
+    invariant_checks: int = 0
+    invariant_violations: List[str] = field(default_factory=list)
+    converged: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.invariant_violations
+            and self.converged
+            and self.partitions >= 1
+            and self.heals >= 1
+            and self.partition_spills >= 1
+            # every gang Scheduled inside the partition kept its
+            # placement across the heal (partition ≠ crash: nothing
+            # fails over that was already placed)
+            and self.placements_in_partition >= 1
+            and self.placements_kept == self.placements_in_partition
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "regions": self.regions,
+            "ticks": self.ticks,
+            "faults": self.faults,
+            "applied": self.applied,
+            "partitions": self.partitions,
+            "heals": self.heals,
+            "partition_spills": self.partition_spills,
+            "placements_kept": self.placements_kept,
+            "placements_in_partition": self.placements_in_partition,
+            "invariant_checks": self.invariant_checks,
+            "invariant_violations": self.invariant_violations,
+            "converged": self.converged,
+            "ok": self.ok,
+        }
+
+
+class PartitionChaosRunner:
+    """One seeded chaos run exercising `cluster_partition` — the fault
+    `cluster_crash` is NOT: the region's control plane keeps running
+    (its harness converges on the shared clock the whole time), only
+    the router's view of it goes dark. A second traffic wave is caught
+    mid-convergence by the partition, so the victim region holds BOTH
+    Scheduled gangs (which must stay bound — partition ≠ crash) and
+    still-pending gangs (which the router spills after the suspicion
+    timeout). On heal, the router deletes its own spilled copies from
+    the rejoined region and the split-brain invariant must have held
+    throughout:
+
+    F3. no PodGang is ever Scheduled in two clusters across a
+        partition/heal cycle — checked per tick by scanning EVERY
+        harness (including the partitioned one; it is alive, that is
+        the point) for PCSes whose gangs are Scheduled in more than
+        one region at once.
+
+    The federation F1 invariant ("placements point at Ready clusters")
+    deliberately does NOT ride along: a placement staying in a
+    Partitioned region is the CORRECT outcome here, not a violation.
+    """
+
+    def __init__(
+        self,
+        seed: int = 1234,
+        regions: int = 3,
+        num_nodes: int = 8,
+        n_each: int = 2,
+        spill_after: float = 5.0,
+        partition_suspect_after: float = 5.0,
+    ) -> None:
+        from grove_tpu.federation import FederationRouter
+
+        self.seed = seed
+        self.n_each = n_each
+        self.region_names = [f"region-{i}" for i in range(regions)]
+        self.rng = random.Random(seed ^ 0x9A47)
+        self.router = FederationRouter(
+            self.region_names,
+            num_nodes=num_nodes,
+            phase_offsets=[i * 200.0 for i in range(regions)],
+            spill_after=spill_after,
+            partition_suspect_after=partition_suspect_after,
+        )
+        self.report = PartitionChaosReport(seed=seed, regions=regions)
+
+    # -- invariants ------------------------------------------------------
+
+    def _scheduled_regions(self) -> Dict[Tuple[str, str], Set[str]]:
+        """PCS key -> regions where at least one of its gangs is
+        currently Scheduled, over EVERY live harness (reachable or
+        not — the partitioned control plane is alive and counts)."""
+        from grove_tpu.api import names as namegen
+
+        where: Dict[Tuple[str, str], Set[str]] = {}
+        for cl in self.router.clusters():
+            if cl.harness is None:
+                continue
+            for gang in cl.harness.store.scan("PodGang"):
+                cond = get_condition(
+                    gang.status.conditions, COND_PODGANG_SCHEDULED
+                )
+                if cond is None or not cond.is_true():
+                    continue
+                pcs_name = gang.metadata.labels.get(namegen.LABEL_PART_OF)
+                if not pcs_name:
+                    continue
+                where.setdefault(
+                    (gang.metadata.namespace, pcs_name), set()
+                ).add(cl.region)
+        return where
+
+    def _check_invariants(self, t0: float) -> None:
+        router = self.router
+        rep = self.report
+        rep.invariant_checks += 1
+        rel_now = router.clock.now() - t0
+        violations = rep.invariant_violations
+        # F3: split-brain — a PCS with Scheduled gangs in two regions
+        for key, regions in sorted(self._scheduled_regions().items()):
+            if len(regions) > 1:
+                violations.append(
+                    f"t={rel_now:.0f}s: F3 split-brain — PCS"
+                    f" {key[0]}/{key[1]} Scheduled in"
+                    f" {sorted(regions)}"
+                )
+        # the global quota fold only folds reachable Ready regions —
+        # it must equal the sum of recounts over exactly that set
+        from grove_tpu.quota.oracle import usage_oracle
+
+        recount: dict = {}
+        for cl in router.clusters():
+            if (
+                cl.harness is None
+                or cl.state != "Ready"
+                or not cl.reachable
+            ):
+                continue
+            oracle = usage_oracle(
+                cl.harness.store.scan("Pod"),
+                cl.harness.scheduler.quota.accountant.default_queue,
+            )
+            for q, usage in oracle.items():
+                row = recount.setdefault(q, {})
+                for r, v in usage.items():
+                    row[r] = row.get(r, 0.0) + v
+        global_usage = router.global_usage()
+        for q in sorted(set(global_usage) | set(recount)):
+            a = global_usage.get(q, {})
+            b = recount.get(q, {})
+            for r in sorted(set(a) | set(b)):
+                if abs(a.get(r, 0.0) - b.get(r, 0.0)) > 1e-6:
+                    violations.append(
+                        f"t={rel_now:.0f}s: global fold queue {q}"
+                        f" usage {r}: root {a.get(r, 0.0)} != sum over"
+                        f" reachable clusters {b.get(r, 0.0)}"
+                    )
+
+    def _all_scheduled(self) -> bool:
+        for cl in self.router.clusters():
+            if cl.harness is None:
+                continue
+            for gang in cl.harness.store.list("PodGang"):
+                cond = get_condition(
+                    gang.status.conditions, COND_PODGANG_SCHEDULED
+                )
+                if cond is None or not cond.is_true():
+                    return False
+        return True
+
+    def _apply_wave(self, suffix: str, home: Optional[str] = None) -> None:
+        from grove_tpu.api import names as namegen
+
+        for pcs in chaos_workload(n_each=self.n_each):
+            if suffix:
+                pcs.metadata.name = f"{pcs.metadata.name}{suffix}"
+            pcs.metadata.labels[namegen.LABEL_FEDERATION_HOME] = (
+                home if home is not None else self.rng.choice(
+                    self.region_names
+                )
+            )
+            self.router.apply(pcs)
+            self.report.applied += 1
+
+    # -- the run ---------------------------------------------------------
+
+    def run(self, max_ticks: int = 400) -> PartitionChaosReport:
+        router = self.router
+        rep = self.report
+        t0 = router.clock.now()
+        budget = max_ticks
+        # wave 1: steady state across seeded homes
+        self._apply_wave("")
+        rep.ticks += router.converge(max_ticks=min(60, budget))
+        self._check_invariants(t0)
+        # the busiest wave-1 region is the victim; wave 2 is homed
+        # there and the partition lands in the same instant — before a
+        # single converge tick — so the victim holds wave-1 gangs
+        # Scheduled AND wave-2 gangs still pending (the split the spill
+        # walk must honor: pending spills, Scheduled never moves)
+        counts = {name: 0 for name in self.region_names}
+        for region in router.placements().values():
+            counts[region] += 1
+        victim = max(
+            self.region_names, key=lambda name: (counts[name], name)
+        )
+        self._apply_wave("-w2", home=victim)
+        bound_before = {
+            key: regions
+            for key, regions in self._scheduled_regions().items()
+            if victim in regions
+        }
+        rep.placements_in_partition = len(bound_before)
+        rep.faults.append(
+            Fault(
+                at=router.clock.now() - t0,
+                kind="cluster_partition",
+                target=victim,
+                note=(
+                    f"{counts[victim]} placements,"
+                    f" {len(bound_before)} Scheduled inside"
+                ),
+            ).as_dict()
+        )
+        assert router.partition_cluster(victim)
+        # converge in short slices so the per-tick F3 scan brackets the
+        # suspicion flip, the spill walk, and the fenced dwell
+        for _ in range(6):
+            rep.ticks += router.converge(max_ticks=10)
+            self._check_invariants(t0)
+        # heal: reachable again, stale spilled copies deleted, fence up
+        rep.faults.append(
+            Fault(
+                at=router.clock.now() - t0,
+                kind="cluster_heal",
+                target=victim,
+            ).as_dict()
+        )
+        assert router.heal_cluster(victim)
+        rep.ticks += router.converge(max_ticks=min(120, budget))
+        self._check_invariants(t0)
+        # a late wave homed at the healed region proves it serves again
+        self._apply_wave("-late", home=victim)
+        rep.ticks += router.converge(max_ticks=min(160, budget))
+        self._check_invariants(t0)
+        after = self._scheduled_regions()
+        rep.placements_kept = sum(
+            1
+            for key, regions in bound_before.items()
+            if victim in after.get(key, set())
+        )
+        row = next(
+            cl for cl in router.clusters() if cl.region == victim
+        )
+        rep.partitions = row.partitions
+        rep.partition_spills = router.partition_spills
+        rep.heals = 1 if row.reachable and row.state == "Ready" else 0
+        rep.converged = self._all_scheduled()
+        return rep
+
+
+def run_partition_chaos(
+    seed: int = 1234,
+    regions: int = 3,
+    num_nodes: int = 8,
+    n_each: int = 2,
+    max_ticks: int = 400,
+) -> PartitionChaosReport:
+    """One seeded partition chaos run (`chaos_smoke.py --partition`)."""
+    return PartitionChaosRunner(
         seed=seed, regions=regions, num_nodes=num_nodes, n_each=n_each
     ).run(max_ticks=max_ticks)

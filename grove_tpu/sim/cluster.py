@@ -12,7 +12,15 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from grove_tpu.api.meta import Condition, clone_status, deep_copy, set_condition
+import zlib
+
+from grove_tpu.api.meta import (
+    Condition,
+    clone_status,
+    deep_copy,
+    get_condition,
+    set_condition,
+)
 from grove_tpu.api.pod import (
     COND_POD_READY,
     COND_POD_SCHEDULED,
@@ -36,6 +44,13 @@ from grove_tpu.runtime.store import Store, commit_status
 NODE_READY = "Ready"
 NODE_NOT_READY = "NotReady"
 NODE_LOST = "Lost"
+# gray failure (docs/robustness.md "Gray failures"): the node heartbeats
+# — late but inside the grace window — and its pods keep running, yet the
+# monitor's suspicion score says it is fail-slow. Degraded masks the node
+# from NEW placements (same `schedulable` predicate every solve path
+# consumes) without evicting anything; only the remediation controller
+# may drain it, behind a what-if-proven flip and the disruption budget.
+NODE_DEGRADED = "Degraded"
 
 
 @dataclass
@@ -57,8 +72,9 @@ class Node:
     @property
     def schedulable(self) -> bool:
         """Eligible as a placement target: not cordoned AND healthy. This is
-        the single predicate every solve path masks nodes with — NotReady
-        and Lost nodes leave the dense tensors exactly like cordoned ones."""
+        the single predicate every solve path masks nodes with — NotReady,
+        Lost and Degraded (fail-slow) nodes leave the dense tensors exactly
+        like cordoned ones."""
         return not self.cordoned and self.state == NODE_READY
 
 
@@ -90,6 +106,14 @@ class SimCluster:
         # pod's lifetime (gate removal clones the spec but never touches
         # requests), and node accounting re-derives them per tick
         self._requests_by_uid: Dict[str, Dict[str, float]] = {}
+        # fail-slow injection registry (docs/robustness.md "Gray
+        # failures"): node name -> (seed, lag_min, lag_max, start_penalty).
+        # A registered node's kubelet heartbeats LATE by a seeded,
+        # virtual-time-pure lag (GL001: crc32 of (seed, node, tick) — no
+        # wall clock, no unseeded RNG) and starts containers only after a
+        # scheduling-age penalty. Private state: only inject_failslow /
+        # heal_failslow write it (grovelint GL022 `grayfail-state`).
+        self._failslow: Dict[str, tuple] = {}
         # in-memory Store only: its events fire synchronously at commit, so
         # the set is always exact. HttpStore events arrive on watch threads
         # and LAG live reads — there kubelet_tick keeps the full scan.
@@ -298,6 +322,15 @@ class SimCluster:
         for node in self.nodes:
             if not node.crashed:
                 node.last_heartbeat = now
+        if self._failslow:
+            # fail-slow nodes heartbeat LATE: the report that lands this
+            # tick was produced `lag` seconds ago. The lag stays inside the
+            # monitor's NotReady grace window by default, so the binary
+            # lifecycle never fires — only the suspicion EWMA sees it.
+            for name in self._failslow:
+                node = self.node(name)
+                if node is not None and not node.crashed:
+                    node.last_heartbeat = now - self.failslow_lag(name, now)
 
     def kubelet_tick(self, namespace: Optional[str] = None) -> int:
         """Advance scheduled pods (all namespaces by default) toward Ready:
@@ -325,6 +358,23 @@ class SimCluster:
                 continue
             if dead_nodes and view.status.node_name in dead_nodes:
                 continue
+            if self._failslow:
+                fs = self._failslow.get(view.status.node_name)
+                if fs is not None:
+                    # a fail-slow kubelet is alive but drags its feet: a
+                    # pod bound there starts only after `start_penalty`
+                    # virtual seconds of scheduling age — this is the
+                    # attainment drag the grayfail smoke measures, and why
+                    # masking the node (Degraded) visibly helps
+                    cond = get_condition(
+                        view.status.conditions, COND_POD_SCHEDULED
+                    )
+                    now = self.store.clock.now()
+                    if (
+                        cond is not None
+                        and now - cond.last_transition_time < fs[3]
+                    ):
+                        continue
             waiter_cfg = view.spec.extra.get("groveInitWaiter")
             waiter_clears = bool(waiter_cfg) and not view.status.init_waiter_done
             if waiter_clears and not is_ready_to_start(
@@ -376,6 +426,60 @@ class SimCluster:
         node.crashed = False
         node.last_heartbeat = self.store.clock.now()
         return True
+
+    def inject_failslow(
+        self,
+        node_name: str,
+        seed: int,
+        lag_min: float = 3.0,
+        lag_max: float = 8.0,
+        start_penalty: float = 120.0,
+    ) -> bool:
+        """Arm the fail-slow (gray) fault on a node: heartbeats arrive
+        `lag_min..lag_max` seconds late (seeded per-tick draw, below the
+        monitor's 10s NotReady grace by default — the BINARY detector never
+        fires) and bound pods start only after `start_penalty` seconds of
+        scheduling age. Nothing crashes; the node looks alive everywhere
+        except to the suspicion EWMA."""
+        if self.node(node_name) is None:
+            return False
+        self._failslow[node_name] = (seed, lag_min, lag_max, start_penalty)
+        return True
+
+    def heal_failslow(self, node_name: str) -> bool:
+        """Clear the fail-slow fault: heartbeats arrive on time again from
+        the next tick; the monitor's hysteresis flips Degraded → Ready once
+        the suspicion score decays below the recovery threshold."""
+        return self._failslow.pop(node_name, None) is not None
+
+    def failslow_lag(self, node_name: str, now: float) -> float:
+        """The seeded heartbeat lag for a fail-slow node at virtual time
+        `now` — a PURE function of (seed, node, tick): crc32, not random
+        or hash(), so replays and the suspicion-oracle test (NumPy EWMA
+        over this exact trace) see identical values. 0.0 when the node is
+        not registered."""
+        fs = self._failslow.get(node_name)
+        if fs is None:
+            return 0.0
+        seed, lag_min, lag_max, _penalty = fs
+        u = (
+            zlib.crc32(f"{seed}:{node_name}:{int(now)}".encode()) & 0xFFFF
+        ) / float(1 << 16)
+        return lag_min + (lag_max - lag_min) * u
+
+    def failslow_spec(self, node_name: str):
+        """(seed, lag_min, lag_max, start_penalty) of an armed fail-slow
+        fault, or None — the re-injection handle for harness swaps
+        (leader failover / control-plane crash rebuild a SimCluster; the
+        kubelet-side fault must survive, it is node state, not leader
+        memory)."""
+        return self._failslow.get(node_name)
+
+    def failslow_names(self) -> set:
+        """Nodes currently under the fail-slow fault (chaos invariants +
+        the grayfail smoke read this; nothing outside this module writes
+        the registry — GL022)."""
+        return set(self._failslow)
 
     def unschedulable_names(self) -> set:
         """Names of nodes no solve may target (cordoned or unhealthy) —

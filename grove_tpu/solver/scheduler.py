@@ -104,6 +104,12 @@ class GangScheduler:
         # solve until released (rate-limited re-admission after a gang
         # termination). None → no holds (tests that build a bare scheduler).
         self.monitor = None
+        # partition admission fence (docs/federation.md "Partition ≠
+        # crash"): set by the federation router when this region's lease
+        # expires mid-partition; schedule_pending early-returns while set.
+        # One boolean check — False (always, outside federation faults)
+        # is byte-identical to the pre-fence scheduler.
+        self.admission_fenced = False
         # disruption broker (grove_tpu/disruption, docs/robustness.md):
         # preemption and quota reclaim must be GRANTED their victim sets
         # before evicting — per-PCS disruptionBudgets and the storm breaker
@@ -515,6 +521,14 @@ class GangScheduler:
         nodes are shared cluster-wide, so per-namespace rounds would let a
         low-priority gang in an alphabetically-earlier namespace take
         capacity a high-priority gang elsewhere needs (priority inversion)."""
+        if self.admission_fenced:
+            # partition fence (docs/federation.md "Partition ≠ crash"): a
+            # region cut off from the federation stops admitting NEW gangs
+            # the moment its lease expires — running pods are untouched,
+            # but no PodGang may flip to Scheduled while fenced, so
+            # invariant F3 (never Scheduled in two clusters across a
+            # partition/heal cycle) holds by construction
+            return 0
         # wall attribution: everything below lands under controller
         # "scheduler" — pending-scan/encode/solve/commit phases open their
         # own rows, this phase's self-time is ordering/quota/round glue

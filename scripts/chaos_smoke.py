@@ -105,7 +105,44 @@ def main() -> int:
         " fold equals the sum of per-cluster recounts"
         " (docs/federation.md)",
     )
+    parser.add_argument(
+        "--partition",
+        action="store_true",
+        help="run the PARTITION chaos scenario instead: a 3-region"
+        " FederationRouter under cluster_partition — the victim region"
+        " stays ALIVE but unreachable (gray failure), pending gangs"
+        " spill after the suspicion timeout, Scheduled gangs never"
+        " move, and the split-brain invariant F3 (no PodGang Scheduled"
+        " in two clusters) is checked every tick"
+        " (docs/robustness.md 'Gray failures')",
+    )
+    parser.add_argument(
+        "--failslow",
+        action="store_true",
+        help="add the fail-slow (gray node) fault to the schedule:"
+        " heartbeats run late but inside the NotReady grace, the"
+        " suspicion EWMA must flip the node Degraded (masked from new"
+        " placements, running gangs untouched) and back after heal",
+    )
+    parser.add_argument(
+        "--failslow-seed",
+        type=int,
+        help="with --seeds: the one seed of the matrix that runs with"
+        " the fail-slow fault armed (the `make chaos-matrix` mode)",
+    )
     args = parser.parse_args()
+
+    if args.partition:
+        if args.seeds:
+            rc = 0
+            for raw in args.seeds.split(","):
+                seed = int(raw.strip())
+                print(f"=== partition chaos seed {seed} ===", flush=True)
+                rc = run_partition_one(seed, args.json)
+                if rc:
+                    return rc
+            return rc
+        return run_partition_one(args.seed, args.json)
 
     if args.federation:
         if args.seeds:
@@ -126,11 +163,15 @@ def main() -> int:
             sanitized = args.sanitize or seed == args.sanitize_seed
             cp_crash = args.cp_crash or seed == args.cp_crash_seed
             remediate = args.remediate or seed == args.remediate_seed
+            failslow = args.failslow or seed == args.failslow_seed
             tag = " [sanitize]" if sanitized else ""
             tag += " [cp-crash]" if cp_crash else ""
             tag += " [remediator]" if remediate else ""
+            tag += " [failslow]" if failslow else ""
             print(f"=== chaos seed {seed}{tag} ===", flush=True)
-            rc = run_one(seed, args.json, sanitized, cp_crash, remediate)
+            rc = run_one(
+                seed, args.json, sanitized, cp_crash, remediate, failslow
+            )
             if rc:
                 return rc
         return rc
@@ -141,6 +182,7 @@ def main() -> int:
         args.sanitize or args.seed == args.sanitize_seed,
         args.cp_crash or args.seed == args.cp_crash_seed,
         args.remediate or args.seed == args.remediate_seed,
+        args.failslow or args.seed == args.failslow_seed,
     )
 
 
@@ -204,12 +246,82 @@ def run_federation_one(seed: int, as_json: bool) -> int:
     return 0
 
 
+def run_partition_one(seed: int, as_json: bool) -> int:
+    from grove_tpu.sim.chaos import run_partition_chaos
+
+    report = run_partition_chaos(seed=seed)
+    doc = report.as_dict()
+
+    problems = []
+    if report.partitions < 1:
+        problems.append("no cluster_partition fault fired")
+    if report.heals < 1:
+        problems.append("the partitioned region never healed")
+    if report.partition_spills < 1:
+        problems.append("no pending gang spilled out of the partition")
+    if report.placements_in_partition < 1:
+        problems.append(
+            "no gang was Scheduled inside the partition (the"
+            " Scheduled-stays-bound half of the scenario is missing)"
+        )
+    elif report.placements_kept != report.placements_in_partition:
+        problems.append(
+            f"only {report.placements_kept} of"
+            f" {report.placements_in_partition} Scheduled gang(s) kept"
+            " their placement across the partition/heal cycle"
+            " (partition must not be treated as a crash)"
+        )
+    if report.invariant_violations:
+        problems.append(
+            f"{len(report.invariant_violations)} invariant violation(s): "
+            + "; ".join(report.invariant_violations[:5])
+        )
+    if not report.converged:
+        problems.append("the federation did not converge after the heal")
+
+    if as_json:
+        print(json.dumps({"partition_chaos": doc, "ok": not problems}))
+    else:
+        print(
+            f"seed={report.seed} regions={report.regions}"
+            f" ticks={report.ticks} applied={report.applied}"
+            f" partitions={report.partitions} heals={report.heals}"
+            f" spills={report.partition_spills}"
+            f" kept={report.placements_kept}/"
+            f"{report.placements_in_partition}"
+        )
+        for fault in doc["faults"]:
+            note = f" ({fault['note']})" if fault["note"] else ""
+            print(
+                f"  t={fault['at']:>6.2f}s {fault['kind']:<17}"
+                f" {fault['target']}{note}"
+            )
+        print(
+            f"converged={report.converged}"
+            f" violations={len(report.invariant_violations)}"
+        )
+
+    if problems:
+        print(
+            f"\nCHAOS SMOKE FAILED (replay with --partition --seed"
+            f" {seed}):",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not as_json:
+        print("partition chaos smoke OK")
+    return 0
+
+
 def run_one(
     seed: int,
     as_json: bool,
     sanitized: bool = False,
     cp_crash: bool = False,
     remediate: bool = False,
+    failslow: bool = False,
 ) -> int:
     from grove_tpu.sim.chaos import run_chaos
 
@@ -219,7 +331,10 @@ def run_one(
         sanitize.install()
     try:
         report = run_chaos(
-            seed=seed, controlplane_crash=cp_crash, remediator=remediate
+            seed=seed,
+            controlplane_crash=cp_crash,
+            remediator=remediate,
+            failslow=failslow,
         )
     finally:
         if sanitized:
@@ -230,6 +345,7 @@ def run_one(
     doc["sanitized"] = sanitized
     doc["cp_crash"] = cp_crash
     doc["remediate"] = remediate
+    doc["failslow"] = failslow
 
     problems = []
     if report.node_losses < 2:
@@ -261,6 +377,17 @@ def run_one(
         if report.torn_tails < 1:
             problems.append(
                 "the injected torn WAL tail was never detected/truncated"
+            )
+    if failslow:
+        if report.failslow_degraded < 1:
+            problems.append(
+                "the fail-slow node was never flipped Degraded (the"
+                " suspicion EWMA missed the gray failure)"
+            )
+        if report.failslow_recovered < 1:
+            problems.append(
+                "the Degraded node never recovered after the heal"
+                " (suspicion hysteresis stuck)"
             )
     if report.invariant_violations:
         problems.append(
@@ -308,6 +435,13 @@ def run_one(
                 f" {report.remediations_executed} executed /"
                 f" {report.remediations_skipped} skipped remediation(s)"
                 " (invariants above cover every action)"
+            )
+        if failslow:
+            print(
+                "fail-slow armed:"
+                f" degraded={report.failslow_degraded}"
+                f" recovered={report.failslow_recovered}"
+                " (Ready ⇄ Degraded via the suspicion EWMA)"
             )
 
     if problems:

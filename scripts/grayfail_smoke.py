@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""Gray-failure smoke: the degradation ladder end to end
+(docs/robustness.md "Gray failures"; the `make grayfail-smoke` target).
+
+Four arms, one verdict:
+
+1. FAIL-SLOW, detection ON beats OFF — the same seeded sick node (late
+   heartbeats inside the NotReady grace + a pod start penalty) under an
+   identical two-wave workload. With the suspicion EWMA armed the node
+   is flipped Degraded and masked from new placements, so the second
+   wave's attainment (pods Ready within the deadline) must strictly
+   beat the detection-off twin, with ZERO disruption-budget spend and
+   every gang already running on the sick node left bound.
+2. PARTITION — the seeded partition chaos scenario (region unreachable
+   but alive, pending spills, Scheduled stays put, split-brain F3
+   checked every slice) must pass.
+3. WAL LADDER — slow-fsync steps the durable store ok → degraded
+   (loud, still durable) and back; disk-full steps it to read-only
+   (creates/updates rejected, deletes allowed, nothing acked is lost)
+   and heals back to ok with the retained buffer flushed.
+4. ALL-OFF INERT A/B — detection armed but quiet (no fault injected)
+   must leave a byte-identical resource tree vs the default harness,
+   and the worker-process boundary with fault injection armed at ZERO
+   rates must dump byte-identical to the serial twin: the ladder costs
+   nothing when nothing is gray.
+
+On failure the seed prints for replay:
+    python scripts/grayfail_smoke.py --seed <N>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ATTAIN_HORIZON_S = 30.0  # virtual deadline for wave-2 attainment
+START_PENALTY_S = 60.0  # sick-node pod start penalty (past the horizon)
+
+
+def _fresh_world():
+    """Process-global observability layers carry state between arms —
+    every arm starts from a clean slate so its assertions are its own."""
+    from grove_tpu.observability.events import EVENTS
+    from grove_tpu.observability.metrics import METRICS
+
+    METRICS.reset()
+    EVENTS.reset()
+
+
+def _wave(suffix: str):
+    from grove_tpu.sim.chaos import chaos_workload
+
+    out = []
+    for pcs in chaos_workload(n_each=1):
+        if suffix:
+            pcs.metadata.name = f"{pcs.metadata.name}{suffix}"
+        out.append(pcs)
+    return out
+
+
+def probe_sick_node(seed: int) -> str:
+    """Deterministic probe: replay the two-wave scenario with NO fault
+    and return the node wave 2 leans on hardest — injecting the
+    fail-slow fault THERE guarantees the detection-off twin (which
+    replays this exact placement) puts wave-2 pods on the sick node,
+    so the two arms genuinely disagree about something."""
+    h, w2_pods, _bound = _two_wave_run(seed, detection_on=False, sick=None)
+    w2_names = {p.metadata.name for p in w2_pods}
+    per_node: dict = {}
+    for p in w2_pods:
+        node = h.cluster.bindings.get(
+            (p.metadata.namespace, p.metadata.name)
+        )
+        if node:
+            per_node[node] = per_node.get(node, 0) + 1
+    assert per_node, "probe placed no wave-2 pod"
+    # prefer a node that ALSO hosts wave-1 pods: the stay-bound half of
+    # the assertion (running gangs never evicted by the mask) then has
+    # real victims to watch, not a vacuous empty set
+    wave1_nodes = {
+        node
+        for (_ns, pod), node in h.cluster.bindings.items()
+        if pod not in w2_names
+    }
+    ranked = sorted(per_node, key=lambda n: (-per_node[n], n))
+    for node in ranked:
+        if node in wave1_nodes:
+            return node
+    return ranked[0]
+
+
+def _two_wave_run(seed: int, detection_on: bool, sick):
+    """Shared scenario body: steady wave, (optional) seeded sick node,
+    second wave, fixed virtual horizon. Returns (harness, wave-2 pods,
+    pre-injection bindings on the sick node)."""
+    from grove_tpu.api import names as namegen
+    from grove_tpu.sim.harness import SimHarness
+
+    _fresh_world()
+    h = SimHarness(num_nodes=8)
+    if detection_on:
+        h.node_monitor.failslow_threshold = 1.5
+        h.node_monitor.failslow_recover = 0.75
+    for pcs in _wave(""):
+        h.apply(pcs)
+    h.converge(max_ticks=60)
+
+    # EVERY steady-state binding, not just the sick node's: the mask
+    # must not move ANY running pod anywhere (Degraded ≠ drain)
+    bound_before = dict(h.cluster.bindings)
+    if sick is not None:
+        h.cluster.inject_failslow(
+            sick,
+            seed=seed,
+            lag_min=2.0,
+            lag_max=4.5,
+            start_penalty=START_PENALTY_S,
+        )
+    # a few observation ticks: with detection ON the EWMA crosses the
+    # threshold here and the mask is already up when wave 2 lands
+    h.converge(max_ticks=6, tick_seconds=1.0)
+
+    t0 = h.clock.now()
+    wave2 = {pcs.metadata.name for pcs in _wave("-w2")}
+    for pcs in _wave("-w2"):
+        h.apply(pcs)
+    while h.clock.now() - t0 < ATTAIN_HORIZON_S:
+        h.tick_once()
+        h.clock.advance(1.0)
+    w2_pods = [
+        p
+        for p in h.store.list("Pod")
+        if p.metadata.labels.get(namegen.LABEL_PART_OF) in wave2
+    ]
+    return h, w2_pods, bound_before
+
+
+def failslow_arm(seed: int, detection_on: bool, sick: str) -> dict:
+    """One detection arm: steady wave, seeded sick node, second wave,
+    attainment measured at a fixed virtual horizon."""
+    from grove_tpu.api.pod import is_ready
+    from grove_tpu.observability.metrics import METRICS
+
+    h, w2_pods, bound_before = _two_wave_run(seed, detection_on, sick)
+    ready = sum(1 for p in w2_pods if is_ready(p))
+    on_sick = sum(
+        1
+        for p in w2_pods
+        if h.cluster.bindings.get(
+            (p.metadata.namespace, p.metadata.name)
+        )
+        == sick
+    )
+    still_bound = sum(
+        1
+        for key, node in bound_before.items()
+        if h.cluster.bindings.get(key) == node
+    )
+    return {
+        "detection": "on" if detection_on else "off",
+        "sick_node": sick,
+        "wave2_pods": len(w2_pods),
+        "wave2_ready": ready,
+        "attainment": ready / len(w2_pods) if w2_pods else 0.0,
+        "wave2_on_sick_node": on_sick,
+        "bound_before": len(bound_before),
+        "still_bound": still_bound,
+        "degraded": int(
+            METRICS.counters.get("node_degraded_total", 0) or 0
+        ),
+        # METRICS was reset at arm start: ANY voluntary drain is spend
+        "budget_spend": int(
+            METRICS.counters.get("gang_drains_total", 0) or 0
+        ),
+    }
+
+
+def wal_ladder_arm(seed: int) -> dict:
+    """Slow-fsync → degraded → ok, then disk-full → read-only → ok,
+    with durability of everything acked audited at the end."""
+    from grove_tpu.durability import recover_store
+    from grove_tpu.observability.events import EVENTS
+    from grove_tpu.runtime.errors import GroveError
+    from grove_tpu.sim.harness import SimHarness
+
+    _fresh_world()
+    out: dict = {"steps": []}
+    directory = tempfile.mkdtemp(prefix="grove-grayfail-wal-")
+    h = SimHarness(num_nodes=4, durability_dir=directory)
+    sd = h.durability
+    waves = _wave("")
+    h.apply(waves[0])
+    h.converge(max_ticks=40)
+    assert sd.degraded_mode == "ok", sd.degraded_mode
+
+    # step 1: fsync latency over the SLO — degraded, loud, still durable
+    sd.wal.fault_slow_fsync = sd.fsync_slo_seconds + 0.5
+    h.apply(waves[1])
+    h.converge(max_ticks=20)
+    out["steps"].append(("slow-fsync", sd.degraded_mode))
+    assert sd.degraded_mode == "degraded", sd.degraded_mode
+    assert EVENTS.list(reason="WalDegraded"), "WalDegraded never emitted"
+
+    # heal the disk: the next flushed write steps the ladder back down
+    sd.wal.fault_slow_fsync = 0.0
+    h.apply(waves[2])
+    h.converge(max_ticks=20)
+    out["steps"].append(("fsync-healed", sd.degraded_mode))
+    assert sd.degraded_mode == "ok", sd.degraded_mode
+    assert EVENTS.list(reason="WalRecovered"), "WalRecovered never emitted"
+
+    # step 2: disk full — the flush fails BEFORE anything is acked, the
+    # buffer is retained, and the store goes read-only (creates/updates
+    # rejected like etcd NOSPACE; deletes still allowed to free space)
+    sd.wal.fault_disk_full = True
+    survivor = _wave("-ro")[0]
+    h.apply(survivor)  # buffered, not yet durable
+    sd.pump()
+    out["steps"].append(("disk-full", sd.degraded_mode))
+    assert sd.degraded_mode == "read-only", sd.degraded_mode
+    rejected = False
+    try:
+        h.apply(_wave("-rejected")[0])
+    except GroveError:
+        rejected = True
+    assert rejected, "create went through a read-only store"
+    h.delete(waves[0].metadata.name)  # deletes free space: allowed
+
+    # heal: retained buffer (the survivor PCS above) flushes, ladder
+    # steps back to ok, and the write fence comes down
+    sd.wal.fault_disk_full = False
+    sd.pump()
+    out["steps"].append(("disk-healed", sd.degraded_mode))
+    assert sd.degraded_mode == "ok", sd.degraded_mode
+    h.apply(_wave("-after")[0])  # fence is down again
+    h.converge(max_ticks=40)
+    sd.close()
+
+    # nothing acked was lost: the recovered store holds the survivor
+    # applied while the disk was full AND the post-heal create
+    store, _recovery = recover_store(directory)
+    for name in (survivor.metadata.name, _wave("-after")[0].metadata.name):
+        assert (
+            store.get("PodCliqueSet", "default", name) is not None
+        ), f"{name} lost across the read-only window"
+    import shutil
+
+    shutil.rmtree(directory, ignore_errors=True)
+    return out
+
+
+def inert_ab_arm(seed: int) -> dict:
+    """Armed-but-quiet must be byte-identical to default-off."""
+    from grove_tpu.sim.chaos import resource_signature
+    from grove_tpu.sim.harness import SimHarness
+
+    def signature(arm_detection: bool):
+        _fresh_world()
+        h = SimHarness(num_nodes=8)
+        if arm_detection:
+            h.node_monitor.failslow_threshold = 1.5
+            h.node_monitor.failslow_recover = 0.75
+        for pcs in _wave(""):
+            h.apply(pcs)
+        h.converge(max_ticks=60)
+        return resource_signature(h.store)
+
+    detection_identical = signature(False) == signature(True)
+
+    # worker-process boundary: injection armed at ZERO rates (frames are
+    # wrapped/sequenced/deduped, but no fault ever fires) vs the serial
+    # twin — the store dumps must match byte for byte
+    from grove_tpu.sim.parallel import _dump, _make_harness
+
+    def boundary_dump(armed: bool):
+        _fresh_world()
+        h = _make_harness(12, 3, 2 if armed else 1, backend="process")
+        if armed:
+            h.engine.workers.inject_boundary_faults(
+                seed, drop_rate=0.0, dup_rate=0.0, delay_rate=0.0
+            )
+        for pcs in _wave(""):
+            h.apply(pcs)
+        h.converge(max_ticks=60)
+        dump = _dump(h)
+        h.engine.close()
+        return dump
+
+    boundary_identical = boundary_dump(False) == boundary_dump(True)
+    return {
+        "detection_identical": detection_identical,
+        "boundary_identical": boundary_identical,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seed", type=int, default=2026)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+    problems = []
+
+    # arm 1: fail-slow detection ON must beat OFF on attainment. The
+    # probe replays the scenario fault-free to find the node wave 2
+    # actually leans on — the sick node both arms then share
+    sick = probe_sick_node(args.seed)
+    off = failslow_arm(args.seed, detection_on=False, sick=sick)
+    on = failslow_arm(args.seed, detection_on=True, sick=sick)
+    if on["degraded"] < 1:
+        problems.append("detection ON never flipped the sick node Degraded")
+    if on["wave2_on_sick_node"] != 0:
+        problems.append(
+            f"{on['wave2_on_sick_node']} wave-2 pod(s) placed on the"
+            " Degraded node (the mask leaked)"
+        )
+    if off["wave2_on_sick_node"] < 1:
+        problems.append(
+            "detection OFF placed nothing on the sick node — the arms"
+            " are not comparable (scenario too loose)"
+        )
+    if not on["attainment"] > off["attainment"]:
+        problems.append(
+            f"attainment ON ({on['attainment']:.2f}) does not beat OFF"
+            f" ({off['attainment']:.2f})"
+        )
+    if on["bound_before"] < 1:
+        problems.append("no steady-state binding to watch (empty wave 1?)")
+    if on["still_bound"] != on["bound_before"]:
+        problems.append(
+            f"only {on['still_bound']} of {on['bound_before']} steady-"
+            "state pods kept their binding under the mask (Degraded"
+            " must not evict or move anything)"
+        )
+    for arm in (on, off):
+        if arm["budget_spend"]:
+            problems.append(
+                f"detection {arm['detection']} spent"
+                f" {arm['budget_spend']} disruption-budget drain(s) —"
+                " masking must be free"
+            )
+
+    # arm 2: partition chaos scenario
+    from grove_tpu.sim.chaos import run_partition_chaos
+
+    _fresh_world()
+    partition = run_partition_chaos(seed=4242)
+    if not partition.ok:
+        problems.append(
+            "partition chaos failed: "
+            + "; ".join(partition.invariant_violations[:3])
+            if partition.invariant_violations
+            else "partition chaos verdict not ok"
+        )
+
+    # arm 3: WAL degradation ladder
+    ladder = wal_ladder_arm(args.seed)
+
+    # arm 4: all-off inertness
+    inert = inert_ab_arm(args.seed)
+    if not inert["detection_identical"]:
+        problems.append(
+            "armed-but-quiet suspicion lane changed the resource tree"
+        )
+    if not inert["boundary_identical"]:
+        problems.append(
+            "zero-rate boundary injection changed the process-backend"
+            " store dump"
+        )
+
+    doc = {
+        "seed": args.seed,
+        "failslow": {"on": on, "off": off},
+        "partition": {
+            "ok": partition.ok,
+            "spills": partition.partition_spills,
+            "kept": partition.placements_kept,
+        },
+        "wal_ladder": ladder["steps"],
+        "inert": inert,
+        "ok": not problems,
+    }
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(
+            f"fail-slow: ON attainment {on['attainment']:.2f}"
+            f" (0 of {on['wave2_pods']} pods on the Degraded node) vs"
+            f" OFF {off['attainment']:.2f}"
+            f" ({off['wave2_on_sick_node']} pod(s) on the sick node);"
+            f" {on['still_bound']}/{on['bound_before']} steady-state"
+            " pods kept their binding; budget spend 0"
+        )
+        print(
+            f"partition: ok={partition.ok}"
+            f" spills={partition.partition_spills}"
+            f" kept={partition.placements_kept}/"
+            f"{partition.placements_in_partition}"
+        )
+        print(f"wal ladder: {' -> '.join(f'{s}={m}' for s, m in ladder['steps'])}")
+        print(
+            "inert A/B: detection"
+            f" {'identical' if inert['detection_identical'] else 'DIVERGED'},"
+            " boundary"
+            f" {'identical' if inert['boundary_identical'] else 'DIVERGED'}"
+        )
+    if problems:
+        print(
+            f"\nGRAYFAIL SMOKE FAILED (replay with --seed {args.seed}):",
+            file=sys.stderr,
+        )
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("grayfail smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

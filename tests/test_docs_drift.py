@@ -440,6 +440,7 @@ class TestLedgerActionDrift:
             "slo-burn": "TRIGGER_SLO_BURN",
             "forecast-peak": "TRIGGER_FORECAST_PEAK",
             "frag-threshold": "TRIGGER_FRAG_THRESHOLD",
+            "fail-slow": "TRIGGER_FAILSLOW",
             "drain-node": "ACTION_DRAIN_NODE",
             "migrate-gang": "ACTION_MIGRATE_GANG",
             "scale-up": "ACTION_SCALE_UP",

@@ -1,0 +1,430 @@
+"""Gray-failure tolerance (docs/robustness.md "Gray failures").
+
+Gray faults — a node that answers late, a region that is alive but
+unreachable, an fsync that takes 800 ms — sit below every binary
+detector. Pinned here:
+
+- **Suspicion EWMA oracle**: the monitor's fail-slow score over a
+  seeded lag trace equals a NumPy EWMA replay of
+  ``SimCluster.failslow_lag`` exactly — the peer-relative floor cancels
+  tick cadence, so the observed lateness IS the injected lag — and the
+  Degraded/Ready hysteresis flips at the documented thresholds.
+- **Fail-slow storm** (x3 seeds): a Degraded node is masked from new
+  placements (zero wave-2 pods land on it) while every steady-state
+  binding survives untouched and zero disruption budget is spent —
+  Degraded is not a drain.
+- **Partition chaos**: the seeded partition scenario (pending gangs
+  spill, Scheduled gangs keep their placement across the heal,
+  split-brain invariant F3 checked every slice) converges clean.
+- **Rejoin/spillover race**: ``rejoin_cluster`` flips Ready LAST — a
+  spillover walk interleaved with the rebuild never sees (or targets)
+  the half-built region, and no spill decision ever routed into the
+  region while it was Lost.
+- **Boundary faults**: seeded drop/dup/delay on the worker-process
+  wire leaves the store dump bit-identical to the serial twin — the
+  frame dedup + retransmission protocol changes when bytes cross,
+  never what the round computes.
+- **WAL degradation ladder**: ok → degraded → ok (slow fsync) and
+  ok → read-only → ok (disk full) with loud events at every step,
+  creates fenced / deletes allowed while read-only, and nothing acked
+  lost across the whole walk.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import names as namegen
+from grove_tpu.api.load import load_podcliquesets
+from grove_tpu.controller.nodehealth import NODE_DEGRADED, NODE_READY
+from grove_tpu.durability import recover_store
+from grove_tpu.federation import FederationRouter
+from grove_tpu.observability.events import EVENTS
+from grove_tpu.observability.metrics import METRICS
+from grove_tpu.runtime.errors import GroveError
+from grove_tpu.sim.chaos import chaos_workload, run_partition_chaos
+from grove_tpu.sim.harness import SimHarness
+from grove_tpu.sim.parallel import _dump, _make_harness
+
+
+def _fresh_world():
+    METRICS.reset()
+    EVENTS.reset()
+
+
+def _wave(suffix: str):
+    out = []
+    for pcs in chaos_workload(n_each=1):
+        if suffix:
+            pcs.metadata.name = f"{pcs.metadata.name}{suffix}"
+        out.append(pcs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# suspicion EWMA: NumPy oracle + hysteresis
+# ---------------------------------------------------------------------------
+
+
+class TestSuspicionOracle:
+    def test_ewma_matches_numpy_replay_of_lag_trace(self):
+        """Drive heartbeat + monitor ticks by hand: the suspicion score
+        must equal s <- a*lag + (1-a)*s over the seeded failslow_lag
+        trace (peer-relative lateness == injected lag, because every
+        healthy peer's heartbeat age is exactly 0 at observation)."""
+        _fresh_world()
+        h = SimHarness(num_nodes=4)
+        mon = h.node_monitor
+        mon.failslow_threshold = 1.5
+        mon.failslow_recover = 0.75
+        sick = h.cluster.nodes[1].name
+        h.cluster.inject_failslow(sick, seed=77, lag_min=2.0, lag_max=4.5)
+
+        lags, scores = [], []
+        for _ in range(20):
+            h.cluster.heartbeat_tick()
+            lags.append(h.cluster.failslow_lag(sick, h.clock.now()))
+            mon.tick()
+            scores.append(mon._suspicion[sick])
+            h.clock.advance(1.0)
+        # heal: the lag trace drops to zero and the score decays
+        h.cluster.heal_failslow(sick)
+        for _ in range(20):
+            h.cluster.heartbeat_tick()
+            lags.append(0.0)
+            mon.tick()
+            scores.append(mon._suspicion[sick])
+            h.clock.advance(1.0)
+
+        alpha = mon.failslow_alpha
+        oracle, s = [], 0.0
+        for lag in lags:
+            s = alpha * lag + (1.0 - alpha) * s
+            if s < 1e-3:
+                s = 0.0  # the monitor's quiescence clamp
+            oracle.append(s)
+        np.testing.assert_allclose(
+            np.asarray(scores), np.asarray(oracle), rtol=0.0, atol=1e-9
+        )
+        # healthy peers never accumulate suspicion at all
+        for node in h.cluster.nodes:
+            if node.name != sick:
+                assert mon._suspicion.get(node.name, 0.0) == 0.0, node.name
+
+    def test_hysteresis_flips_degraded_then_ready(self):
+        _fresh_world()
+        h = SimHarness(num_nodes=4)
+        mon = h.node_monitor
+        mon.failslow_threshold = 1.5
+        mon.failslow_recover = 0.75
+        sick = h.cluster.nodes[2].name
+        node = h.cluster.node(sick)
+        h.cluster.inject_failslow(sick, seed=3, lag_min=2.0, lag_max=4.5)
+        for _ in range(10):
+            h.cluster.heartbeat_tick()
+            mon.tick()
+            h.clock.advance(1.0)
+        assert node.state == NODE_DEGRADED
+        assert not node.schedulable  # masked from every solve path
+        assert EVENTS.list(reason="NodeDegraded")
+        assert METRICS.counters.get("node_degraded_total", 0) >= 1
+
+        h.cluster.heal_failslow(sick)
+        for _ in range(30):
+            h.cluster.heartbeat_tick()
+            mon.tick()
+            h.clock.advance(1.0)
+            if node.state == NODE_READY:
+                break
+        assert node.state == NODE_READY
+        assert node.schedulable
+        assert EVENTS.list(reason="NodeRecovered")
+        assert METRICS.counters.get("node_recovered_total", 0) >= 1
+
+    def test_detection_off_by_default_folds_nothing(self):
+        _fresh_world()
+        h = SimHarness(num_nodes=4)
+        sick = h.cluster.nodes[0].name
+        h.cluster.inject_failslow(sick, seed=5, lag_min=2.0, lag_max=4.5)
+        for _ in range(8):
+            h.cluster.heartbeat_tick()
+            h.node_monitor.tick()
+            h.clock.advance(1.0)
+        assert h.node_monitor._suspicion == {}
+        assert h.cluster.node(sick).state == NODE_READY
+
+
+# ---------------------------------------------------------------------------
+# fail-slow storm: mask without eviction, x3 seeds
+# ---------------------------------------------------------------------------
+
+
+class TestFailslowStorm:
+    @pytest.mark.parametrize("seed", [11, 23, 2026])
+    def test_degraded_masks_new_placements_keeps_running_gangs(self, seed):
+        _fresh_world()
+        h = SimHarness(num_nodes=6)
+        h.node_monitor.failslow_threshold = 1.5
+        h.node_monitor.failslow_recover = 0.75
+        for pcs in _wave(""):
+            h.apply(pcs)
+        h.converge(max_ticks=60)
+        bound_before = dict(h.cluster.bindings)
+        assert bound_before, "wave 1 placed nothing"
+
+        # sicken the busiest bound node: the stay-bound assertion then
+        # watches real victims, not an empty set
+        per_node = {}
+        for node in bound_before.values():
+            per_node[node] = per_node.get(node, 0) + 1
+        sick = sorted(per_node, key=lambda n: (-per_node[n], n))[0]
+        h.cluster.inject_failslow(
+            sick, seed=seed, lag_min=2.0, lag_max=4.5, start_penalty=60.0
+        )
+        h.converge(max_ticks=6, tick_seconds=1.0)
+        assert h.cluster.node(sick).state == NODE_DEGRADED, seed
+
+        wave2 = {pcs.metadata.name for pcs in _wave("-w2")}
+        for pcs in _wave("-w2"):
+            h.apply(pcs)
+        t0 = h.clock.now()
+        while h.clock.now() - t0 < 20.0:
+            h.tick_once()
+            h.clock.advance(1.0)
+
+        w2_on_sick = sum(
+            1
+            for p in h.store.list("Pod")
+            if p.metadata.labels.get(namegen.LABEL_PART_OF) in wave2
+            and h.cluster.bindings.get(
+                (p.metadata.namespace, p.metadata.name)
+            )
+            == sick
+        )
+        assert w2_on_sick == 0, (
+            f"seed {seed}: {w2_on_sick} wave-2 pod(s) landed on the"
+            " Degraded node — the schedulable mask leaked"
+        )
+        moved = {
+            key: (node, h.cluster.bindings.get(key))
+            for key, node in bound_before.items()
+            if h.cluster.bindings.get(key) != node
+        }
+        assert not moved, (
+            f"seed {seed}: Degraded moved steady-state bindings {moved}"
+            " (masking must not evict)"
+        )
+        # masking is free: no voluntary disruption was spent
+        assert not METRICS.counters.get("gang_drains_total", 0), seed
+
+        h.cluster.heal_failslow(sick)
+        for _ in range(40):
+            h.tick_once()
+            h.clock.advance(1.0)
+            if h.cluster.node(sick).state == NODE_READY:
+                break
+        assert h.cluster.node(sick).state == NODE_READY, seed
+
+
+# ---------------------------------------------------------------------------
+# partition chaos + the rejoin/spillover race
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionChaos:
+    def test_partition_scenario_holds_f3_and_converges(self):
+        _fresh_world()
+        report = run_partition_chaos(seed=1234)
+        assert report.invariant_violations == []
+        assert report.ok, report
+        assert report.partition_spills >= 1
+        assert report.placements_kept == report.placements_in_partition
+        assert EVENTS.list(reason="ClusterPartitioned")
+        assert EVENTS.list(reason="ClusterHealed")
+
+
+# one gang = 2 pods x cpu:6 — one pod per 8-cpu node, so a 4-node
+# region holds two gangs and further gangs MUST pend (then spill)
+_TIGHT_YAML = """
+apiVersion: grove.io/v1alpha1
+kind: PodCliqueSet
+metadata:
+  name: job
+spec:
+  replicas: 1
+  template:
+    cliques:
+      - name: worker
+        spec:
+          roleName: worker
+          replicas: 2
+          minAvailable: 2
+          podSpec:
+            containers:
+              - name: w
+                image: busybox:stable
+                resources:
+                  requests:
+                    cpu: 6
+"""
+
+
+def _tight_pcs(name: str, home: str):
+    pcs = load_podcliquesets(_TIGHT_YAML)[0]
+    pcs.metadata.name = name
+    pcs.metadata.labels[namegen.LABEL_FEDERATION_HOME] = home
+    return pcs
+
+
+class TestRejoinSpilloverRace:
+    def test_rejoin_flips_ready_last(self, monkeypatch):
+        """A spillover walk interleaved with rejoin_cluster's rebuild
+        must neither see nor target the half-built region: Ready flips
+        LAST. The interleaving is forced by running a real _spill_tick
+        from inside the harness factory — the widest window the race
+        has — with pending gangs hungry for exactly that capacity."""
+        _fresh_world()
+        router = FederationRouter(["us", "eu"], num_nodes=4, spill_after=2.0)
+        router.crash_cluster("eu")
+        for name in ("a", "b", "c", "d"):
+            router.apply(_tight_pcs(name, "us"))
+        router.converge(max_ticks=40)
+        # us holds 2 gangs, 2 pend; with eu Lost there is nowhere to go
+        assert router.spillovers == 0
+        decisions_before = len(router.decisions())
+
+        seen = {}
+        orig = FederationRouter._build_harness
+
+        def racing(self, region):
+            harness = orig(self, region)
+            if region == "eu" and "ready_during" not in seen:
+                cl = self.cluster("eu")
+                seen["state_during"] = cl.state
+                seen["ready_during"] = sorted(
+                    c.region for c in self._ready()
+                )
+                seen["spills_during"] = self._spill_tick(self._ready())
+            return harness
+
+        monkeypatch.setattr(FederationRouter, "_build_harness", racing)
+        router.rejoin_cluster("eu")
+        assert seen["state_during"] == "Lost"  # Ready not yet flipped
+        assert seen["ready_during"] == ["us"]
+        assert seen["spills_during"] == 0  # nothing routed into eu
+
+        # while eu was Lost, no decision of any kind targeted it
+        dark = router.decisions()[decisions_before:]
+        for d in dark:
+            assert d.get("to") != "eu", d
+
+        # after the flip, the pending gangs spill onto eu normally
+        router.converge(max_ticks=80)
+        spilled_to_eu = [
+            d
+            for d in router.decisions()
+            if d["kind"] == "spill" and d.get("to") == "eu"
+        ]
+        assert spilled_to_eu, "rejoined capacity never absorbed the backlog"
+        for cl in router.clusters():
+            if cl.harness is not None:
+                cl.harness.engine.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-boundary fault injection: serial-twin bit-identity
+# ---------------------------------------------------------------------------
+
+
+class TestBoundaryFaults:
+    def test_faulty_wire_is_bit_identical_to_serial_twin(self):
+        def run(workers: int, faulty: bool):
+            _fresh_world()
+            h = _make_harness(12, 3, workers, backend="process")
+            if faulty:
+                h.engine.workers.inject_boundary_faults(
+                    7, drop_rate=0.08, dup_rate=0.08, delay_rate=0.08
+                )
+            for pcs in _wave(""):
+                h.apply(pcs)
+            h.converge(max_ticks=60)
+            counts = (
+                dict(h.engine.workers.boundary_fault_counts)
+                if faulty
+                else {}
+            )
+            dump = _dump(h)
+            h.engine.close()
+            return dump, counts
+
+        clean, _ = run(1, faulty=False)
+        faulty, counts = run(2, faulty=True)
+        injected = (
+            counts.get("drop", 0)
+            + counts.get("dup", 0)
+            + counts.get("delay", 0)
+        )
+        assert injected >= 1, f"no fault ever fired: {counts}"
+        assert counts.get("retransmits", 0) >= 1, counts
+        assert faulty == clean, (
+            "store dump diverged from the serial twin under boundary"
+            f" faults {counts}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# WAL degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class TestWalLadder:
+    def test_ladder_walks_both_rungs_loudly(self, tmp_path):
+        _fresh_world()
+        h = SimHarness(num_nodes=4, durability_dir=str(tmp_path))
+        sd = h.durability
+        waves = _wave("")
+        h.apply(waves[0])
+        h.converge(max_ticks=40)
+        assert sd.degraded_mode == "ok"
+
+        # slow fsync: degraded — loud, still durable
+        sd.wal.fault_slow_fsync = sd.fsync_slo_seconds + 0.5
+        h.apply(waves[1])
+        h.converge(max_ticks=20)
+        assert sd.degraded_mode == "degraded"
+        assert EVENTS.list(reason="WalDegraded")
+        assert METRICS.gauges.get("wal_degraded_mode") == 1.0
+        sd.wal.fault_slow_fsync = 0.0
+        h.apply(waves[2])
+        h.converge(max_ticks=20)
+        assert sd.degraded_mode == "ok"
+        assert EVENTS.list(reason="WalRecovered")
+        assert METRICS.gauges.get("wal_degraded_mode") == 0.0
+
+        # disk full: the flush fails BEFORE anything is acked and the
+        # store goes read-only — creates fenced, deletes allowed
+        sd.wal.fault_disk_full = True
+        survivor = _wave("-ro")[0]
+        h.apply(survivor)  # buffered, not yet durable
+        sd.pump()
+        assert sd.degraded_mode == "read-only"
+        with pytest.raises(GroveError):
+            h.apply(_wave("-rejected")[0])
+        assert METRICS.counters.get(
+            "wal_read_only_writes_rejected_total", 0
+        ) >= 1
+        h.delete(waves[0].metadata.name)  # frees space: allowed
+
+        sd.wal.fault_disk_full = False
+        sd.pump()
+        assert sd.degraded_mode == "ok"
+        after = _wave("-after")[0]
+        h.apply(after)  # the fence is down again
+        h.converge(max_ticks=40)
+        sd.close()
+
+        # nothing acked was lost across the whole walk
+        store, _recovery = recover_store(str(tmp_path))
+        for name in (survivor.metadata.name, after.metadata.name):
+            assert store.get("PodCliqueSet", "default", name) is not None, (
+                f"{name} lost across the read-only window"
+            )

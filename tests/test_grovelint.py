@@ -2,7 +2,7 @@
 
 Three layers (docs/static-analysis.md):
 
-1. **Fixture teeth** — for every enforced rule (GL001..GL021), a
+1. **Fixture teeth** — for every enforced rule (GL001..GL022), a
    known-bad snippet
    must fire and its known-good twin must pass. This is what pins
    "deleting any single enforced invariant makes `make lint` fail".
@@ -357,6 +357,23 @@ FIXTURES = {
             "    self.router.apply(pcs, home=region)\n"
             "    where = self.router.placements()\n"
             "    return self.router.status(), where\n"
+        ),
+    },
+    "GL022": {
+        "rel": "grove_tpu/autoscale/fixture.py",
+        "bad": (
+            "def quiet(self, monitor, cluster, sd, drain):\n"
+            "    monitor._suspicion['node-3'] = 0.0\n"
+            "    cluster._failslow.pop('node-3')\n"
+            "    sd.degraded_mode = 'ok'\n"
+            "    drain._faults = None\n"
+        ),
+        "good": (
+            "def quiet(self, monitor, cluster, sd):\n"
+            "    cluster.inject_failslow('node-3', seed=7)\n"
+            "    spec = cluster.failslow_spec('node-3')\n"
+            "    cluster.heal_failslow('node-3')\n"
+            "    return sd.degraded_mode, spec\n"
         ),
     },
     "GL010": {
@@ -841,6 +858,62 @@ def test_grafting_federation_state_write_fails_lint():
         assert "GL021" not in rules_of(
             lint_source(ok_src, "grove_tpu/autoscale/fixture.py")
         ), ok_src
+
+
+def test_grafting_grayfail_state_write_fails_lint():
+    """GL022 live-tree teeth: a rogue helper quieting a gray-failure
+    detector from a non-owner source must fail lint — zeroing the
+    suspicion EWMA, stepping the WAL ladder, or swapping the boundary
+    fault plan mid-run skips the registered events and desyncs the
+    detector from what it measures. Each detector's owner package
+    mutates its own memory freely."""
+    rel = "grove_tpu/sim/chaos.py"
+    src = (ROOT / rel).read_text()
+    assert "GL022" not in rules_of(lint_source(src, rel))
+    rogue = (
+        "\n\ndef _rogue_quiet(monitor, sd, drain):\n"
+        "    monitor._suspicion.clear()\n"
+        "    sd.degraded_mode = 'ok'\n"
+        "    drain._faults = None\n"
+        "    drain._rx_seq['w0'] = 0\n"
+    )
+    report = lint_source(src + rogue, rel)
+    assert len([v for v in report.violations if v.rule == "GL022"]) == 4
+    # each detector's owner may mutate its own memory
+    for own_rel in (
+        "grove_tpu/controller/nodehealth.py",
+        "grove_tpu/sim/cluster.py",
+        "grove_tpu/durability/recovery.py",
+        "grove_tpu/runtime/procworkers.py",
+    ):
+        own = (ROOT / own_rel).read_text()
+        assert "GL022" not in rules_of(lint_source(own, own_rel)), own_rel
+    # ownership is per-field: sim/ owns the fail-slow registry (chaos
+    # harness swaps still go through failslow_names()/failslow_spec(),
+    # but a sim-side write is in-owner)...
+    assert "GL022" not in rules_of(
+        lint_source(
+            "def f(self, n):\n"
+            "    self._failslow[n] = (1, 2.0, 4.5, 10.0)\n",
+            "grove_tpu/sim/cluster.py",
+        )
+    )
+    # ...while the same write from the suspicion owner's package fires
+    assert "GL022" in rules_of(
+        lint_source(
+            "def f(self, cluster, n):\n"
+            "    cluster._failslow[n] = (1, 2.0, 4.5, 10.0)\n",
+            "grove_tpu/controller/nodehealth.py",
+        )
+    )
+    # reading the ladder position (or the suspicion) is always legal
+    assert "GL022" not in rules_of(
+        lint_source(
+            "def f(self, sd, monitor, n):\n"
+            "    return sd.degraded_mode, monitor._suspicion.get(n)\n",
+            "grove_tpu/autoscale/fixture.py",
+        )
+    )
 
 
 def test_gl001_strict_scope_bans_perf_counter_in_traffic():

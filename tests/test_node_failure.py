@@ -355,7 +355,9 @@ class TestNodesEndpoint:
             assert len(doc["items"]) == 4
             for item in doc["items"]:
                 assert isinstance(item["name"], str)
-                assert item["state"] in ("Ready", "NotReady", "Lost")
+                assert item["state"] in (
+                    "Ready", "NotReady", "Lost", "Degraded",
+                )
                 assert isinstance(item["cordoned"], bool)
                 assert isinstance(item["schedulable"], bool)
                 assert isinstance(item["heartbeatAgeSeconds"], (int, float))

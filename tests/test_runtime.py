@@ -260,6 +260,68 @@ class TestWorkQueue:
         assert all(d <= 60.0 for d in delays)
 
 
+class TestBackoffPolicy:
+    """runtime/backoff.py — the one deterministic-jitter policy every
+    retry loop (workqueue rate limiter, node-health requeue, procworkers
+    recv pacing) now shares. The A/B pins prove byte-identical behavior
+    at the old defaults."""
+
+    def test_policy_matches_legacy_inline_formula_exactly(self):
+        """Byte-identical A/B against the formula that used to live inline
+        in WorkQueue.add_rate_limited — same crc32 token, same float ops,
+        same order of operations, == (not approx)."""
+        import zlib
+
+        from grove_tpu.runtime.backoff import (
+            BASE_BACKOFF,
+            JITTER_FRAC,
+            MAX_BACKOFF,
+            BackoffPolicy,
+        )
+
+        policy = BackoffPolicy()
+        for key in [("PodClique", "default", "a"), ("PodGang", "ns2", "g")]:
+            for failures in range(0, 30):
+                u = (
+                    zlib.crc32(f"{key}:{failures}".encode()) & 0xFFFF
+                ) / float(1 << 16)
+                legacy = min(
+                    BASE_BACKOFF * (2**failures) * (1.0 + JITTER_FRAC * u),
+                    MAX_BACKOFF,
+                )
+                assert policy.delay(key, failures) == legacy
+
+    def test_workqueue_delegates_byte_identically(self):
+        """WorkQueue.add_rate_limited delays == policy.delay at every
+        failure count, for both the default and a per-instance curve."""
+        from grove_tpu.runtime.backoff import BackoffPolicy
+
+        for base, cap in [(None, None), (1.0, 60.0)]:
+            q = (
+                WorkQueue()
+                if base is None
+                else WorkQueue(base_backoff=base, max_backoff=cap)
+            )
+            policy = (
+                BackoffPolicy() if base is None else BackoffPolicy(base, cap)
+            )
+            key = ("PodGang", "default", "g")
+            for f in range(12):
+                q.add_rate_limited(key, now=0.0)
+                got = max(d.ready_at for d in q._delayed)
+                assert got == policy.delay(key, f)
+
+    def test_constants_reexported_from_workqueue(self):
+        """Historical import site stays valid: the constants consumers
+        (and these tests) import from workqueue ARE backoff's."""
+        from grove_tpu.runtime import backoff, workqueue
+
+        assert workqueue.BASE_BACKOFF is backoff.BASE_BACKOFF
+        assert workqueue.MAX_BACKOFF is backoff.MAX_BACKOFF
+        assert workqueue.JITTER_FRAC is backoff.JITTER_FRAC
+        assert workqueue.BackoffPolicy is backoff.BackoffPolicy
+
+
 class TestWorkQueueShardFairness:
     """Per-shard fairness (docs/control-plane.md): ready keys bucket by
     the namespace's keyspace shard and pop round-robin, so one shard's
